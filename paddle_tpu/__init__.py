@@ -1,0 +1,137 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of the reference (ToNextOne2018/Paddle, a PaddlePaddle fork; see
+SURVEY.md). Eager tensors + autograd over XLA, one-compiled-program training
+via `paddle_tpu.jit`, GSPMD mesh parallelism via `paddle_tpu.distributed`,
+Pallas kernels under `paddle_tpu.ops`.
+
+The public namespace mirrors the reference's `paddle.*` top level
+(«python/paddle/__init__.py» [U]) so reference users can map 1:1.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (bool_ as bool8,  # noqa: F401
+                         uint8, int8, int16, int32, int64, float16, bfloat16,
+                         float32, float64, complex64, complex128,
+                         set_default_dtype, get_default_dtype, finfo, iinfo)
+from .core.dtype import bool_  # noqa: F401
+from .core.tape import (no_grad, enable_grad, is_grad_enabled,  # noqa: F401
+                        set_grad_enabled)
+from .core.tensor import Tensor, to_tensor  # noqa: F401
+from .framework import Parameter  # noqa: F401
+
+# op surface (paddle.* top-level functions)
+from .tensor import *  # noqa: F401,F403
+from .tensor import (abs, all, any, max, min, pow, round, sum,  # noqa: F401
+                     slice)
+from .tensor.random import seed, get_rng_state, set_rng_state  # noqa: F401
+
+from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
+from . import device  # noqa: F401
+from . import framework  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import linalg  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import profiler  # noqa: F401
+from . import utils  # noqa: F401
+from . import vision  # noqa: F401
+
+from .device import (get_device, set_device, is_compiled_with_cuda,  # noqa: F401
+                     is_compiled_with_rocm, is_compiled_with_xpu,
+                     device_count)
+from .framework.io import save, load  # noqa: F401
+from .jit import to_static  # noqa: F401
+from .autograd import grad  # noqa: F401
+from .tensor.manipulation import concat, stack  # noqa: F401
+
+# paddle keeps `paddle.cast` as a top-level fn
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def in_dynamic_mode() -> bool:
+    """The framework is always 'dynamic' from the user's view; compilation
+    happens per-function under paddle_tpu.jit (no global static mode)."""
+    return True
+
+
+def in_dynamic_or_pir_mode() -> bool:
+    return True
+
+
+def enable_static():
+    raise NotImplementedError(
+        "Global static-graph mode is intentionally not supported: the "
+        "TPU-native compile path is per-function `paddle_tpu.jit.to_static` "
+        "(whole-train-step XLA compilation). See SURVEY.md §7 stage 3.")
+
+
+def disable_static():
+    pass
+
+
+def disable_signal_handler():
+    pass
+
+
+def get_flags(flags):
+    from .utils import flags as _f
+    return _f.get_flags(flags)
+
+
+def set_flags(flags):
+    from .utils import flags as _f
+    return _f.set_flags(flags)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     linewidth=None, sci_mode=None):
+    import numpy as np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+# distributed is imported lazily (it pulls in mesh machinery); exposed as
+# attribute for `paddle_tpu.distributed.*`
+def __getattr__(name):
+    if name == "distributed":
+        import importlib
+        mod = importlib.import_module(".distributed", __name__)
+        globals()["distributed"] = mod
+        return mod
+    if name == "incubate":
+        import importlib
+        mod = importlib.import_module(".incubate", __name__)
+        globals()["incubate"] = mod
+        return mod
+    if name == "Model":
+        from .hapi import Model
+        globals()["Model"] = Model
+        return Model
+    if name == "hapi":
+        import importlib
+        mod = importlib.import_module(".hapi", __name__)
+        globals()["hapi"] = mod
+        return mod
+    if name == "sparse":
+        import importlib
+        mod = importlib.import_module(".sparse", __name__)
+        globals()["sparse"] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

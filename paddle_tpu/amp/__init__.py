@@ -1,0 +1,162 @@
+"""AMP: auto_cast + GradScaler. ≙ reference «python/paddle/amp/» [U].
+
+On TPU the recommended mode is bf16 (no loss scaling needed — same exponent
+range as fp32); fp16 + dynamic loss scaling is implemented for parity."""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import amp_state as _amp
+from ..core.tensor import Tensor
+
+
+@contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """≙ paddle.amp.auto_cast."""
+    s = _amp.amp_state
+    prev = (s.enabled, s.dtype, s.level, s.custom_white_list,
+            s.custom_black_list)
+    s.enabled = enable
+    s.dtype = dtype
+    s.level = level
+    s.custom_white_list = set(custom_white_list or ())
+    s.custom_black_list = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (s.enabled, s.dtype, s.level, s.custom_white_list,
+         s.custom_black_list) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """≙ paddle.amp.decorate: O2 casts model params to the low dtype and
+    enables optimizer master weights."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+        if optimizers is not None:
+            opts = [optimizers] if not isinstance(
+                optimizers, (list, tuple)) else optimizers
+            for opt in opts:
+                opt._multi_precision = True if master_weight is None \
+                    else bool(master_weight)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+def is_auto_cast_enabled() -> bool:
+    return _amp.amp_state.enabled
+
+
+def get_amp_dtype() -> str:
+    return _amp.amp_state.dtype
+
+
+class GradScaler:
+    """Dynamic loss scaling. ≙ paddle.amp.GradScaler [U]. With bf16 the
+    scale stays 1.0 and this is a pass-through."""
+
+    def __init__(self, enable=True, init_loss_scaling=65536.0,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list:
+            if p.grad is not None:
+                g = p.grad._value.astype(jnp.float32) * inv
+                finite = bool(jnp.all(jnp.isfinite(g)))
+                found = found or not finite
+                p.grad._value = g.astype(p.grad._value.dtype)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def set_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+def debugging_check_numerics(x, name=""):
+    """≙ paddle.amp.debugging / FLAGS_check_nan_inf per-op blame."""
+    v = x._value if isinstance(x, Tensor) else x
+    if not bool(jnp.all(jnp.isfinite(v))):
+        raise FloatingPointError(f"NaN/Inf detected in {name or 'tensor'}")
+    return x

@@ -1,0 +1,103 @@
+"""Autograd public API. ≙ reference «python/paddle/autograd/» [U]."""
+from __future__ import annotations
+
+from ..core.tape import (no_grad, enable_grad, is_grad_enabled,  # noqa: F401
+                         set_grad_enabled, grad)
+from ..core.tensor import Tensor, apply
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """≙ paddle.autograd.backward."""
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        t.backward(g, retain_graph=retain_graph)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def set_materialize_grads(self, value):
+        self.materialize_grads = value
+
+    def mark_not_inplace(self, *a):
+        pass
+
+    def mark_non_differentiable(self, *a):
+        pass
+
+
+class PyLayer:
+    """Custom autograd op. ≙ reference `paddle.autograd.PyLayer` [U].
+
+    Subclass with static `forward(ctx, *args)` and `backward(ctx, *grads)`.
+    The forward runs outside the tape; a custom grad node stitches the
+    user-defined backward into the tape traversal."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import tape
+        from ..core.tape import Node
+
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (list, tuple))
+        out_list = list(outs) if multi else [outs]
+        out_list = [o if isinstance(o, Tensor) else Tensor(o)
+                    for o in out_list]
+
+        needs = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_args)
+        if needs:
+            def vjp_fn(cots):
+                cots = cots if isinstance(cots, tuple) else (cots,)
+                gin = cls.backward(ctx, *[Tensor(c) for c in cots])
+                gin = gin if isinstance(gin, (list, tuple)) else (gin,)
+                vals = []
+                gi = iter(gin)
+                for a in args:
+                    if isinstance(a, Tensor):
+                        g = next(gi, None)
+                        vals.append(None if g is None else
+                                    (g._value if isinstance(g, Tensor) else g))
+                return tuple(vals)
+
+            from ..core.tape import Ref
+            node = Node(
+                name=f"PyLayer<{cls.__name__}>",
+                vjp_fn=lambda cots: vjp_fn(cots),
+                inputs=[Ref(t) for t in tensor_args],
+                n_outputs=len(out_list),
+                out_shapes=[tuple(o.shape) for o in out_list],
+                out_dtypes=[o._value.dtype for o in out_list],
+            )
+            for i, o in enumerate(out_list):
+                o._node, o._out_index = node, i
+                o.stop_gradient = False
+        if multi:
+            return type(outs)(out_list)
+        return out_list[0]

@@ -1,0 +1,9 @@
+from . import dtype
+from .dtype import (bool_, uint8, int8, int16, int32, int64, float16,
+                    bfloat16, float32, float64, complex64, complex128,
+                    float8_e4m3fn, float8_e5m2, set_default_dtype,
+                    get_default_dtype, convert_dtype, promote_types,
+                    finfo, iinfo)
+from .tape import (no_grad, enable_grad, is_grad_enabled, set_grad_enabled,
+                   grad, backward)
+from .tensor import Tensor, Parameter, to_tensor, apply

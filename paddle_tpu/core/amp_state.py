@@ -1,0 +1,54 @@
+"""AMP autocast state consulted by the op dispatch point (core.tensor.apply).
+≙ reference eager AMP auto-cast insertion in generated dygraph functions
+(SURVEY.md §3.1, «paddle/fluid/eager/» amp_utils [U])."""
+from __future__ import annotations
+
+import threading
+
+
+class AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = "bfloat16"
+        self.level = "O1"
+        self.custom_white_list = set()
+        self.custom_black_list = set()
+
+
+amp_state = AmpState()
+
+# Ops that benefit from low precision (MXU ops) — cast inputs down in O1.
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "linear", "einsum", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+    "flash_attention", "sdpa", "addmm", "mv", "inner", "outer",
+}
+
+# Numerically sensitive ops — keep/cast to fp32 in O1.
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "softmax_with_xent",
+    "cross_entropy", "nll_loss", "bce_with_logits", "binary_cross_entropy",
+    "softmax", "log_softmax", "mean", "sum", "var", "std", "norm",
+    "cumsum", "prod", "pow", "rsqrt", "sqrt", "square",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
+    "sigmoid_focal_loss", "kl_div", "mse_loss", "l1_loss",
+}
+
+
+def resolve(op_name: str) -> str | None:
+    """Return 'low'/'high'/None for the given op under current amp state."""
+    s = amp_state
+    if not s.enabled:
+        return None
+    if s.level == "O2":
+        # pure low precision: everything low except black list
+        if op_name in BLACK_LIST and op_name not in s.custom_white_list:
+            return "high"
+        return "low"
+    if op_name in s.custom_black_list:
+        return "high"
+    if op_name in s.custom_white_list or op_name in WHITE_LIST:
+        return "low"
+    if op_name in BLACK_LIST:
+        return "high"
+    return None

@@ -1,0 +1,94 @@
+"""Dtype system: canonical dtypes and type-promotion rules.
+
+Capability parity with the reference's dtype surface (SURVEY.md §2.1 «paddle/phi/core/»
+`DataType`, and §2.2 python dtype handling [U]); implemented over numpy/jax dtypes
+rather than a hand-rolled enum so everything stays XLA-native.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+# Canonical dtype objects are numpy dtypes (jax convention).
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = np.dtype(ml_dtypes.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+float8_e4m3fn = np.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = np.dtype(ml_dtypes.float8_e5m2)
+
+_ALIASES = {
+    "bool": bool_, "uint8": uint8, "int8": int8, "int16": int16,
+    "int32": int32, "int64": int64, "float16": float16, "bfloat16": bfloat16,
+    "float32": float32, "float64": float64, "complex64": complex64,
+    "complex128": complex128, "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # paddle-style shorthand
+    "fp16": float16, "bf16": bfloat16, "fp32": float32, "fp64": float64,
+}
+
+FLOATING = (float8_e4m3fn, float8_e5m2, float16, bfloat16, float32, float64)
+INTEGER = (uint8, int8, int16, int32, int64)
+COMPLEX = (complex64, complex128)
+
+# Default dtype for python floats / float tensor creation (paddle default: fp32).
+_default_dtype = float32
+
+
+def set_default_dtype(d) -> None:
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(d) -> np.dtype:
+    """Normalize any dtype-like (str, np/jnp dtype, Tensor dtype) to np.dtype."""
+    if d is None:
+        return _default_dtype
+    if isinstance(d, str):
+        if d in _ALIASES:
+            return _ALIASES[d]
+        return np.dtype(d)
+    if isinstance(d, np.dtype):
+        return d
+    return np.dtype(d)
+
+
+def is_floating(d) -> bool:
+    return convert_dtype(d) in FLOATING
+
+
+def is_integer(d) -> bool:
+    d = convert_dtype(d)
+    return d in INTEGER or d == bool_
+
+
+def is_complex(d) -> bool:
+    return convert_dtype(d) in COMPLEX
+
+
+def promote_types(a, b) -> np.dtype:
+    """Binary type promotion. Follows jax's (numpy-like) lattice, which matches
+    the reference's promotion for the common cases (int+float -> float, mixed
+    float widths -> wider)."""
+    return np.dtype(jnp.promote_types(convert_dtype(a), convert_dtype(b)))
+
+
+def finfo(d):
+    return ml_dtypes.finfo(convert_dtype(d))
+
+
+def iinfo(d):
+    return np.iinfo(convert_dtype(d))

@@ -1,0 +1,253 @@
+"""Eager autograd engine: a dynamic tape over XLA-executed ops.
+
+Capability parity with the reference's dygraph autograd (SURVEY.md §2.1
+«paddle/fluid/eager/»: `GradNodeBase`, `AutogradMeta`, `Backward()`,
+`GradTensorHolder` [U]) — re-designed for TPU/XLA:
+
+* The reference code-generates a C++ grad node per op. Here every op is a pure
+  JAX function, so `jax.vjp` provides the exact gradient for *any* op with no
+  per-op grad code. Each executed op records one `Node` holding the vjp
+  closure (residuals live in device memory, like the reference's
+  GradTensorHolder saved tensors).
+* `backward()` is a reverse-topological sweep accumulating cotangents —
+  the analogue of the reference's ready-queue traversal.
+* Because every recorded operation is a traceable JAX computation, the same
+  eager code path can run under `jax.jit` (the tape is built at trace time and
+  collapses into one XLA program) — this is what replaces the reference's
+  SOT/to_static bytecode capture for the common case.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _state.enabled = bool(mode)
+
+
+@contextmanager
+def no_grad():
+    prev = _state.enabled
+    _state.enabled = False
+    try:
+        yield
+    finally:
+        _state.enabled = prev
+
+
+@contextmanager
+def enable_grad():
+    prev = _state.enabled
+    _state.enabled = True
+    try:
+        yield
+    finally:
+        _state.enabled = prev
+
+
+class Ref:
+    """Snapshot of an input tensor's autograd wiring at record time.
+
+    Nodes must NOT read `tensor._node` at backward time: in-place ops
+    (`x += 1`, optimizer updates) rebind the tensor to a new node, which
+    would corrupt routing for already-recorded consumers (and create
+    self-cycles for `x op= y`). ≙ the reference's versioned AutogradMeta
+    edge snapshots [U]."""
+
+    __slots__ = ("tensor", "node", "out_index", "stop_gradient")
+
+    def __init__(self, tensor):
+        self.tensor = tensor          # identity for leaf .grad accumulation
+        self.node = tensor._node
+        self.out_index = tensor._out_index
+        self.stop_gradient = tensor.stop_gradient
+
+
+class Node:
+    """One executed differentiable op on the tape."""
+
+    __slots__ = ("name", "vjp_fn", "inputs", "n_outputs", "out_shapes",
+                 "out_dtypes", "__weakref__")
+
+    def __init__(self, name, vjp_fn, inputs, n_outputs, out_shapes, out_dtypes):
+        self.name = name
+        self.vjp_fn = vjp_fn          # maps output cotangents -> input cotangents
+        self.inputs = inputs          # list of (Ref | None); None = non-diff arg
+        self.n_outputs = n_outputs
+        self.out_shapes = out_shapes
+        self.out_dtypes = out_dtypes
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_out={self.n_outputs}>"
+
+
+def record(name: str,
+           fn: Callable,
+           tensor_args: Sequence[Any],
+           out_vals,
+           vjp_fn,
+           multi_output: bool):
+    """Attach a Node to the outputs of an executed op. Returns nothing; the
+    caller wires `_node`/`_out_index` onto the produced Tensors."""
+    outs = out_vals if multi_output else (out_vals,)
+    node = Node(
+        name=name,
+        vjp_fn=vjp_fn,
+        inputs=[None if t is None else Ref(t) for t in tensor_args],
+        n_outputs=len(outs),
+        out_shapes=[getattr(o, "shape", ()) for o in outs],
+        out_dtypes=[getattr(o, "dtype", None) for o in outs],
+    )
+    return node
+
+
+def _topo_order(root_node) -> list:
+    """Iterative post-order DFS over the node graph (inputs after consumers
+    when reversed). Returns nodes in reverse-topological (consumer-first)
+    order."""
+    order, visited = [], set()
+    stack = [(root_node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for r in node.inputs:
+            if r is not None and r.node is not None and \
+                    id(r.node) not in visited:
+                stack.append((r.node, False))
+    order.reverse()  # consumer-first
+    return order
+
+
+def backward(root, grad=None, retain_graph: bool = False) -> None:
+    """Reverse sweep from `root`, accumulating into leaf `.grad`.
+
+    ≙ reference `egr::Backward()` («paddle/fluid/eager/backward.cc» [U])."""
+    from .tensor import Tensor  # cycle-free at call time
+
+    if root.stop_gradient:
+        raise RuntimeError(
+            "Tensor has stop_gradient=True; cannot call backward() on it.")
+    if grad is None:
+        if root.size != 1:
+            raise RuntimeError(
+                "grad must be provided for non-scalar backward() "
+                f"(root shape {root.shape}).")
+        seed = jnp.ones(root.shape, root._value.dtype)
+    else:
+        seed = grad._value if isinstance(grad, Tensor) else jnp.asarray(grad)
+
+    if root._node is None:
+        # Leaf with requires-grad: d root / d root = seed.
+        _accumulate_leaf(root, seed)
+        return
+
+    # cotangent buffers per node output
+    cots: dict[int, list] = {id(root._node): [None] * root._node.n_outputs}
+    node_by_id = {id(root._node): root._node}
+    cots[id(root._node)][root._out_index] = seed
+
+    for node in _topo_order(root._node):
+        buf = cots.get(id(node))
+        if buf is None:
+            continue
+        filled = tuple(
+            b if b is not None else jnp.zeros(s, d)
+            for b, s, d in zip(buf, node.out_shapes, node.out_dtypes))
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"Grad node for '{node.name}' was already freed; pass "
+                "retain_graph=True to backward() to keep the graph.")
+        arg = filled if node.n_outputs > 1 else filled[0]
+        in_cots = node.vjp_fn(arg)
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals
+        for r, c in zip(node.inputs, in_cots):
+            if r is None or c is None or r.stop_gradient:
+                continue
+            for hook in (r.tensor._grad_hooks or ()):
+                new = hook(Tensor(c, stop_gradient=True))
+                if new is not None:
+                    c = new._value if isinstance(new, Tensor) else jnp.asarray(new)
+            if r.node is not None:
+                nid = id(r.node)
+                if nid not in cots:
+                    cots[nid] = [None] * r.node.n_outputs
+                    node_by_id[nid] = r.node
+                slot = cots[nid]
+                idx = r.out_index
+                slot[idx] = c if slot[idx] is None else slot[idx] + c
+            else:
+                _accumulate_leaf(r.tensor, c)
+
+
+def _accumulate_leaf(t, cot) -> None:
+    from .tensor import Tensor
+    if t.grad is None:
+        t.grad = Tensor(cot, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad._value + cot, stop_gradient=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """Functional gradient API: d(outputs)/d(inputs) without touching `.grad`.
+
+    ≙ reference `paddle.grad` («python/paddle/autograd/» [U]). First-order
+    only (create_graph is accepted for API parity; raises if True)."""
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is not supported by the eager "
+            "tape; use the functional jax path (paddle_tpu.incubate.autograd) "
+            "for higher-order derivatives.")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+
+    # Temporarily swap .grad, run backward, read accumulated values.
+    saved = [t.grad for t in inputs]
+    for t in inputs:
+        t.grad = None
+    try:
+        for o, g in zip(outputs, grad_outputs):
+            backward(o, grad=g, retain_graph=True if retain_graph is None
+                     else retain_graph)
+        result = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "One of the inputs is unused in the graph; pass "
+                        "allow_unused=True to get None for it.")
+                result.append(None)
+            else:
+                result.append(t.grad)
+        return result
+    finally:
+        for t, s in zip(inputs, saved):
+            t.grad = s

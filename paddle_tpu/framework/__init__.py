@@ -1,0 +1,40 @@
+"""Framework glue. ≙ reference «python/paddle/framework/» + «python/paddle/base/»
+(Program/dygraph-guard machinery collapses away: there is no global graph,
+only per-function XLA compilation) [U]."""
+from __future__ import annotations
+
+from ..core.tensor import Parameter, Tensor  # noqa: F401
+from ..core import dtype as dtype  # noqa: F401
+from . import io  # noqa: F401
+from .random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
+
+
+def in_dygraph_mode() -> bool:
+    return True
+
+
+class ParamAttr:
+    """≙ paddle.ParamAttr — declarative parameter config consumed by layers."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return False
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        return ParamAttr(initializer=attr)

@@ -1,0 +1,92 @@
+"""paddle.save / paddle.load. ≙ reference «python/paddle/framework/io.py» [U]:
+pickle container + per-tensor binary payload. Here tensors serialize as
+(dtype-tagged) numpy buffers — portable, mmap-friendly, and convertible to/from
+the sharded orbax checkpoints in paddle_tpu.distributed.checkpoint."""
+from __future__ import annotations
+
+import io as _pyio
+import os
+import pickle
+
+import numpy as np
+
+from ..core.tensor import Tensor, Parameter
+
+
+_MAGIC = b"PTPU0001"
+
+
+class _TensorPayload:
+    """Pickle surrogate for a Tensor: numpy buffer + flags."""
+
+    def __init__(self, t: Tensor):
+        arr = np.asarray(t._value)
+        # bfloat16 etc. round-trip via raw bytes + dtype name
+        self.dtype = arr.dtype.name if arr.dtype.names is None else str(arr.dtype)
+        self.shape = arr.shape
+        self.data = arr.tobytes()
+        self.stop_gradient = t.stop_gradient
+        self.is_parameter = isinstance(t, Parameter)
+        self.name = t.name
+
+    def restore(self) -> Tensor:
+        import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
+        dt = np.dtype(self.dtype)
+        arr = np.frombuffer(self.data, dtype=dt).reshape(self.shape)
+        if self.is_parameter:
+            t = Parameter(arr, trainable=not self.stop_gradient,
+                          name=self.name)
+        else:
+            t = Tensor(arr, stop_gradient=self.stop_gradient, name=self.name)
+        return t
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(obj)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        t = obj.restore()
+        return t.numpy() if return_numpy else t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """≙ paddle.save. Accepts state dicts, nested containers, tensors."""
+    if hasattr(path, "write"):
+        f = path
+        f.write(_MAGIC)
+        pickle.dump(_pack(obj), f, protocol=protocol)
+        return
+    d = os.path.dirname(str(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    """≙ paddle.load."""
+    if hasattr(path, "read"):
+        f = path
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError("not a paddle_tpu checkpoint stream")
+        return _unpack(pickle.load(f), return_numpy)
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError(f"{path} is not a paddle_tpu checkpoint")
+        return _unpack(pickle.load(f), return_numpy)

@@ -1,0 +1,11 @@
+"""RNG state helpers (accelerator generator aliases the global one).
+≙ reference «python/paddle/framework/random.py» [U]."""
+from ..tensor import random as _random
+
+
+def get_cuda_rng_state():
+    return _random.get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    _random.set_rng_state(state)

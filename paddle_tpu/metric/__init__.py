@@ -1,0 +1,186 @@
+"""Metrics. ≙ reference «python/paddle/metric/metrics.py» [U]."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+
+class Metric:
+    def __init__(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, pred, label, *args):
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy. ≙ paddle.metric.Accuracy."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred = np.asarray(pred.numpy() if isinstance(pred, Tensor) else pred)
+        label = np.asarray(label.numpy() if isinstance(label, Tensor) else label)
+        idx = np.argsort(-pred, axis=-1)[..., :self.maxk]
+        if label.ndim == pred.ndim:
+            label = label.argmax(-1)
+        correct = idx == label[..., None]
+        return correct
+
+    def update(self, correct, *args):
+        correct = np.asarray(correct.numpy() if isinstance(correct, Tensor)
+                             else correct)
+        accs = []
+        for k in self.topk:
+            num = correct[..., :k].any(-1).sum()
+            self.total[k] += int(num)
+            self.count[k] += int(np.prod(correct.shape[:-1]))
+            accs.append(num / max(np.prod(correct.shape[:-1]), 1))
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = {k: 0 for k in self.topk}
+        self.count = {k: 0 for k in self.topk}
+
+    def accumulate(self):
+        out = [self.total[k] / max(self.count[k], 1) for k in self.topk]
+        return out[0] if len(out) == 1 else out
+
+    def name(self):
+        return [f"{self._name}_top{k}" for k in self.topk] \
+            if len(self.topk) > 1 else [self._name]
+
+
+class Precision(Metric):
+    """Binary precision. ≙ paddle.metric.Precision."""
+
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor)
+                            else labels)
+        pred_pos = (preds.reshape(-1) > 0.5)
+        lab = labels.reshape(-1).astype(bool)
+        self.tp += int((pred_pos & lab).sum())
+        self.fp += int((pred_pos & ~lab).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall. ≙ paddle.metric.Recall."""
+
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor)
+                            else labels)
+        pred_pos = (preds.reshape(-1) > 0.5)
+        lab = labels.reshape(-1).astype(bool)
+        self.tp += int((pred_pos & lab).sum())
+        self.fn += int((~pred_pos & lab).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC AUC via thresholded confusion bins. ≙ paddle.metric.Auc."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = np.asarray(preds.numpy() if isinstance(preds, Tensor) else preds)
+        labels = np.asarray(labels.numpy() if isinstance(labels, Tensor)
+                            else labels)
+        if preds.ndim == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = labels.reshape(-1)
+        bins = np.minimum((preds * self.num_thresholds).astype(np.int64),
+                          self.num_thresholds - 1)
+        for b, l in zip(bins, labels):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds, np.int64)
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # integrate over thresholds high->low
+        pos = self._stat_pos[::-1].cumsum()
+        neg = self._stat_neg[::-1].cumsum()
+        tpr = pos / tot_pos
+        fpr = neg / tot_neg
+        return float(np.trapezoid(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (paddle.metric.accuracy)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply
+    lab = label if isinstance(label, Tensor) else to_tensor(label)
+
+    def fn(pred, l):
+        idx = jnp.argsort(-pred, axis=-1)[..., :k]
+        l2 = l.reshape(l.shape[0], -1)[:, 0]
+        ok = jnp.any(idx == l2[:, None], axis=-1)
+        return jnp.mean(ok.astype(jnp.float32)).reshape(1)
+    return apply("accuracy", fn, (input, lab))

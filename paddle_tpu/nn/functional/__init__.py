@@ -1,0 +1,11 @@
+"""nn.functional namespace. ≙ reference «python/paddle/nn/functional/__init__.py» [U]."""
+from .activation import *  # noqa: F401,F403
+from .attention import (scaled_dot_product_attention, flash_attention,  # noqa: F401
+                        flash_attn_unpadded, sequence_mask)
+from .common import *  # noqa: F401,F403
+from .conv import (conv1d, conv2d, conv3d, conv1d_transpose,  # noqa: F401
+                   conv2d_transpose, conv3d_transpose)
+from .loss import *  # noqa: F401,F403
+from .norm import (layer_norm, rms_norm, batch_norm, instance_norm,  # noqa: F401
+                   group_norm, local_response_norm)
+from .pooling import *  # noqa: F401,F403

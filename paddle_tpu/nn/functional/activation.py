@@ -1,0 +1,194 @@
+"""Activation functionals. ≙ reference «python/paddle/nn/functional/activation.py» [U]."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def relu(x, name=None):
+    return apply("relu", jax.nn.relu, (_t(x),))
+
+
+def relu_(x, name=None):
+    x._assign_inplace(relu(x)); return x
+
+
+def relu6(x, name=None):
+    return apply("relu6", jax.nn.relu6, (_t(x),))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda v: jax.nn.elu(v, alpha), (_t(x),))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply("selu",
+                 lambda v: scale * jnp.where(v > 0, v,
+                                             alpha * jnp.expm1(v)), (_t(x),))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda v: jax.nn.celu(v, alpha), (_t(x),))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda v: jax.nn.gelu(v, approximate=approximate),
+                 (_t(x),))
+
+
+def silu(x, name=None):
+    return apply("silu", jax.nn.silu, (_t(x),))
+
+
+swish = silu
+
+
+def hardswish(x, name=None):
+    return apply("hardswish",
+                 lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, (_t(x),))
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return apply("hardsigmoid",
+                 lambda v: jnp.clip(slope * v + offset, 0.0, 1.0), (_t(x),))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", lambda v: jnp.clip(v, min, max), (_t(x),))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink",
+                 lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), (_t(x),))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply("softshrink",
+                 lambda v: jnp.where(v > threshold, v - threshold,
+                                     jnp.where(v < -threshold, v + threshold,
+                                               0.0)), (_t(x),))
+
+
+def tanhshrink(x, name=None):
+    return apply("tanhshrink", lambda v: v - jnp.tanh(v), (_t(x),))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu",
+                 lambda v: jax.nn.leaky_relu(v, negative_slope), (_t(x),))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def fn(v, w):
+        if w.size == 1:
+            return jnp.where(v >= 0, v, w.reshape(()) * v)
+        ch_axis = 1 if data_format in ("NCHW", "NCL", "NCDHW") else v.ndim - 1
+        shape = [1] * v.ndim
+        shape[ch_axis] = w.size
+        return jnp.where(v >= 0, v, w.reshape(shape) * v)
+    return apply("prelu", fn, (_t(x), _t(weight)))
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=False, name=None):
+    if training:
+        from ...tensor.random import _key
+        k = _key()
+        def fn(v):
+            a = jax.random.uniform(k, v.shape, jnp.float32, lower, upper)
+            return jnp.where(v >= 0, v, (a * v.astype(jnp.float32)).astype(
+                v.dtype))
+        return apply("rrelu", fn, (_t(x),))
+    mid = (lower + upper) / 2.0
+    return apply("rrelu", lambda v: jnp.where(v >= 0, v, mid * v), (_t(x),))
+
+
+def sigmoid(x, name=None):
+    return apply("sigmoid", jax.nn.sigmoid, (_t(x),))
+
+
+def log_sigmoid(x, name=None):
+    return apply("log_sigmoid", jax.nn.log_sigmoid, (_t(x),))
+
+
+def tanh(x, name=None):
+    return apply("tanh", jnp.tanh, (_t(x),))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            from ...core import dtype as dtypes
+            v = v.astype(dtypes.convert_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+    return apply("softmax", fn, (_t(x),))
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    x._assign_inplace(softmax(x, axis, dtype)); return x
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def fn(v):
+        if dtype is not None:
+            from ...core import dtype as dtypes
+            v = v.astype(dtypes.convert_dtype(dtype))
+        return jax.nn.log_softmax(v, axis=axis)
+    return apply("log_softmax", fn, (_t(x),))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...tensor.random import _key
+    k = _key()
+
+    def fn(v):
+        g = jax.random.gumbel(k, v.shape, jnp.float32).astype(v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            # straight-through: hard one-hot forward, soft gradient
+            y_hard = (y == jnp.max(y, axis=axis, keepdims=True)).astype(y.dtype)
+            y = y_hard - jax.lax.stop_gradient(y) + y
+        return y
+    return apply("gumbel_softmax", fn, (_t(x),))
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply("softplus",
+                 lambda v: jnp.where(beta * v > threshold, v,
+                                     jnp.log1p(jnp.exp(beta * v)) / beta),
+                 (_t(x),))
+
+
+def softsign(x, name=None):
+    return apply("softsign", jax.nn.soft_sign, (_t(x),))
+
+
+def mish(x, name=None):
+    return apply("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)), (_t(x),))
+
+
+def maxout(x, groups, axis=1, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        c = v.shape[ax]
+        new = v.shape[:ax] + (c // groups, groups) + v.shape[ax + 1:]
+        return jnp.max(v.reshape(new), axis=ax + 1)
+    return apply("maxout", fn, (_t(x),))
+
+
+def glu(x, axis=-1, name=None):
+    def fn(v):
+        a, b = jnp.split(v, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return apply("glu", fn, (_t(x),))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply("thresholded_relu",
+                 lambda v: jnp.where(v > threshold, v, value), (_t(x),))

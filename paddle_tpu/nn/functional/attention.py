@@ -1,0 +1,155 @@
+"""Attention functionals. ≙ reference flash-attn integration
+(«paddle/phi/kernels/gpu/flash_attn_kernel.cu», fused attention kernels in
+«paddle/phi/kernels/fusion/» [U]) — on TPU the fast path is the Pallas
+flash-attention kernel in paddle_tpu.ops.flash_attention (splash/flash
+blockwise); this module provides the public API and the XLA fallback."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _sdpa_xla(q, k, v, mask=None, causal=False, scale=None, is_bnsd=False):
+    """Reference XLA attention (fused well by XLA for moderate seq lens).
+    Layout: (B, S, H, D) paddle convention unless is_bnsd."""
+    if not is_bnsd:
+        q = jnp.swapaxes(q, 1, 2)  # B H S D
+        k = jnp.swapaxes(k, 1, 2)
+        v = jnp.swapaxes(v, 1, 2)
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    # grouped-query: broadcast kv heads
+    hq, hk = q.shape[1], k.shape[1]
+    if hq != hk:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * s
+    if causal:
+        qlen, klen = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((qlen, klen), bool), k=klen - qlen)
+        logits = jnp.where(cm, logits, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -1e30)
+        else:
+            logits = logits + mask.astype(jnp.float32)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    if not is_bnsd:
+        out = jnp.swapaxes(out, 1, 2)
+    return out
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """≙ paddle.nn.functional.scaled_dot_product_attention.
+    Input layout (B, S, H, D). Uses the Pallas flash kernel on TPU when
+    shapes allow, else the XLA fallback."""
+    from ...ops import flash_attention as fa
+    q, k, v = _t(query), _t(key), _t(value)
+    if attn_mask is None and dropout_p == 0.0 and fa.can_use_flash(
+            q.shape, k.shape, q.dtype):
+        return fa.flash_attention(q, k, v, causal=is_causal)
+
+    m = _t(attn_mask) if attn_mask is not None else None
+    if dropout_p > 0.0 and training:
+        from ...tensor.random import default_generator
+        dk = default_generator.next_key()
+
+        def fn(qq, kk, vv, *mm):
+            mask = mm[0] if mm else None
+            d = qq.shape[-1]
+            qb = jnp.swapaxes(qq, 1, 2)
+            kb = jnp.swapaxes(kk, 1, 2)
+            vb = jnp.swapaxes(vv, 1, 2)
+            hq, hk = qb.shape[1], kb.shape[1]
+            if hq != hk:
+                kb = jnp.repeat(kb, hq // hk, axis=1)
+                vb = jnp.repeat(vb, hq // hk, axis=1)
+            logits = jnp.einsum("bhqd,bhkd->bhqk", qb, kb).astype(
+                jnp.float32) / math.sqrt(d)
+            if is_causal:
+                s1, s2 = logits.shape[-2], logits.shape[-1]
+                cm = jnp.tril(jnp.ones((s1, s2), bool), k=s2 - s1)
+                logits = jnp.where(cm, logits, -1e30)
+            if mask is not None:
+                logits = (jnp.where(mask, logits, -1e30)
+                          if mask.dtype == jnp.bool_
+                          else logits + mask.astype(jnp.float32))
+            p = jax.nn.softmax(logits, -1)
+            keep = jax.random.bernoulli(dk, 1 - dropout_p, p.shape)
+            p = jnp.where(keep, p / (1 - dropout_p), 0.0).astype(qq.dtype)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+            return jnp.swapaxes(out, 1, 2)
+        args = (q, k, v) + ((m,) if m is not None else ())
+        return apply("sdpa", fn, args)
+
+    def fn(qq, kk, vv, *mm):
+        return _sdpa_xla(qq, kk, vv, mask=mm[0] if mm else None,
+                         causal=is_causal)
+    args = (q, k, v) + ((m,) if m is not None else ())
+    return apply("sdpa", fn, args)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """≙ paddle.nn.functional.flash_attention.flash_attention [U].
+    Returns (out, softmax_lse-placeholder) like the reference returns
+    (out, softmax) tuple."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    return out, None
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
+                        causal=False, return_softmax=False, name=None):
+    """Varlen flash attention: ragged batch packed as one sequence with
+    cumulative offsets. XLA path materializes a block mask; the Pallas splash
+    kernel consumes the same segment-id form."""
+    q, k, v = _t(query), _t(key), _t(value)
+    cq = _t(cu_seqlens_q)._value
+    ck = _t(cu_seqlens_k)._value
+
+    def fn(qq, kk, vv):
+        # qq: (total_q, H, D). Build segment ids from cu_seqlens.
+        tq = qq.shape[0]
+        tk = kk.shape[0]
+        seg_q = jnp.cumsum(
+            jnp.zeros(tq, jnp.int32).at[cq[1:-1]].add(1))
+        seg_k = jnp.cumsum(
+            jnp.zeros(tk, jnp.int32).at[ck[1:-1]].add(1))
+        d = qq.shape[-1]
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        logits = jnp.einsum("qhd,khd->hqk", qq, kk).astype(jnp.float32) * s
+        mask = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            pos_q = jnp.arange(tq) - jnp.take(cq, seg_q)
+            pos_k = jnp.arange(tk) - jnp.take(ck, seg_k)
+            mask = mask & (pos_q[:, None] >= pos_k[None, :])
+        logits = jnp.where(mask[None], logits, -1e30)
+        p = jax.nn.softmax(logits, -1).astype(qq.dtype)
+        return jnp.einsum("hqk,khd->qhd", p, vv)
+    out = apply("flash_attn_unpadded", fn, (q, k, v))
+    return out, None
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core import dtype as dtypes
+    xv = _t(x)
+    ml = maxlen if maxlen is not None else int(xv.numpy().max())
+    dt = dtypes.convert_dtype(dtype)
+    return apply("sequence_mask",
+                 lambda v: (jnp.arange(ml)[None, :] < v[..., None]).astype(dt),
+                 (xv,))

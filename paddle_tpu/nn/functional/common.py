@@ -1,0 +1,322 @@
+"""Common functionals: linear, dropout, pad, interpolate, etc.
+≙ reference «python/paddle/nn/functional/common.py» [U]."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import dtype as dtypes
+from ...core.tensor import Tensor, apply, to_tensor
+from ...tensor.random import default_generator
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def cast(x, dtype):
+    return _t(x).astype(dtype)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with W shaped (in, out) — reference convention
+    («paddle/phi/kernels/.../matmul» consumers [U]). Single XLA dot."""
+    if bias is not None:
+        return apply("linear", lambda v, w, b: jnp.matmul(v, w) + b,
+                     (_t(x), _t(weight), _t(bias)))
+    return apply("linear", jnp.matmul, (_t(x), _t(weight)))
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None, rng_key=None):
+    """Dropout. Stateful key draw in eager; under jit pass `rng_key` (the
+    jit-side plumbing is handled by paddle_tpu.jit via the rng tracker)."""
+    if not training or p == 0.0:
+        return _t(x)
+    if p == 1.0:
+        return apply("dropout", lambda v: jnp.zeros_like(v), (_t(x),))
+    k = rng_key if rng_key is not None else default_generator.next_key()
+
+    def fn(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in [a % v.ndim for a in axes] else 1
+                     for i, s in enumerate(v.shape)]
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0).astype(v.dtype)
+        return jnp.where(keep, v, 0.0).astype(v.dtype)
+    return apply("dropout", fn, (_t(x),))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = [2, 3] if data_format == "NCHW" else [1, 2]
+    drop_axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=drop_axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    drop_axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=drop_axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return _t(x)
+    k = default_generator.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(v):
+        keep = jax.random.bernoulli(k, 1.0 - p, v.shape)
+        a = (1.0 / np.sqrt((1.0 - p) * (1.0 + p * alpha_p ** 2))) \
+            if p < 1 else 0.0
+        b = -a * alpha_p * p
+        out = jnp.where(keep, v, alpha_p)
+        return (a * out + b).astype(v.dtype)
+    return apply("alpha_dropout", fn, (_t(x),))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """Supports paddle's two layouts: len(pad)==2*ndim (per-dim pairs,
+    [dim0_lo, dim0_hi, ...]) or the conv-style last-dims form."""
+    if isinstance(pad, Tensor):
+        pad = [int(p) for p in pad.tolist()]
+    pad = [int(p) for p in pad]
+    x = _t(x)
+    nd = x.ndim
+
+    if len(pad) == 2 * nd:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # conv style: pads apply to spatial dims (reversed pair order, like
+        # the reference / torch.nn.functional.pad)
+        n_spatial = len(pad) // 2
+        pairs = [(0, 0)] * nd
+        if data_format.startswith("NC"):
+            spatial = list(range(2, 2 + n_spatial))
+        else:
+            spatial = list(range(1, 1 + n_spatial))
+        for i in range(n_spatial):
+            d = spatial[n_spatial - 1 - i]
+            pairs[d] = (pad[2 * i], pad[2 * i + 1])
+
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+
+    def fn(v):
+        if jmode == "constant":
+            return jnp.pad(v, pairs, mode="constant",
+                           constant_values=np.asarray(value).item()
+                           if not isinstance(value, (int, float)) else value)
+        return jnp.pad(v, pairs, mode=jmode)
+    return apply("pad", fn, (x,))
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    """≙ paddle.nn.functional.interpolate via jax.image.resize."""
+    x = _t(x)
+    nd = x.ndim
+    channel_last = not data_format.startswith("NC")
+    spatial = list(range(1, nd - 1)) if channel_last else list(range(2, nd))
+    in_sizes = [x.shape[d] for d in spatial]
+
+    if size is not None:
+        if isinstance(size, Tensor):
+            size = [int(s) for s in size.tolist()]
+        out_sizes = [int(s._value) if isinstance(s, Tensor) else int(s)
+                     for s in (size if isinstance(size, (list, tuple))
+                               else [size])]
+    else:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+            else [scale_factor] * len(spatial)
+        out_sizes = [int(s * f) for s, f in zip(in_sizes, sf)]
+
+    method = {"nearest": "nearest", "bilinear": "bilinear", "linear": "linear",
+              "trilinear": "trilinear", "bicubic": "bicubic",
+              "area": "linear"}[mode]
+
+    def fn(v):
+        out_shape = list(v.shape)
+        for d, s in zip(spatial, out_sizes):
+            out_shape[d] = s
+        return jax.image.resize(v, out_shape, method=method).astype(v.dtype)
+    return apply("interpolate", fn, (x,))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (_t(x1), _t(x2), _t(weight))
+    if bias is not None:
+        args = args + (_t(bias),)
+    return apply("bilinear", fn, args)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply("cosine_similarity", fn, (_t(x1), _t(x2)))
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def fn(v):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(v * v, axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(v) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+    return apply("normalize", fn, (_t(x),))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col. ≙ paddle.nn.functional.unfold (NCHW)."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) \
+        else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def fn(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, ((0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])))
+        oh = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(
+                    v[:, :, di:di + oh * st[0]:st[0],
+                      dj:dj + ow * st[1]:st[1]])
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return apply("unfold", fn, (_t(x),))
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) \
+        else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) \
+        else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def fn(v):
+        n, ckk, L = v.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os_[0] + pd[0] + pd[2], os_[1] + pd[1] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        v = v.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), v.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                out = out.at[:, :, di:di + oh * st[0]:st[0],
+                             dj:dj + ow * st[1]:st[1]].add(v[:, :, i, j])
+        return out[:, :, pd[0]:pd[0] + os_[0], pd[1]:pd[1] + os_[1]]
+    return apply("fold", fn, (_t(x),))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def fn(l, *rest):
+        k = l.shape[-1]
+        if rest:
+            return (1 - epsilon) * l + epsilon * rest[0]
+        return (1 - epsilon) * l + epsilon / k
+    args = (_t(label),)
+    if prior_dist is not None:
+        args = args + (_t(prior_dist),)
+    return apply("label_smooth", fn, args)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """≙ paddle.nn.functional.embedding — XLA gather; padding_idx rows get
+    zero gradient via weight masking."""
+    def fn(ids, w):
+        if padding_idx is not None:
+            pi = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            w = w.at[pi].set(jax.lax.stop_gradient(w[pi]))
+        return jnp.take(w, ids, axis=0)
+    return apply("embedding", fn, (_t(x), _t(weight)))
+
+
+def one_hot(x, num_classes, name=None):
+    return apply("one_hot",
+                 lambda v: jax.nn.one_hot(
+                     v, num_classes, dtype=dtypes.get_default_dtype()),
+                 (_t(x),))
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError(
+        "class_center_sample (PS-style sampled softmax) is out of scope for "
+        "the TPU build; see SURVEY.md do-not-build list.")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+    return apply("pixel_shuffle", fn, (_t(x),))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            v = v.transpose(0, 1, 3, 5, 2, 4)
+            return v.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h // r, r, w // r, r, c)
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h // r, w // r, c * r * r)
+    return apply("pixel_unshuffle", fn, (_t(x),))
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, groups, c // groups, h, w)
+            return v.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, groups, c // groups)
+        return v.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply("channel_shuffle", fn, (_t(x),))

@@ -1,0 +1,156 @@
+"""Convolution functionals over lax.conv_general_dilated (XLA convs hit the
+MXU). ≙ reference «python/paddle/nn/functional/conv.py» + PHI conv kernels [U].
+Weight layout follows the reference: (out_c, in_c/groups, *kernel)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _padding(padding, n, stride=None, dilation=None, ksize=None):
+    if isinstance(padding, str):
+        return padding.upper()  # 'SAME' / 'VALID'
+    if isinstance(padding, (list, tuple)):
+        p = list(padding)
+        if len(p) == n:
+            return [(int(i), int(i)) for i in p]
+        if len(p) == 2 * n:
+            return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+        if len(p) == n and isinstance(p[0], (list, tuple)):
+            return [tuple(i) for i in p]
+    return [(int(padding), int(padding))] * n
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n,
+             data_format, op_name):
+    st = _tuple(stride, n)
+    dl = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    channel_last = not data_format.startswith("NC")
+    if channel_last:
+        x_spec = "N" + "".join("DHW"[3 - n + i] for i in range(n)) + "C"
+    else:
+        x_spec = "NC" + "".join("DHW"[3 - n + i] for i in range(n))
+    w_spec = "OI" + "".join("DHW"[3 - n + i] for i in range(n))
+    dn = lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
+                                    (x_spec, w_spec, x_spec))
+
+    def fn(v, w, *b):
+        out = lax.conv_general_dilated(
+            v, w.astype(v.dtype), window_strides=st, padding=pad,
+            rhs_dilation=dl, dimension_numbers=dn, feature_group_count=groups)
+        if b:
+            shape = [1] * out.ndim
+            shape[out.ndim - 1 if channel_last else 1] = b[0].shape[0]
+            out = out + b[0].reshape(shape).astype(out.dtype)
+        return out
+    args = (_t(x), _t(weight)) + ((_t(bias),) if bias is not None else ())
+    return apply(op_name, fn, args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NCW" if data_format == "NCL" else "NWC"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1,
+                    df, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2,
+                    data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3,
+                    data_format, "conv3d")
+
+
+def _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                       dilation, groups, n, data_format, op_name,
+                       output_size=None):
+    st = _tuple(stride, n)
+    dl = _tuple(dilation, n)
+    opad = _tuple(output_padding, n)
+    channel_last = not data_format.startswith("NC")
+    if isinstance(padding, str):
+        raise NotImplementedError("string padding for conv_transpose")
+    pad = _padding(padding, n)
+
+    def fn(v, w, *b):
+        # weight layout (in_c, out_c/groups, *k) per reference convention
+        k = w.shape[2:]
+        # transposed conv = lhs-dilated conv with flipped kernel
+        pads = []
+        for i in range(n):
+            lo = dl[i] * (k[i] - 1) - pad[i][0]
+            hi = dl[i] * (k[i] - 1) - pad[i][1] + opad[i]
+            pads.append((lo, hi))
+        wf = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        wf = jnp.swapaxes(wf, 0, 1)  # -> (out_c/groups, in_c, *k)
+        if groups > 1:
+            # regroup: (in, out/g, *k) -> (out, in/g, *k)
+            ci = w.shape[0]
+            co_g = w.shape[1]
+            wg = w.reshape(groups, ci // groups, co_g, *k)
+            wg = jnp.flip(wg, axis=tuple(range(3, 3 + n)))
+            wg = jnp.swapaxes(wg, 1, 2)  # g, out/g, in/g, *k
+            wf = wg.reshape(groups * co_g, ci // groups, *k)
+        if channel_last:
+            x_spec = "N" + "".join("DHW"[3 - n + i] for i in range(n)) + "C"
+        else:
+            x_spec = "NC" + "".join("DHW"[3 - n + i] for i in range(n))
+        w_spec = "OI" + "".join("DHW"[3 - n + i] for i in range(n))
+        dn = lax.conv_dimension_numbers(v.shape, wf.shape,
+                                        (x_spec, w_spec, x_spec))
+        out = lax.conv_general_dilated(
+            v, wf.astype(v.dtype), window_strides=(1,) * n, padding=pads,
+            lhs_dilation=st, rhs_dilation=dl, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            shape = [1] * out.ndim
+            shape[out.ndim - 1 if channel_last else 1] = b[0].shape[0]
+            out = out + b[0].reshape(shape).astype(out.dtype)
+        return out
+    args = (_t(x), _t(weight)) + ((_t(bias),) if bias is not None else ())
+    return apply(op_name, fn, args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    df = "NCW" if data_format == "NCL" else "NWC"
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 1, df, "conv1d_transpose",
+                              output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 2, data_format,
+                              "conv2d_transpose", output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding,
+                              dilation, groups, 3, data_format,
+                              "conv3d_transpose", output_size)

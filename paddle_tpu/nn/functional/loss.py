@@ -1,0 +1,431 @@
+"""Loss functionals. ≙ reference «python/paddle/nn/functional/loss.py» [U]."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """≙ paddle.nn.functional.cross_entropy (softmax+NLL fused into one XLA
+    graph; numerically stable via log_softmax)."""
+    wt = _t(weight) if weight is not None else None
+
+    def fn(logits, lab, *w):
+        lf = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lf, axis=axis) if use_softmax \
+            else jnp.log(jnp.maximum(lf, 1e-30))
+        if soft_label or (lab.ndim == logits.ndim and
+                          lab.shape == logits.shape and
+                          jnp.issubdtype(lab.dtype, jnp.floating)):
+            target = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                k = logits.shape[axis]
+                target = (1 - label_smoothing) * target + label_smoothing / k
+            loss = -jnp.sum(target * logp, axis=axis)
+            if w:
+                cls = jnp.argmax(lab, axis=axis)
+                loss = loss * jnp.take(w[0], cls)
+            return _reduce(loss, reduction)
+        lab_i = lab
+        if lab_i.ndim == logits.ndim:
+            lab_i = jnp.squeeze(lab_i, axis=axis)
+        lab_i = lab_i.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(
+            logp, jnp.expand_dims(safe, axis), axis=axis).squeeze(axis)
+        if label_smoothing > 0:
+            nll = -(1 - label_smoothing) * picked \
+                - label_smoothing * jnp.mean(logp, axis=axis)
+        else:
+            nll = -picked
+        if w:
+            wv = jnp.take(w[0], safe) * valid.astype(jnp.float32)
+        else:
+            wv = valid.astype(jnp.float32)
+        nll = nll * wv
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(jnp.sum(wv), 1e-9)
+        return _reduce(nll, reduction)
+    args = (_t(input), _t(label)) + ((wt,) if wt is not None else ())
+    return apply("cross_entropy", fn, args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    # paddle returns loss with the class axis kept as size-1
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        from .activation import softmax
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    wt = _t(weight) if weight is not None else None
+
+    def fn(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        # class axis is 1 for NCd layout
+        nll = -jnp.take_along_axis(logp, jnp.expand_dims(safe, 1),
+                                   axis=1).squeeze(1)
+        if w:
+            wv = jnp.take(w[0], safe) * valid.astype(jnp.float32)
+        else:
+            wv = valid.astype(jnp.float32)
+        nll = nll * wv
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(jnp.sum(wv), 1e-9)
+        return _reduce(nll, reduction)
+    args = (_t(input), _t(label)) + ((wt,) if wt is not None else ())
+    return apply("nll_loss", fn, args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply("mse_loss",
+                 lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 (_t(input), _t(label)))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply("l1_loss",
+                 lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 (_t(input), _t(label)))
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        loss = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply("smooth_l1_loss", fn, (_t(input), _t(label)))
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    def fn(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        loss = jnp.where(ad <= delta, 0.5 * d * d,
+                         delta * (ad - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply("huber_loss", fn, (_t(input), _t(label)))
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    wt = _t(weight) if weight is not None else None
+
+    def fn(p, l, *w):
+        p = jnp.clip(p.astype(jnp.float32), 1e-12, 1.0 - 1e-7)
+        loss = -(l * jnp.log(p) + (1 - l) * jnp.log1p(-p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = (_t(input), _t(label)) + ((wt,) if wt is not None else ())
+    return apply("binary_cross_entropy", fn, args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    wt = _t(weight) if weight is not None else None
+    pw = _t(pos_weight) if pos_weight is not None else None
+
+    def fn(z, l, *rest):
+        z = z.astype(jnp.float32)
+        l = l.astype(jnp.float32)
+        # stable: max(z,0) - z*l + log(1+exp(-|z|)), with pos_weight folded in
+        i = 0
+        pwv = None
+        if pos_weight is not None:
+            pwv = rest[i]; i += 1
+        wv = rest[i] if weight is not None else None
+        log_sig_neg = -jax.nn.softplus(z)      # log(1-sigmoid(z)) = -sp(z)
+        log_sig = -jax.nn.softplus(-z)         # log(sigmoid(z))
+        if pwv is not None:
+            loss = -(pwv * l * log_sig + (1 - l) * log_sig_neg)
+        else:
+            loss = -(l * log_sig + (1 - l) * log_sig_neg)
+        if wv is not None:
+            loss = loss * wv
+        return _reduce(loss, reduction)
+    args = [_t(logit), _t(label)]
+    if pw is not None:
+        args.append(pw)
+    if wt is not None:
+        args.append(wt)
+    return apply("bce_with_logits", fn, tuple(args))
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = t * (jnp.log(jnp.maximum(t, 1e-30)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+    return apply("kl_div", fn, (_t(input), _t(label)))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def fn(a, b, l):
+        loss = jnp.maximum(0.0, -l * (a - b) + margin)
+        return _reduce(loss, reduction)
+    return apply("margin_ranking_loss", fn, (_t(input), _t(other), _t(label)))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def fn(a, l):
+        loss = jnp.where(l == 1.0, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply("hinge_embedding_loss", fn, (_t(input), _t(label)))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def fn(a, b, l):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(l == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply("cosine_embedding_loss", fn,
+                 (_t(input1), _t(input2), _t(label)))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-06, swap=False, reduction="mean",
+                        name=None):
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, -1) ** (1.0 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        loss = jnp.maximum(0.0, d_ap - d_an + margin)
+        return _reduce(loss, reduction)
+    return apply("triplet_margin_loss", fn,
+                 (_t(input), _t(positive), _t(negative)))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative, margin=margin,
+                                   swap=swap, reduction=reduction)
+    d_ap = distance_function(input, positive)
+    d_an = distance_function(input, negative)
+    if swap:
+        d_pn = distance_function(positive, negative)
+        d_an = minimum_t(d_an, d_pn)
+    from ...tensor.math import maximum
+    loss = maximum(d_ap - d_an + margin, 0.0)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def minimum_t(a, b):
+    from ...tensor.math import minimum
+    return minimum(a, b)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    wt = _t(weight) if weight is not None else None
+
+    def fn(z, l, *w):
+        loss = -(l * jax.nn.log_sigmoid(z) + (1 - l) * jax.nn.log_sigmoid(-z))
+        loss = jnp.mean(loss, -1)
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = (_t(input), _t(label)) + ((wt,) if wt is not None else ())
+    return apply("multi_label_soft_margin_loss", fn, args)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def fn(z, l):
+        return _reduce(jnp.log1p(jnp.exp(-l * z)), reduction)
+    return apply("soft_margin_loss", fn, (_t(input), _t(label)))
+
+
+def square_error_cost(input, label):
+    return apply("square_error_cost",
+                 lambda a, b: jnp.square(a - b), (_t(input), _t(label)))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def fn(p, l):
+        return -(l * jnp.log(p + epsilon)
+                 + (1 - l) * jnp.log(1 - p + epsilon))
+    return apply("log_loss", fn, (_t(input), _t(label)))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    norm = _t(normalizer) if normalizer is not None else None
+
+    def fn(z, l, *n):
+        z = z.astype(jnp.float32)
+        p = jax.nn.sigmoid(z)
+        ce = -(l * jax.nn.log_sigmoid(z) + (1 - l) * jax.nn.log_sigmoid(-z))
+        pt = p * l + (1 - p) * (1 - l)
+        a_t = alpha * l + (1 - alpha) * (1 - l)
+        loss = a_t * ((1 - pt) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = (_t(logit), _t(label)) + ((norm,) if norm is not None else ())
+    return apply("sigmoid_focal_loss", fn, args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (lax.scan over
+    time). ≙ warpctc integration in the reference [U]."""
+    def fn(lp, lab, in_len, lab_len):
+        # lp: (T, B, C) log probs; lab: (B, S)
+        T, B, C = lp.shape
+        S = lab.shape[1]
+        ext = jnp.full((B, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        L = 2 * S + 1
+        neg_inf = jnp.float32(-1e30)
+        alpha0 = jnp.full((B, L), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), blank])
+        first_lab = jnp.take_along_axis(
+            lp[0], ext[:, 1][:, None], axis=-1)[:, 0]
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(lab_len > 0, first_lab, neg_inf))
+
+        allow_skip = jnp.concatenate(
+            [jnp.zeros((B, 2), bool),
+             ext[:, 2:] != ext[:, :-2]], axis=1) & \
+            (jnp.arange(L)[None, :] % 2 == 1)
+
+        def step(alpha, lp_t):
+            a_prev1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_prev2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_prev2 = jnp.where(allow_skip, a_prev2, neg_inf)
+            m = jnp.maximum(jnp.maximum(alpha, a_prev1), a_prev2)
+            msafe = jnp.maximum(m, neg_inf)
+            s = (jnp.exp(alpha - msafe) + jnp.exp(a_prev1 - msafe)
+                 + jnp.exp(a_prev2 - msafe))
+            new = msafe + jnp.log(jnp.maximum(s, 1e-30))
+            emit = jnp.take_along_axis(lp_t, ext, axis=-1)
+            return new + emit, new
+
+        def step2(alpha, lp_t):
+            new_emit, _ = step(alpha, lp_t)
+            return new_emit, new_emit
+        _, seq = jax.lax.scan(step2, alpha0, lp[1:])
+        seq = jnp.concatenate([alpha0[None], seq], axis=0)  # (T, B, L)
+        t_idx = jnp.clip(in_len.astype(jnp.int32) - 1, 0, T - 1)
+        a_final = seq[t_idx, jnp.arange(B)]  # (B, L)
+        end1 = jnp.take_along_axis(
+            a_final, (2 * lab_len.astype(jnp.int32))[:, None], axis=1)[:, 0]
+        end2 = jnp.take_along_axis(
+            a_final,
+            jnp.maximum(2 * lab_len.astype(jnp.int32) - 1, 0)[:, None],
+            axis=1)[:, 0]
+        m = jnp.maximum(end1, end2)
+        ll = m + jnp.log(jnp.exp(end1 - m) + jnp.exp(end2 - m))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len.astype(jnp.float32),
+                                               1.0))
+        return _reduce(loss, reduction)
+    return apply("ctc_loss", fn, (_t(log_probs), _t(labels),
+                                  _t(input_lengths), _t(label_lengths)))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(a, b):
+        if log_input:
+            loss = jnp.exp(a) - b * a
+        else:
+            loss = a - b * jnp.log(a + epsilon)
+        if full:
+            stirling = b * jnp.log(b + 1e-30) - b + 0.5 * jnp.log(
+                2 * np.pi * jnp.maximum(b, 1.0))
+            loss = loss + jnp.where(b > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply("poisson_nll_loss", fn, (_t(input), _t(label)))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, t, var):
+        var = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(var) + jnp.square(mu - t) / var)
+        if full:
+            loss = loss + 0.5 * np.log(2 * np.pi)
+        return _reduce(loss, reduction)
+    return apply("gaussian_nll_loss", fn,
+                 (_t(input), _t(label), _t(variance)))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, l):
+        sim = a @ p.T
+        l = l.reshape(-1, 1)
+        tgt = (l == l.T).astype(jnp.float32)
+        tgt = tgt / jnp.sum(tgt, -1, keepdims=True)
+        ce = -jnp.sum(tgt * jax.nn.log_softmax(sim, -1), -1)
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1))
+                        + jnp.mean(jnp.sum(p * p, -1))) * 0.25
+        return jnp.mean(ce) + reg
+    return apply("npair_loss", fn, (_t(anchor), _t(positive), _t(labels)))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def fn(p, l):
+        lab_oh = jax.nn.one_hot(l.squeeze(-1), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * lab_oh, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(lab_oh, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply("dice_loss", fn, (_t(input), _t(label)))
+
+
+def rnnt_loss(*args, **kwargs):
+    raise NotImplementedError(
+        "rnnt_loss: transducer loss is deferred (not in north-star configs); "
+        "the CTC path covers speech CTC training.")

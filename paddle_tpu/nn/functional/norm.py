@@ -1,0 +1,190 @@
+"""Normalization functionals. ≙ reference «python/paddle/nn/functional/norm.py»
++ fused rms_norm kernels («paddle/phi/kernels/fusion/» [U]). On TPU these are
+single fused XLA ops; a Pallas fast path for rms/layer-norm lives in
+paddle_tpu.ops and is used automatically for large hidden sizes."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
+               name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) \
+        else [normalized_shape]
+    n_axes = len(ns)
+
+    def fn(v, *wb):
+        axes = tuple(range(v.ndim - n_axes, v.ndim))
+        # compute in fp32 for bf16 stability (reference does the same in its
+        # fused kernels)
+        vf = v.astype(jnp.float32)
+        mean = jnp.mean(vf, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(vf - mean), axis=axes, keepdims=True)
+        out = (vf - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].astype(jnp.float32)
+        return out.astype(v.dtype)
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("layer_norm", fn, tuple(args))
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm (≙ fused rms_norm «paddle/phi/kernels/fusion/» [U])."""
+    def fn(v, *w):
+        vf = v.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(vf), axis=-1, keepdims=True)
+        out = vf * jax.lax.rsqrt(ms + epsilon)
+        if w:
+            out = out * w[0].astype(jnp.float32)
+        return out.astype(v.dtype)
+    args = (_t(x),) + ((_t(weight),) if weight is not None else ())
+    return apply("rms_norm", fn, args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-05,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """≙ paddle.nn.functional.batch_norm. Running stats update eagerly
+    (buffers mutate) in training mode."""
+    x = _t(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        # compute batch stats and update running buffers
+        mean = apply("bn_mean",
+                     lambda v: jnp.mean(v.astype(jnp.float32), axis=axes), (x,))
+        var = apply("bn_var",
+                    lambda v: jnp.var(v.astype(jnp.float32), axis=axes), (x,))
+        if running_mean is not None:
+            running_mean._value = (momentum * running_mean._value
+                                   + (1 - momentum) * mean._value).astype(
+                running_mean._value.dtype)
+        if running_var is not None:
+            n = int(np.prod([x.shape[a] for a in axes]))
+            unbiased = var._value * (n / max(n - 1, 1))
+            running_var._value = (momentum * running_var._value
+                                  + (1 - momentum) * unbiased).astype(
+                running_var._value.dtype)
+        m_t, v_t = mean, var
+    else:
+        m_t, v_t = _t(running_mean), _t(running_var)
+
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    def fn(v, m, s, *wb):
+        vf = v.astype(jnp.float32)
+        out = (vf - m.reshape(shape)) * jax.lax.rsqrt(
+            s.reshape(shape).astype(jnp.float32) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape).astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape).astype(jnp.float32)
+        return out.astype(v.dtype)
+    args = [x, m_t, v_t]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("batch_norm", fn, tuple(args))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-05,
+                  data_format="NCHW", name=None):
+    x = _t(x)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    spatial = tuple(i for i in range(x.ndim) if i not in (0, ch_axis))
+
+    def fn(v, *wb):
+        vf = v.astype(jnp.float32)
+        mean = jnp.mean(vf, axis=spatial, keepdims=True)
+        var = jnp.var(vf, axis=spatial, keepdims=True)
+        out = (vf - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1] * v.ndim
+        shape[ch_axis] = v.shape[ch_axis]
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape).astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape).astype(jnp.float32)
+        return out.astype(v.dtype)
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("instance_norm", fn, tuple(args))
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = _t(x)
+    channel_last = not data_format.startswith("NC")
+
+    def fn(v, *wb):
+        if channel_last:
+            v2 = jnp.moveaxis(v, -1, 1)
+        else:
+            v2 = v
+        n, c = v2.shape[0], v2.shape[1]
+        rest = v2.shape[2:]
+        g = v2.reshape(n, num_groups, c // num_groups, *rest).astype(
+            jnp.float32)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v2.shape)
+        shape = [1] * v2.ndim
+        shape[1] = c
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape).astype(jnp.float32)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape).astype(jnp.float32)
+        out = out.astype(v.dtype)
+        return jnp.moveaxis(out, 1, -1) if channel_last else out
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply("group_norm", fn, tuple(args))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def fn(v):
+        ch_axis = 1 if data_format.startswith("NC") else v.ndim - 1
+        sq = jnp.square(v.astype(jnp.float32))
+        c = v.shape[ch_axis]
+        sq_m = jnp.moveaxis(sq, ch_axis, 0)
+        pad_lo = (size - 1) // 2
+        pad_hi = size - 1 - pad_lo
+        padded = jnp.pad(sq_m, [(pad_lo, pad_hi)] + [(0, 0)] * (v.ndim - 1))
+        win = sum(padded[i:i + c] for i in range(size))
+        win = jnp.moveaxis(win, 0, ch_axis)
+        return (v / ((k + alpha * win) ** beta).astype(v.dtype))
+    return apply("local_response_norm", fn, (_t(x),))

@@ -1,0 +1,272 @@
+"""Pooling functionals via lax.reduce_window.
+≙ reference «python/paddle/nn/functional/pooling.py» [U]."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.tensor import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _pool_nd(x, kernel, stride, padding, n, data_format, reducer, init,
+             op_name, ceil_mode=False, exclusive=True, is_avg=False):
+    ks = _tuple(kernel, n)
+    st = _tuple(stride if stride is not None else kernel, n)
+    channel_last = not data_format.startswith("NC")
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pads = None
+    else:
+        pad_mode = None
+        p = _tuple(padding, n) if not isinstance(padding, (list, tuple)) \
+            or len(padding) == n else None
+        if p is None:
+            pl = list(padding)
+            pads_sp = [(int(pl[2 * i]), int(pl[2 * i + 1])) for i in range(n)]
+        else:
+            pads_sp = [(i, i) for i in p]
+        pads = pads_sp
+
+    def fn(v):
+        if channel_last:
+            window = (1,) + ks + (1,)
+            strides = (1,) + st + (1,)
+            sp_dims = list(range(1, 1 + n))
+        else:
+            window = (1, 1) + ks
+            strides = (1, 1) + st
+            sp_dims = list(range(2, 2 + n))
+        if pad_mode is not None:
+            padding_cfg = pad_mode
+        else:
+            full = [(0, 0)] * v.ndim
+            for d, pp in zip(sp_dims, pads):
+                hi = pp[1]
+                if ceil_mode:
+                    size = v.shape[d] + pp[0] + pp[1]
+                    rem = (size - ks[sp_dims.index(d)]) % st[sp_dims.index(d)]
+                    if rem:
+                        hi += st[sp_dims.index(d)] - rem
+                full[d] = (pp[0], hi)
+            padding_cfg = full
+        if is_avg:
+            vf = v.astype(jnp.float32)
+            s = lax.reduce_window(vf, 0.0, lax.add, window, strides,
+                                  padding_cfg)
+            if exclusive and (pad_mode is None and
+                              any(p != (0, 0) for p in padding_cfg)):
+                ones = jnp.ones_like(vf)
+                cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides,
+                                        padding_cfg)
+                return (s / jnp.maximum(cnt, 1.0)).astype(v.dtype)
+            return (s / float(np.prod(ks))).astype(v.dtype)
+        return lax.reduce_window(v, init(v.dtype), reducer, window, strides,
+                                 padding_cfg)
+    return apply(op_name, fn, (_t(x),))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format == "NCL" else "NWC"
+    out = _pool_nd(x, kernel_size, stride, padding, 1, df, lax.max,
+                   lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating)
+                   else jnp.iinfo(dt).min, "max_pool1d", ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 1, df)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool_nd(x, kernel_size, stride, padding, 2, data_format, lax.max,
+                   lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating)
+                   else jnp.iinfo(dt).min, "max_pool2d", ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 2,
+                               data_format)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool_nd(x, kernel_size, stride, padding, 3, data_format, lax.max,
+                   lambda dt: -jnp.inf if jnp.issubdtype(dt, jnp.floating)
+                   else jnp.iinfo(dt).min, "max_pool3d", ceil_mode)
+    if return_mask:
+        return out, _pool_mask(x, out, kernel_size, stride, padding, 3,
+                               data_format)
+    return out
+
+
+def _pool_mask(x, out, kernel, stride, padding, n, data_format):
+    """Indices of max elements (flat spatial index per window), computed by
+    enumerating the K=prod(kernel) window offsets (small, static)."""
+    import itertools
+    ks = _tuple(kernel, n)
+    st = _tuple(stride if stride is not None else kernel, n)
+    pd = _tuple(padding, n) if not isinstance(padding, str) else (0,) * n
+    x = _t(x)
+
+    def fn(v):
+        channel_last = not data_format.startswith("NC")
+        sp_dims = list(range(1, 1 + n)) if channel_last \
+            else list(range(2, 2 + n))
+        sp_shape = [v.shape[d] for d in sp_dims]
+        neg = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) \
+            else jnp.iinfo(v.dtype).min
+        pads = [(0, 0)] * v.ndim
+        for i, d in enumerate(sp_dims):
+            pads[d] = (pd[i], pd[i] + ks[i])  # extra hi pad for safety
+        padded = jnp.pad(v, pads, constant_values=neg)
+        out_sizes = [(sp_shape[i] + 2 * pd[i] - ks[i]) // st[i] + 1
+                     for i in range(n)]
+        vals = []
+        for offs in itertools.product(*[range(k) for k in ks]):
+            idx = [builtins_slice(None)] * v.ndim
+            for i, d in enumerate(sp_dims):
+                idx[d] = builtins_slice(offs[i],
+                                        offs[i] + out_sizes[i] * st[i], st[i])
+            vals.append(padded[tuple(idx)])
+        stacked = jnp.stack(vals, 0)
+        best = jnp.argmax(stacked, axis=0)  # flat kernel-offset index
+        # decode offset -> input coords -> flat spatial index (unpadded)
+        in_strides = np.cumprod([1] + sp_shape[::-1])[::-1][1:]  # row-major
+        flat = jnp.zeros(best.shape, jnp.int64)
+        rem = best
+        for i in range(n):
+            k_stride = int(np.prod(ks[i + 1:]))
+            off_i = rem // k_stride
+            rem = rem % k_stride
+            grid = jnp.arange(out_sizes[i])
+            shape = [1] * best.ndim
+            shape[sp_dims[i]] = out_sizes[i]
+            coord = grid.reshape(shape) * st[i] + off_i - pd[i]
+            flat = flat + coord.astype(jnp.int64) * int(in_strides[i])
+        return flat
+    import builtins
+    builtins_slice = builtins.slice
+    return apply("pool_mask", fn, (x,))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    df = "NCW" if data_format == "NCL" else "NWC"
+    return _pool_nd(x, kernel_size, stride, padding, 1, df, lax.add,
+                    lambda dt: 0.0, "avg_pool1d", ceil_mode, exclusive, True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, data_format, lax.add,
+                    lambda dt: 0.0, "avg_pool2d", ceil_mode, exclusive, True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, data_format, lax.add,
+                    lambda dt: 0.0, "avg_pool3d", ceil_mode, exclusive, True)
+
+
+def _adaptive_pool(x, output_size, n, data_format, is_avg, op_name):
+    channel_last = not data_format.startswith("NC")
+    os_ = _tuple(output_size, n)
+
+    def fn(v):
+        sp_dims = list(range(1, 1 + n)) if channel_last \
+            else list(range(2, 2 + n))
+        out = v
+        for i, d in enumerate(sp_dims):
+            if os_[i] is None:
+                continue
+            in_s, out_s = out.shape[d], os_[i]
+            if in_s % out_s == 0:
+                k = in_s // out_s
+                moved = jnp.moveaxis(out, d, -1)
+                moved = moved.reshape(moved.shape[:-1] + (out_s, k))
+                red = jnp.mean(moved.astype(jnp.float32), -1).astype(v.dtype) \
+                    if is_avg else jnp.max(moved, -1)
+                out = jnp.moveaxis(red, -1, d)
+            else:
+                # general case: per-output-bin gather
+                starts = (np.arange(out_s) * in_s) // out_s
+                ends = ((np.arange(out_s) + 1) * in_s + out_s - 1) // out_s
+                moved = jnp.moveaxis(out, d, 0)
+                bins = []
+                for s, e in zip(starts, ends):
+                    seg = moved[int(s):int(e)]
+                    r = (jnp.mean(seg.astype(jnp.float32), 0).astype(v.dtype)
+                         if is_avg else jnp.max(seg, 0))
+                    bins.append(r)
+                out = jnp.moveaxis(jnp.stack(bins, 0), 0, d)
+        return out
+    return apply(op_name, fn, (_t(x),))
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", True, "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, True,
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, True,
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "NCW", False,
+                         "adaptive_max_pool1d")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 2, "NCHW", False,
+                         "adaptive_max_pool2d")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 3, "NCDHW", False,
+                         "adaptive_max_pool3d")
+    return (out, None) if return_mask else out
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    p = float(norm_type)
+    xp = apply("lp_pow", lambda v: jnp.abs(v.astype(jnp.float32)) ** p,
+               (_t(x),))
+    s = _pool_nd(xp, kernel_size, stride, padding, 1, "NCW", lax.add,
+                 lambda dt: 0.0, "lp_pool1d", ceil_mode, False, True)
+    ks = _tuple(kernel_size, 1)
+    return apply("lp_root",
+                 lambda v: ((v * float(np.prod(ks))) ** (1.0 / p)), (s,))
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    xp = apply("lp_pow", lambda v: jnp.abs(v.astype(jnp.float32)) ** p,
+               (_t(x),))
+    s = _pool_nd(xp, kernel_size, stride, padding, 2, data_format, lax.add,
+                 lambda dt: 0.0, "lp_pool2d", ceil_mode, False, True)
+    ks = _tuple(kernel_size, 2)
+    return apply("lp_root",
+                 lambda v: ((v * float(np.prod(ks))) ** (1.0 / p)), (s,))

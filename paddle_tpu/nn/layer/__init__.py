@@ -1,0 +1,17 @@
+from .layers import (Layer, Sequential, LayerList, LayerDict,  # noqa: F401
+                     ParameterList)
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import (Conv1D, Conv2D, Conv3D, Conv1DTranspose,  # noqa: F401
+                   Conv2DTranspose, Conv3DTranspose)
+from .loss import *  # noqa: F401,F403
+from .norm import (LayerNorm, RMSNorm, BatchNorm, BatchNorm1D,  # noqa: F401
+                   BatchNorm2D, BatchNorm3D, SyncBatchNorm, GroupNorm,
+                   InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+                   LocalResponseNorm, SpectralNorm)
+from .pooling import *  # noqa: F401,F403
+from .rnn import (SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,  # noqa: F401
+                  SimpleRNN, LSTM, GRU)
+from .transformer import (MultiHeadAttention, Transformer,  # noqa: F401
+                          TransformerEncoder, TransformerEncoderLayer,
+                          TransformerDecoder, TransformerDecoderLayer)

@@ -1,0 +1,87 @@
+"""Activation layers. ≙ reference «python/paddle/nn/layer/activation.py» [U]."""
+from __future__ import annotations
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+
+def _simple(name, ffn, **defaults):
+    class _Act(Layer):
+        def __init__(self, **kwargs):
+            super().__init__()
+            self._kwargs = {**defaults, **{k: v for k, v in kwargs.items()
+                                           if k != "name"}}
+
+        def forward(self, x):
+            return ffn(x, **self._kwargs)
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+CELU = _simple("CELU", F.celu)
+ELU = _simple("ELU", F.elu)
+GELU = _simple("GELU", F.gelu)
+Hardshrink = _simple("Hardshrink", F.hardshrink)
+Hardsigmoid = _simple("Hardsigmoid", F.hardsigmoid)
+Hardswish = _simple("Hardswish", F.hardswish)
+Hardtanh = _simple("Hardtanh", F.hardtanh)
+LeakyReLU = _simple("LeakyReLU", F.leaky_relu)
+LogSigmoid = _simple("LogSigmoid", F.log_sigmoid)
+Mish = _simple("Mish", F.mish)
+ReLU = _simple("ReLU", F.relu)
+ReLU6 = _simple("ReLU6", F.relu6)
+SELU = _simple("SELU", F.selu)
+Sigmoid = _simple("Sigmoid", F.sigmoid)
+Silu = _simple("Silu", F.silu)
+Softplus = _simple("Softplus", F.softplus)
+Softshrink = _simple("Softshrink", F.softshrink)
+Softsign = _simple("Softsign", F.softsign)
+Swish = _simple("Swish", F.silu)
+Tanh = _simple("Tanh", F.tanh)
+Tanhshrink = _simple("Tanhshrink", F.tanhshrink)
+ThresholdedReLU = _simple("ThresholdedReLU", F.thresholded_relu)
+GLU = _simple("GLU", F.glu)
+RReLU = _simple("RReLU", F.rrelu)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups = groups
+        self.axis = axis
+
+    def forward(self, x):
+        return F.maxout(x, self.groups, self.axis)

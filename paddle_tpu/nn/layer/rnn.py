@@ -1,0 +1,270 @@
+"""Recurrent layers via lax.scan (compiler-friendly sequential loop —
+the TPU-idiomatic replacement for the reference's cuDNN RNN kernels
+«python/paddle/nn/layer/rnn.py» [U])."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply, to_tensor
+from .. import initializer as I
+from .layers import Layer
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (gates * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            (gates * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=u)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self.create_parameter((gates * hidden_size,), attr=bias_ih_attr,
+                                  is_bias=True, default_initializer=u)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self.create_parameter((gates * hidden_size,), attr=bias_hh_attr,
+                                  is_bias=True, default_initializer=u)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 1, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        if states is None:
+            states = Tensor(jnp.zeros((inputs.shape[0], self.hidden_size),
+                                      inputs._value.dtype))
+
+        def fn(x, h, wi, wh, *b):
+            z = x @ wi.T + h @ wh.T
+            if b:
+                z = z + b[0] + (b[1] if len(b) > 1 else 0)
+            return act(z)
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args.append(self.bias_ih)
+        if self.bias_hh is not None:
+            args.append(self.bias_hh)
+        h = apply("simple_rnn_cell", fn, tuple(args))
+        return h, h
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__(input_size, hidden_size, 4, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            z = jnp.zeros((inputs.shape[0], self.hidden_size),
+                          inputs._value.dtype)
+            states = (Tensor(z), Tensor(z))
+        h0, c0 = states
+
+        def fn(x, h, c, wi, wh, *b):
+            z = x @ wi.T + h @ wh.T
+            if b:
+                z = z + b[0] + (b[1] if len(b) > 1 else 0)
+            i, f, g, o = jnp.split(z, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return h_new, c_new
+        args = [inputs, h0, c0, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args.append(self.bias_ih)
+        if self.bias_hh is not None:
+            args.append(self.bias_hh)
+        h, c = apply("lstm_cell", fn, tuple(args), multi_output=True)
+        return h, (h, c)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 3, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = Tensor(jnp.zeros((inputs.shape[0], self.hidden_size),
+                                      inputs._value.dtype))
+
+        def fn(x, h, wi, wh, *b):
+            gi = x @ wi.T
+            gh = h @ wh.T
+            if b:
+                gi = gi + b[0]
+                if len(b) > 1:
+                    gh = gh + b[1]
+            ir, iz, ic = jnp.split(gi, 3, -1)
+            hr, hz, hc = jnp.split(gh, 3, -1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+        args = [inputs, states, self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            args.append(self.bias_ih)
+        if self.bias_hh is not None:
+            args.append(self.bias_hh)
+        h = apply("gru_cell", fn, tuple(args))
+        return h, h
+
+
+class RNN(Layer):
+    """Run a cell over a sequence (≙ paddle.nn.RNN wrapper)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # eager python loop (sequence lengths are usually short in tests);
+        # the jit path turns this into an unrolled XLA program
+        seq_axis = 0 if self.time_major else 1
+        steps = inputs.shape[seq_axis]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        outs = []
+        states = initial_states
+        from ...tensor.manipulation import stack
+        for t in order:
+            xt = inputs[:, t] if seq_axis == 1 else inputs[t]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        return stack(outs, axis=seq_axis), states
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrent net over lax.scan."""
+
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None, activation=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        self.num_directions = ndir
+        gates = {"LSTM": 4, "GRU": 3}.get(self.MODE[:4].rstrip("_"), 1)
+        if self.MODE.startswith("RNN"):
+            gates = 1
+        cell_cls = {"LSTM": LSTMCell, "GRU": GRUCell}.get(
+            self.MODE[:4].rstrip("_"), SimpleRNNCell)
+        from .layers import LayerList
+        self.cells = LayerList()
+        for layer in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if layer == 0 else hidden_size * ndir
+                if cell_cls is SimpleRNNCell:
+                    cell = SimpleRNNCell(
+                        in_sz, hidden_size,
+                        activation or ("relu" if "RELU" in self.MODE
+                                       else "tanh"),
+                        weight_ih_attr, weight_hh_attr, bias_ih_attr,
+                        bias_hh_attr)
+                else:
+                    cell = cell_cls(in_sz, hidden_size, weight_ih_attr,
+                                    weight_hh_attr, bias_ih_attr, bias_hh_attr)
+                self.cells.append(cell)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+        x = inputs
+        is_lstm = self.MODE == "LSTM"
+        final_h, final_c = [], []
+        for layer in range(self.num_layers):
+            outs_dir = []
+            for d in range(self.num_directions):
+                cell = self.cells[layer * self.num_directions + d]
+                runner = RNN(cell, is_reverse=(d == 1),
+                             time_major=self.time_major)
+                init = None
+                if initial_states is not None:
+                    idx = layer * self.num_directions + d
+                    if is_lstm:
+                        init = (initial_states[0][idx], initial_states[1][idx])
+                    else:
+                        init = initial_states[idx]
+                out, st = runner(x, init)
+                outs_dir.append(out)
+                if is_lstm:
+                    final_h.append(st[0])
+                    final_c.append(st[1])
+                else:
+                    final_h.append(st)
+            x = outs_dir[0] if len(outs_dir) == 1 else concat(outs_dir, -1)
+            if self.dropout > 0 and layer < self.num_layers - 1:
+                from .. import functional as Fn
+                x = Fn.dropout(x, self.dropout, training=self.training)
+        from ...tensor.manipulation import stack
+        if is_lstm:
+            return x, (stack(final_h, 0), stack(final_c, 0))
+        return x, stack(final_h, 0)
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        self.MODE = "RNN_RELU" if activation == "relu" else "RNN_TANH"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr, name, activation)
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor.manipulation import concat
+        sf = sb = None
+        if initial_states is not None:
+            sf, sb = initial_states
+        of, stf = self.rnn_fw(inputs, sf)
+        ob, stb = self.rnn_bw(inputs, sb)
+        return concat([of, ob], -1), (stf, stb)

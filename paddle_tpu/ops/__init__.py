@@ -1,0 +1,4 @@
+"""paddle_tpu.ops — TPU kernel library (Pallas/Mosaic), the counterpart of the
+reference's CUDA fused kernels («paddle/phi/kernels/fusion/» [U]).
+Each op ships a Pallas fast path + XLA fallback with identical semantics."""
+from . import flash_attention  # noqa: F401

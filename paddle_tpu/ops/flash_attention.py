@@ -1,0 +1,31 @@
+"""Flash attention for TPU. Stage-6 home of the Pallas blockwise kernel
+(≙ reference «paddle/phi/kernels/gpu/flash_attn_kernel.cu» + external
+flash-attn v2 [U]); until the Pallas path lands, `can_use_flash` gates to the
+XLA fallback in nn.functional.attention."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+
+_PALLAS_READY = False  # flipped when the Pallas kernel lands (stage 6)
+
+
+def can_use_flash(q_shape, k_shape, dtype) -> bool:
+    if not _PALLAS_READY:
+        return False
+    return (len(q_shape) == 4 and q_shape[-1] <= 256
+            and q_shape[1] % 128 == 0 and k_shape[1] % 128 == 0)
+
+
+def flash_attention(q: Tensor, k: Tensor, v: Tensor, causal: bool = False,
+                    scale=None) -> Tensor:
+    """(B, S, H, D) in/out. Dispatches to the Pallas kernel when available."""
+    from ..nn.functional.attention import _sdpa_xla
+
+    def fn(qq, kk, vv):
+        return _sdpa_xla(qq, kk, vv, causal=causal, scale=scale)
+    return apply("flash_attention", fn, (q, k, v))

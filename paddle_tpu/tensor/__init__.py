@@ -1,0 +1,174 @@
+"""Op surface assembly: exports every op and attaches the method/operator
+surface onto Tensor. ≙ reference «python/paddle/tensor/__init__.py» method
+registration (`tensor_method_func` monkey-patching) [U]."""
+from __future__ import annotations
+
+from ..core.tensor import Tensor, Parameter, to_tensor
+
+from . import attribute, creation, einsum as _einsum_mod, linalg, logic, \
+    manipulation, math, random, search, stat
+
+from .attribute import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+# names that collide with python builtins are still exported (paddle does this)
+from .math import abs, all, any, max, min, pow, round, sum  # noqa: F401
+from .manipulation import slice  # noqa: F401
+
+_METHOD_SOURCES = [math, manipulation, logic, search, stat, linalg, attribute,
+                   creation]
+
+# ops attached as Tensor methods (tensor-first signature)
+_METHOD_NAMES = [
+    # math
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "square", "abs", "sign", "neg", "reciprocal", "sin", "cos", "tan",
+    "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh", "acosh",
+    "atanh", "floor", "ceil", "round", "trunc", "frac", "erf", "erfinv",
+    "sigmoid", "digamma", "lgamma", "conj", "real", "imag", "angle",
+    "deg2rad", "rad2deg", "add", "subtract", "multiply", "divide",
+    "floor_divide", "mod", "remainder", "pow", "maximum", "minimum",
+    "fmax", "fmin", "atan2", "logaddexp", "heaviside", "gcd", "lcm",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "scale", "clip", "lerp", "nan_to_num", "stanh",
+    "sum", "mean", "prod", "max", "min", "amax", "amin", "nansum",
+    "nanmean", "logsumexp", "all", "any", "count_nonzero", "cumsum",
+    "cumprod", "cummax", "cummin", "logcumsumexp", "matmul", "mm", "bmm",
+    "dot", "inner", "outer", "mv", "kron", "cross", "trace", "diagonal",
+    "diff", "isfinite", "isinf", "isnan", "isclose", "allclose",
+    "equal_all", "take", "trapezoid", "frexp", "signbit", "multiplex",
+    "addmm", "increment",
+    # manipulation
+    "reshape", "reshape_", "flatten", "squeeze", "unsqueeze", "transpose",
+    "moveaxis", "swapaxes", "t", "concat", "split", "chunk", "tensor_split",
+    "gather", "gather_nd", "take_along_axis", "put_along_axis", "scatter",
+    "scatter_", "scatter_nd_add", "index_select", "index_sample",
+    "index_add", "index_put", "index_fill", "tile", "expand", "expand_as",
+    "broadcast_to", "flip", "rot90", "roll", "repeat_interleave", "unbind",
+    "unique", "unique_consecutive", "masked_select", "masked_fill",
+    "masked_scatter", "where", "nonzero", "unstack", "strided_slice",
+    "view", "view_as", "as_strided", "unflatten", "unfold", "bincount",
+    "histogram", "cdist", "as_complex", "as_real", "pad",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "is_empty", "isin",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "searchsorted",
+    "bucketize", "kthvalue", "mode",
+    # stat
+    "var", "std", "median", "nanmedian", "quantile", "nanquantile",
+    # linalg
+    "norm", "det", "inv", "pinv", "cholesky", "qr", "svd", "eigvals",
+    "matrix_power", "dist",
+    # attribute
+    "rank", "numel", "is_floating_point", "is_complex", "is_integer",
+    # creation
+    "tril", "triu", "diag",
+]
+
+
+def _attach_methods():
+    for name in _METHOD_NAMES:
+        fn = None
+        for mod in _METHOD_SOURCES:
+            fn = getattr(mod, name, None)
+            if callable(fn):
+                break
+        if fn is None:
+            raise RuntimeError(f"tensor method {name!r} not found")
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    # in-place variants: paddle `op_`(x, ...) == x = op(x, ...)
+    def _make_inplace(fname):
+        base = getattr(Tensor, fname)
+
+        def method(self, *args, **kwargs):
+            self._assign_inplace(base(self, *args, **kwargs))
+            return self
+        method.__name__ = fname + "_"
+        return method
+
+    for fname in ["add", "subtract", "multiply", "divide", "clip", "scale",
+                  "exp", "sqrt", "rsqrt", "floor", "ceil", "round", "abs",
+                  "sin", "cos", "tanh", "sigmoid", "reciprocal", "flatten",
+                  "squeeze", "unsqueeze", "transpose", "tril", "triu",
+                  "masked_fill", "index_fill", "put_along_axis", "lerp",
+                  "pow", "remainder", "mod", "logical_and", "logical_or",
+                  "logical_xor", "logical_not", "where", "trunc", "frac",
+                  "gcd", "lcm", "hypot", "nan_to_num", "index_add",
+                  "erfinv", "neg"]:
+        iname = fname + "_"
+        if not hasattr(Tensor, iname) and hasattr(Tensor, fname):
+            setattr(Tensor, iname, _make_inplace(fname))
+
+    def zero_(self):
+        import jax.numpy as jnp
+        self._value = jnp.zeros_like(self._value)
+        self._node = None
+        return self
+
+    def fill_(self, value):
+        import jax.numpy as jnp
+        self._value = jnp.full_like(self._value, value)
+        self._node = None
+        return self
+
+    Tensor.zero_ = zero_
+    Tensor.fill_ = fill_
+    Tensor.uniform_ = random.uniform_
+    Tensor.normal_ = random.normal_
+    Tensor.exponential_ = random.exponential_
+    Tensor.bernoulli_ = random.bernoulli_
+    Tensor.cast = Tensor.astype
+
+    # -- operator dunders ----------------------------------------------------
+    Tensor.__add__ = lambda s, o: math.add(s, o)
+    Tensor.__radd__ = lambda s, o: math.add(o, s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+    Tensor.__mod__ = lambda s, o: math.mod(s, o)
+    Tensor.__rmod__ = lambda s, o: math.mod(o, s)
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+    Tensor.__matmul__ = lambda s, o: math.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: math.matmul(o, s)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    Tensor.__and__ = lambda s, o: math.bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: math.bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: math.bitwise_xor(s, o)
+    Tensor.__invert__ = lambda s: math.bitwise_not(s)
+    Tensor.__lshift__ = lambda s, o: math.bitwise_left_shift(s, o)
+    Tensor.__rshift__ = lambda s, o: math.bitwise_right_shift(s, o)
+    # iadd etc. keep tape semantics via _assign_inplace
+    def _imake(opfn):
+        def im(self, other):
+            self._assign_inplace(opfn(self, other))
+            return self
+        return im
+    Tensor.__iadd__ = _imake(math.add)
+    Tensor.__isub__ = _imake(math.subtract)
+    Tensor.__imul__ = _imake(math.multiply)
+    Tensor.__itruediv__ = _imake(math.divide)
+
+
+_attach_methods()

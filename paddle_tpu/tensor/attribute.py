@@ -1,0 +1,44 @@
+"""Tensor attribute helpers. ≙ reference «python/paddle/tensor/attribute.py» [U]."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, to_tensor
+
+
+def shape(input) -> Tensor:
+    t = input if isinstance(input, Tensor) else to_tensor(input)
+    return Tensor(jnp.asarray(t.shape, jnp.int64))
+
+
+def rank(input) -> Tensor:
+    t = input if isinstance(input, Tensor) else to_tensor(input)
+    return Tensor(jnp.asarray(t.ndim, jnp.int64))
+
+
+def numel(x, name=None) -> Tensor:
+    t = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(jnp.asarray(t.size, jnp.int64))
+
+
+def is_floating_point(x) -> bool:
+    return dtypes.is_floating(x.dtype if isinstance(x, Tensor) else x)
+
+
+def is_integer(x) -> bool:
+    return dtypes.is_integer(x.dtype if isinstance(x, Tensor) else x)
+
+
+def is_complex(x) -> bool:
+    return dtypes.is_complex(x.dtype if isinstance(x, Tensor) else x)
+
+
+def real(x, name=None):
+    from .math import real as _r
+    return _r(x)
+
+
+def imag(x, name=None):
+    from .math import imag as _i
+    return _i(x)

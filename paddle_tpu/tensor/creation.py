@@ -1,0 +1,193 @@
+"""Tensor creation ops. ≙ reference «python/paddle/tensor/creation.py» [U]."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, apply, to_tensor
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else dtypes.get_default_dtype()
+    return dtypes.convert_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape_arg(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape_arg(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = dtypes.get_default_dtype() if isinstance(fill_value, float) \
+            else None
+    v = jnp.full(_shape_arg(shape), fill_value,
+                 _dt(dtype) if dtype is not None else None)
+    return Tensor(v)
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(jnp.zeros(x._value.shape,
+                            _dt(dtype, default=x._value.dtype)))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(jnp.ones(x._value.shape, _dt(dtype, default=x._value.dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(jnp.full(x._value.shape, fill_value,
+                           _dt(dtype, default=x._value.dtype)))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _scalar(start), _scalar(end), _scalar(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (dtypes.get_default_dtype()
+                 if any(isinstance(v, float) for v in (start, end, step))
+                 else dtypes.int64)
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    def _scalar(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(_scalar(start), _scalar(stop), int(_scalar(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(start, stop, int(num), base=base,
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+
+    def fn(v):
+        out = jnp.diag(v, k=offset)
+        if padding_value != 0 and v.ndim == 1:
+            mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+            out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+        return out
+    return apply("diag", fn, (x,))
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return apply("diagflat", lambda v: jnp.diagflat(v, k=offset), (x,))
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None) -> Tensor:
+    x = input if isinstance(input, Tensor) else to_tensor(input)
+
+    def fn(v):
+        n = v.shape[-1] + abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(v)
+        if (dim1, dim2) != (-2, -1):
+            out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+        return out
+    return apply("diag_embed", fn, (x,))
+
+
+def meshgrid(*args, **kwargs):
+    ts = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    ts = tuple(t if isinstance(t, Tensor) else to_tensor(t) for t in ts)
+    return apply("meshgrid", lambda *vs: tuple(jnp.meshgrid(*vs, indexing="ij")),
+                 ts, multi_output=True)
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return apply("tril", lambda v: jnp.tril(v, k=diagonal), (x,))
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return apply("triu", lambda v: jnp.triu(v, k=diagonal), (x,))
+
+
+def tril_indices(row, col, offset=0, dtype="int64", name=None) -> Tensor:
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64", name=None) -> Tensor:
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def assign(x, output=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+    out = apply("assign", lambda v: v, (x,))
+    if output is not None:
+        output._assign_inplace(out)
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return x.clone()
+
+
+def complex(real, imag, name=None) -> Tensor:
+    return apply("complex", lambda r, i: jax.lax.complex(r, i),
+                 (real, imag))
+
+
+def polar(abs, angle, name=None) -> Tensor:
+    return apply("polar",
+                 lambda a, th: jax.lax.complex(a * jnp.cos(th),
+                                               a * jnp.sin(th)),
+                 (abs, angle))
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return apply("one_hot",
+                 lambda v: jax.nn.one_hot(v, num_classes,
+                                          dtype=dtypes.get_default_dtype()),
+                 (x,))

@@ -1,0 +1,12 @@
+"""einsum. ≙ reference «python/paddle/tensor/einsum.py» [U] — delegates to
+XLA's dot_general-based jnp.einsum (MXU-friendly)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, to_tensor
+
+
+def einsum(equation, *operands, **kwargs):
+    ts = tuple(o if isinstance(o, Tensor) else to_tensor(o) for o in operands)
+    return apply("einsum", lambda *vs: jnp.einsum(equation, *vs), ts)

@@ -1,0 +1,267 @@
+"""Linear algebra ops (`paddle.linalg` namespace). ≙ reference
+«python/paddle/tensor/linalg.py» [U]. Heavy decompositions delegate to
+jax.numpy.linalg / jax.scipy.linalg (XLA-native)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def fn(v):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p is None:  # frobenius / 2-norm default
+            if ax is None:
+                return jnp.sqrt(jnp.sum(v * v))
+            return jnp.linalg.norm(v, ord=None, axis=ax, keepdims=keepdim)
+        if p == "fro":
+            return jnp.linalg.norm(v, ord="fro" if isinstance(ax, tuple)
+                                   else None, axis=ax, keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(v, ord="nuc", axis=ax, keepdims=keepdim)
+        if ax is None:
+            flat = v.reshape(-1)
+            if p == np.inf:
+                out = jnp.max(jnp.abs(flat))
+            elif p == -np.inf:
+                out = jnp.min(jnp.abs(flat))
+            elif p == 0:
+                out = jnp.sum(flat != 0).astype(v.dtype)
+            else:
+                out = jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p)
+            return out.reshape((1,) * v.ndim) if keepdim else out
+        return jnp.linalg.norm(v, ord=p, axis=ax, keepdims=keepdim)
+    return apply("norm", fn, (_t(x),))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply("vector_norm",
+                 lambda v: jnp.linalg.vector_norm(v, ord=p, axis=ax,
+                                                  keepdims=keepdim), (_t(x),))
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return apply("matrix_norm",
+                 lambda v: jnp.linalg.matrix_norm(v, ord=p, keepdims=keepdim),
+                 (_t(x),))
+
+
+def cond(x, p=None, name=None):
+    return apply("cond", lambda v: jnp.linalg.cond(v, p=p), (_t(x),))
+
+
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, (_t(x),))
+
+
+def slogdet(x, name=None):
+    def fn(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+    return apply("slogdet", fn, (_t(x),))
+
+
+def inv(x, name=None):
+    return apply("inv", jnp.linalg.inv, (_t(x),))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv",
+                 lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian),
+                 (_t(x),))
+
+
+def solve(x, y, name=None):
+    return apply("solve", jnp.linalg.solve, (_t(x), _t(y)))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return apply("triangular_solve",
+                 lambda a, b: jax.scipy.linalg.solve_triangular(
+                     a, b, lower=not upper, trans=1 if transpose else 0,
+                     unit_diagonal=unitriangular),
+                 (_t(x), _t(y)))
+
+
+def cholesky(x, upper=False, name=None):
+    def fn(v):
+        l = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(l, -1, -2).conj() if upper else l
+    return apply("cholesky", fn, (_t(x),))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return apply("cholesky_solve", fn, (_t(x), _t(y)))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def fn(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, (piv + 1).astype(jnp.int32)  # paddle pivots are 1-based
+    lu_t, piv_t = apply("lu", fn, (_t(x),), multi_output=True)
+    if get_infos:
+        info = Tensor(jnp.zeros((1,), jnp.int32))
+        return lu_t, piv_t, info
+    return lu_t, piv_t
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    def fn(lu_, piv):
+        n, m = lu_.shape[-2], lu_.shape[-1]
+        k = min(n, m)
+        l = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(n, k, dtype=lu_.dtype)
+        u = jnp.triu(lu_[..., :k, :])
+        # permutation matrix from 1-based pivot swaps
+        perm = jnp.arange(n)
+        def body(i, p):
+            j = piv[i] - 1
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        pmat = jnp.eye(n, dtype=lu_.dtype)[perm].T
+        return pmat, l, u
+    return apply("lu_unpack", fn, (_t(x), _t(y)), multi_output=True)
+
+
+def qr(x, mode="reduced", name=None):
+    if mode == "r":
+        return apply("qr_r", lambda v: jnp.linalg.qr(v, mode="r"), (_t(x),))
+    return apply("qr", lambda v: tuple(jnp.linalg.qr(v, mode=mode)),
+                 (_t(x),), multi_output=True)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd",
+                 lambda v: tuple(jnp.linalg.svd(
+                     v, full_matrices=full_matrices)),
+                 (_t(x),), multi_output=True)
+
+
+def svdvals(x, name=None):
+    return apply("svdvals",
+                 lambda v: jnp.linalg.svd(v, compute_uv=False), (_t(x),))
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    def fn(v):
+        u, s, vt = jnp.linalg.svd(v, full_matrices=False)
+        k = min(q, s.shape[-1])
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+    return apply("svd_lowrank", fn, (_t(x),), multi_output=True)
+
+
+def eig(x, name=None):
+    """General eigendecomposition — CPU-only in XLA; runs on host."""
+    xv = np.asarray(_t(x)._value)
+    w, v = np.linalg.eig(xv)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    xv = np.asarray(_t(x)._value)
+    return Tensor(jnp.asarray(np.linalg.eigvals(xv)))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh",
+                 lambda v: tuple(jnp.linalg.eigh(
+                     v, symmetrize_input=True)),
+                 (_t(x),), multi_output=True)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda v: jnp.linalg.eigvalsh(v), (_t(x),))
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power",
+                 lambda v: jnp.linalg.matrix_power(v, n), (_t(x),))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    tv = tol._value if isinstance(tol, Tensor) else tol
+    def fn(v):
+        s = (jnp.linalg.eigvalsh(v).__abs__() if hermitian
+             else jnp.linalg.svd(v, compute_uv=False))
+        if tv is None:
+            t = s.max(-1, keepdims=True) * max(v.shape[-2:]) * \
+                jnp.finfo(s.dtype).eps
+        else:
+            t = jnp.asarray(tv)
+            while t.ndim < s.ndim:
+                t = t[..., None]
+        return jnp.sum(s > t, axis=-1).astype(jnp.int64)
+    return apply("matrix_rank", fn, (_t(x),))
+
+
+def multi_dot(x, name=None):
+    ts = tuple(_t(i) for i in x)
+    return apply("multi_dot", lambda *vs: jnp.linalg.multi_dot(vs), ts)
+
+
+def matrix_exp(x, name=None):
+    return apply("matrix_exp", jax.scipy.linalg.expm, (_t(x),))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def fn(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank.astype(jnp.int64), sv
+    return apply("lstsq", fn, (_t(x), _t(y)), multi_output=True)
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0,
+                          jnp.where(jnp.arange(m) == i, 1.0, a[..., :, i]))
+            h = eye - t[i] * jnp.outer(v, v)
+            return q @ h
+        q = jax.lax.fori_loop(0, n, body, eye)
+        return q[..., :, :n]
+    return apply("householder_product", fn, (_t(x), _t(tau)))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def fn(v):
+        k = q if q is not None else min(6, *v.shape[-2:])
+        a = v - v.mean(axis=-2, keepdims=True) if center else v
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :k], s[..., :k], jnp.swapaxes(vt, -1, -2)[..., :k]
+    return apply("pca_lowrank", fn, (_t(x),), multi_output=True)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    from .stat import corrcoef as _c
+    return _c(x, rowvar)
+
+
+def bmm(x, y, name=None):
+    from .math import bmm as _b
+    return _b(x, y)
+
+
+def dist(x, y, p=2, name=None):
+    def fn(a, b):
+        d = (a - b).reshape(-1)
+        if p == np.inf:
+            return jnp.max(jnp.abs(d))
+        if p == -np.inf:
+            return jnp.min(jnp.abs(d))
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+    return apply("dist", fn, (_t(x), _t(y)))

@@ -1,0 +1,53 @@
+"""Comparison & logical ops. ≙ reference «python/paddle/tensor/logic.py» [U]."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _cmp(op_name, jfn):
+    def op(x, y, name=None):
+        xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+        if xt and yt:
+            return apply(op_name, jfn, (x, y))
+        if xt:
+            return apply(op_name, lambda v: jfn(v, y), (x,))
+        if yt:
+            return apply(op_name, lambda v: jfn(x, v), (y,))
+        return apply(op_name, jfn, (_t(x), _t(y)))
+    op.__name__ = op_name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+
+
+def logical_not(x, out=None, name=None):
+    return apply("logical_not", jnp.logical_not, (_t(x),))
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    tv = _t(test_x)._value
+    return apply("isin",
+                 lambda v: jnp.isin(v, tv, invert=invert), (_t(x),))

@@ -1,0 +1,640 @@
+"""Shape/layout manipulation ops. ≙ reference
+«python/paddle/tensor/manipulation.py» [U]. All static-shape → XLA-friendly;
+ops whose output shape is data-dependent (`masked_select`, `nonzero`) return
+host-synced results and are documented as eager-only."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in shape.tolist()]
+    return [int(s._value) if isinstance(s, Tensor) else int(s) for s in shape]
+
+
+def reshape(x, shape, name=None):
+    s = _shape_list(shape)
+    return apply("reshape", lambda v: jnp.reshape(v, s), (_t(x),))
+
+
+def reshape_(x, shape, name=None):
+    x._assign_inplace(reshape(x, shape)); return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def fn(v):
+        nd = v.ndim
+        a = start_axis % nd if nd else 0
+        b = stop_axis % nd if nd else 0
+        new_shape = v.shape[:a] + (-1,) + v.shape[b + 1:]
+        return v.reshape(new_shape)
+    return apply("flatten", fn, (_t(x),))
+
+
+def squeeze(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+    return apply("squeeze", fn, (_t(x),))
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    axes = [int(a._value) if isinstance(a, Tensor) else int(a) for a in axes]
+
+    def fn(v):
+        out = v
+        for a in sorted(axes):
+            out = jnp.expand_dims(out, a)
+        return out
+    return apply("unsqueeze", fn, (_t(x),))
+
+
+def transpose(x, perm=None, name=None):
+    p = [int(i) for i in perm] if perm is not None else None
+    return apply("transpose", lambda v: jnp.transpose(v, p), (_t(x),))
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", lambda v: jnp.moveaxis(v, source, destination),
+                 (_t(x),))
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply("swapaxes", lambda v: jnp.swapaxes(v, axis1, axis2), (_t(x),))
+
+
+transpose_ = None
+def t(input, name=None):
+    return apply("t", lambda v: v.T if v.ndim >= 2 else v, (_t(input),))
+
+
+def concat(x, axis=0, name=None):
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    ts = tuple(_t(i) for i in x)
+    dt = ts[0]._value.dtype
+    for u in ts[1:]:
+        dt = jnp.promote_types(dt, u._value.dtype)
+    return apply("concat", lambda *vs: jnp.concatenate(
+        [v.astype(dt) for v in vs], axis=ax), ts)
+
+
+def stack(x, axis=0, name=None):
+    ts = tuple(_t(i) for i in x)
+    return apply("stack", lambda *vs: jnp.stack(vs, axis=axis), ts)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    x = _t(x)
+    n = num if num is not None else x.shape[axis]
+    return apply("unstack",
+                 lambda v: tuple(jnp.squeeze(s, axis)
+                                 for s in jnp.split(v, n, axis)),
+                 (x,), multi_output=True)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+
+    if isinstance(num_or_sections, int):
+        n = num_or_sections
+        return apply("split", lambda v: tuple(jnp.split(v, n, ax)), (x,),
+                     multi_output=True)
+    secs = [int(s._value) if isinstance(s, Tensor) else int(s)
+            for s in num_or_sections]
+    total = x.shape[ax]
+    n_unknown = builtins_sum(1 for s in secs if s < 0)
+    if n_unknown:
+        known = builtins_sum(s for s in secs if s >= 0)
+        secs = [s if s >= 0 else total - known for s in secs]
+    splits = np.cumsum(secs)[:-1].tolist()
+    return apply("split", lambda v: tuple(jnp.split(v, splits, ax)), (x,),
+                 multi_output=True)
+
+
+def builtins_sum(it, start=0):
+    total = start
+    for v in it:
+        total = total + v
+    return total
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = _t(x)
+    return apply("tensor_split",
+                 lambda v: tuple(jnp.array_split(v, num_or_indices, axis)),
+                 (x,), multi_output=True)
+
+
+def slice(input, axes, starts, ends):
+    axes = [int(a) for a in axes]
+    starts = [int(s._value) if isinstance(s, Tensor) else int(s) for s in starts]
+    ends = [int(e._value) if isinstance(e, Tensor) else int(e) for e in ends]
+
+    def fn(v):
+        idx = [builtins_slice(None)] * v.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = builtins_slice(s, e)
+        return v[tuple(idx)]
+    return apply("slice", fn, (_t(input),))
+
+
+import builtins as _bi
+builtins_slice = _bi.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def fn(v):
+        idx = [_bi.slice(None)] * v.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[int(a)] = _bi.slice(int(s), int(e), int(st))
+        return v[tuple(idx)]
+    return apply("strided_slice", fn, (_t(x),))
+
+
+def gather(x, index, axis=0, name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return apply("gather", lambda v: jnp.take(v, idx.reshape(-1) if idx.ndim
+                                              else idx, axis=ax), (_t(x),))
+
+
+def gather_nd(x, index, name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(v):
+        k = idx.shape[-1]
+        return v[tuple(jnp.moveaxis(idx, -1, 0))] if k > 0 else v
+    return apply("gather_nd", fn, (_t(x),))
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    idx = indices._value if isinstance(indices, Tensor) else jnp.asarray(indices)
+
+    def fn(v):
+        i = idx
+        if broadcast:
+            tgt = list(v.shape)
+            tgt[axis] = i.shape[axis]
+            i = jnp.broadcast_to(i, tgt)
+        return jnp.take_along_axis(v, i, axis=axis)
+    return apply("take_along_axis", fn, (_t(arr),))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    idx = indices._value if isinstance(indices, Tensor) else jnp.asarray(indices)
+    mode = reduce
+
+    def fn(v, val):
+        val = jnp.broadcast_to(val, idx.shape).astype(v.dtype)
+        dims = list(range(v.ndim))
+        grids = jnp.meshgrid(*[jnp.arange(s) for s in idx.shape], indexing="ij")
+        full_idx = tuple(idx if d == axis % v.ndim else grids[d] for d in dims)
+        a = v.at[full_idx]
+        if mode == "assign":
+            return a.set(val)
+        if mode in ("add", "sum"):
+            return a.add(val)
+        if mode in ("mul", "multiply"):
+            return a.multiply(val)
+        if mode == "amax":
+            return a.max(val)
+        if mode == "amin":
+            return a.min(val)
+        if mode == "mean":
+            ones = jnp.zeros(v.shape, jnp.float32).at[full_idx].add(1.0)
+            summed = v.at[full_idx].add(val)
+            cnt = jnp.maximum(ones + 1.0, 1.0)
+            return jnp.where(ones > 0, (summed / cnt).astype(v.dtype), v)
+        raise ValueError(f"unknown reduce mode {mode}")
+    vt = values if isinstance(values, Tensor) else to_tensor(values)
+    return apply("put_along_axis", fn, (_t(arr), vt))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(v, u):
+        u = u.astype(v.dtype)
+        if overwrite:
+            return v.at[idx].set(u)
+        # paddle: overwrite=False accumulates after zeroing target rows
+        z = v.at[idx].set(jnp.zeros_like(u))
+        return z.at[idx].add(u)
+    ut = updates if isinstance(updates, Tensor) else to_tensor(updates)
+    return apply("scatter", fn, (_t(x), ut))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    x._assign_inplace(scatter(x, index, updates, overwrite)); return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    s = _shape_list(shape)
+
+    def fn(u):
+        z = jnp.zeros(s, u.dtype)
+        return z.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u)
+    ut = updates if isinstance(updates, Tensor) else to_tensor(updates)
+    return apply("scatter_nd", fn, (ut,))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(v, u):
+        return v.at[tuple(jnp.moveaxis(idx, -1, 0))].add(u.astype(v.dtype))
+    ut = updates if isinstance(updates, Tensor) else to_tensor(updates)
+    return apply("scatter_nd_add", fn, (_t(x), ut))
+
+
+def index_select(x, index, axis=0, name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    return apply("index_select", lambda v: jnp.take(v, idx, axis=axis), (_t(x),))
+
+
+def index_sample(x, index):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    return apply("index_sample",
+                 lambda v: jnp.take_along_axis(v, idx, axis=1), (_t(x),))
+
+
+def index_add(x, index, axis, value, name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(v, val):
+        perm_v = jnp.moveaxis(v, axis, 0)
+        perm_val = jnp.moveaxis(val.astype(v.dtype), axis, 0)
+        out = perm_v.at[idx].add(perm_val)
+        return jnp.moveaxis(out, 0, axis)
+    vt = value if isinstance(value, Tensor) else to_tensor(value)
+    return apply("index_add", fn, (_t(x), vt))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(i._value if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in indices)
+
+    def fn(v, val):
+        a = v.at[idx]
+        return a.add(val.astype(v.dtype)) if accumulate \
+            else a.set(val.astype(v.dtype))
+    vt = value if isinstance(value, Tensor) else to_tensor(value)
+    return apply("index_put", fn, (_t(x), vt))
+
+
+def index_fill(x, index, axis, value, name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(v):
+        perm_v = jnp.moveaxis(v, axis, 0)
+        out = perm_v.at[idx].set(jnp.asarray(value, v.dtype))
+        return jnp.moveaxis(out, 0, axis)
+    return apply("index_fill", fn, (_t(x),))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_list(repeat_times)
+    return apply("tile", lambda v: jnp.tile(v, reps), (_t(x),))
+
+
+def expand(x, shape, name=None):
+    s = _shape_list(shape)
+
+    def fn(v):
+        tgt = list(s)
+        # -1 means keep original dim
+        off = len(tgt) - v.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - off]
+        return jnp.broadcast_to(v, tgt)
+    return apply("expand", fn, (_t(x),))
+
+
+def expand_as(x, y, name=None):
+    tgt = tuple(_t(y)._value.shape)
+    return apply("expand_as", lambda v: jnp.broadcast_to(v, tgt), (_t(x),))
+
+
+def broadcast_to(x, shape, name=None):
+    s = tuple(_shape_list(shape))
+    return apply("broadcast_to", lambda v: jnp.broadcast_to(v, s), (_t(x),))
+
+
+def broadcast_tensors(input, name=None):
+    ts = tuple(_t(i) for i in input)
+    return apply("broadcast_tensors",
+                 lambda *vs: tuple(jnp.broadcast_arrays(*vs)), ts,
+                 multi_output=True)
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply("flip", lambda v: jnp.flip(v, axis=tuple(axes)), (_t(x),))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), (_t(x),))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = tuple(shifts) if isinstance(shifts, (list, tuple)) else shifts
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply("roll", lambda v: jnp.roll(v, sh, axis=ax), (_t(x),))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = repeats._value
+        total = int(np.asarray(reps).sum())
+        return apply("repeat_interleave",
+                     lambda v: jnp.repeat(v, reps, axis=axis,
+                                          total_repeat_length=total), (_t(x),))
+    return apply("repeat_interleave",
+                 lambda v: jnp.repeat(v, repeats, axis=axis), (_t(x),))
+
+
+def unbind(input, axis=0):
+    return unstack(input, axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    """Eager-only (data-dependent output shape): computed on host."""
+    xv = np.asarray(_t(x)._value)
+    out = np.unique(xv, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(out, tuple):
+        return Tensor(jnp.asarray(out))
+    res = [Tensor(jnp.asarray(o)) for o in out]
+    # paddle order: (out, index, inverse, counts)
+    return tuple(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    xv = np.asarray(_t(x)._value)
+    flat = xv.reshape(-1) if axis is None else xv
+    keep = np.ones(flat.shape[0] if axis is None else flat.shape[axis],
+                   dtype=bool)
+    if axis is None:
+        keep[1:] = flat[1:] != flat[:-1]
+        out = flat[keep]
+    else:
+        sl = np.moveaxis(flat, axis, 0)
+        keep[1:] = np.any(sl[1:] != sl[:-1],
+                          axis=tuple(range(1, sl.ndim)))
+        out = np.moveaxis(sl[keep], 0, axis)
+    res = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        res.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        cnt = np.diff(np.append(idx, keep.shape[0]))
+        res.append(Tensor(jnp.asarray(cnt.astype(np.int64))))
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def masked_select(x, mask, name=None):
+    """Eager-only (data-dependent output shape)."""
+    xv = np.asarray(_t(x)._value)
+    mv = np.asarray(_t(mask)._value)
+    return Tensor(jnp.asarray(xv[np.broadcast_to(mv, xv.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    m = _t(mask)._value
+    if isinstance(value, Tensor):
+        return apply("masked_fill",
+                     lambda v, val: jnp.where(m, val.astype(v.dtype), v),
+                     (_t(x), value))
+    return apply("masked_fill",
+                 lambda v: jnp.where(m, jnp.asarray(value, v.dtype), v),
+                 (_t(x),))
+
+
+def masked_scatter(x, mask, value, name=None):
+    xv = np.asarray(_t(x)._value)
+    mv = np.broadcast_to(np.asarray(_t(mask)._value), xv.shape)
+    vv = np.asarray(_t(value)._value).reshape(-1)
+    out = xv.copy()
+    out[mv] = vv[:mv.sum()]
+    return Tensor(jnp.asarray(out))
+
+
+def where(condition, x=None, y=None, name=None):
+    c = _t(condition)
+    if x is None and y is None:
+        return nonzero(c, as_tuple=True)
+    if isinstance(x, Tensor) and isinstance(y, Tensor):
+        return apply("where", lambda cc, a, b: jnp.where(cc, a, b), (c, x, y))
+    if isinstance(x, Tensor):
+        return apply("where", lambda cc, a: jnp.where(cc, a, y), (c, x))
+    if isinstance(y, Tensor):
+        return apply("where", lambda cc, b: jnp.where(cc, x, b), (c, y))
+    return apply("where", lambda cc: jnp.where(cc, x, y), (c,))
+
+
+def nonzero(x, as_tuple=False):
+    """Eager-only (data-dependent output shape)."""
+    xv = np.asarray(_t(x)._value)
+    nz = np.nonzero(xv)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.astype(np.int64))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..nn import functional as F
+    return F.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(v):
+        size = index_num // nshards
+        shard = v // size
+        return jnp.where(shard == shard_id, v % size, ignore_value)
+    return apply("shard_index", fn, (_t(input),))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = _shape_list(shape)
+    off = [int(o) for o in (offsets or [0] * len(s))]
+
+    def fn(v):
+        idx = tuple(_bi.slice(o, o + d if d != -1 else None)
+                    for o, d in zip(off, s))
+        return v[idx]
+    return apply("crop", fn, (_t(x),))
+
+
+def as_complex(x, name=None):
+    return apply("as_complex",
+                 lambda v: jax.lax.complex(v[..., 0], v[..., 1]), (_t(x),))
+
+
+def as_real(x, name=None):
+    return apply("as_real",
+                 lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1),
+                 (_t(x),))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    dt = dtypes.convert_dtype(shape_or_dtype)
+    return apply("view_dtype", lambda v: v.view(dt), (_t(x),))
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def fn(v):
+        flat = v.reshape(-1)
+        idx = np.zeros(tuple(shape), dtype=np.int64) + offset
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            ix = np.arange(s) * st
+            idx += ix.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        return flat[jnp.asarray(idx)]
+    return apply("as_strided", fn, (_t(x),))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, (_t(i),)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, (_t(i),)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, (_t(i),)) for i in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def hsplit(x, num_or_indices, name=None):
+    return apply("hsplit", lambda v: tuple(jnp.hsplit(v, num_or_indices)),
+                 (_t(x),), multi_output=True)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return apply("vsplit", lambda v: tuple(jnp.vsplit(v, num_or_indices)),
+                 (_t(x),), multi_output=True)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return apply("dsplit", lambda v: tuple(jnp.dsplit(v, num_or_indices)),
+                 (_t(x),), multi_output=True)
+
+
+def hstack(x, name=None):
+    ts = tuple(_t(i) for i in x)
+    return apply("hstack", lambda *vs: jnp.hstack(vs), ts)
+
+
+def vstack(x, name=None):
+    ts = tuple(_t(i) for i in x)
+    return apply("vstack", lambda *vs: jnp.vstack(vs), ts)
+
+
+def dstack(x, name=None):
+    ts = tuple(_t(i) for i in x)
+    return apply("dstack", lambda *vs: jnp.dstack(vs), ts)
+
+
+def row_stack(x, name=None):
+    return vstack(x)
+
+
+def column_stack(x, name=None):
+    ts = tuple(_t(i) for i in x)
+    return apply("column_stack", lambda *vs: jnp.column_stack(vs), ts)
+
+
+def unflatten(x, axis, shape, name=None):
+    s = _shape_list(shape)
+
+    def fn(v):
+        ax = axis % v.ndim
+        return v.reshape(v.shape[:ax] + tuple(s) + v.shape[ax + 1:])
+    return apply("unflatten", fn, (_t(x),))
+
+
+def unfold(x, axis, size, step, name=None):
+    def fn(v):
+        n = (v.shape[axis] - size) // step + 1
+        starts = jnp.arange(n) * step
+        sl = jnp.moveaxis(v, axis, 0)
+        win = jax.vmap(
+            lambda s: jax.lax.dynamic_slice_in_dim(sl, s, size, 0))(starts)
+        # win: (n, size, ...rest) -> (..., n at axis, ..., size last)
+        return jnp.moveaxis(jnp.moveaxis(win, 1, -1), 0, axis)
+    return apply("unfold", fn, (_t(x),))
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    def fn(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return apply("cdist", fn, (_t(x), _t(y)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    xv = _t(x)
+    n = int(np.asarray(xv._value).max()) + 1 if xv.size else 0
+    length = _bi.max(n, minlength)
+    if weights is not None:
+        return apply("bincount",
+                     lambda v, w: jnp.bincount(v, w, length=length),
+                     (xv, _t(weights)))
+    return apply("bincount", lambda v: jnp.bincount(v, length=length), (xv,))
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    iv = np.asarray(_t(input)._value)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (iv.min(), iv.max())
+    w = np.asarray(_t(weight)._value) if weight is not None else None
+    hist, _ = np.histogram(iv, bins=bins, range=(lo, hi), weights=w,
+                           density=density)
+    return Tensor(jnp.asarray(hist if density else hist.astype(np.int64)))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    xv = np.asarray(_t(x)._value)
+    w = np.asarray(_t(weights)._value) if weights is not None else None
+    hist, edges = np.histogramdd(xv, bins=bins, range=ranges, density=density,
+                                 weights=w)
+    return (Tensor(jnp.asarray(hist)),
+            [Tensor(jnp.asarray(e)) for e in edges])

@@ -1,0 +1,479 @@
+"""Math ops. ≙ reference «python/paddle/tensor/math.py» + PHI math kernels
+(SURVEY.md §2.1/§2.2 [U]); every op is a pure jnp/lax function executed
+through the eager tape (autograd via jax.vjp, no per-op grad code)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _unary(op_name, jfn):
+    def op(x, name=None):
+        return apply(op_name, jfn, (_t(x),))
+    op.__name__ = op_name
+    op.__qualname__ = op_name
+    op.__doc__ = (f"Elementwise {op_name}. "
+                  f"TPU-native equivalent of paddle.{op_name}.")
+    return op
+
+
+def _binary(op_name, jfn):
+    def op(x, y, name=None):
+        xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+        if xt and yt:
+            return apply(op_name, jfn, (x, y))
+        if xt:  # y is a python/numpy scalar: keep weak typing (no promotion)
+            return apply(op_name, lambda v: jfn(v, y), (x,))
+        if yt:
+            return apply(op_name, lambda v: jfn(x, v), (y,))
+        return apply(op_name, jfn, (_t(x), _t(y)))
+    op.__name__ = op_name
+    op.__qualname__ = op_name
+    op.__doc__ = f"Elementwise {op_name} with broadcasting."
+    return op
+
+
+# -- elementwise unary -------------------------------------------------------
+exp = _unary("exp", jnp.exp)
+expm1 = _unary("expm1", jnp.expm1)
+log = _unary("log", jnp.log)
+log2 = _unary("log2", jnp.log2)
+log10 = _unary("log10", jnp.log10)
+log1p = _unary("log1p", jnp.log1p)
+sqrt = _unary("sqrt", jnp.sqrt)
+rsqrt = _unary("rsqrt", lax.rsqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+sign = _unary("sign", jnp.sign)
+neg = _unary("neg", jnp.negative)
+negative = neg
+reciprocal = _unary("reciprocal", lambda v: 1.0 / v)
+sin = _unary("sin", jnp.sin)
+cos = _unary("cos", jnp.cos)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+acos = _unary("acos", jnp.arccos)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+cosh = _unary("cosh", jnp.cosh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+acosh = _unary("acosh", jnp.arccosh)
+atanh = _unary("atanh", jnp.arctanh)
+floor = _unary("floor", jnp.floor)
+ceil = _unary("ceil", jnp.ceil)
+round = _unary("round", jnp.round)
+trunc = _unary("trunc", jnp.trunc)
+frac = _unary("frac", lambda v: v - jnp.trunc(v))
+erf = _unary("erf", jax.scipy.special.erf)
+erfinv = _unary("erfinv", jax.scipy.special.erfinv)
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+logit = _unary("logit", jax.scipy.special.logit)
+digamma = _unary("digamma", jax.scipy.special.digamma)
+lgamma = _unary("lgamma", jax.scipy.special.gammaln)
+angle = _unary("angle", jnp.angle)
+conj = _unary("conj", jnp.conj)
+real = _unary("real", jnp.real)
+imag = _unary("imag", jnp.imag)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+exponent = None  # not in reference surface
+i0 = _unary("i0", jax.scipy.special.i0)
+i1 = _unary("i1", jax.scipy.special.i1)
+
+# -- elementwise binary ------------------------------------------------------
+add = _binary("add", jnp.add)
+subtract = _binary("subtract", jnp.subtract)
+multiply = _binary("multiply", jnp.multiply)
+divide = _binary("divide", jnp.divide)
+floor_divide = _binary("floor_divide", jnp.floor_divide)
+mod = _binary("mod", jnp.mod)
+remainder = mod
+floor_mod = mod
+pow = _binary("pow", jnp.power)
+maximum = _binary("maximum", jnp.maximum)
+minimum = _binary("minimum", jnp.minimum)
+fmax = _binary("fmax", jnp.fmax)
+fmin = _binary("fmin", jnp.fmin)
+atan2 = _binary("atan2", jnp.arctan2)
+hypot = _binary("hypot", jnp.hypot)
+logaddexp = _binary("logaddexp", jnp.logaddexp)
+heaviside = _binary("heaviside", jnp.heaviside)
+gcd = _binary("gcd", jnp.gcd)
+lcm = _binary("lcm", jnp.lcm)
+ldexp = _binary("ldexp", jnp.ldexp)
+copysign = _binary("copysign", jnp.copysign)
+nextafter = _binary("nextafter", jnp.nextafter)
+
+# bitwise (on ints/bools)
+bitwise_and = _binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = _binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _binary("bitwise_xor", jnp.bitwise_xor)
+bitwise_not = _unary("bitwise_not", jnp.bitwise_not)
+bitwise_left_shift = _binary("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _binary("bitwise_right_shift", jnp.right_shift)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """≙ paddle.scale."""
+    s, b = scale, bias
+    if bias_after_scale:
+        fn = lambda v: v * s + b
+    else:
+        fn = lambda v: (v + b) * s
+    out = apply("scale", fn, (_t(x),))
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return apply("clip", lambda v: jnp.clip(v, lo, hi), (_t(x),))
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply("lerp", lambda a, b, w: a + w * (b - a), (_t(x), _t(y), weight))
+    return apply("lerp", lambda a, b: a + weight * (b - a), (_t(x), _t(y)))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply("addmm",
+                 lambda i, a, b: beta * i + alpha * (a @ b),
+                 (_t(input), _t(x), _t(y)))
+
+
+def multiplex(inputs, index, name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    return apply("multiplex",
+                 lambda *vs: jnp.stack(vs, 0)[idx.reshape(-1),
+                                              jnp.arange(vs[0].shape[0])],
+                 tuple(_t(i) for i in inputs))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply("nan_to_num",
+                 lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                          neginf=neginf), (_t(x),))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda v: scale_b * jnp.tanh(scale_a * v), (_t(x),))
+
+
+def rsqrt_(x):
+    x._assign_inplace(rsqrt(x)); return x
+
+
+# -- reductions --------------------------------------------------------------
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(op_name, jfn, upcast_int=False):
+    def op(x, axis=None, keepdim=False, name=None):
+        x = _t(x)
+        ax = _axis_arg(axis)
+
+        def fn(v):
+            out = jfn(v, axis=ax, keepdims=keepdim)
+            if upcast_int and np.issubdtype(v.dtype, np.integer):
+                out = out.astype(jnp.int64 if v.dtype == jnp.int64 else jnp.int32)
+            return out
+        return apply(op_name, fn, (x,))
+    op.__name__ = op_name
+    return op
+
+
+sum = _reduce("sum", jnp.sum)
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)
+min = _reduce("min", jnp.min)
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+logsumexp_ = None
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply("logsumexp",
+                 lambda v: jax.scipy.special.logsumexp(v, axis=ax,
+                                                       keepdims=keepdim),
+                 (_t(x),))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply("all", lambda v: jnp.all(v, axis=ax, keepdims=keepdim), (_t(x),))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply("any", lambda v: jnp.any(v, axis=ax, keepdims=keepdim), (_t(x),))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    return apply("count_nonzero",
+                 lambda v: jnp.count_nonzero(v, axis=ax, keepdims=keepdim)
+                 .astype(jnp.int64), (_t(x),))
+
+
+# -- cumulative --------------------------------------------------------------
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype) if dtype is not None else None
+
+    def fn(v):
+        if axis is None:
+            v = v.reshape(-1)
+            return jnp.cumsum(v, dtype=dt)
+        return jnp.cumsum(v, axis=int(axis), dtype=dt)
+    return apply("cumsum", fn, (_t(x),))
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype) if dtype is not None else None
+    return apply("cumprod", lambda v: jnp.cumprod(v, axis=int(dim), dtype=dt),
+                 (_t(x),))
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    ax = 0 if axis is None else int(axis)
+    t = _t(x)
+    vals = apply("cummax_v", lambda v: lax.associative_scan(
+        jnp.maximum, v.reshape(-1) if axis is None else v, axis=ax), (t,))
+    idx = apply("cummax_i", lambda v: _running_argextreme(
+        v.reshape(-1) if axis is None else v, ax, jnp.greater).astype(
+            dtypes.convert_dtype(dtype)), (t,))
+    return vals, idx
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    ax = 0 if axis is None else int(axis)
+    t = _t(x)
+    vals = apply("cummin_v", lambda v: lax.associative_scan(
+        jnp.minimum, v.reshape(-1) if axis is None else v, axis=ax), (t,))
+    idx = apply("cummin_i", lambda v: _running_argextreme(
+        v.reshape(-1) if axis is None else v, ax, jnp.less).astype(
+            dtypes.convert_dtype(dtype)), (t,))
+    return vals, idx
+
+
+def _running_argextreme(v, axis, cmp):
+    n = v.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == axis % v.ndim else 1
+                                 for i in range(v.ndim)])
+    idx = jnp.broadcast_to(idx, v.shape)
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = cmp(bv, av)
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+    _, out_idx = lax.associative_scan(combine, (v, idx), axis=axis)
+    return out_idx
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def fn(v):
+        if axis is None:
+            v2 = v.reshape(-1)
+            return _logcumsumexp_impl(v2, 0)
+        return _logcumsumexp_impl(v, int(axis))
+    return apply("logcumsumexp", fn, (_t(x),))
+
+
+def _logcumsumexp_impl(v, axis):
+    def combine(a, b):
+        return jnp.logaddexp(a, b)
+    return lax.associative_scan(combine, v, axis=axis)
+
+
+# -- matmul family -----------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """≙ paddle.matmul → phi::MatmulKernel (SURVEY.md §3.1). Lowers straight
+    to XLA dot_general; bf16/fp16 operands hit the MXU natively."""
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply("matmul", fn, (_t(x), _t(y)))
+
+
+def mm(input, mat2, name=None):
+    return apply("mm", jnp.matmul, (_t(input), _t(mat2)))
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", jnp.matmul, (_t(x), _t(y)))
+
+
+def dot(x, y, name=None):
+    return apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), (_t(x), _t(y)))
+
+
+def inner(x, y, name=None):
+    return apply("inner", jnp.inner, (_t(x), _t(y)))
+
+
+def outer(x, y, name=None):
+    return apply("outer", lambda a, b: jnp.outer(a, b), (_t(x), _t(y)))
+
+
+def mv(x, vec, name=None):
+    return apply("mv", jnp.matmul, (_t(x), _t(vec)))
+
+
+def kron(x, y, name=None):
+    return apply("kron", jnp.kron, (_t(x), _t(y)))
+
+
+def cross(x, y, axis=9, name=None):
+    def fn(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply("cross", fn, (_t(x), _t(y)))
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace",
+                 lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2),
+                 (_t(x),))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal",
+                 lambda v: jnp.diagonal(v, offset=offset, axis1=axis1,
+                                        axis2=axis2), (_t(x),))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._value if isinstance(prepend, Tensor) else prepend
+    app = append._value if isinstance(append, Tensor) else append
+    return apply("diff",
+                 lambda v: jnp.diff(v, n=n, axis=axis, prepend=pre, append=app),
+                 (_t(x),))
+
+
+# -- misc --------------------------------------------------------------------
+def isfinite(x, name=None):
+    return apply("isfinite", jnp.isfinite, (_t(x),))
+
+
+def isinf(x, name=None):
+    return apply("isinf", jnp.isinf, (_t(x),))
+
+
+def isnan(x, name=None):
+    return apply("isnan", jnp.isnan, (_t(x),))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("isclose",
+                 lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan),
+                 (_t(x), _t(y)))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply("allclose",
+                 lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan),
+                 (_t(x), _t(y)))
+
+
+def equal_all(x, y, name=None):
+    return apply("equal_all", lambda a, b: jnp.array_equal(a, b),
+                 (_t(x), _t(y)))
+
+
+def increment(x, value=1.0, name=None):
+    out = apply("increment", lambda v: v + value, (x,))
+    x._assign_inplace(out)
+    return x
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def fn(v):
+        axes = tuple(i for i in range(v.ndim) if i != axis % v.ndim)
+        norms = jnp.sum(jnp.abs(v) ** p, axis=axes, keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+    return apply("renorm", fn, (_t(x),))
+
+
+def take(x, index, mode="raise", name=None):
+    idx = index._value if isinstance(index, Tensor) else jnp.asarray(index)
+    m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return apply("take", lambda v: jnp.take(v.reshape(-1), idx, mode=m), (_t(x),))
+
+
+def gammaln(x, name=None):
+    return lgamma(x)
+
+
+def polygamma(x, n, name=None):
+    return apply("polygamma",
+                 lambda v: jax.scipy.special.polygamma(n, v), (_t(x),))
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    xv = _t(x)
+    n = xv.shape[0]
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    idx = np.array(list(gen(range(n), r)), dtype=np.int32).reshape(-1, r)
+    return apply("combinations", lambda v: v[idx], (xv,))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply("vander",
+                 lambda v: jnp.vander(v, N=n, increasing=increasing), (_t(x),))
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply("trapezoid",
+                     lambda yy, xx: jnp.trapezoid(yy, xx, axis=axis),
+                     (_t(y), _t(x)))
+    return apply("trapezoid",
+                 lambda yy: jnp.trapezoid(yy, dx=dx if dx is not None else 1.0,
+                                          axis=axis), (_t(y),))
+
+
+def frexp(x, name=None):
+    return apply("frexp", lambda v: jnp.frexp(v), (_t(x),), multi_output=True)
+
+
+def signbit(x, name=None):
+    return apply("signbit", jnp.signbit, (_t(x),))

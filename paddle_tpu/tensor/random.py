@@ -1,0 +1,232 @@
+"""Random ops over a global stateful generator. ≙ reference
+«python/paddle/tensor/random.py» + CPU/GPU Generator [U].
+
+JAX PRNG is functional (explicit keys); Paddle's API is stateful. The bridge
+is a module-level `Generator` holding a jax PRNG key that is split per call —
+deterministic given `paddle_tpu.seed(n)`. NOTE: inside `jax.jit` tracing the
+split happens at trace time (randomness frozen into the compiled program);
+training-loop randomness (dropout) instead uses the RNG-state tracker in
+`paddle_tpu.distributed.fleet.meta_parallel` / `nn.functional.dropout`'s key
+plumbing, mirroring the reference's `get_rng_state_tracker` design."""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, apply, to_tensor
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+        self._seed = seed
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int):
+        self._key = jax.random.key(seed)
+        self._seed = seed
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def get_state(self):
+        return Tensor(jax.random.key_data(self._key))
+
+    def set_state(self, state):
+        data = state._value if isinstance(state, Tensor) else jnp.asarray(state)
+        self._key = jax.random.wrap_key_data(data)
+
+
+default_generator = Generator(0)
+
+
+def seed(s: int) -> Generator:
+    """≙ paddle.seed."""
+    return default_generator.manual_seed(int(s))
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(state_list):
+    default_generator.set_state(state_list[0])
+
+
+def _key():
+    return default_generator.next_key()
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._value) if isinstance(s, Tensor) else int(s)
+                 for s in shape)
+
+
+def _dt(dtype):
+    return dtypes.convert_dtype(dtype) if dtype is not None \
+        else dtypes.get_default_dtype()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    k = _key() if seed in (0, None) else jax.random.key(seed)
+    return Tensor(jax.random.uniform(k, _shape_arg(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(_key(), _shape_arg(shape), _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        out_shape = np.broadcast_shapes(np.shape(m), np.shape(s))
+        k = _key()
+        return Tensor(m + s * jax.random.normal(
+            k, out_shape, dtypes.get_default_dtype()))
+    sh = _shape_arg(shape if shape is not None else [1])
+    return Tensor(mean + std * jax.random.normal(
+        _key(), sh, dtypes.get_default_dtype()))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    k = _key() if seed in (0, None) else jax.random.key(seed)
+    return Tensor(mean + std * jax.random.normal(k, _shape_arg(shape),
+                                                 _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def standard_gamma(x, name=None):
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.gamma(_key(), xv))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(), _shape_arg(shape), low, high,
+                                     dtypes.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = dtypes.convert_dtype(dtype) if dtype is not None else x.dtype
+    return Tensor(jax.random.randint(_key(), tuple(x.shape), low, high, dt))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_key(), n).astype(
+        dtypes.convert_dtype(dtype)))
+
+
+def shuffle(x, name=None):
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.permutation(_key(), xv, axis=0))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(xv, 1e-30))
+    if replacement:
+        out = jax.random.categorical(_key(), logits, axis=-1,
+                                     shape=xv.shape[:-1] + (num_samples,))
+    else:
+        k = _key()
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(k, xv.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(_key(), xv).astype(xv.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x._value = jax.random.bernoulli(_key(), p, tuple(x.shape)).astype(
+        x._value.dtype)
+    return x
+
+
+def poisson(x, name=None):
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(_key(), xv).astype(xv.dtype))
+
+
+def binomial(count, prob, name=None):
+    cv = count._value if isinstance(count, Tensor) else jnp.asarray(count)
+    pv = prob._value if isinstance(prob, Tensor) else jnp.asarray(prob)
+    return Tensor(jax.random.binomial(_key(), cv.astype(jnp.float32),
+                                      pv).astype(jnp.int64))
+
+
+def exponential_(x, lam=1.0, name=None):
+    x._value = (jax.random.exponential(_key(), tuple(x.shape)) / lam).astype(
+        x._value.dtype)
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    x._value = (loc + scale * jax.random.cauchy(
+        _key(), tuple(x.shape))).astype(x._value.dtype)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    u = jax.random.uniform(_key(), tuple(x.shape))
+    x._value = (jnp.ceil(jnp.log1p(-u) / jnp.log1p(-probs))).astype(
+        x._value.dtype)
+    return x
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    x._value = jnp.exp(mean + std * jax.random.normal(
+        _key(), tuple(x.shape))).astype(x._value.dtype)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._value = (mean + std * jax.random.normal(
+        _key(), tuple(x.shape))).astype(x._value.dtype)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    k = _key() if seed in (0, None) else jax.random.key(seed)
+    x._value = jax.random.uniform(k, tuple(x.shape), x._value.dtype,
+                                  minval=min, maxval=max)
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jax.random.uniform(_key(), tuple(x.shape), dt))
+
+
+def randn_like(x, dtype=None, name=None):
+    dt = dtypes.convert_dtype(dtype) if dtype is not None else x._value.dtype
+    return Tensor(jax.random.normal(_key(), tuple(x.shape), dt))

@@ -1,0 +1,122 @@
+"""Search/sort ops. ≙ reference «python/paddle/tensor/search.py» [U]."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = dtypes.convert_dtype(dtype)
+
+    def fn(v):
+        if axis is None:
+            out = jnp.argmax(v.reshape(-1))
+            return out.reshape((1,) * v.ndim if keepdim else ()).astype(dt)
+        return jnp.argmax(v, axis=int(axis), keepdims=keepdim).astype(dt)
+    return apply("argmax", fn, (_t(x),))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = dtypes.convert_dtype(dtype)
+
+    def fn(v):
+        if axis is None:
+            out = jnp.argmin(v.reshape(-1))
+            return out.reshape((1,) * v.ndim if keepdim else ()).astype(dt)
+        return jnp.argmin(v, axis=int(axis), keepdims=keepdim).astype(dt)
+    return apply("argmin", fn, (_t(x),))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def fn(v):
+        out = jnp.argsort(v, axis=axis, stable=stable or descending,
+                          descending=descending)
+        return out.astype(jnp.int64)
+    return apply("argsort", fn, (_t(x),))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return apply("sort",
+                 lambda v: jnp.sort(v, axis=axis, stable=stable or descending,
+                                    descending=descending), (_t(x),))
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def fn(v):
+        ax = v.ndim - 1 if axis is None else axis % v.ndim
+        sl = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(sl, k)
+        else:
+            vals, idx = jax.lax.top_k(-sl, k)
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+    return apply("topk", fn, (_t(x),), multi_output=True)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+
+    def fn(seq, v):
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, v, side=side).astype(dt)
+        flat_seq = seq.reshape(-1, seq.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        out = jax.vmap(lambda s, vv: jnp.searchsorted(s, vv, side=side))(
+            flat_seq, flat_v)
+        return out.reshape(v.shape).astype(dt)
+    return apply("searchsorted", fn, (_t(sorted_sequence), _t(values)))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        sv = jnp.sort(v, axis=ax)
+        si = jnp.argsort(v, axis=ax).astype(jnp.int64)
+        vals = jnp.take(sv, k - 1, axis=ax)
+        idx = jnp.take(si, k - 1, axis=ax)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx
+    return apply("kthvalue", fn, (_t(x),), multi_output=True)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def fn(v):
+        ax = axis % v.ndim
+        sv = jnp.sort(jnp.moveaxis(v, ax, -1), axis=-1)
+        n = sv.shape[-1]
+        runs = jnp.cumsum(
+            jnp.concatenate([jnp.ones(sv.shape[:-1] + (1,), jnp.int32),
+                             (sv[..., 1:] != sv[..., :-1]).astype(jnp.int32)],
+                            axis=-1), axis=-1)
+        counts = jnp.sum(runs[..., :, None] == runs[..., None, :], axis=-1)
+        best = jnp.argmax(counts, axis=-1)
+        vals = jnp.take_along_axis(sv, best[..., None], axis=-1)[..., 0]
+        orig = jnp.moveaxis(v, ax, -1)
+        match = orig == vals[..., None]
+        idx = (n - 1) - jnp.argmax(jnp.flip(match, -1), axis=-1)
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, ax), jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int64)
+    out_v, out_i = apply("mode", fn, (_t(x),), multi_output=True)
+    return out_v, out_i

@@ -1,0 +1,99 @@
+"""Statistics ops. ≙ reference «python/paddle/tensor/stat.py» [U]."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, to_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _axis_arg(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    dd = 1 if unbiased else 0
+    return apply("var", lambda v: jnp.var(v, axis=ax, ddof=dd,
+                                          keepdims=keepdim), (_t(x),))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis_arg(axis)
+    dd = 1 if unbiased else 0
+    return apply("std", lambda v: jnp.std(v, axis=ax, ddof=dd,
+                                          keepdims=keepdim), (_t(x),))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis_arg(axis)
+
+    def fn(v):
+        if mode == "avg":
+            return jnp.median(v, axis=ax, keepdims=keepdim)
+        # mode='min': lower of the two middle values + its index
+        a = v.reshape(-1) if ax is None else jnp.moveaxis(v, ax, -1)
+        sv = jnp.sort(a, axis=-1)
+        si = jnp.argsort(a, axis=-1)
+        k = (a.shape[-1] - 1) // 2
+        vals, idx = sv[..., k], si[..., k].astype(jnp.int64)
+        if keepdim:
+            where = 0 if ax is None else ax
+            vals = jnp.expand_dims(vals, where) if ax is not None else \
+                vals.reshape((1,) * v.ndim)
+            idx = jnp.expand_dims(idx, where) if ax is not None else \
+                idx.reshape((1,) * v.ndim)
+        return vals, idx
+    if mode == "avg":
+        return apply("median", fn, (_t(x),))
+    return apply("median", fn, (_t(x),), multi_output=True)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis_arg(axis)
+    return apply("nanmedian",
+                 lambda v: jnp.nanmedian(v, axis=ax, keepdims=keepdim),
+                 (_t(x),))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axis_arg(axis)
+    qs = q.tolist() if isinstance(q, Tensor) else q
+    return apply("quantile",
+                 lambda v: jnp.quantile(v, jnp.asarray(qs), axis=ax,
+                                        keepdims=keepdim,
+                                        method=interpolation),
+                 (_t(x),))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    ax = _axis_arg(axis)
+    qs = q.tolist() if isinstance(q, Tensor) else q
+    return apply("nanquantile",
+                 lambda v: jnp.nanquantile(v, jnp.asarray(qs), axis=ax,
+                                           keepdims=keepdim,
+                                           method=interpolation), (_t(x),))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef",
+                 lambda v: jnp.corrcoef(v, rowvar=rowvar), (_t(x),))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = _t(fweights)._value if fweights is not None else None
+    aw = _t(aweights)._value if aweights is not None else None
+    return apply("cov",
+                 lambda v: jnp.cov(v, rowvar=rowvar,
+                                   ddof=1 if ddof else 0,
+                                   fweights=fw, aweights=aw), (_t(x),))

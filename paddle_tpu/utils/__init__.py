@@ -1,0 +1,28 @@
+from . import flags  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+
+
+def try_import(name: str):
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        return None
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = ""):
+    def deco(fn):
+        return fn
+    return deco
+
+
+def run_check():
+    """≙ paddle.utils.run_check: verify the device works end to end."""
+    import jax
+    import jax.numpy as jnp
+    d = jax.devices()[0]
+    x = jnp.ones((128, 128), jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    assert y.shape == (128, 128)
+    print(f"paddle_tpu works on {d.platform}:{d.device_kind}. "
+          f"{len(jax.devices())} device(s) available.")

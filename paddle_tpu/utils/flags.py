@@ -1,0 +1,74 @@
+"""Runtime flags. ≙ reference flags system (SURVEY.md §5: ~300 FLAGS_* via
+gflags-compatible C++ lib, env import, runtime get/set «paddle/phi/core/flags.cc»
+[U?]). TPU-native: a typed Python registry; flags that map to XLA behaviors
+set the corresponding jax config / XLA_FLAGS when applied."""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+
+@dataclass
+class FlagInfo:
+    name: str
+    default: Any
+    doc: str
+    type: type
+    on_set: Optional[Callable[[Any], None]] = None
+    value: Any = None
+
+
+_REGISTRY: dict[str, FlagInfo] = {}
+
+
+def define_flag(name: str, default, doc: str = "", on_set=None):
+    env = os.environ.get(name)
+    value = default
+    if env is not None:
+        t = type(default)
+        value = (env.lower() in ("1", "true", "yes") if t is bool
+                 else t(env))
+    _REGISTRY[name] = FlagInfo(name, default, doc, type(default), on_set, value)
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        if f not in _REGISTRY:
+            raise ValueError(f"unknown flag {f}")
+        out[f] = _REGISTRY[f].value
+    return out
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if k not in _REGISTRY:
+            raise ValueError(f"unknown flag {k}")
+        info = _REGISTRY[k]
+        info.value = info.type(v) if not isinstance(v, info.type) else v
+        if info.on_set:
+            info.on_set(info.value)
+
+
+def _set_debug_nans(v: bool):
+    import jax
+    jax.config.update("jax_debug_nans", v)
+
+
+# core flag set (subset of the reference's FLAGS_* that is meaningful on TPU)
+define_flag("FLAGS_check_nan_inf", False,
+            "Per-op NaN/Inf checking (jax_debug_nans underneath).",
+            on_set=_set_debug_nans)
+define_flag("FLAGS_use_autotune", True, "Let XLA autotune (no-op knob).")
+define_flag("FLAGS_embedding_deterministic", 1,
+            "Deterministic embedding grad (XLA scatter is deterministic).")
+define_flag("FLAGS_cudnn_deterministic", True,
+            "Determinism knob (TPU execution is deterministic by default).")
+define_flag("FLAGS_allocator_strategy", "auto_growth",
+            "Allocator strategy label (XLA BFC allocator underneath).")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.9,
+            "Maps to XLA_PYTHON_CLIENT_MEM_FRACTION at process start.")
+define_flag("FLAGS_log_level", 0, "Framework log verbosity.")

@@ -1,0 +1,5 @@
+"""paddle_tpu.vision — transforms + datasets + model zoo (subset).
+≙ reference «python/paddle/vision/» [U]. The DiT/SD3 north-star models live in
+paddle_tpu.models; this module provides the torchvision-like utility surface."""
+from . import transforms  # noqa: F401
+from .models import ResNet, resnet18, resnet50  # noqa: F401

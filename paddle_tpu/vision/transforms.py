@@ -1,0 +1,141 @@
+"""Vision transforms (numpy/host-side, feeding the DataLoader).
+≙ reference «python/paddle/vision/transforms/» [U]."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class BaseTransform:
+    def __call__(self, x):
+        return self._apply_image(x)
+
+    def _apply_image(self, x):
+        raise NotImplementedError
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32) / 255.0
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return to_tensor(arr)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(
+            img, np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        arr = (arr - self.mean.reshape(shape)) / self.std.reshape(shape)
+        return to_tensor(arr) if isinstance(img, Tensor) else arr
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        import jax
+        import jax.numpy as jnp
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        h_axis = 1 if chw else 0
+        out_shape = list(arr.shape)
+        out_shape[h_axis] = self.size[0]
+        out_shape[h_axis + 1] = self.size[1]
+        out = np.asarray(jax.image.resize(jnp.asarray(arr, jnp.float32),
+                                          out_shape, "bilinear"))
+        return to_tensor(out) if isinstance(img, Tensor) else out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        h_axis = 1 if chw else 0
+        h, w = arr.shape[h_axis], arr.shape[h_axis + 1]
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        sl = [slice(None)] * arr.ndim
+        sl[h_axis] = slice(i, i + th)
+        sl[h_axis + 1] = slice(j, j + tw)
+        out = arr[tuple(sl)]
+        return to_tensor(out) if isinstance(img, Tensor) else out
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.random() < self.prob:
+            arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+            out = arr[..., ::-1] if not chw else arr[:, :, ::-1]
+            out = np.ascontiguousarray(out)
+            return to_tensor(out) if isinstance(img, Tensor) else out
+        return img
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        h_axis = 1 if chw else 0
+        if self.padding:
+            p = self.padding
+            pads = [(0, 0)] * arr.ndim
+            pads[h_axis] = (p, p)
+            pads[h_axis + 1] = (p, p)
+            arr = np.pad(arr, pads)
+        h, w = arr.shape[h_axis], arr.shape[h_axis + 1]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        sl = [slice(None)] * arr.ndim
+        sl[h_axis] = slice(i, i + th)
+        sl[h_axis + 1] = slice(j, j + tw)
+        out = arr[tuple(sl)]
+        return to_tensor(out) if isinstance(img, Tensor) else out
+
+
+def to_tensor_fn(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)

@@ -1,0 +1,19 @@
+"""Test env: force CPU with 8 virtual devices BEFORE jax import.
+
+≙ the reference's fake custom_cpu device plugin strategy for testing the
+whole device/comm path without accelerator hardware (SURVEY.md §4
+«test/custom_runtime/»): every parallelism test must pass on this fake
+8-device mesh."""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+# this jaxlib's CPU matmul defaults to fast (bf16-ish) passes; tests compare
+# against NumPy, so force exact fp32 matmuls in the test env only
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")

@@ -1,0 +1,80 @@
+"""OpTest harness: NumPy-reference forward check + numeric-vs-autograd
+gradient check. ≙ reference «test/legacy_test/op_test.py» `OpTest` base class
+(SURVEY.md §4): per op — run kernel, compare vs NumPy reference; gradient
+check vs finite differences; dtype tolerance ladders."""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+TOL = {
+    "float32": dict(rtol=1e-5, atol=1e-6),
+    "float64": dict(rtol=1e-7, atol=1e-9),
+    "float16": dict(rtol=1e-2, atol=1e-3),
+    "bfloat16": dict(rtol=2e-2, atol=2e-2),
+}
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy(), dtype=np.float64) \
+            if x.dtype.name == "bfloat16" else x.numpy()
+    return np.asarray(x)
+
+
+def check_forward(op_fn, np_fn, inputs, dtype="float32", rtol=None, atol=None,
+                  **op_kwargs):
+    """Run op_fn on Tensors and np_fn on numpy arrays; assert allclose."""
+    tol = dict(TOL[dtype])
+    if rtol is not None:
+        tol["rtol"] = rtol
+    if atol is not None:
+        tol["atol"] = atol
+    t_in = [paddle.to_tensor(np.asarray(i, dtype)) for i in inputs]
+    out = op_fn(*t_in, **op_kwargs)
+    ref = np_fn(*[np.asarray(i, np.float64 if dtype != "float32"
+                             else np.float32) for i in inputs])
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(_np(o).astype(np.float64),
+                                   np.asarray(r, np.float64), **tol)
+    return outs
+
+
+def numeric_grad(fn, inputs, idx, delta=1e-3):
+    """Central finite differences of sum(fn(inputs)) w.r.t. inputs[idx]."""
+    inputs = [np.asarray(i, np.float64) for i in inputs]
+    x = inputs[idx]
+    grad = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        orig = x[i]
+        x[i] = orig + delta
+        hi = float(np.sum(fn(*inputs)))
+        x[i] = orig - delta
+        lo = float(np.sum(fn(*inputs)))
+        x[i] = orig
+        grad[i] = (hi - lo) / (2 * delta)
+        it.iternext()
+    return grad
+
+
+def check_grad(op_fn, np_fn, inputs, grad_inputs=None, dtype="float32",
+               rtol=5e-3, atol=5e-4, delta=1e-3, **op_kwargs):
+    """Autograd (tape) gradient vs numeric finite-difference gradient."""
+    t_in = [paddle.to_tensor(np.asarray(i, dtype), stop_gradient=False)
+            for i in inputs]
+    out = op_fn(*t_in, **op_kwargs)
+    loss = out.sum() if out.ndim > 0 else out
+    loss.backward()
+    check_idx = grad_inputs if grad_inputs is not None else range(len(inputs))
+    for idx in check_idx:
+        assert t_in[idx].grad is not None, f"no grad for input {idx}"
+        got = _np(t_in[idx].grad).astype(np.float64)
+        want = numeric_grad(np_fn, inputs, idx, delta)
+        np.testing.assert_allclose(got, want, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch for input {idx}")

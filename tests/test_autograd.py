@@ -1,0 +1,144 @@
+"""Autograd tape tests. ≙ reference eager backward tests [U]."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x * x  # y = x^3, dy/dx = 3x^2 = 12
+        y.backward()
+        assert abs(float(x.grad) - 12.0) < 1e-5
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_shared_subexpression(self):
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = x * x          # used twice
+        z = y + y
+        z.backward()
+        assert abs(float(x.grad) - 12.0) < 1e-5  # d(2x^2)/dx = 4x
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0])  # stop_gradient=True
+        z = (x * y).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient
+        z = (x * y).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+        assert y._node is None
+
+    def test_non_scalar_backward_with_grad(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 3
+        y.backward(paddle.to_tensor([1.0, 0.5]))
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 1.5])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        assert abs(float(x.grad) - 8.0) < 1e-5
+        with pytest.raises(RuntimeError):
+            y.backward()  # graph freed now
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(np.arange(6, np.float32).reshape(2, 3)
+                             if False else
+                             np.arange(6, dtype=np.float32).reshape(2, 3),
+                             stop_gradient=False)
+        a, b, c = paddle.split(x, 3, axis=1)
+        (a.sum() * 2 + c.sum()).backward()
+        want = np.array([[2, 0, 1], [2, 0, 1]], np.float32)
+        np.testing.assert_allclose(x.grad.numpy(), want)
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        h = x.register_hook(lambda g: g * 10)
+        (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [20.0])
+        h.remove()
+        x.clear_grad()
+        (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_inplace_iadd_tracks_grad(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        y += 1.0
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor(2.0, stop_gradient=False)
+        y = x * x
+        (g,) = paddle.grad(y, x)
+        assert abs(float(g) - 4.0) < 1e-5
+        assert x.grad is None  # .grad untouched
+
+    def test_grad_unused(self):
+        x = paddle.to_tensor(1.0, stop_gradient=False)
+        z = paddle.to_tensor(1.0, stop_gradient=False)
+        y = x * 2
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gz is None
+
+
+class TestPyLayer:
+    def test_custom_op(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = Double.apply(x)
+        np.testing.assert_allclose(y.numpy(), [2.0, 4.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_custom_op_chained(self):
+        class Square(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor
+                return grad * 2.0 * x
+
+        x = paddle.to_tensor(3.0, stop_gradient=False)
+        y = Square.apply(x) * 2  # y = 2x^2, dy/dx = 4x = 12
+        y.backward()
+        assert abs(float(x.grad) - 12.0) < 1e-5

@@ -1,0 +1,263 @@
+"""nn.Layer system + layers + functional tests. ≙ reference «test/nn/» [U]."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+rng = np.random.default_rng(0)
+
+
+class TestLayerSystem:
+    def test_parameters_and_naming(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        names = [n for n, _ in m.named_parameters()]
+        assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+        assert len(m.parameters()) == 4
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Linear(4, 4)
+        m2 = nn.Linear(4, 4)
+        m2.set_state_dict(m1.state_dict())
+        x = paddle.randn([2, 4])
+        np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_train_eval(self):
+        m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+        m.eval()
+        assert not m[1].training
+        x = paddle.ones([8, 4])
+        np.testing.assert_allclose(m[1](x).numpy(), 1.0)
+        m.train()
+        assert m[1].training
+
+    def test_hooks(self):
+        m = nn.Linear(2, 2)
+        calls = []
+        m.register_forward_pre_hook(lambda l, inp: calls.append("pre"))
+        m.register_forward_post_hook(lambda l, inp, out: calls.append("post"))
+        m(paddle.ones([1, 2]))
+        assert calls == ["pre", "post"]
+
+    def test_apply_and_to(self):
+        m = nn.Linear(2, 2)
+        m.to(dtype="bfloat16")
+        assert m.weight.dtype == paddle.bfloat16
+
+    def test_sublayers_containers(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll) == 3
+        ll.append(nn.Linear(2, 2))
+        assert len(list(ll.parameters())) == 8
+        ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+        assert "a" in ld
+
+
+class TestLayers:
+    def test_linear(self):
+        m = nn.Linear(3, 5)
+        x = paddle.randn([4, 3])
+        out = m(x)
+        assert out.shape == [4, 5]
+        want = x.numpy() @ m.weight.numpy() + m.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor([[1, 0, 3]]))
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_allclose(out.numpy()[0, 1], 0.0)
+
+    def test_layernorm_matches_numpy(self):
+        ln = nn.LayerNorm(8)
+        x = rng.normal(size=(2, 8)).astype(np.float32)
+        out = ln(paddle.to_tensor(x)).numpy()
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        want = (x - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_rmsnorm(self):
+        m = nn.RMSNorm(8)
+        x = rng.normal(size=(2, 8)).astype(np.float32)
+        out = m(paddle.to_tensor(x)).numpy()
+        want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_train_and_eval(self):
+        bn = nn.BatchNorm1D(4)
+        x = paddle.to_tensor(rng.normal(size=(16, 4)).astype(np.float32))
+        out = bn(x)
+        assert abs(out.numpy().mean()) < 1e-5
+        # running stats moved
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [16, 4]
+
+    def test_conv2d_shape_and_value(self):
+        conv = nn.Conv2D(3, 8, 3, padding=1)
+        x = paddle.randn([2, 3, 16, 16])
+        assert conv(x).shape == [2, 8, 16, 16]
+        # value check vs manual correlation for 1x1 kernel
+        c1 = nn.Conv2D(2, 3, 1, bias_attr=False)
+        xi = rng.normal(size=(1, 2, 4, 4)).astype(np.float32)
+        out = c1(paddle.to_tensor(xi)).numpy()
+        w = c1.weight.numpy()  # (3,2,1,1)
+        want = np.einsum("nchw,oc->nohw", xi, w[:, :, 0, 0])
+        np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_conv_transpose_shape(self):
+        m = nn.Conv2DTranspose(4, 2, 3, stride=2, padding=1)
+        x = paddle.randn([1, 4, 8, 8])
+        assert m(x).shape == [1, 2, 15, 15]
+
+    def test_pool(self):
+        x = paddle.to_tensor(
+            np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        mp = nn.MaxPool2D(2, 2)(x)
+        np.testing.assert_allclose(mp.numpy()[0, 0],
+                                   [[5, 7], [13, 15]])
+        ap = nn.AvgPool2D(2, 2)(x)
+        np.testing.assert_allclose(ap.numpy()[0, 0],
+                                   [[2.5, 4.5], [10.5, 12.5]])
+        aap = nn.AdaptiveAvgPool2D(1)(x)
+        np.testing.assert_allclose(aap.numpy()[0, 0, 0, 0], 7.5)
+
+    def test_activations(self):
+        x = paddle.to_tensor([-1.0, 0.0, 1.0])
+        np.testing.assert_allclose(nn.ReLU()(x).numpy(), [0, 0, 1])
+        assert nn.GELU()(x).shape == [3]
+        assert nn.Softmax()(x).numpy().sum() == pytest.approx(1.0, rel=1e-5)
+
+    def test_dropout_scaling(self):
+        paddle.seed(7)
+        x = paddle.ones([1000])
+        d = nn.Dropout(0.5)
+        out = d(x)
+        kept = out.numpy()[out.numpy() > 0]
+        np.testing.assert_allclose(kept, 2.0)  # upscale_in_train
+
+    def test_multihead_attention(self):
+        m = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 5, 16])
+        out = m(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.randn([2, 6, 16])
+        assert enc(x).shape == [2, 6, 16]
+
+    def test_lstm_gru(self):
+        lstm = nn.LSTM(4, 8, num_layers=1)
+        x = paddle.randn([2, 5, 4])
+        out, (h, c) = lstm(x)
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [1, 2, 8]
+        gru = nn.GRU(4, 8, direction="bidirect")
+        out2, h2 = gru(x)
+        assert out2.shape == [2, 5, 16]
+
+    def test_grad_flows_through_layer(self):
+        m = nn.Linear(3, 2)
+        x = paddle.randn([4, 3])
+        loss = m(x).sum()
+        loss.backward()
+        assert m.weight.grad is not None
+        assert m.weight.grad.shape == [3, 2]
+
+
+class TestFunctionalLoss:
+    def test_cross_entropy_vs_numpy(self):
+        logits = rng.normal(size=(8, 5)).astype(np.float32)
+        labels = rng.integers(0, 5, 8)
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels))
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        want = -np.log(p[np.arange(8), labels]).mean()
+        assert float(loss) == pytest.approx(want, rel=1e-4)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = rng.normal(size=(4, 3)).astype(np.float32)
+        labels = np.array([0, -100, 2, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels), ignore_index=-100)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        want = -np.log(p[[0, 2], [0, 2]]).mean()
+        assert float(loss) == pytest.approx(want, rel=1e-4)
+
+    def test_soft_label_and_smoothing(self):
+        logits = rng.normal(size=(4, 3)).astype(np.float32)
+        soft = np.float32(np.eye(3)[[0, 1, 2, 0]])
+        l1 = F.cross_entropy(paddle.to_tensor(logits),
+                             paddle.to_tensor(soft), soft_label=True)
+        l2 = F.cross_entropy(paddle.to_tensor(logits),
+                             paddle.to_tensor(np.array([0, 1, 2, 0])))
+        assert float(l1) == pytest.approx(float(l2), rel=1e-4)
+
+    def test_mse_l1(self):
+        a = rng.normal(size=(3, 3)).astype(np.float32)
+        b = rng.normal(size=(3, 3)).astype(np.float32)
+        assert float(F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b))) \
+            == pytest.approx(((a - b) ** 2).mean(), rel=1e-5)
+        assert float(F.l1_loss(paddle.to_tensor(a), paddle.to_tensor(b))) \
+            == pytest.approx(np.abs(a - b).mean(), rel=1e-5)
+
+    def test_bce_with_logits(self):
+        z = rng.normal(size=(6,)).astype(np.float32)
+        y = (rng.random(6) > 0.5).astype(np.float32)
+        got = float(F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(z), paddle.to_tensor(y)))
+        p = 1 / (1 + np.exp(-z))
+        want = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        assert got == pytest.approx(want, rel=1e-4)
+
+    def test_kl_div(self):
+        logp = np.log(np.float32([[0.3, 0.7], [0.5, 0.5]]))
+        t = np.float32([[0.4, 0.6], [0.2, 0.8]])
+        got = float(F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(t),
+                             reduction="sum"))
+        want = (t * (np.log(t) - logp)).sum()
+        assert got == pytest.approx(want, rel=1e-4)
+
+
+class TestAttentionFunctional:
+    def test_sdpa_matches_naive(self):
+        q = rng.normal(size=(2, 4, 2, 8)).astype(np.float32)
+        k = rng.normal(size=(2, 4, 2, 8)).astype(np.float32)
+        v = rng.normal(size=(2, 4, 2, 8)).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v))
+        # naive reference
+        qb = q.transpose(0, 2, 1, 3)
+        kb = k.transpose(0, 2, 1, 3)
+        vb = v.transpose(0, 2, 1, 3)
+        logits = qb @ kb.transpose(0, 1, 3, 2) / np.sqrt(8)
+        w = np.exp(logits - logits.max(-1, keepdims=True))
+        w = w / w.sum(-1, keepdims=True)
+        want = (w @ vb).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)
+
+    def test_sdpa_causal(self):
+        q = rng.normal(size=(1, 4, 1, 4)).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True)
+        assert out.shape == [1, 4, 1, 4]
+
+    def test_softmax_logsoftmax(self):
+        x = rng.normal(size=(3, 5)).astype(np.float32)
+        s = F.softmax(paddle.to_tensor(x), axis=-1).numpy()
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+        ls = F.log_softmax(paddle.to_tensor(x), axis=-1).numpy()
+        np.testing.assert_allclose(np.exp(ls), s, rtol=1e-4, atol=1e-6)

@@ -1,0 +1,221 @@
+"""Optimizer + LR scheduler + AMP tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.optimizer import (SGD, Adam, AdamW, Momentum, Adagrad,
+                                  RMSProp, Lamb, lr as lr_mod)
+
+rng = np.random.default_rng(0)
+
+
+def _quadratic_converges(opt_cls, lr=0.1, steps=60, tol=0.1, **kw):
+    """All optimizers must minimize ||x - c||^2."""
+    target = np.float32([1.0, -2.0, 3.0])
+    x = paddle.framework.Parameter(np.zeros(3, np.float32))
+    opt = opt_cls(learning_rate=lr, parameters=[x], **kw)
+    for _ in range(steps):
+        loss = ((x - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(((x - paddle.to_tensor(target)) ** 2).sum()) < tol, \
+        f"{opt_cls.__name__} failed to converge: x={x.numpy()}"
+
+
+class TestOptimizers:
+    def test_sgd(self):
+        _quadratic_converges(SGD, lr=0.1)
+
+    def test_momentum(self):
+        _quadratic_converges(Momentum, lr=0.05)
+
+    def test_adam(self):
+        _quadratic_converges(Adam, lr=0.3)
+
+    def test_adamw(self):
+        _quadratic_converges(AdamW, lr=0.3, weight_decay=0.0)
+
+    def test_adagrad(self):
+        _quadratic_converges(Adagrad, lr=1.0, steps=120, tol=0.5)
+
+    def test_rmsprop(self):
+        _quadratic_converges(RMSProp, lr=0.3, tol=0.3)
+
+    def test_lamb(self):
+        _quadratic_converges(Lamb, lr=0.15, steps=120, tol=0.3)
+
+    def test_adamw_decoupled_decay(self):
+        # with huge decay and zero grad-producing loss, params shrink
+        p = paddle.framework.Parameter(np.ones(2, np.float32))
+        opt = AdamW(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+        for _ in range(5):
+            (p * 0.0).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        assert np.all(p.numpy() < 1.0)
+
+    def test_master_weights_bf16(self):
+        p = paddle.framework.Parameter(
+            np.ones(4, np.float32)).astype("bfloat16")
+        p = paddle.framework.Parameter(p.numpy())
+        p._value = p._value.astype("bfloat16")
+        opt = AdamW(learning_rate=1e-3, parameters=[p], multi_precision=True)
+        (p.astype("float32") ** 2).sum().backward()
+        opt.step()
+        assert id(p) in opt._master_weights
+        assert opt._master_weights[id(p)].dtype == np.float32
+
+    def test_grad_clip_global_norm(self):
+        p = paddle.framework.Parameter(np.zeros(2, np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+        (p * 100.0).sum().backward()  # grad = [100, 100], norm ~141
+        opt.step()
+        # clipped to norm 1 -> update magnitude ~0.707 each
+        np.testing.assert_allclose(-p.numpy(),
+                                   [100 / np.sqrt(2 * 100 ** 2)] * 2,
+                                   rtol=1e-4)
+
+    def test_state_dict_roundtrip(self):
+        p = paddle.framework.Parameter(np.ones(2, np.float32))
+        opt = Adam(learning_rate=0.1, parameters=[p])
+        (p ** 2).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        p2 = paddle.framework.Parameter(np.ones(2, np.float32))
+        opt2 = Adam(learning_rate=0.1, parameters=[p2])
+        (p2 ** 2).sum().backward()
+        opt2.step()  # create accumulators
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == opt._step_count
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        s = lr_mod.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(s())
+            s.step()
+        assert lrs[0] == pytest.approx(0.1)
+        assert lrs[2] == pytest.approx(0.05)
+        assert lrs[4] == pytest.approx(0.025)
+
+    def test_cosine(self):
+        s = lr_mod.CosineAnnealingDecay(1.0, T_max=10)
+        assert s() == pytest.approx(1.0)
+        for _ in range(10):
+            s.step()
+        assert s() == pytest.approx(0.0, abs=1e-6)
+
+    def test_linear_warmup(self):
+        s = lr_mod.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0,
+                                end_lr=0.1)
+        assert s() == pytest.approx(0.0)
+        for _ in range(10):
+            s.step()
+        assert s() == pytest.approx(0.1)
+
+    def test_scheduler_with_optimizer(self):
+        p = paddle.framework.Parameter(np.ones(1, np.float32))
+        sched = lr_mod.StepDecay(0.1, step_size=1, gamma=0.1)
+        opt = SGD(learning_rate=sched, parameters=[p])
+        assert opt.get_lr() == pytest.approx(0.1)
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.01)
+
+    def test_noam_and_poly(self):
+        s = lr_mod.NoamDecay(d_model=512, warmup_steps=10, learning_rate=1.0)
+        v1 = s()
+        s.step()
+        s.step()
+        assert s() > v1  # rising during warmup
+        p = lr_mod.PolynomialDecay(0.1, decay_steps=10, end_lr=0.0)
+        for _ in range(10):
+            p.step()
+        assert p() == pytest.approx(0.0, abs=1e-6)
+
+
+class TestAMP:
+    def test_autocast_o1_matmul_bf16(self):
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            out = paddle.matmul(a, b)
+        assert out.dtype == paddle.bfloat16
+        out2 = paddle.matmul(a, b)
+        assert out2.dtype == np.float32
+
+    def test_autocast_black_list_kept_fp32(self):
+        a = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(level="O1"):
+            out = F.softmax(a)
+        assert out.dtype == np.float32
+
+    def test_grad_scaler_noop_path(self):
+        p = paddle.framework.Parameter(np.ones(2, np.float32))
+        opt = SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0)
+        loss = (p ** 2).sum()
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        # grad was 2*p*scale=4, unscaled to 2, update = 0.1*2
+        np.testing.assert_allclose(p.numpy(), 0.8, rtol=1e-5)
+
+    def test_decorate_o2(self):
+        m = nn.Linear(4, 4)
+        opt = AdamW(learning_rate=1e-3, parameters=m.parameters())
+        m, opt = paddle.amp.decorate(m, opt, level="O2", dtype="bfloat16")
+        assert m.weight.dtype == paddle.bfloat16
+        assert opt._multi_precision
+
+
+class TestTrainStep:
+    def test_compiled_matches_eager(self):
+        paddle.seed(0)
+        x = paddle.randn([16, 8])
+        y = paddle.randint(0, 3, [16])
+
+        def build():
+            paddle.seed(42)
+            m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 3))
+            o = AdamW(learning_rate=1e-2, parameters=m.parameters())
+            return m, o
+
+        # eager
+        m1, o1 = build()
+        losses_eager = []
+        for _ in range(4):
+            loss = F.cross_entropy(m1(x), y)
+            loss.backward()
+            o1.step()
+            o1.clear_grad()
+            losses_eager.append(float(loss))
+        # compiled
+        m2, o2 = build()
+        step = paddle.jit.TrainStep(
+            m2, o2, loss_fn=lambda m, a, b: F.cross_entropy(m(a), b))
+        losses_jit = [float(step(x, y)) for _ in range(4)]
+        np.testing.assert_allclose(losses_eager, losses_jit, rtol=2e-4,
+                                   atol=1e-5)
+
+    def test_to_static_function(self):
+        @paddle.jit.to_static
+        def f(a, b):
+            return a * b + a.exp()
+
+        x = paddle.randn([3, 3])
+        y = paddle.randn([3, 3])
+        want = x.numpy() * y.numpy() + np.exp(x.numpy())
+        np.testing.assert_allclose(f(x, y).numpy(), want, rtol=1e-5)
+
+    def test_to_static_layer(self):
+        m = nn.Linear(4, 2)
+        x = paddle.randn([3, 4])
+        want = m(x).numpy()
+        paddle.jit.to_static(m)
+        got = m(x).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5)

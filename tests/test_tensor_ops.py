@@ -1,0 +1,309 @@
+"""Op forward/grad parity vs NumPy (OpTest style, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_forward, check_grad
+
+rng = np.random.default_rng(42)
+
+
+class TestElementwise:
+    def test_add(self):
+        a, b = rng.normal(size=(3, 4)), rng.normal(size=(3, 4))
+        check_forward(paddle.add, np.add, [a, b])
+        check_grad(paddle.add, np.add, [a, b])
+
+    def test_add_broadcast(self):
+        a, b = rng.normal(size=(3, 4)), rng.normal(size=(4,))
+        check_forward(paddle.add, np.add, [a, b])
+
+    def test_sub_mul_div(self):
+        a = rng.normal(size=(2, 5))
+        b = rng.normal(size=(2, 5)) + 3.0
+        check_forward(paddle.subtract, np.subtract, [a, b])
+        check_forward(paddle.multiply, np.multiply, [a, b])
+        check_forward(paddle.divide, np.divide, [a, b])
+        check_grad(paddle.multiply, np.multiply, [a, b])
+        check_grad(paddle.divide, np.divide, [a, b])
+
+    def test_scalar_ops(self):
+        x = paddle.to_tensor([1.0, 2.0])
+        assert np.allclose((x + 1.5).numpy(), [2.5, 3.5])
+        assert np.allclose((2.0 * x).numpy(), [2.0, 4.0])
+        assert np.allclose((1.0 / x).numpy(), [1.0, 0.5])
+        assert (x + 1).dtype == np.float32  # no promotion from python scalar
+
+    def test_unary(self):
+        x = rng.uniform(0.1, 2.0, size=(3, 3))
+        for name in ["exp", "log", "sqrt", "tanh", "sin", "cos", "abs",
+                     "sigmoid", "square", "rsqrt", "log1p", "floor", "ceil"]:
+            np_fn = {"sigmoid": lambda v: 1 / (1 + np.exp(-v)),
+                     "square": np.square,
+                     "rsqrt": lambda v: 1 / np.sqrt(v)}.get(
+                name, getattr(np, name, None))
+            check_forward(getattr(paddle, name), np_fn, [x])
+
+    def test_unary_grads(self):
+        x = rng.uniform(0.5, 1.5, size=(2, 3))
+        check_grad(paddle.exp, np.exp, [x])
+        check_grad(paddle.tanh, np.tanh, [x])
+        check_grad(paddle.sqrt, np.sqrt, [x])
+
+    def test_pow_maximum_minimum(self):
+        a = rng.uniform(0.5, 2, (3, 3))
+        b = rng.uniform(0.5, 2, (3, 3))
+        check_forward(paddle.pow, np.power, [a, b])
+        check_forward(paddle.maximum, np.maximum, [a, b])
+        check_forward(paddle.minimum, np.minimum, [a, b])
+
+    def test_clip(self):
+        x = rng.normal(size=(4, 4))
+        check_forward(lambda t: paddle.clip(t, -0.5, 0.5),
+                      lambda v: np.clip(v, -0.5, 0.5), [x])
+
+
+class TestReduce:
+    def test_sum_mean(self):
+        x = rng.normal(size=(3, 4, 5))
+        check_forward(paddle.sum, np.sum, [x])
+        check_forward(lambda t: paddle.sum(t, axis=1),
+                      lambda v: np.sum(v, axis=1), [x])
+        check_forward(lambda t: paddle.mean(t, axis=[0, 2], keepdim=True),
+                      lambda v: np.mean(v, axis=(0, 2), keepdims=True), [x])
+        check_grad(paddle.sum, np.sum, [x])
+        check_grad(lambda t: paddle.mean(t, axis=1),
+                   lambda v: np.mean(v, axis=1), [x])
+
+    def test_max_min_prod(self):
+        x = rng.normal(size=(3, 4))
+        check_forward(lambda t: paddle.max(t, axis=1),
+                      lambda v: np.max(v, axis=1), [x])
+        check_forward(lambda t: paddle.min(t, axis=0),
+                      lambda v: np.min(v, axis=0), [x])
+        check_forward(lambda t: paddle.prod(t, axis=1),
+                      lambda v: np.prod(v, axis=1), [x])
+
+    def test_logsumexp_std_var(self):
+        x = rng.normal(size=(3, 4))
+        from scipy.special import logsumexp as np_lse
+        check_forward(lambda t: paddle.logsumexp(t, axis=1),
+                      lambda v: np_lse(v, axis=1), [x])
+        check_forward(lambda t: paddle.std(t, axis=1),
+                      lambda v: np.std(v, axis=1, ddof=1), [x])
+        check_forward(lambda t: paddle.var(t, axis=1, unbiased=False),
+                      lambda v: np.var(v, axis=1), [x])
+
+    def test_cumsum_cumprod(self):
+        x = rng.normal(size=(3, 4))
+        check_forward(lambda t: paddle.cumsum(t, axis=1),
+                      lambda v: np.cumsum(v, axis=1), [x])
+        check_forward(lambda t: paddle.cumprod(t, dim=0),
+                      lambda v: np.cumprod(v, axis=0), [x])
+
+
+class TestMatmul:
+    def test_matmul(self):
+        a, b = rng.normal(size=(3, 4)), rng.normal(size=(4, 5))
+        check_forward(paddle.matmul, np.matmul, [a, b])
+        check_grad(paddle.matmul, np.matmul, [a, b])
+
+    def test_matmul_transpose(self):
+        a, b = rng.normal(size=(4, 3)), rng.normal(size=(5, 4))
+        check_forward(
+            lambda x, y: paddle.matmul(x, y, transpose_x=True,
+                                       transpose_y=True),
+            lambda x, y: x.T @ y.T, [a, b])
+
+    def test_bmm_einsum(self):
+        a, b = rng.normal(size=(2, 3, 4)), rng.normal(size=(2, 4, 5))
+        check_forward(paddle.bmm, np.matmul, [a, b])
+        check_forward(lambda x, y: paddle.einsum("bij,bjk->bik", x, y),
+                      np.matmul, [a, b])
+
+    def test_dot_outer(self):
+        a, b = rng.normal(size=(5,)), rng.normal(size=(5,))
+        check_forward(paddle.dot, lambda x, y: np.sum(x * y), [a, b])
+        check_forward(paddle.outer, np.outer, [a, b])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = rng.normal(size=(2, 3, 4))
+        check_forward(lambda t: paddle.reshape(t, [6, 4]),
+                      lambda v: v.reshape(6, 4), [x])
+        check_forward(lambda t: paddle.transpose(t, [2, 0, 1]),
+                      lambda v: v.transpose(2, 0, 1), [x])
+        check_grad(lambda t: paddle.reshape(t, [24]),
+                   lambda v: v.reshape(24), [x])
+
+    def test_concat_stack_split(self):
+        a, b = rng.normal(size=(2, 3)), rng.normal(size=(2, 3))
+        check_forward(lambda x, y: paddle.concat([x, y], axis=0),
+                      lambda x, y: np.concatenate([x, y], 0), [a, b])
+        check_forward(lambda x, y: paddle.stack([x, y], axis=1),
+                      lambda x, y: np.stack([x, y], 1), [a, b])
+        x = rng.normal(size=(6, 4))
+        outs = paddle.split(paddle.to_tensor(np.float32(x)), 3, axis=0)
+        assert len(outs) == 3
+        np.testing.assert_allclose(outs[1].numpy(), x[2:4], rtol=1e-6)
+        outs = paddle.split(paddle.to_tensor(np.float32(x)), [1, 2, -1],
+                            axis=0)
+        assert outs[2].shape == [3, 4]
+
+    def test_squeeze_unsqueeze_tile(self):
+        x = rng.normal(size=(1, 3, 1, 4))
+        check_forward(lambda t: paddle.squeeze(t, axis=0),
+                      lambda v: np.squeeze(v, 0), [x])
+        check_forward(lambda t: paddle.unsqueeze(t, axis=[0, 2]),
+                      lambda v: np.expand_dims(np.expand_dims(v, 0), 2), [x])
+        y = rng.normal(size=(2, 3))
+        check_forward(lambda t: paddle.tile(t, [2, 2]),
+                      lambda v: np.tile(v, (2, 2)), [y])
+
+    def test_gather_scatter(self):
+        x = rng.normal(size=(5, 3))
+        idx = np.array([0, 2, 4])
+        check_forward(lambda t: paddle.gather(t, paddle.to_tensor(idx)),
+                      lambda v: v[idx], [x])
+        upd = np.float32(rng.normal(size=(3, 3)))
+        out = paddle.scatter(paddle.to_tensor(np.float32(x)),
+                             paddle.to_tensor(idx),
+                             paddle.to_tensor(upd))
+        want = x.astype(np.float32).copy()
+        want[idx] = upd
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-6)
+
+    def test_getitem_setitem(self):
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        np.testing.assert_allclose(x[1].numpy(), [4, 5, 6, 7])
+        np.testing.assert_allclose(x[:, 1:3].numpy(),
+                                   [[1, 2], [5, 6], [9, 10]])
+        x[0] = 0.0
+        np.testing.assert_allclose(x[0].numpy(), [0, 0, 0, 0])
+
+    def test_getitem_grad(self):
+        x = paddle.to_tensor(np.ones((3, 4), np.float32),
+                             stop_gradient=False)
+        y = x[1].sum()
+        y.backward()
+        want = np.zeros((3, 4))
+        want[1] = 1
+        np.testing.assert_allclose(x.grad.numpy(), want)
+
+    def test_where_masked_fill(self):
+        x = rng.normal(size=(3, 3))
+        cond = x > 0
+        out = paddle.where(paddle.to_tensor(cond),
+                           paddle.to_tensor(np.float32(x)),
+                           paddle.to_tensor(np.zeros((3, 3), np.float32)))
+        np.testing.assert_allclose(out.numpy(), np.where(cond, x, 0),
+                                   rtol=1e-6)
+
+    def test_flip_roll_pad(self):
+        x = rng.normal(size=(3, 4))
+        check_forward(lambda t: paddle.flip(t, axis=[1]),
+                      lambda v: np.flip(v, 1), [x])
+        check_forward(lambda t: paddle.roll(t, 1, axis=0),
+                      lambda v: np.roll(v, 1, 0), [x])
+
+
+class TestSearchSort:
+    def test_argmax_argsort_topk(self):
+        x = rng.normal(size=(4, 6))
+        check_forward(lambda t: paddle.argmax(t, axis=1),
+                      lambda v: np.argmax(v, 1), [x])
+        check_forward(lambda t: paddle.argsort(t, axis=1),
+                      lambda v: np.argsort(v, 1, kind="stable"), [x])
+        vals, idx = paddle.topk(paddle.to_tensor(np.float32(x)), 3, axis=1)
+        want = np.sort(x.astype(np.float32), 1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), want, rtol=1e-6)
+
+    def test_sort_unique(self):
+        x = np.array([3.0, 1.0, 2.0, 1.0])
+        check_forward(paddle.sort, np.sort, [x])
+        out = paddle.unique(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+
+    def test_nonzero_masked_select(self):
+        x = np.array([[1.0, 0.0], [0.0, 2.0]])
+        nz = paddle.nonzero(paddle.to_tensor(x))
+        np.testing.assert_array_equal(nz.numpy(), [[0, 0], [1, 1]])
+        ms = paddle.masked_select(paddle.to_tensor(x),
+                                  paddle.to_tensor(x > 0))
+        np.testing.assert_allclose(ms.numpy(), [1.0, 2.0])
+
+
+class TestLinalg:
+    def test_norm_det_inv(self):
+        x = rng.normal(size=(3, 3)) + 3 * np.eye(3)
+        check_forward(paddle.linalg.det, np.linalg.det, [x], rtol=1e-4)
+        check_forward(paddle.linalg.inv, np.linalg.inv, [x], rtol=1e-4)
+        check_forward(lambda t: paddle.norm(t),
+                      lambda v: np.sqrt((v * v).sum()), [x])
+
+    def test_svd_qr_cholesky(self):
+        a = rng.normal(size=(4, 3))
+        s_got = paddle.linalg.svdvals(paddle.to_tensor(np.float32(a)))
+        s_want = np.linalg.svd(a, compute_uv=False)
+        np.testing.assert_allclose(s_got.numpy(), s_want, rtol=1e-4)
+        spd = a.T @ a + 3 * np.eye(3)
+        l = paddle.linalg.cholesky(paddle.to_tensor(np.float32(spd)))
+        np.testing.assert_allclose(l.numpy() @ l.numpy().T, spd, rtol=1e-3)
+
+    def test_solve_eigh(self):
+        a = rng.normal(size=(3, 3)) + 3 * np.eye(3)
+        b = rng.normal(size=(3, 2))
+        check_forward(paddle.linalg.solve, np.linalg.solve, [a, b], rtol=1e-4)
+        sym = (a + a.T) / 2
+        w, v = paddle.linalg.eigh(paddle.to_tensor(np.float32(sym)))
+        w_want = np.linalg.eigvalsh(sym)
+        np.testing.assert_allclose(np.sort(w.numpy()), np.sort(w_want),
+                                   rtol=1e-4)
+
+
+class TestCreation:
+    def test_creation_ops(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], "int64").dtype == np.int64
+        assert np.allclose(paddle.full([2, 2], 7.0).numpy(), 7.0)
+        assert np.allclose(paddle.arange(5).numpy(), np.arange(5))
+        assert np.allclose(paddle.linspace(0, 1, 5).numpy(),
+                           np.linspace(0, 1, 5))
+        assert np.allclose(paddle.eye(3).numpy(), np.eye(3))
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert np.allclose(paddle.zeros_like(x).numpy(), 0)
+        assert np.allclose(paddle.tril(x).numpy(), np.tril(x.numpy()))
+
+    def test_dtype_semantics(self):
+        assert paddle.to_tensor([1.0, 2.0]).dtype == np.float32
+        assert paddle.to_tensor([1, 2]).dtype in (np.int32, np.int64)
+        assert paddle.to_tensor(np.float64([1.0])).dtype == np.float32
+        x32 = paddle.ones([2], "float32")
+        x16 = paddle.ones([2], "bfloat16")
+        assert (x32 + x16).dtype == np.float32  # promotion
+
+    def test_one_hot(self):
+        x = paddle.to_tensor([0, 2, 1])
+        oh = paddle.one_hot(x, 3)
+        np.testing.assert_allclose(oh.numpy(), np.eye(3)[[0, 2, 1]])
+
+
+class TestRandom:
+    def test_seed_reproducible(self):
+        paddle.seed(123)
+        a = paddle.randn([4, 4]).numpy()
+        paddle.seed(123)
+        b = paddle.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_distributions(self):
+        paddle.seed(0)
+        u = paddle.uniform([1000], min=0.0, max=1.0).numpy()
+        assert 0.4 < u.mean() < 0.6
+        n = paddle.normal(0.0, 1.0, [1000]).numpy()
+        assert abs(n.mean()) < 0.2
+        r = paddle.randint(0, 10, [100]).numpy()
+        assert r.min() >= 0 and r.max() < 10
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
