@@ -266,7 +266,8 @@ class TrainStep:
         lr = np.float32(opt.get_lr()) if opt else np.float32(0.0)
         key = default_generator._key
         arg_vals = _tensors_to_values(list(args))
-        step_count = (opt._step_count + 1) if opt else 1
+        # pass the PRE-step count; opt.step() increments it inside the trace
+        step_count = opt._step_count if opt else 0
         (new_p, new_b, new_acc, new_master, out_key, loss_val,
          aux_vals) = self._jitted(
             [p._value for p in self._params],
@@ -284,7 +285,7 @@ class TrainStep:
             opt._master_weights = {
                 id(self._params[i]): arr
                 for i, arr in new_master.items()}
-            opt._step_count = step_count
+            opt._step_count = step_count + 1
             if hasattr(opt._learning_rate, "step"):
                 pass  # user drives scheduler.step() as in the reference
         default_generator._key = out_key
