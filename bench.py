@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Benchmark: Llama causal-LM training step on the attached TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is MFU / 0.40 — the BASELINE.json north-star target MFU
+(no published reference numbers exist; see BASELINE.md).
+
+Model size is chosen to exercise the chip seriously while fitting one
+v5e (≈16 GiB HBM) with AdamW fp32 state: ~340M params, bf16 compute.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         synthetic_lm_batch)
+    from paddle_tpu.optimizer import AdamW
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform != "cpu"
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=2048)
+        batch, seq, steps = 8, 2048, 20
+    else:  # CI / no chip: tiny sanity config
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps = 2, 128, 3
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    # norms stay bf16-safe (they compute in fp32 internally)
+    opt = AdamW(learning_rate=3e-4, parameters=model.parameters(),
+                weight_decay=0.01, multi_precision=True)
+    ids, labels = synthetic_lm_batch(batch, seq, cfg.vocab_size)
+
+    step = paddle.jit.TrainStep(
+        model, opt, loss_fn=lambda m, x, y: m(x, labels=y)[0])
+
+    # warmup / compile
+    loss = step(ids, labels)
+    jax.block_until_ready(loss._value)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    jax.block_until_ready(loss._value)
+    dt = (time.perf_counter() - t0) / steps
+
+    n_params = cfg.num_params()
+    tokens = batch * seq
+    # standard 6ND approximation + attention term
+    attn_flops = (12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+                  * tokens)
+    flops_per_step = 6.0 * n_params * tokens + attn_flops
+    achieved = flops_per_step / dt
+
+    peak = {"TPU v5 lite": 394e12, "TPU v5e": 394e12,
+            "TPU v5p": 459e12, "TPU v4": 275e12}.get(
+        str(dev.device_kind), 394e12 if on_tpu else 1e12)
+    mfu = achieved / peak
+    tok_per_sec = tokens / dt
+
+    print(json.dumps({
+        "metric": "llama_train_mfu" if on_tpu else "llama_train_mfu_cpu_ci",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "detail": {
+            "device": str(dev.device_kind),
+            "params": n_params,
+            "batch": batch, "seq": seq,
+            "step_time_s": round(dt, 4),
+            "tokens_per_sec_per_chip": round(tok_per_sec, 1),
+            "loss": float(loss),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -54,16 +54,30 @@ def get_default_dtype():
 
 
 def convert_dtype(d) -> np.dtype:
-    """Normalize any dtype-like (str, np/jnp dtype, Tensor dtype) to np.dtype."""
+    """Normalize any dtype-like (str, np/jnp dtype, Tensor dtype) to np.dtype.
+
+    TPU-native deviation from the reference: 64-bit dtypes canonicalize to
+    32-bit when jax x64 mode is off (the default) — int64 indices are an
+    anti-pattern on TPU (VPU lanes are 32-bit). Set JAX_ENABLE_X64=1 to get
+    true 64-bit semantics."""
     if d is None:
         return _default_dtype
     if isinstance(d, str):
-        if d in _ALIASES:
-            return _ALIASES[d]
-        return np.dtype(d)
-    if isinstance(d, np.dtype):
-        return d
-    return np.dtype(d)
+        d = _ALIASES.get(d) or np.dtype(d)
+    elif not isinstance(d, np.dtype):
+        d = np.dtype(d)
+    import jax
+    if not jax.config.jax_enable_x64:
+        d = _X64_DOWN.get(d, d)
+    return d
+
+
+_X64_DOWN = {
+    np.dtype(np.int64): np.dtype(np.int32),
+    np.dtype(np.uint64): np.dtype(np.uint32),
+    np.dtype(np.float64): np.dtype(np.float32),
+    np.dtype(np.complex128): np.dtype(np.complex64),
+}
 
 
 def is_floating(d) -> bool:

@@ -1,0 +1,38 @@
+"""paddle_tpu.distributed — mesh-based parallelism over ICI/DCN.
+≙ reference «python/paddle/distributed/» (SURVEY.md §2.3)."""
+from .parallel import (init_parallel_env, get_rank, get_world_size,  # noqa: F401
+                       is_initialized, is_available, ParallelEnv)
+from .mesh import (ProcessMesh, Placement, Shard, Replicate, Partial,  # noqa: F401
+                   ReduceType, shard_tensor, reshard, shard_layer,
+                   dtensor_from_local, local_map, create_mesh, get_mesh,
+                   set_mesh, use_mesh, shard_constraint)
+from .collective import (ReduceOp, Group, new_group, all_reduce,  # noqa: F401
+                         all_gather, all_gather_object, reduce_scatter,
+                         broadcast, reduce, scatter, alltoall,
+                         alltoall_single, send, recv, barrier,
+                         destroy_process_group, get_backend, get_group)
+from .random_ import get_rng_state_tracker  # noqa: F401
+from . import fleet  # noqa: F401
+from .fleet import DataParallel  # noqa: F401
+
+
+def __getattr__(name):
+    import importlib
+    if name in ("checkpoint", "launch", "sharding", "auto_parallel"):
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """≙ paddle.distributed.spawn. On TPU the runtime is single-process per
+    host; spawn just calls func (the mesh provides parallelism)."""
+    func(*args)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    raise NotImplementedError(
+        "paddle.distributed.split: use fleet.meta_parallel "
+        "Column/RowParallelLinear / VocabParallelEmbedding placements.")

@@ -1,0 +1,257 @@
+"""fleet — hybrid-parallel API. ≙ reference «python/paddle/distributed/fleet/»
+(SURVEY.md §2.3/§3.2): `fleet.init(strategy)`, `DistributedStrategy`,
+`HybridCommunicateGroup`, `distributed_model`, `distributed_optimizer`.
+
+TPU-native: instead of building NCCL process groups per axis, `init` builds
+ONE jax mesh with named axes (pp, dp, sharding, sep, mp) — sub-"groups" are
+just axis names; DP/sharding/TP/SP compose as GSPMD shardings inside the
+single compiled train step, and 1F1B pipeline runs as a shard_map schedule
+(meta_parallel.PipelineParallel)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+
+from ...core.tensor import Parameter, Tensor
+from ..mesh import (ProcessMesh, Replicate, Shard, create_mesh, get_mesh,
+                    set_mesh, shard_tensor)
+from ..collective import Group
+from ..random_ import get_rng_state_tracker, model_parallel_random_seed  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
+                            VocabParallelEmbedding, ParallelCrossEntropy,
+                            PipelineLayer, LayerDesc, SharedLayerDesc)
+
+
+class DistributedStrategy:
+    """≙ fleet.base.distributed_strategy.DistributedStrategy (protobuf of
+    toggles in the reference [U]); a plain typed config here."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline_configs = {"accumulate_steps": 1}
+        self.find_unused_parameters = False
+
+    def __repr__(self):
+        return f"DistributedStrategy({self.hybrid_configs})"
+
+
+class HybridCommunicateGroup:
+    """≙ «.../fleet/base/topology.py» HybridCommunicateGroup: axis handles
+    over the one global mesh."""
+
+    AXES = ("pp", "dp", "sharding", "sep", "mp")
+
+    def __init__(self, strategy: DistributedStrategy):
+        cfg = strategy.hybrid_configs
+        degrees = {
+            "pp": cfg.get("pp_degree", 1),
+            "dp": cfg.get("dp_degree", 1),
+            "sharding": cfg.get("sharding_degree", 1),
+            "sep": cfg.get("sep_degree", 1),
+            "mp": cfg.get("mp_degree", 1),
+        }
+        n_dev = len(jax.devices())
+        used = int(np.prod(list(degrees.values())))
+        if used > n_dev:
+            raise ValueError(
+                f"hybrid degrees {degrees} need {used} devices, "
+                f"have {n_dev}")
+        # absorb leftover devices into dp
+        if used < n_dev and n_dev % used == 0 and degrees["dp"] == 1 \
+                and cfg.get("dp_degree", 1) == 1:
+            degrees["dp"] = n_dev // used
+        self.degrees = degrees
+        self.mesh = create_mesh({a: degrees[a] for a in self.AXES})
+        set_mesh(self.mesh)
+
+    # group handles (axis views)
+    def get_data_parallel_group(self) -> Group:
+        return Group(self.mesh, "dp")
+
+    def get_model_parallel_group(self) -> Group:
+        return Group(self.mesh, "mp")
+
+    def get_pipe_parallel_group(self) -> Group:
+        return Group(self.mesh, "pp")
+
+    def get_sharding_parallel_group(self) -> Group:
+        return Group(self.mesh, "sharding")
+
+    def get_sep_parallel_group(self) -> Group:
+        return Group(self.mesh, "sep")
+
+    def get_data_parallel_world_size(self) -> int:
+        return self.degrees["dp"]
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.degrees["mp"]
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.degrees["pp"]
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self.degrees["sharding"]
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self.degrees["sep"]
+
+    # single-controller: ranks are global views
+    def get_data_parallel_rank(self) -> int:
+        return 0
+
+    def get_model_parallel_rank(self) -> int:
+        return 0
+
+    def get_stage_id(self) -> int:
+        return 0
+
+    def topology(self):
+        return self
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(is_collective: bool = True, strategy: DistributedStrategy | None = None,
+         role_maker=None):
+    """≙ fleet.init (SURVEY.md §3.2)."""
+    global _hcg, _strategy
+    from .. import parallel
+    parallel.init_parallel_env()
+    _strategy = strategy or DistributedStrategy()
+    _hcg = HybridCommunicateGroup(_strategy)
+    return _hcg
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    if _hcg is None:
+        raise RuntimeError("call fleet.init() first")
+    return _hcg
+
+
+def fleet_initialized() -> bool:
+    return _hcg is not None
+
+
+def worker_index() -> int:
+    return jax.process_index()
+
+
+def worker_num() -> int:
+    return jax.process_count()
+
+
+def distributed_model(model):
+    """≙ fleet.distributed_model: place every parameter on the mesh.
+    TP layers (Column/RowParallelLinear…) carry their own placements;
+    everything else is replicated over mp/pp and (ZeRO) sharded over the
+    sharding axis on dim 0 when divisible."""
+    hcg = get_hybrid_communicate_group()
+    mesh = hcg.mesh
+    shard_deg = hcg.get_sharding_parallel_world_size()
+    for name, p in model.named_parameters():
+        if getattr(p, "dist_attr", None) is not None:
+            continue  # TP layer already annotated
+        placements = [Replicate() for _ in mesh.dim_names]
+        if shard_deg > 1 and p._value.ndim > 0 and \
+                p._value.shape[0] % shard_deg == 0:
+            placements[mesh.dim_names.index("sharding")] = Shard(0)
+        sharded = shard_tensor(p, mesh, placements)
+        p._value = sharded._value
+        p.dist_attr = sharded.dist_attr
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """≙ fleet.distributed_optimizer → HybridParallelOptimizer: optimizer
+    state inherits each parameter's placement (ZeRO-1 falls out of the
+    sharding-axis placement + GSPMD)."""
+    orig_acc = optimizer._acc
+
+    def _acc(name, p, init=None, dtype=None):
+        store = optimizer._accumulators.setdefault(name, {})
+        k = id(p)
+        created = k not in store
+        out = orig_acc(name, p, init=init, dtype=dtype)
+        if created and hasattr(p._value, "sharding") and \
+                not isinstance(out, jax.core.Tracer):
+            try:
+                out = jax.device_put(out, p._value.sharding)
+                store[k] = out
+            except Exception:
+                pass
+        return out
+    optimizer._acc = _acc
+
+    orig_master = optimizer._master
+
+    def _master(p):
+        k = id(p)
+        created = k not in optimizer._master_weights
+        out = orig_master(p)
+        if created and hasattr(p._value, "sharding") and \
+                not isinstance(out, jax.core.Tracer):
+            try:
+                out = jax.device_put(out, p._value.sharding)
+                optimizer._master_weights[k] = out
+            except Exception:
+                pass
+        return out
+    optimizer._master = _master
+    return optimizer
+
+
+class DataParallel:
+    """≙ paddle.DataParallel wrapper + C++ Reducer
+    («.../collective/reducer.cc» [U]). On TPU there is no bucketed
+    allreduce to write: with params replicated over dp and the batch
+    sharded over dp, XLA's gradient psum IS the fused, overlapped
+    allreduce. This wrapper shards inputs and places params."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        self._layers = layers
+        if not fleet_initialized():
+            init()
+        distributed_model(layers)
+        self.mesh = get_hybrid_communicate_group().mesh
+
+    def __call__(self, *args, **kwargs):
+        sharded = []
+        for a in args:
+            if isinstance(a, Tensor) and a.ndim > 0:
+                placements = [Replicate() for _ in self.mesh.dim_names]
+                placements[self.mesh.dim_names.index("dp")] = Shard(0)
+                sharded.append(shard_tensor(a, self.mesh, placements,
+                                            stop_gradient=a.stop_gradient))
+            else:
+                sharded.append(a)
+        return self._layers(*sharded, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

@@ -1,0 +1,311 @@
+"""Megatron-style parallel layers + pipeline segmentation.
+≙ reference «.../fleet/layers/mpu/mp_layers.py» (ColumnParallelLinear,
+RowParallelLinear, VocabParallelEmbedding, ParallelCrossEntropy),
+«.../fleet/meta_parallel/parallel_layers/pp_layers.py» (PipelineLayer,
+LayerDesc) — SURVEY.md §2.3 TP/PP rows.
+
+TPU-native: a TP layer is its weight's GSPMD placement. Column = shard the
+output dim over 'mp'; Row = shard the input dim; XLA then partitions the
+matmuls and inserts the identity/allreduce pattern the reference codes by
+hand (c_identity fwd / allreduce bwd etc.). No mp_ops module is needed —
+those collectives exist only inside the compiled program."""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from ...core.tensor import Parameter, Tensor, apply
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer, LayerList
+from ..mesh import (ProcessMesh, Replicate, Shard, get_mesh, shard_tensor,
+                    shard_constraint)
+
+
+def _mp_mesh():
+    from . import get_hybrid_communicate_group, fleet_initialized
+    if fleet_initialized():
+        return get_hybrid_communicate_group().mesh
+    return get_mesh()
+
+
+def _placements(mesh, **axis_to_dim):
+    pl = [Replicate() for _ in mesh.dim_names]
+    for axis, dim in axis_to_dim.items():
+        if axis in mesh.dim_names:
+            pl[mesh.dim_names.index(axis)] = Shard(dim)
+    return pl
+
+
+class ColumnParallelLinear(Layer):
+    """weight (in, out) with out sharded over 'mp'.
+    ≙ mp_layers.ColumnParallelLinear [U]."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(
+            (out_features,), is_bias=True) if has_bias else None
+        mesh = _mp_mesh()
+        if mesh is not None:
+            w = shard_tensor(self.weight, mesh, _placements(mesh, mp=1))
+            self.weight._value = w._value
+            self.weight.dist_attr = w.dist_attr
+            if self.bias is not None:
+                b = shard_tensor(self.bias, mesh, _placements(mesh, mp=0))
+                self.bias._value = b._value
+                self.bias.dist_attr = b.dist_attr
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        mesh = _mp_mesh()
+        if mesh is not None and not self.gather_output:
+            # keep activation sharded on the feature dim
+            axes = [None] * (out.ndim - 1) + ["mp"]
+            out_v = shard_constraint(out._value, *axes, mesh=mesh)
+            res = Tensor(out_v, stop_gradient=out.stop_gradient)
+            res._node, res._out_index = out._node, out._out_index
+            return res
+        return out
+
+
+class RowParallelLinear(Layer):
+    """weight (in, out) with in sharded over 'mp'.
+    ≙ mp_layers.RowParallelLinear [U]."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(
+            (out_features,), is_bias=True) if has_bias else None
+        mesh = _mp_mesh()
+        if mesh is not None:
+            w = shard_tensor(self.weight, mesh, _placements(mesh, mp=0))
+            self.weight._value = w._value
+            self.weight.dist_attr = w.dist_attr
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class VocabParallelEmbedding(Layer):
+    """embedding table sharded over vocab dim.
+    ≙ mp_layers.VocabParallelEmbedding [U]."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        mesh = _mp_mesh()
+        if mesh is not None:
+            w = shard_tensor(self.weight, mesh, _placements(mesh, mp=0))
+            self.weight._value = w._value
+            self.weight.dist_attr = w.dist_attr
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ParallelCrossEntropy(Layer):
+    """CE over class-dim-sharded logits; the partial-softmax allreduce the
+    reference hand-codes is emitted by XLA from the sharding.
+    ≙ mp_layers.ParallelCrossEntropy [U]."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+# -- sequence parallel utils -------------------------------------------------
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """≙ «.../fleet/utils/sequence_parallel_utils.py» [U]: SP activations are
+    sequence-dim sharded outside TP regions; with GSPMD this is an input
+    constraint, the all-gather/reduce-scatter pair is compiler-inserted."""
+
+    def forward(self, x):
+        mesh = _mp_mesh()
+        if mesh is not None:
+            axes = [None, "mp"] + [None] * (x.ndim - 2)
+            xv = shard_constraint(x._value, *axes, mesh=mesh)
+            t = Tensor(xv, stop_gradient=x.stop_gradient)
+            t._node, t._out_index = x._node, x._out_index
+            x = t
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    def forward(self, x):
+        out = super().forward(x)
+        mesh = _mp_mesh()
+        if mesh is not None:
+            axes = [None, "mp"] + [None] * (out.ndim - 2)
+            ov = shard_constraint(out._value, *axes, mesh=mesh)
+            t = Tensor(ov, stop_gradient=out.stop_gradient)
+            t._node, t._out_index = out._node, out._out_index
+            return t
+        return out
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, *a, **k):
+    """No-op on TPU: SP grad sync is inside the compiled program."""
+    return model
+
+
+# -- pipeline segmentation ---------------------------------------------------
+class LayerDesc:
+    """≙ pp_layers.LayerDesc — deferred layer construction for stage
+    assignment."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """≙ pp_layers.SharedLayerDesc — embedding/output weight sharing across
+    stages. With GSPMD + one program there is one parameter object; sharing
+    is simple aliasing."""
+
+    def __init__(self, key, layer_cls, forward_func=None, shared_weight_attr
+                 ="weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """≙ pp_layers.PipelineLayer: a sequence of LayerDescs segmented into
+    pp stages. In this framework every stage's params carry a 'pp'-axis
+    placement; the schedule (1F1B over microbatches) is applied by
+    PipelineParallel.train_batch via shard_map (SURVEY.md §7 stage 7)."""
+
+    def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
+                 seg_method="uniform", recompute_interval=0, **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        descs = list(layers)
+        built = []
+        shared = {}
+        for d in descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in shared:
+                    built.append(("shared", d, shared[d.layer_name]))
+                else:
+                    layer = d.build_layer()
+                    shared[d.layer_name] = layer
+                    built.append(("layer", d, layer))
+            elif isinstance(d, LayerDesc):
+                built.append(("layer", d, d.build_layer()))
+            else:
+                built.append(("layer", None, d))
+        self.run_funcs = []
+        self.layers = LayerList([b[2] for b in built
+                                 if b[0] == "layer"])
+        self._built = built
+        from . import fleet_initialized, get_hybrid_communicate_group
+        self.num_stages = num_stages
+        if num_stages is None and fleet_initialized():
+            self.num_stages = get_hybrid_communicate_group() \
+                .get_pipe_parallel_world_size()
+        self.num_stages = self.num_stages or 1
+        self._segment()
+
+    def _segment(self):
+        """Uniform segmentation of layers into stages (≙ seg_method
+        'uniform'; 'layer:' prefix counting deferred)."""
+        n = len(self._built)
+        per = math.ceil(n / self.num_stages)
+        self.stage_of = [min(i // per, self.num_stages - 1)
+                         for i in range(n)]
+
+    def get_stage_layers(self, stage: int):
+        return [b[2] for b, s in zip(self._built, self.stage_of)
+                if s == stage and b[0] == "layer"]
+
+    def forward(self, x):
+        for kind, desc, layer in self._built:
+            if kind == "shared" and desc.forward_func is not None:
+                x = desc.forward_func(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+
+class PipelineParallel(Layer):
+    """≙ «.../fleet/meta_parallel/pipeline_parallel.py» PipelineParallel.
+    train_batch splits into micro-batches and runs the schedule; the 1F1B
+    shard_map schedule lands with stage 7 — until then micro-batches run
+    sequentially inside one compiled program (GPipe-equivalent memory)."""
+
+    def __init__(self, layers, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._strategy = strategy
+        self.accumulate_steps = (strategy.pipeline_configs.get(
+            "accumulate_steps", 1) if strategy else 1)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        inputs, labels = data
+        micro = self.accumulate_steps
+        bs = inputs.shape[0]
+        mb = max(bs // micro, 1)
+        total = None
+        for i in range(0, bs, mb):
+            x = inputs[i:i + mb]
+            y = labels[i:i + mb]
+            out = self._layers(x)
+            loss_fn = getattr(self._layers, "_loss_fn", None)
+            loss = loss_fn(out, y) if loss_fn else F.cross_entropy(out, y)
+            scaled = loss / micro if micro > 1 else loss
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total = float(loss) if total is None else total + float(loss)
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return paddle.to_tensor(total / max(micro, 1))

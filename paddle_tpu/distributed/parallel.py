@@ -1,0 +1,82 @@
+"""Process/runtime env. ≙ reference `init_parallel_env` + TCPStore rendezvous
+(«paddle/phi/core/distributed/store/tcp_store.cc», fleet launch env vars [U]).
+
+TPU-native: `jax.distributed.initialize` (coordinator service) replaces
+TCPStore; one process per host, all chips of the host attached to it. Rank =
+process_index, world = process_count. On a single host this is trivially a
+no-op and the 'world' is the local chip set."""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env():
+    """≙ paddle.distributed.init_parallel_env. Reads the same env-var shape
+    the reference launcher sets (PADDLE_TRAINER_ID etc. become
+    COORDINATOR/NUM_PROCESSES/PROCESS_ID)."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "COORDINATOR_ADDRESS")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM",
+                                os.environ.get("NUM_PROCESSES", "1")))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID",
+                             os.environ.get("PROCESS_ID", "0")))
+    if coord and nprocs > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nprocs, process_id=pid)
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def is_available() -> bool:
+    return True
+
+
+class ParallelEnv:
+    """≙ paddle.distributed.ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def world_size(self) -> int:
+        return jax.process_count()
+
+    @property
+    def local_rank(self) -> int:
+        return 0  # one process per host on TPU; chips are in-process
+
+    @property
+    def device_id(self) -> int:
+        return 0
+
+    @property
+    def nranks(self) -> int:
+        return self.world_size
+
+    @property
+    def dev_id(self) -> int:
+        return 0

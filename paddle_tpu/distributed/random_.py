@@ -1,0 +1,67 @@
+"""RNG state tracker for model-parallel determinism. ≙ reference
+`get_rng_state_tracker` («.../fleet/meta_parallel/parallel_layers/random.py»
+[U]): dropout inside TP regions must be identical across TP ranks for
+replicated activations and different for sharded ones.
+
+TPU-native: there are no per-rank RNG states — a traced PRNG key is folded
+with the mesh axis index (`jax.random.fold_in` of `lax.axis_index`) inside
+shard_map regions, giving exactly the local-seed/global-seed split the
+reference maintains by hand."""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ..tensor.random import default_generator
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states = {}
+
+    def add(self, name: str, seed: int):
+        if name in self.states:
+            raise ValueError(f"state {name} already exists")
+        self.states[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states)
+
+    def set_states_tracker(self, states):
+        self.states = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        """Within the context, the default generator draws from the named
+        stream (≙ reference's CUDA rng state swap)."""
+        if name not in self.states:
+            self.states[name] = jax.random.key(len(self.states) + 1234)
+        old = default_generator._key
+        default_generator._key = self.states[name]
+        try:
+            yield
+        finally:
+            self.states[name] = default_generator._key
+            default_generator._key = old
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int = 2048):
+    """≙ fleet.meta_parallel.model_parallel_random_seed: seed global +
+    local (axis-folded) streams."""
+    global _tracker
+    _tracker = RNGStatesTracker()
+    _tracker.add("global_seed", seed)
+    _tracker.add("local_seed", seed + 1)
+
+
+def local_key_for_axis(key, axis_name: str):
+    """Fold the mesh-axis index into a key (call inside shard_map)."""
+    return jax.random.fold_in(key, jax.lax.axis_index(axis_name))

@@ -1,0 +1,135 @@
+"""BERT for masked-LM. North-star config #1 (BASELINE.md): BERT-base MLM
+fine-tune on a single chip. Mirrors the PaddleNLP BertModel surface
+(outside-repo model zoo per SURVEY.md §1) built on paddle_tpu.nn."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def base():
+        return BertConfig()
+
+    @staticmethod
+    def tiny():
+        return BertConfig(vocab_size=1024, hidden_size=128,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=512, max_position_embeddings=128)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        seq_len = input_ids.shape[1]
+        pos = paddle.arange(seq_len, dtype="int32").unsqueeze(0)
+        emb = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            layer_norm_eps=cfg.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_hidden_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        mask = None
+        if attention_mask is not None:
+            # (B, S) 1/0 -> additive (B, 1, 1, S)
+            mask = ((1.0 - attention_mask.astype("float32"))
+                    * -1e4).unsqueeze([1, 2])
+        seq = self.encoder(x, mask)
+        pooled = F.tanh(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class BertLMHead(nn.Layer):
+    def __init__(self, cfg: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        self.act = nn.GELU()
+        # decoder tied to word embeddings (weight sharing like the reference)
+        self.embedding_weights = embedding_weights
+        self.decoder_bias = self.create_parameter(
+            (cfg.vocab_size,), is_bias=True)
+
+    def forward(self, hidden):
+        h = self.layer_norm(self.act(self.transform(hidden)))
+        logits = paddle.matmul(h, self.embedding_weights,
+                               transpose_y=True) + self.decoder_bias
+        return logits
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, cfg: BertConfig | None = None):
+        super().__init__()
+        cfg = cfg or BertConfig.base()
+        self.config = cfg
+        self.bert = BertModel(cfg)
+        self.cls = BertLMHead(
+            cfg, self.bert.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.cls(seq)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]), ignore_index=-100)
+            return loss, logits
+        return logits
+
+
+def synthetic_mlm_batch(batch_size, seq_len, vocab_size, mask_prob=0.15,
+                        seed=0):
+    """Synthetic tokenized MLM batch (no network: data is generated)."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(5, vocab_size, (batch_size, seq_len), dtype=np.int32)
+    labels = np.full((batch_size, seq_len), -100, np.int32)
+    mask = rng.random((batch_size, seq_len)) < mask_prob
+    labels[mask] = ids[mask]
+    ids[mask] = 3  # [MASK]
+    return (paddle.to_tensor(ids), paddle.to_tensor(labels))

