@@ -147,12 +147,13 @@ def all_gather(tensor_list, tensor: Tensor = None,
     g = _get_group(group)
 
     def fn(v):
-        return jax.lax.all_gather(v, g.axis, axis=0)
-    out = g._run(fn, tensor)  # (nranks, nranks, ...)
+        # tiled concat along the stacked axis; result identical on every
+        # shard -> replicated out_spec
+        return jax.lax.all_gather(v, g.axis, axis=0, tiled=True)
+    out = g._run(fn, tensor, out_spec=PartitionSpec())  # (nranks, ...)
     if tensor_list is not None:
-        gathered = out._value[0]
         for i in range(g.nranks):
-            tensor_list.append(Tensor(gathered[i]))
+            tensor_list.append(Tensor(out._value[i]))
         return tensor_list
     return out
 

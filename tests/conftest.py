@@ -6,7 +6,9 @@ whole device/comm path without accelerator hardware (SURVEY.md §4
 8-device mesh."""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU: the ambient env may pin JAX_PLATFORMS=axon (TPU tunnel), but
+# the test tier always runs on the virtual 8-device CPU mesh (SURVEY.md §4)
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
