@@ -77,23 +77,14 @@ def precompute_rope(head_dim: int, max_len: int, theta: float):
 
 
 def apply_rope(x: Tensor, cos: Tensor, sin: Tensor, position_offset=0):
-    """x: (B, S, H, D). Rotates pairs (even, odd) — fused by XLA; the Pallas
-    fused rope kernel (paddle_tpu.ops.rope) replaces this on TPU for long S.
-    ≙ fused_rotary_position_embedding «paddle/phi/kernels/fusion/» [U]."""
+    """x: (B, S, H, D) — Pallas fused rope kernel (custom VJP = inverse
+    rotation). ≙ fused_rotary_position_embedding
+    «paddle/phi/kernels/fusion/» [U]."""
     from paddle_tpu.core.tensor import apply as _apply
+    from paddle_tpu.ops.rope import rope_values
 
     def fn(v, c, s):
-        import jax.numpy as jnp
-        S = v.shape[1]
-        c = c[position_offset:position_offset + S]
-        s = s[position_offset:position_offset + S]
-        c = c[None, :, None, :].astype(v.dtype)
-        s = s[None, :, None, :].astype(v.dtype)
-        x1 = v[..., 0::2]
-        x2 = v[..., 1::2]
-        r1 = x1 * c - x2 * s
-        r2 = x2 * c + x1 * s
-        return jnp.stack([r1, r2], axis=-1).reshape(v.shape)
+        return rope_values(v, c, s, position_offset)
     return _apply("rope", fn, (x, cos, sin))
 
 

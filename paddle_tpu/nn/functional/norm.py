@@ -15,11 +15,20 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
+def _pallas_ok(x) -> bool:
+    import jax
+    return jax.devices()[0].platform == "tpu"
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
                name=None):
     ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) \
         else [normalized_shape]
     n_axes = len(ns)
+    if (n_axes == 1 and weight is not None and bias is not None
+            and _pallas_ok(x)):
+        from ...ops import norm_kernels
+        return norm_kernels.layer_norm(_t(x), _t(weight), _t(bias), epsilon)
 
     def fn(v, *wb):
         axes = tuple(range(v.ndim - n_axes, v.ndim))
@@ -45,7 +54,12 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
-    """RMSNorm (≙ fused rms_norm «paddle/phi/kernels/fusion/» [U])."""
+    """RMSNorm (≙ fused rms_norm «paddle/phi/kernels/fusion/» [U]).
+    Pallas fused kernel on TPU; XLA fallback elsewhere."""
+    if weight is not None and _pallas_ok(x):
+        from ...ops import norm_kernels
+        return norm_kernels.rms_norm(_t(x), _t(weight), epsilon)
+
     def fn(v, *w):
         vf = v.astype(jnp.float32)
         ms = jnp.mean(jnp.square(vf), axis=-1, keepdims=True)

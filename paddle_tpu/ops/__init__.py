@@ -2,3 +2,5 @@
 reference's CUDA fused kernels («paddle/phi/kernels/fusion/» [U]).
 Each op ships a Pallas fast path + XLA fallback with identical semantics."""
 from . import flash_attention  # noqa: F401
+from . import norm_kernels  # noqa: F401
+from . import rope  # noqa: F401

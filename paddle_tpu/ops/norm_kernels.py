@@ -1,0 +1,229 @@
+"""Fused RMSNorm / LayerNorm Pallas kernels with custom VJP.
+
+≙ reference fused rms_norm / layer-norm CUDA kernels
+(«paddle/phi/kernels/fusion/», fused_bias_dropout_residual_layer_norm [U]).
+Row-blocked over (rows, hidden): one VMEM pass computes stats + normalized
+output; bwd recomputes x_hat from saved rstd (memory-light) and reduces
+dgamma/dbeta across row blocks via output accumulation.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from ..core.tensor import Tensor, apply
+
+BLOCK_ROWS = 256
+
+
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+# -- rmsnorm -----------------------------------------------------------------
+def _rms_fwd_kernel(x_ref, w_ref, o_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    o_ref[:] = (x * rstd * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    rstd_ref[:] = rstd[:, 0]
+
+
+def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dw_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    rstd = rstd_ref[:][:, None]
+    xhat = x * rstd
+    wg = g * w
+    # dx = rstd * (wg - xhat * mean(wg * xhat))
+    mean_wgx = jnp.mean(wg * xhat, axis=-1, keepdims=True)
+    dx_ref[:] = (rstd * (wg - xhat * mean_wgx)).astype(dx_ref.dtype)
+    dw_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)  # per-block partial
+
+
+def _rms_fwd(x2, w, eps, block_rows):
+    n, h = x2.shape
+    grid = (pl.cdiv(n, block_rows),)
+    o, rstd = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps),
+        grid=grid,
+        in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                  pl.BlockSpec((h,), lambda i: (0,))],
+        out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                   pl.BlockSpec((block_rows,), lambda i: (i,))],
+        out_shape=[jax.ShapeDtypeStruct((n, h), x2.dtype),
+                   jax.ShapeDtypeStruct((n,), jnp.float32)],
+        interpret=_interpret(),
+    )(x2, w)
+    return o, rstd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms(x2, w, eps, block_rows):
+    return _rms_fwd(x2, w, eps, block_rows)[0]
+
+
+def _rms_fwd_rule(x2, w, eps, block_rows):
+    o, rstd = _rms_fwd(x2, w, eps, block_rows)
+    return o, (x2, w, rstd)
+
+
+def _rms_bwd_rule(eps, block_rows, res, g):
+    x2, w, rstd = res
+    n, h = x2.shape
+    nb = pl.cdiv(n, block_rows)
+    dx, dw_part = pl.pallas_call(
+        functools.partial(_rms_bwd_kernel, eps=eps),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                  pl.BlockSpec((h,), lambda i: (0,)),
+                  pl.BlockSpec((block_rows,), lambda i: (i,)),
+                  pl.BlockSpec((block_rows, h), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n, h), x2.dtype),
+                   jax.ShapeDtypeStruct((nb, h), jnp.float32)],
+        interpret=_interpret(),
+    )(x2, w, rstd, g)
+    dw = jnp.sum(dw_part, axis=0).astype(w.dtype)
+    return dx, dw
+
+
+_rms.defvjp(_rms_fwd_rule, _rms_bwd_rule)
+
+
+def rms_norm_values(x, w, eps=1e-6, block_rows=BLOCK_ROWS):
+    shape = x.shape
+    h = shape[-1]
+    x2 = x.reshape(-1, h)
+    n = x2.shape[0]
+    br = min(block_rows, n)
+    if n % br:  # fall back to XLA for ragged row counts
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), -1, keepdims=True)
+        return (xf * jax.lax.rsqrt(ms + eps) * w.astype(jnp.float32)) \
+            .astype(x.dtype).reshape(shape)
+    return _rms(x2, w, float(eps), br).reshape(shape)
+
+
+def rms_norm(x: Tensor, weight: Tensor, epsilon: float = 1e-6) -> Tensor:
+    def fn(v, w):
+        return rms_norm_values(v, w, epsilon)
+    return apply("rms_norm_pallas", fn, (x, weight))
+
+
+# -- layernorm ---------------------------------------------------------------
+def _ln_fwd_kernel(x_ref, w_ref, b_ref, o_ref, mean_ref, rstd_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x - mu) * rstd
+    o_ref[:] = (xhat * w_ref[:].astype(jnp.float32)
+                + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+    mean_ref[:] = mu[:, 0]
+    rstd_ref[:] = rstd[:, 0]
+
+
+def _ln_bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, g_ref,
+                   dx_ref, dw_ref, db_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    mu = mean_ref[:][:, None]
+    rstd = rstd_ref[:][:, None]
+    xhat = (x - mu) * rstd
+    wg = g * w
+    m1 = jnp.mean(wg, axis=-1, keepdims=True)
+    m2 = jnp.mean(wg * xhat, axis=-1, keepdims=True)
+    dx_ref[:] = (rstd * (wg - m1 - xhat * m2)).astype(dx_ref.dtype)
+    dw_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)
+    db_ref[:] = jnp.sum(g, axis=0, keepdims=True)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln(x2, w, b, eps, block_rows):
+    return _ln_fwd(x2, w, b, eps, block_rows)[0]
+
+
+def _ln_fwd(x2, w, b, eps, block_rows):
+    n, h = x2.shape
+    o, mean, rstd = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=(pl.cdiv(n, block_rows),),
+        in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                  pl.BlockSpec((h,), lambda i: (0,)),
+                  pl.BlockSpec((h,), lambda i: (0,))],
+        out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                   pl.BlockSpec((block_rows,), lambda i: (i,)),
+                   pl.BlockSpec((block_rows,), lambda i: (i,))],
+        out_shape=[jax.ShapeDtypeStruct((n, h), x2.dtype),
+                   jax.ShapeDtypeStruct((n,), jnp.float32),
+                   jax.ShapeDtypeStruct((n,), jnp.float32)],
+        interpret=_interpret(),
+    )(x2, w, b)
+    return o, mean, rstd
+
+
+def _ln_fwd_rule(x2, w, b, eps, block_rows):
+    o, mean, rstd = _ln_fwd(x2, w, b, eps, block_rows)
+    return o, (x2, w, mean, rstd)
+
+
+def _ln_bwd_rule(eps, block_rows, res, g):
+    x2, w, mean, rstd = res
+    n, h = x2.shape
+    nb = pl.cdiv(n, block_rows)
+    dx, dw_p, db_p = pl.pallas_call(
+        functools.partial(_ln_bwd_kernel, eps=eps),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                  pl.BlockSpec((h,), lambda i: (0,)),
+                  pl.BlockSpec((block_rows,), lambda i: (i,)),
+                  pl.BlockSpec((block_rows,), lambda i: (i,)),
+                  pl.BlockSpec((block_rows, h), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
+                   pl.BlockSpec((1, h), lambda i: (i, 0)),
+                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((n, h), x2.dtype),
+                   jax.ShapeDtypeStruct((nb, h), jnp.float32),
+                   jax.ShapeDtypeStruct((nb, h), jnp.float32)],
+        interpret=_interpret(),
+    )(x2, w, mean, rstd, g)
+    return (dx, jnp.sum(dw_p, 0).astype(w.dtype),
+            jnp.sum(db_p, 0).astype(w.dtype))
+
+
+_ln.defvjp(_ln_fwd_rule, _ln_bwd_rule)
+
+
+def layer_norm_values(x, w, b, eps=1e-5, block_rows=BLOCK_ROWS):
+    shape = x.shape
+    h = shape[-1]
+    x2 = x.reshape(-1, h)
+    n = x2.shape[0]
+    br = min(block_rows, n)
+    if n % br:
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, -1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), -1, keepdims=True)
+        return ((xf - mu) * jax.lax.rsqrt(var + eps)
+                * w.astype(jnp.float32) + b.astype(jnp.float32)) \
+            .astype(x.dtype).reshape(shape)
+    return _ln(x2, w, b, float(eps), br).reshape(shape)
+
+
+def layer_norm(x: Tensor, weight: Tensor, bias: Tensor,
+               epsilon: float = 1e-5) -> Tensor:
+    def fn(v, w, b):
+        return layer_norm_values(v, w, b, epsilon)
+    return apply("layer_norm_pallas", fn, (x, weight, bias))

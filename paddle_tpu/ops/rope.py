@@ -1,0 +1,96 @@
+"""Fused rotary position embedding (RoPE) Pallas kernel.
+
+≙ reference fused_rotary_position_embedding («paddle/phi/kernels/fusion/»
+[U]). Rotation is linear, so the VJP is the inverse rotation of the
+cotangent — no residuals saved at all (cheaper than autodiff through the
+elementwise graph).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..core.tensor import Tensor, apply
+
+BLOCK_S = 256
+_FORCE_PALLAS = False  # tests flip this to exercise interpret mode off-TPU
+
+
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref, *, sign):
+    x = x_ref[:]                      # (1, Bs, H, D)
+    c = cos_ref[:][None, :, None, :]  # (1, Bs, 1, D/2)
+    s = sin_ref[:][None, :, None, :] * sign
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    r1 = x1 * c - x2 * s
+    r2 = x2 * c + x1 * s
+    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+    o_ref[:] = out.astype(o_ref.dtype)
+
+
+def _rope_apply(x, cos, sin, sign, block_s):
+    b, seq, h, d = x.shape
+    bs = min(block_s, seq)
+    if seq % bs or (_interpret() and not _FORCE_PALLAS):
+        # XLA fallback for ragged sequence lengths
+        c = cos[None, :, None, :].astype(jnp.float32)
+        s = (sin * sign)[None, :, None, :].astype(jnp.float32)
+        x1 = x[..., 0::2].astype(jnp.float32)
+        x2 = x[..., 1::2].astype(jnp.float32)
+        return jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s],
+                         axis=-1).reshape(x.shape).astype(x.dtype)
+    return pl.pallas_call(
+        functools.partial(_rope_kernel, sign=sign),
+        grid=(b, seq // bs),
+        in_specs=[
+            pl.BlockSpec((1, bs, h, d), lambda i, j: (i, j, 0, 0)),
+            pl.BlockSpec((bs, d // 2), lambda i, j: (j, 0)),
+            pl.BlockSpec((bs, d // 2), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bs, h, d), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=_interpret(),
+    )(x, cos, sin)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _rope(x, cos, sin, block_s):
+    return _rope_apply(x, cos, sin, 1.0, block_s)
+
+
+def _rope_fwd(x, cos, sin, block_s):
+    return _rope_apply(x, cos, sin, 1.0, block_s), (cos, sin)
+
+
+def _rope_bwd(block_s, res, g):
+    cos, sin = res
+    # inverse rotation (angle -> -angle)
+    return _rope_apply(g, cos, sin, -1.0, block_s), None, None
+
+
+_rope.defvjp(_rope_fwd, _rope_bwd)
+
+
+def rope_values(x, cos, sin, position_offset=0, block_s=BLOCK_S):
+    """x: (B, S, H, D); cos/sin: (max_len, D/2)."""
+    seq = x.shape[1]
+    c = jax.lax.dynamic_slice_in_dim(cos, position_offset, seq, 0)
+    s = jax.lax.dynamic_slice_in_dim(sin, position_offset, seq, 0)
+    return _rope(x, c.astype(jnp.float32), s.astype(jnp.float32), block_s)
+
+
+def fused_rotary_position_embedding(q: Tensor, k: Tensor, cos: Tensor,
+                                    sin: Tensor, position_offset: int = 0):
+    """≙ paddle.incubate.nn.functional.fused_rotary_position_embedding [U]."""
+    def fn_q(v, c, s):
+        return rope_values(v, c, s, position_offset)
+    qo = apply("fused_rope", fn_q, (q, cos, sin))
+    ko = apply("fused_rope", fn_q, (k, cos, sin))
+    return qo, ko

@@ -1,0 +1,212 @@
+"""Pallas kernel parity tests (interpret mode on CPU; compiled on TPU).
+≙ reference kernel unit tests «test/cpp/phi/kernels» + flash-attn tests [U]."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import flash_attention as fa
+from paddle_tpu.ops import norm_kernels as nk
+from paddle_tpu.ops import rope as rk
+
+rng = np.random.default_rng(7)
+
+
+def _sdpa_ref(q, k, v, causal=False):
+    b, s, h, d = q.shape
+    qb = q.transpose(0, 2, 1, 3).astype(np.float64)
+    kb = k.transpose(0, 2, 1, 3).astype(np.float64)
+    vb = v.transpose(0, 2, 1, 3).astype(np.float64)
+    logits = qb @ kb.transpose(0, 1, 3, 2) / np.sqrt(d)
+    if causal:
+        mask = np.tril(np.ones((s, s), bool))
+        logits = np.where(mask, logits, -1e30)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    return (w @ vb).transpose(0, 2, 1, 3)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_reference(self, causal):
+        q = rng.normal(size=(2, 128, 2, 64)).astype(np.float32)
+        k = rng.normal(size=(2, 128, 2, 64)).astype(np.float32)
+        v = rng.normal(size=(2, 128, 2, 64)).astype(np.float32)
+        out = fa.flash_attention_values(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal,
+            block_q=64, block_k=64)
+        want = _sdpa_ref(q, k, v, causal)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_multi_kv_block_online_softmax(self):
+        # more k blocks than q blocks exercises the running-max merge
+        q = rng.normal(size=(1, 64, 1, 32)).astype(np.float32) * 3
+        k = rng.normal(size=(1, 256, 1, 32)).astype(np.float32) * 3
+        v = rng.normal(size=(1, 256, 1, 32)).astype(np.float32)
+        out = fa.flash_attention_values(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            block_q=64, block_k=64)
+        want = _sdpa_ref(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_gqa(self):
+        q = rng.normal(size=(1, 64, 4, 16)).astype(np.float32)
+        k = rng.normal(size=(1, 64, 2, 16)).astype(np.float32)
+        v = rng.normal(size=(1, 64, 2, 16)).astype(np.float32)
+        out = fa.flash_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                        jnp.asarray(v), block_q=64,
+                                        block_k=64)
+        kr = np.repeat(k, 2, axis=2)
+        vr = np.repeat(v, 2, axis=2)
+        want = _sdpa_ref(q, kr, vr)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4,
+                                   atol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grad_matches_xla_attention(self, causal):
+        q = rng.normal(size=(1, 64, 1, 32)).astype(np.float32)
+        k = rng.normal(size=(1, 64, 1, 32)).astype(np.float32)
+        v = rng.normal(size=(1, 64, 1, 32)).astype(np.float32)
+
+        def flash_loss(q_, k_, v_):
+            return jnp.sum(fa.flash_attention_values(
+                q_, k_, v_, causal=causal, block_q=32, block_k=32) ** 2)
+
+        def xla_loss(q_, k_, v_):
+            d = q_.shape[-1]
+            qb = jnp.swapaxes(q_, 1, 2)
+            kb = jnp.swapaxes(k_, 1, 2)
+            vb = jnp.swapaxes(v_, 1, 2)
+            logits = qb @ jnp.swapaxes(kb, -1, -2) / np.sqrt(d)
+            if causal:
+                s = logits.shape[-1]
+                logits = jnp.where(jnp.tril(jnp.ones((s, s), bool)),
+                                   logits, -1e30)
+            w = jax.nn.softmax(logits, -1)
+            return jnp.sum(jnp.swapaxes(w @ vb, 1, 2) ** 2)
+
+        g_flash = jax.grad(flash_loss, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        g_xla = jax.grad(xla_loss, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for gf, gx in zip(g_flash, g_xla):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gx),
+                                       rtol=5e-3, atol=5e-4)
+
+    def test_tape_integration(self):
+        q = paddle.to_tensor(
+            rng.normal(size=(1, 64, 2, 16)).astype(np.float32),
+            stop_gradient=False)
+        out = fa.flash_attention(q, q, q, causal=True)
+        out.sum().backward()
+        assert q.grad is not None
+        assert np.isfinite(q.grad.numpy()).all()
+
+
+class TestNormKernels:
+    def test_rmsnorm_forward(self):
+        x = rng.normal(size=(256, 128)).astype(np.float32)
+        w = rng.normal(size=(128,)).astype(np.float32)
+        out = nk.rms_norm_values(jnp.asarray(x), jnp.asarray(w))
+        want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_rmsnorm_grad(self):
+        x = rng.normal(size=(256, 64)).astype(np.float32)
+        w = np.abs(rng.normal(size=(64,))).astype(np.float32)
+
+        def pallas_loss(x_, w_):
+            return jnp.sum(nk.rms_norm_values(x_, w_) ** 2)
+
+        def xla_loss(x_, w_):
+            ms = jnp.mean(x_ ** 2, -1, keepdims=True)
+            return jnp.sum((x_ * jax.lax.rsqrt(ms + 1e-6) * w_) ** 2)
+
+        gp = jax.grad(pallas_loss, (0, 1))(jnp.asarray(x), jnp.asarray(w))
+        gx = jax.grad(xla_loss, (0, 1))(jnp.asarray(x), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(gx[0]),
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gx[1]),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_layernorm_forward_and_grad(self):
+        x = rng.normal(size=(128, 64)).astype(np.float32)
+        w = rng.normal(size=(64,)).astype(np.float32)
+        b = rng.normal(size=(64,)).astype(np.float32)
+        out = nk.layer_norm_values(jnp.asarray(x), jnp.asarray(w),
+                                   jnp.asarray(b))
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        want = (x - mu) / np.sqrt(var + 1e-5) * w + b
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-4)
+
+        def pallas_loss(x_, w_, b_):
+            return jnp.sum(nk.layer_norm_values(x_, w_, b_) ** 3)
+
+        def xla_loss(x_, w_, b_):
+            mu_ = jnp.mean(x_, -1, keepdims=True)
+            var_ = jnp.mean((x_ - mu_) ** 2, -1, keepdims=True)
+            return jnp.sum(((x_ - mu_) * jax.lax.rsqrt(var_ + 1e-5)
+                            * w_ + b_) ** 3)
+        gp = jax.grad(pallas_loss, (0, 1, 2))(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+        gx = jax.grad(xla_loss, (0, 1, 2))(
+            jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+        for a, c in zip(gp, gx):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                       rtol=2e-3, atol=2e-3)
+
+    def test_ragged_rows_fallback(self):
+        x = rng.normal(size=(100, 32)).astype(np.float32)  # 100 % 256 != 0
+        w = np.ones(32, np.float32)
+        out = nk.rms_norm_values(jnp.asarray(x), jnp.asarray(w))
+        want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestRope:
+    def setup_method(self):
+        rk._FORCE_PALLAS = True
+
+    def teardown_method(self):
+        rk._FORCE_PALLAS = False
+
+    def test_rope_matches_reference(self):
+        b, s, h, d = 2, 64, 2, 32
+        x = rng.normal(size=(b, s, h, d)).astype(np.float32)
+        inv = 1.0 / (10000 ** (np.arange(0, d, 2) / d))
+        t = np.arange(128)
+        freqs = np.outer(t, inv)
+        cos, sin = np.cos(freqs).astype(np.float32), \
+            np.sin(freqs).astype(np.float32)
+        out = rk.rope_values(jnp.asarray(x), jnp.asarray(cos),
+                             jnp.asarray(sin), block_s=64)
+        c = cos[:s][None, :, None, :]
+        sn = sin[:s][None, :, None, :]
+        x1, x2 = x[..., 0::2], x[..., 1::2]
+        want = np.stack([x1 * c - x2 * sn, x2 * c + x1 * sn],
+                        axis=-1).reshape(x.shape)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_rope_grad_is_inverse_rotation(self):
+        b, s, h, d = 1, 32, 1, 16
+        x = rng.normal(size=(b, s, h, d)).astype(np.float32)
+        inv = 1.0 / (10000 ** (np.arange(0, d, 2) / d))
+        freqs = np.outer(np.arange(64), inv)
+        cos = jnp.asarray(np.cos(freqs).astype(np.float32))
+        sin = jnp.asarray(np.sin(freqs).astype(np.float32))
+
+        def loss(x_):
+            return jnp.sum(rk.rope_values(x_, cos, sin, block_s=32) ** 2)
+        g = jax.grad(loss)(jnp.asarray(x))
+        # rotation preserves norms: grad = 2 * x
+        np.testing.assert_allclose(np.asarray(g), 2 * x, rtol=1e-4,
+                                   atol=1e-4)
