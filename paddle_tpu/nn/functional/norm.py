@@ -15,18 +15,14 @@ def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
 
 
-def _pallas_ok(x) -> bool:
-    import jax
-    return jax.devices()[0].platform == "tpu"
-
-
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
                name=None):
+    from ...ops import on_tpu
     ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) \
         else [normalized_shape]
     n_axes = len(ns)
     if (n_axes == 1 and weight is not None and bias is not None
-            and _pallas_ok(x)):
+            and on_tpu()):
         from ...ops import norm_kernels
         return norm_kernels.layer_norm(_t(x), _t(weight), _t(bias), epsilon)
 
@@ -56,7 +52,8 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05,
 def rms_norm(x, weight=None, epsilon=1e-6, name=None):
     """RMSNorm (≙ fused rms_norm «paddle/phi/kernels/fusion/» [U]).
     Pallas fused kernel on TPU; XLA fallback elsewhere."""
-    if weight is not None and _pallas_ok(x):
+    from ...ops import on_tpu
+    if weight is not None and on_tpu():
         from ...ops import norm_kernels
         return norm_kernels.rms_norm(_t(x), _t(weight), epsilon)
 

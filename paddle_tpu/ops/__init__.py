@@ -1,6 +1,14 @@
 """paddle_tpu.ops — TPU kernel library (Pallas/Mosaic), the counterpart of the
 reference's CUDA fused kernels («paddle/phi/kernels/fusion/» [U]).
 Each op ships a Pallas fast path + XLA fallback with identical semantics."""
-from . import flash_attention  # noqa: F401
-from . import norm_kernels  # noqa: F401
-from . import rope  # noqa: F401
+import jax as _jax
+
+
+def on_tpu() -> bool:
+    """Shared TPU-detection gate for every Pallas fast path."""
+    return _jax.devices()[0].platform == "tpu"
+
+
+from . import flash_attention  # noqa: F401,E402
+from . import norm_kernels  # noqa: F401,E402
+from . import rope  # noqa: F401,E402

@@ -7,6 +7,14 @@ across k-blocks; bwd uses the saved logsumexp + delta trick (two kernels:
 dq over q-blocks, dkv over k-blocks). Layout (B, S, H, D) — paddle
 convention; internally (B*H, S, D).
 
+GQA is native: K/V stay at (B*HK, S, D) and the BlockSpec index maps fold
+the q-head -> kv-head mapping (no jnp.repeat HBM expansion). The causal
+mask is END-aligned (q row i attends keys <= i + Sk - Sq), matching the
+XLA fallback and the KV-cache/chunked-prefill convention. A q row that
+attends zero keys (causal with Sq > Sk) outputs 0 with zero gradient —
+the flash-attn convention; the XLA softmax fallback returns a uniform
+average there (both are mathematically undefined).
+
 Falls back to interpreter mode off-TPU so the same code is testable on the
 8-virtual-CPU-device CI mesh (SURVEY.md §4).
 """
@@ -26,31 +34,45 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
+from . import on_tpu
 from ..core.tensor import Tensor, apply
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
-
-
-def _on_tpu() -> bool:
-    return jax.devices()[0].platform == "tpu"
+# Per-row scalars (lse, delta) are stored broadcast across a full 128-lane
+# vector register: Mosaic requires the minor block dim to be 128-aligned, so
+# a (bh, sq)-shaped residual cannot be blocked (1, block_q).
+LANES = 128
 
 
 def _interpret() -> bool:
-    return not _on_tpu()
+    return not on_tpu()
+
+
+def _aligned(sq, sk, d, block_q, block_k) -> bool:
+    return (d <= 256 and sq % block_q == 0 and sk % block_k == 0
+            and sq >= block_q and sk >= block_k)
 
 
 def can_use_flash(q_shape, k_shape, dtype) -> bool:
     """Gate for the default nn.functional path: Pallas on real TPU only
     (interpret mode stays available for direct use + CI kernel tests)."""
-    if not _on_tpu() or len(q_shape) != 4:
+    if not on_tpu() or len(q_shape) != 4:
         return False
     b, sq, h, d = q_shape
     sk = k_shape[1]
-    return (d <= 256 and sq % DEFAULT_BLOCK_Q == 0
-            and sk % DEFAULT_BLOCK_K == 0 and sq >= DEFAULT_BLOCK_Q
-            and sk >= DEFAULT_BLOCK_K)
+    return _aligned(sq, sk, d, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+
+
+def _causal_mask(s, qi, ki, block_q, block_k, offset):
+    """End-aligned causal mask on a (Bq, Bk) logits tile: q row (absolute
+    position p) sees keys <= p + offset where offset = Sk - Sq."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
 
 
 # ---------------------------------------------------------------------------
@@ -58,7 +80,7 @@ def can_use_flash(q_shape, k_shape, dtype) -> bool:
 # ---------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                num_k_blocks):
+                num_k_blocks, offset):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -75,15 +97,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
         m_prev = m_scr[:]                  # (Bq, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)             # (Bq, Bk)
+        # fully-masked rows leave m_new at NEG_INF; without the guard
+        # exp(NEG_INF - NEG_INF) = 1 turns the mask into a uniform average
+        p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)    # (Bq, 1)
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -93,8 +113,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = l_new
 
     if causal:
-        # skip fully-masked blocks above the diagonal
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        # skip tiles strictly above the (end-aligned) diagonal
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1 + offset)
         def _():
             compute()
     else:
@@ -104,35 +124,39 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finalize():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_scr[:] + jnp.log(l))[:, 0]
+        lse_ref[0] = jnp.broadcast_to(m_scr[:] + jnp.log(l),
+                                      (l.shape[0], LANES))
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    """q,k,v: (BH, S, D) -> (o, lse)."""
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, group):
+    """q: (B*H, Sq, D); k,v: (B*HK, Sk, D) -> (o, lse[lane-broadcast])."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq = pl.cdiv(sq, block_q)
     nk = pl.cdiv(sk, block_k)
+    offset = sk - sq
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=nk)
+        block_k=block_k, num_k_blocks=nk, offset=offset)
 
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -148,7 +172,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
 # backward
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k, num_k_blocks):
+                   dq_scr, *, scale, causal, block_q, block_k, num_k_blocks,
+                   offset):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -164,23 +189,25 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])           # (Bq, Bk)
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+        # lse/delta arrive lane-broadcast; max over identical lanes restores
+        # the (Bq, 1) column without an unsupported minor-dim slice.
+        lse = jnp.max(lse_ref[0], axis=-1, keepdims=True)
+        delta = jnp.max(delta_ref[0], axis=-1, keepdims=True)
+        # masked entries must be exactly 0: for a fully-masked row lse is
+        # ~NEG_INF and exp(s - lse) would blow up instead of vanishing
+        p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # (Bq, Bk)
-        ds = p * (dp - delta_ref[0][:, None]) * scale  # (Bq, Bk)
+        ds = p * (dp - delta) * scale                  # (Bq, Bk)
         dq_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1)
+        @pl.when(ki * block_k <= qi * block_q + block_q - 1 + offset)
         def _():
             compute()
     else:
@@ -193,11 +220,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    block_q, block_k, num_q_blocks):
+                    block_q, block_k, num_q_blocks, group, offset):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    t = pl.program_id(2)           # fused (group, q-block) index
+    qi = t % num_q_blocks
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -210,12 +238,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+        lse = jnp.max(lse_ref[0], axis=-1, keepdims=True)
+        delta = jnp.max(delta_ref[0], axis=-1, keepdims=True)
+        p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)
         do = do_ref[0].astype(jnp.float32)
         dv_scr[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -223,43 +249,50 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None]) * scale    # (Bq, Bk)
+        ds = p * (dp - delta) * scale                    # (Bq, Bk)
         dk_scr[:] += jax.lax.dot_general(
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (Bk, D)
 
     if causal:
-        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        @pl.when(qi * block_q + block_q - 1 + offset >= ki * block_k)
         def _():
             compute()
     else:
         compute()
 
-    @pl.when(qi == num_q_blocks - 1)
+    @pl.when(t == group * num_q_blocks - 1)
     def _fin():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, group):
     bh, sq, d = q.shape
+    bhk = k.shape[0]
     sk = k.shape[1]
     nq = pl.cdiv(sq, block_q)
     nk = pl.cdiv(sk, block_k)
-    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
-                    axis=-1)  # (BH, S)
+    offset = sk - sq
+    delta = jnp.broadcast_to(
+        jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32),
+                axis=-1, keepdims=True),
+        (bh, sq, LANES))  # (BH, S, LANES) lane-broadcast
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_k_blocks=nk),
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                          offset=offset),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -267,25 +300,31 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
+    # dk/dv: grid over kv heads; the innermost axis fuses (group, q-block)
+    # so one scratch accumulates over every q head sharing this kv head.
+    def q_map(b, j, t):
+        return (b * group + t // nq, t % nq, 0)
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_q_blocks=nq),
-        grid=(bh, nk, nq),
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq,
+                          group=group, offset=offset),
+        grid=(bhk, nk, group * nq),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_q, LANES), q_map),
+            pl.BlockSpec((1, block_q, LANES), q_map),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((bhk, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bhk, sk, d), v.dtype),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
@@ -295,32 +334,41 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
 
 
 # ---------------------------------------------------------------------------
-# public op (custom vjp over (BH, S, D))
+# public op (custom vjp over (BH, S, D) + (BHK, S, D))
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, group):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, group)
     return o
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k)
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, group):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, group)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
+def _flash_bwd_rule(scale, causal, block_q, block_k, group, res, do):
     q, k, v, o, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q,
-                            block_k)
+                            block_k, group)
     return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def _attention_xla(q, k, v, scale, causal):
+    """XLA-fallback attention for shapes the blocked kernel cannot tile.
+    Delegates to the canonical nn.functional reference impl (end-aligned
+    causal, GQA aware) so the two paths cannot drift apart. Deferred import:
+    nn.functional.attention imports this module at load time."""
+    from ..nn.functional.attention import _sdpa_xla
+    return _sdpa_xla(q, k, v, causal=causal, scale=scale).astype(q.dtype)
+
+
 def flash_attention_values(q, k, v, causal=False, scale=None,
                            block_q=None, block_k=None):
-    """jnp-level flash attention, (B, S, H, D) layout, GQA supported."""
+    """jnp-level flash attention, (B, S, H, D) layout, GQA native."""
     b, sq, h, d = q.shape
     hk = k.shape[2]
     sk = k.shape[1]
@@ -328,14 +376,15 @@ def flash_attention_values(q, k, v, causal=False, scale=None,
         scale = 1.0 / math.sqrt(d)
     bq = block_q or min(DEFAULT_BLOCK_Q, sq)
     bk = block_k or min(DEFAULT_BLOCK_K, sk)
-    if h != hk:  # GQA: repeat kv heads
-        k = jnp.repeat(k, h // hk, axis=2)
-        v = jnp.repeat(v, h // hk, axis=2)
+    if not _aligned(sq, sk, d, bq, bk) or h % hk:
+        # blocked kernel can't tile this shape — XLA fallback, identical math
+        return _attention_xla(q, k, v, float(scale), bool(causal))
+    group = h // hk
     # (B, S, H, D) -> (B*H, S, D)
     qb = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
-    kb = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
-    vb = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
-    ob = _flash(qb, kb, vb, float(scale), bool(causal), bq, bk)
+    kb = jnp.swapaxes(k, 1, 2).reshape(b * hk, sk, d)
+    vb = jnp.swapaxes(v, 1, 2).reshape(b * hk, sk, d)
+    ob = _flash(qb, kb, vb, float(scale), bool(causal), bq, bk, group)
     return jnp.swapaxes(ob.reshape(b, h, sq, d), 1, 2)
 
 

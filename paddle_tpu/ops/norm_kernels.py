@@ -5,6 +5,13 @@
 Row-blocked over (rows, hidden): one VMEM pass computes stats + normalized
 output; bwd recomputes x_hat from saved rstd (memory-light) and reduces
 dgamma/dbeta across row blocks via output accumulation.
+
+Mosaic tiling invariant: every BlockSpec here is either the whole array
+dim (weights (h,), small-n row blocks) or a multiple of BLOCK_ROWS=256
+(rstd/mean 1-D blocks) — the `n % br` guard in the *_values entry points
+routes every other shape to the XLA fallback, so no unaligned block can
+reach the compiled path. h=64 whole-dim blocks are exercised natively on
+TPU by the llama e2e path.
 """
 from __future__ import annotations
 
@@ -19,13 +26,14 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
+from . import on_tpu
 from ..core.tensor import Tensor, apply
 
 BLOCK_ROWS = 256
 
 
 def _interpret() -> bool:
-    return jax.devices()[0].platform != "tpu"
+    return not on_tpu()
 
 
 # -- rmsnorm -----------------------------------------------------------------
@@ -38,6 +46,12 @@ def _rms_fwd_kernel(x_ref, w_ref, o_ref, rstd_ref, *, eps):
 
 
 def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dw_ref, *, eps):
+    # dw accumulates across row blocks into one revisited (1, h) output
+    # block — Mosaic can't tile a (nb, h) partials array with (1, h) blocks.
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+
     x = x_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     w = w_ref[:].astype(jnp.float32)
@@ -47,7 +61,7 @@ def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dw_ref, *, eps):
     # dx = rstd * (wg - xhat * mean(wg * xhat))
     mean_wgx = jnp.mean(wg * xhat, axis=-1, keepdims=True)
     dx_ref[:] = (rstd * (wg - xhat * mean_wgx)).astype(dx_ref.dtype)
-    dw_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)  # per-block partial
+    dw_ref[:] += jnp.sum(g * xhat, axis=0, keepdims=True)
 
 
 def _rms_fwd(x2, w, eps, block_rows):
@@ -81,7 +95,7 @@ def _rms_bwd_rule(eps, block_rows, res, g):
     x2, w, rstd = res
     n, h = x2.shape
     nb = pl.cdiv(n, block_rows)
-    dx, dw_part = pl.pallas_call(
+    dx, dw_acc = pl.pallas_call(
         functools.partial(_rms_bwd_kernel, eps=eps),
         grid=(nb,),
         in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
@@ -89,13 +103,12 @@ def _rms_bwd_rule(eps, block_rows, res, g):
                   pl.BlockSpec((block_rows,), lambda i: (i,)),
                   pl.BlockSpec((block_rows, h), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
-                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+                   pl.BlockSpec((1, h), lambda i: (0, 0))],
         out_shape=[jax.ShapeDtypeStruct((n, h), x2.dtype),
-                   jax.ShapeDtypeStruct((nb, h), jnp.float32)],
+                   jax.ShapeDtypeStruct((1, h), jnp.float32)],
         interpret=_interpret(),
     )(x2, w, rstd, g)
-    dw = jnp.sum(dw_part, axis=0).astype(w.dtype)
-    return dx, dw
+    return dx, dw_acc[0].astype(w.dtype)
 
 
 _rms.defvjp(_rms_fwd_rule, _rms_bwd_rule)
@@ -116,9 +129,11 @@ def rms_norm_values(x, w, eps=1e-6, block_rows=BLOCK_ROWS):
 
 
 def rms_norm(x: Tensor, weight: Tensor, epsilon: float = 1e-6) -> Tensor:
+    # op name matches the XLA path so the AMP BLACK_LIST fp32 protection
+    # applies identically on both backends
     def fn(v, w):
         return rms_norm_values(v, w, epsilon)
-    return apply("rms_norm_pallas", fn, (x, weight))
+    return apply("rms_norm", fn, (x, weight))
 
 
 # -- layernorm ---------------------------------------------------------------
@@ -136,6 +151,11 @@ def _ln_fwd_kernel(x_ref, w_ref, b_ref, o_ref, mean_ref, rstd_ref, *, eps):
 
 def _ln_bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, g_ref,
                    dx_ref, dw_ref, db_ref, *, eps):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+
     x = x_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     w = w_ref[:].astype(jnp.float32)
@@ -146,8 +166,8 @@ def _ln_bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, g_ref,
     m1 = jnp.mean(wg, axis=-1, keepdims=True)
     m2 = jnp.mean(wg * xhat, axis=-1, keepdims=True)
     dx_ref[:] = (rstd * (wg - m1 - xhat * m2)).astype(dx_ref.dtype)
-    dw_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)
-    db_ref[:] = jnp.sum(g, axis=0, keepdims=True)
+    dw_ref[:] += jnp.sum(g * xhat, axis=0, keepdims=True)
+    db_ref[:] += jnp.sum(g, axis=0, keepdims=True)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -192,15 +212,14 @@ def _ln_bwd_rule(eps, block_rows, res, g):
                   pl.BlockSpec((block_rows,), lambda i: (i,)),
                   pl.BlockSpec((block_rows, h), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
-                   pl.BlockSpec((1, h), lambda i: (i, 0)),
-                   pl.BlockSpec((1, h), lambda i: (i, 0))],
+                   pl.BlockSpec((1, h), lambda i: (0, 0)),
+                   pl.BlockSpec((1, h), lambda i: (0, 0))],
         out_shape=[jax.ShapeDtypeStruct((n, h), x2.dtype),
-                   jax.ShapeDtypeStruct((nb, h), jnp.float32),
-                   jax.ShapeDtypeStruct((nb, h), jnp.float32)],
+                   jax.ShapeDtypeStruct((1, h), jnp.float32),
+                   jax.ShapeDtypeStruct((1, h), jnp.float32)],
         interpret=_interpret(),
     )(x2, w, mean, rstd, g)
-    return (dx, jnp.sum(dw_p, 0).astype(w.dtype),
-            jnp.sum(db_p, 0).astype(w.dtype))
+    return (dx, dw_p[0].astype(w.dtype), db_p[0].astype(w.dtype))
 
 
 _ln.defvjp(_ln_fwd_rule, _ln_bwd_rule)
@@ -226,4 +245,4 @@ def layer_norm(x: Tensor, weight: Tensor, bias: Tensor,
                epsilon: float = 1e-5) -> Tensor:
     def fn(v, w, b):
         return layer_norm_values(v, w, b, epsilon)
-    return apply("layer_norm_pallas", fn, (x, weight, bias))
+    return apply("layer_norm", fn, (x, weight, bias))

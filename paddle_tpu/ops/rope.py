@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from . import on_tpu
 from ..core.tensor import Tensor, apply
 
 BLOCK_S = 256
@@ -20,19 +21,19 @@ _FORCE_PALLAS = False  # tests flip this to exercise interpret mode off-TPU
 
 
 def _interpret() -> bool:
-    return jax.devices()[0].platform != "tpu"
+    return not on_tpu()
 
 
-def _rope_kernel(x_ref, cos_ref, sin_ref, o_ref, *, sign):
-    x = x_ref[:]                      # (1, Bs, H, D)
-    c = cos_ref[:][None, :, None, :]  # (1, Bs, 1, D/2)
+def _rope_kernel(x1_ref, x2_ref, cos_ref, sin_ref, r1_ref, r2_ref, *, sign):
+    # Pure elementwise on de-interleaved halves: Mosaic cannot lower the
+    # strided last-dim slice a fused interleaved kernel would need, so the
+    # (de)interleave lives in XLA around the pallas_call.
+    x1 = x1_ref[:].astype(jnp.float32)  # (1, Bs, H, D/2)
+    x2 = x2_ref[:].astype(jnp.float32)
+    c = cos_ref[:][None, :, None, :]    # (1, Bs, 1, D/2)
     s = sin_ref[:][None, :, None, :] * sign
-    x1 = x[..., 0::2].astype(jnp.float32)
-    x2 = x[..., 1::2].astype(jnp.float32)
-    r1 = x1 * c - x2 * s
-    r2 = x2 * c + x1 * s
-    out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
-    o_ref[:] = out.astype(o_ref.dtype)
+    r1_ref[:] = (x1 * c - x2 * s).astype(r1_ref.dtype)
+    r2_ref[:] = (x2 * c + x1 * s).astype(r2_ref.dtype)
 
 
 def _rope_apply(x, cos, sin, sign, block_s):
@@ -46,18 +47,17 @@ def _rope_apply(x, cos, sin, sign, block_s):
         x2 = x[..., 1::2].astype(jnp.float32)
         return jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s],
                          axis=-1).reshape(x.shape).astype(x.dtype)
-    return pl.pallas_call(
+    half_spec = pl.BlockSpec((1, bs, h, d // 2), lambda i, j: (i, j, 0, 0))
+    trig_spec = pl.BlockSpec((bs, d // 2), lambda i, j: (j, 0))
+    r1, r2 = pl.pallas_call(
         functools.partial(_rope_kernel, sign=sign),
         grid=(b, seq // bs),
-        in_specs=[
-            pl.BlockSpec((1, bs, h, d), lambda i, j: (i, j, 0, 0)),
-            pl.BlockSpec((bs, d // 2), lambda i, j: (j, 0)),
-            pl.BlockSpec((bs, d // 2), lambda i, j: (j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, bs, h, d), lambda i, j: (i, j, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        in_specs=[half_spec, half_spec, trig_spec, trig_spec],
+        out_specs=[half_spec, half_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, seq, h, d // 2), x.dtype)] * 2,
         interpret=_interpret(),
-    )(x, cos, sin)
+    )(x[..., 0::2], x[..., 1::2], cos, sin)
+    return jnp.stack([r1, r2], axis=-1).reshape(x.shape)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -81,6 +81,13 @@ _rope.defvjp(_rope_fwd, _rope_bwd)
 def rope_values(x, cos, sin, position_offset=0, block_s=BLOCK_S):
     """x: (B, S, H, D); cos/sin: (max_len, D/2)."""
     seq = x.shape[1]
+    if isinstance(position_offset, int) and \
+            position_offset + seq > cos.shape[0]:
+        # dynamic_slice clamps out-of-range starts, silently reusing wrong
+        # angles — fail loudly instead (decode past the precomputed table)
+        raise ValueError(
+            f"rope: position_offset {position_offset} + seq {seq} exceeds "
+            f"precomputed table length {cos.shape[0]}")
     c = jax.lax.dynamic_slice_in_dim(cos, position_offset, seq, 0)
     s = jax.lax.dynamic_slice_in_dim(sin, position_offset, seq, 0)
     return _rope(x, c.astype(jnp.float32), s.astype(jnp.float32), block_s)

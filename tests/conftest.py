@@ -1,21 +1,29 @@
-"""Test env: force CPU with 8 virtual devices BEFORE jax import.
+"""Test env: 8 virtual CPU devices (SURVEY.md §4 fake-backend strategy).
 
-≙ the reference's fake custom_cpu device plugin strategy for testing the
-whole device/comm path without accelerator hardware (SURVEY.md §4
-«test/custom_runtime/»): every parallelism test must pass on this fake
-8-device mesh."""
+≙ the reference's fake custom_cpu device plugin («test/custom_runtime/»):
+every parallelism test must pass on this fake 8-device mesh. Set
+PDT_TEST_PLATFORM=tpu to run the suite natively on the attached chip
+instead (distributed >1-device tests will skip there).
+
+The axon sitecustomize imports jax at interpreter start, so env-var
+platform selection is already too late here; jax.config.update after
+import is the only override that sticks. XLA_FLAGS must still be set
+before the (lazy) CPU client is created.
+"""
 import os
 
-# force CPU: the ambient env may pin JAX_PLATFORMS=axon (TPU tunnel), but
-# the test tier always runs on the virtual 8-device CPU mesh (SURVEY.md §4)
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+if os.environ.get("PDT_TEST_PLATFORM", "cpu") == "cpu":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import jax
+
 # this jaxlib's CPU matmul defaults to fast (bf16-ish) passes; tests compare
 # against NumPy, so force exact fp32 matmuls in the test env only
-import jax  # noqa: E402
-
 jax.config.update("jax_default_matmul_precision", "highest")

@@ -45,7 +45,10 @@ class TestOptimizers:
         _quadratic_converges(RMSProp, lr=0.3, tol=0.3)
 
     def test_lamb(self):
-        _quadratic_converges(Lamb, lr=0.15, steps=120, tol=0.3)
+        # decay off: LAMB's fixed point with weight decay is biased away
+        # from the quadratic minimum, which is what this oracle checks
+        _quadratic_converges(Lamb, lr=0.15, steps=150, tol=0.3,
+                             lamb_weight_decay=0.0)
 
     def test_adamw_decoupled_decay(self):
         # with huge decay and zero grad-producing loss, params shrink

@@ -15,13 +15,18 @@ rng = np.random.default_rng(7)
 
 
 def _sdpa_ref(q, k, v, causal=False):
-    b, s, h, d = q.shape
+    """End-aligned causal (q row i sees keys <= i + sk - sq), GQA aware."""
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if h != hk:
+        k = np.repeat(k, h // hk, axis=2)
+        v = np.repeat(v, h // hk, axis=2)
     qb = q.transpose(0, 2, 1, 3).astype(np.float64)
     kb = k.transpose(0, 2, 1, 3).astype(np.float64)
     vb = v.transpose(0, 2, 1, 3).astype(np.float64)
     logits = qb @ kb.transpose(0, 1, 3, 2) / np.sqrt(d)
     if causal:
-        mask = np.tril(np.ones((s, s), bool))
+        mask = np.arange(sq)[:, None] + (sk - sq) >= np.arange(sk)[None, :]
         logits = np.where(mask, logits, -1e30)
     w = np.exp(logits - logits.max(-1, keepdims=True))
     w = w / w.sum(-1, keepdims=True)
@@ -106,6 +111,82 @@ class TestFlashAttention:
         assert q.grad is not None
         assert np.isfinite(q.grad.numpy()).all()
 
+    def test_causal_cross_attention_end_aligned(self):
+        # sq < sk (KV-cache / chunked-prefill shape): mask must be
+        # end-aligned like the XLA fallback, not start-aligned
+        q = rng.normal(size=(1, 64, 1, 32)).astype(np.float32)
+        k = rng.normal(size=(1, 128, 1, 32)).astype(np.float32)
+        v = rng.normal(size=(1, 128, 1, 32)).astype(np.float32)
+        out = fa.flash_attention_values(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True,
+            block_q=32, block_k=32)
+        want = _sdpa_ref(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_unaligned_lengths_fall_back(self):
+        # sk=192 is not a block_k multiple: must not produce NaN (XLA path)
+        q = rng.normal(size=(1, 64, 1, 32)).astype(np.float32)
+        k = rng.normal(size=(1, 192, 1, 32)).astype(np.float32)
+        v = rng.normal(size=(1, 192, 1, 32)).astype(np.float32)
+        out = fa.flash_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                        jnp.asarray(v))
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_allclose(np.asarray(out), _sdpa_ref(q, k, v),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_fully_masked_rows_zero_output_and_grad(self):
+        # causal with sq > sk: first sq-sk query rows attend no keys.
+        # Kernel convention: output 0, zero grad (no exp(0)=1 leakage
+        # corrupting the shared dk/dv accumulators).
+        q = rng.normal(size=(1, 128, 1, 32)).astype(np.float32)
+        k = rng.normal(size=(1, 64, 1, 32)).astype(np.float32)
+        v = rng.normal(size=(1, 64, 1, 32)).astype(np.float32)
+
+        def loss(q_, k_, v_):
+            o = fa.flash_attention_values(q_, k_, v_, causal=True,
+                                          block_q=64, block_k=32)
+            return jnp.sum(o ** 2), o
+
+        (val, o), grads = jax.value_and_grad(loss, argnums=(0, 1, 2),
+                                             has_aux=True)(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        o = np.asarray(o)
+        # rows 0..63 attend nothing -> exactly 0
+        np.testing.assert_array_equal(o[0, :64], 0.0)
+        # rows 64.. match the reference on the defined region
+        want = _sdpa_ref(q, k, v, causal=True)
+        np.testing.assert_allclose(o[0, 64:], want[0, 64:], rtol=2e-4,
+                                   atol=2e-4)
+        gq, gk, gv = (np.asarray(g) for g in grads)
+        assert np.isfinite(gq).all() and np.isfinite(gk).all() \
+            and np.isfinite(gv).all()
+        np.testing.assert_array_equal(gq[0, :64], 0.0)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gqa_grad_no_repeat(self, causal):
+        # dk/dv accumulate over the q-head group inside the kernel
+        q = rng.normal(size=(1, 64, 4, 16)).astype(np.float32)
+        k = rng.normal(size=(1, 64, 2, 16)).astype(np.float32)
+        v = rng.normal(size=(1, 64, 2, 16)).astype(np.float32)
+
+        def flash_loss(q_, k_, v_):
+            return jnp.sum(fa.flash_attention_values(
+                q_, k_, v_, causal=causal, block_q=32, block_k=32) ** 2)
+
+        def xla_loss(q_, k_, v_):
+            return jnp.sum(fa._attention_xla(
+                q_, k_, v_, 1.0 / np.sqrt(16), causal) ** 2)
+
+        g_flash = jax.grad(flash_loss, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        g_xla = jax.grad(xla_loss, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for gf, gx in zip(g_flash, g_xla):
+            assert gf.shape == gx.shape
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gx),
+                                       rtol=5e-3, atol=5e-4)
+
 
 class TestNormKernels:
     def test_rmsnorm_forward(self):
@@ -122,6 +203,25 @@ class TestNormKernels:
 
         def pallas_loss(x_, w_):
             return jnp.sum(nk.rms_norm_values(x_, w_) ** 2)
+
+        def xla_loss(x_, w_):
+            ms = jnp.mean(x_ ** 2, -1, keepdims=True)
+            return jnp.sum((x_ * jax.lax.rsqrt(ms + 1e-6) * w_) ** 2)
+
+        gp = jax.grad(pallas_loss, (0, 1))(jnp.asarray(x), jnp.asarray(w))
+        gx = jax.grad(xla_loss, (0, 1))(jnp.asarray(x), jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(gp[0]), np.asarray(gx[0]),
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(gp[1]), np.asarray(gx[1]),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_rmsnorm_grad_multi_row_block(self):
+        # n > block_rows: dw must accumulate across revisited output blocks
+        x = rng.normal(size=(512, 64)).astype(np.float32)
+        w = np.abs(rng.normal(size=(64,))).astype(np.float32)
+
+        def pallas_loss(x_, w_):
+            return jnp.sum(nk.rms_norm_values(x_, w_, block_rows=128) ** 2)
 
         def xla_loss(x_, w_):
             ms = jnp.mean(x_ ** 2, -1, keepdims=True)
