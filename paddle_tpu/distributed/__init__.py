@@ -12,6 +12,10 @@ from .collective import (ReduceOp, Group, new_group, all_reduce,  # noqa: F401
                          alltoall_single, send, recv, barrier,
                          destroy_process_group, get_backend, get_group)
 from .random_ import get_rng_state_tracker  # noqa: F401
+from .ring_attention import (ring_flash_attention,  # noqa: F401
+                             ring_attention_values,
+                             ulysses_flash_attention,
+                             ulysses_attention_values)
 from . import fleet  # noqa: F401
 from .fleet import DataParallel  # noqa: F401
 

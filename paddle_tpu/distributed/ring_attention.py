@@ -1,0 +1,190 @@
+"""Context parallelism: ring flash attention + Ulysses (alltoall) attention.
+
+≙ reference PaddleNLP `ring_flash_attention.py` (RingFlashAttention: ring
+P2P of KV blocks with online-softmax merge over the `sep` group) and the
+DeepSpeed-Ulysses-style alltoall head-scatter variant — SURVEY.md §2.3
+"CP / ring attention" row. The reference builds these from NCCL send/recv;
+here they are `shard_map` programs over a mesh axis: the KV rotation is a
+`ppermute` (collective_permute riding ICI) and the schedule is a `lax.scan`,
+so the whole thing jits, differentiates (scan + ppermute both have
+transpose rules), and composes with every other mesh axis.
+
+Layout convention (B, S, H, D) — paddle flash_attn convention; activations
+arrive sequence-sharded over the `sep` axis.
+
+Ring v1 computes each (q-chunk, kv-chunk) step with an XLA chunk kernel
+that returns (o, lse) for the online merge; fully-masked steps contribute
+lse = -inf and drop out of the merge exactly. Causal uses per-step masking
+(no zigzag load-balancing yet). Ulysses runs the *local* full-sequence
+attention through the Pallas flash kernel when shapes allow.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8 name
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..core.tensor import Tensor, apply
+from .mesh import ProcessMesh, get_mesh
+
+NEG_INF = -1e30
+
+
+def _chunk_attn_with_lse(q, k, v, scale, mask):
+    """One (q-chunk, kv-chunk) attention step.
+
+    q: (B, Sq, H, D); k, v: (B, Sk, H, D); mask: (Sq, Sk) bool or None.
+    Returns (o, lse) with lse = log sum exp of the scaled logits, -inf for
+    fully-masked rows (their o rows are 0).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)               # (B,H,Sq,1)
+    masked_row = m <= NEG_INF * 0.5
+    p = jnp.where(s > NEG_INF * 0.5,
+                  jnp.exp(s - jnp.where(masked_row, 0.0, m)), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    o = o / jnp.maximum(jnp.swapaxes(l, 1, 2), 1e-30)    # (B,Sq,H,D)
+    lse = jnp.where(masked_row, NEG_INF,
+                    m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]  # (B,H,Sq)
+    return o, jnp.swapaxes(lse, 1, 2)                    # lse (B,Sq,H)
+
+
+def _merge(o_a, lse_a, o_b, lse_b):
+    """Associative online-softmax merge of two partial attention results."""
+    lse_m = jnp.logaddexp(lse_a, lse_b)                  # (B,Sq,H)
+    both_masked = lse_m <= NEG_INF * 0.5
+    wa = jnp.where(both_masked, 0.0, jnp.exp(lse_a - lse_m))[..., None]
+    wb = jnp.where(both_masked, 0.0, jnp.exp(lse_b - lse_m))[..., None]
+    return o_a * wa + o_b * wb, lse_m
+
+
+def ring_attention_values(q, k, v, mesh: Optional[ProcessMesh] = None,
+                          axis: str = "sep", causal: bool = False,
+                          scale: Optional[float] = None):
+    """jnp-level ring attention. q/k/v: GLOBAL (B, S, H, D), sequence-
+    sharded over `axis`; returns the globally-sharded output."""
+    mesh = mesh or get_mesh()
+    if mesh is None or axis not in mesh.dim_names or \
+            mesh.get_dim_size(axis) == 1:
+        from ..ops.flash_attention import flash_attention_values
+        return flash_attention_values(q, k, v, causal=causal, scale=scale)
+
+    n = mesh.get_dim_size(axis)
+    b, s_global, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    hk = k.shape[2]
+    if h != hk:
+        # ring rotates KV; keep chunks head-complete by expanding GQA here
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+    c = s_global // n  # local chunk length
+
+    def local_fn(ql, kl, vl):
+        # ql/kl/vl: (B, c, H, D) — this device's sequence chunk
+        my = jax.lax.axis_index(axis)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+
+        def step(carry, i):
+            o_acc, lse_acc, k_cur, v_cur = carry
+            src = (my - i) % n  # whose chunk we hold at step i
+            if causal:
+                # chunk-level relation: src < my full, == local causal,
+                # > fully masked
+                q_pos = my * c + jnp.arange(c)[:, None]
+                k_pos = src * c + jnp.arange(c)[None, :]
+                mask = q_pos >= k_pos
+            else:
+                mask = None
+            o_i, lse_i = _chunk_attn_with_lse(ql, k_cur, v_cur, scale, mask)
+            o_acc, lse_acc = _merge(o_acc, lse_acc, o_i, lse_i)
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return (o_acc, lse_acc, k_nxt, v_nxt), None
+
+        o0 = jnp.zeros(ql.shape, jnp.float32)
+        lse0 = jnp.full(ql.shape[:3], NEG_INF, jnp.float32)
+        (o, lse, _, _), _ = jax.lax.scan(
+            step, (o0, lse0, kl, vl), jnp.arange(n))
+        return o.astype(ql.dtype)
+
+    spec = P(None, axis, None, None)
+    return _shard_map(local_fn, mesh=mesh.jax_mesh,
+                      in_specs=(spec, spec, spec), out_specs=spec,
+                      check_vma=False)(q, k, v)
+
+
+def ulysses_attention_values(q, k, v, mesh: Optional[ProcessMesh] = None,
+                             axis: str = "sep", causal: bool = False,
+                             scale: Optional[float] = None):
+    """Ulysses sequence parallelism: alltoall scatters heads / gathers
+    sequence, full-length attention runs locally per head shard (through
+    the Pallas flash kernel when aligned), alltoall back."""
+    mesh = mesh or get_mesh()
+    from ..ops.flash_attention import flash_attention_values
+    if mesh is None or axis not in mesh.dim_names or \
+            mesh.get_dim_size(axis) == 1:
+        return flash_attention_values(q, k, v, causal=causal, scale=scale)
+
+    n = mesh.get_dim_size(axis)
+    b, s_global, h, d = q.shape
+    hk = k.shape[2]
+    if h % n or (hk % n and h != hk):
+        # heads must split evenly across the axis; expand GQA if the kv
+        # heads alone cannot
+        if h % n:
+            raise ValueError(f"ulysses: num heads {h} not divisible by "
+                             f"sep degree {n}")
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
+        hk = h
+
+    def local_fn(ql, kl, vl):
+        # (B, c, H, D) -> tiled alltoall: scatter heads, gather sequence
+        def head_scatter(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)   # (B, S, H/n, D)
+
+        qf, kf, vf = head_scatter(ql), head_scatter(kl), head_scatter(vl)
+        of = flash_attention_values(qf, kf, vf, causal=causal, scale=scale)
+        # (B, S, H/n, D) -> inverse alltoall -> (B, c, H, D)
+        return jax.lax.all_to_all(of, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    spec = P(None, axis, None, None)
+    return _shard_map(local_fn, mesh=mesh.jax_mesh,
+                      in_specs=(spec, spec, spec), out_specs=spec,
+                      check_vma=False)(q, k, v)
+
+
+def ring_flash_attention(q: Tensor, k: Tensor, v: Tensor,
+                         mesh: Optional[ProcessMesh] = None,
+                         axis: str = "sep", causal: bool = False,
+                         scale=None) -> Tensor:
+    """Eager/tape entry point. ≙ PaddleNLP RingFlashAttention [U?]."""
+    def fn(qq, kk, vv):
+        return ring_attention_values(qq, kk, vv, mesh, axis, causal, scale)
+    return apply("ring_flash_attention", fn, (q, k, v))
+
+
+def ulysses_flash_attention(q: Tensor, k: Tensor, v: Tensor,
+                            mesh: Optional[ProcessMesh] = None,
+                            axis: str = "sep", causal: bool = False,
+                            scale=None) -> Tensor:
+    def fn(qq, kk, vv):
+        return ulysses_attention_values(qq, kk, vv, mesh, axis, causal,
+                                        scale)
+    return apply("ulysses_flash_attention", fn, (q, k, v))

@@ -1,0 +1,175 @@
+"""Mixture-of-Experts with expert parallelism — TPU-native.
+
+≙ reference «python/paddle/incubate/distributed/models/moe/» (MoELayer,
+GShard/Switch gates) + the `global_scatter`/`global_gather` alltoall
+dispatch ops («paddle/fluid/operators/collective/global_scatter_op*» [U?],
+SURVEY.md §2.3 EP row).
+
+TPU-native design: dispatch/combine are dense one-hot einsums (GShard
+style, MXU-friendly, static shapes — no ragged recompilations); experts
+are ONE stacked parameter (E, ...) sharded over the `ep` mesh axis, and
+the alltoall the reference hand-codes is inserted by XLA from the
+sharding of the dispatched (E, C, d) tensor. Capacity-based top-k routing
+with the standard load-balancing auxiliary loss.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, apply
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer
+
+__all__ = ["moe_gating_values", "moe_ffn_values", "MoELayer", "shard_moe"]
+
+
+def moe_gating_values(logits, top_k: int, capacity: int):
+    """GShard-style top-k capacity gating (all static shapes).
+
+    logits: (T, E) router scores.
+    Returns (dispatch (T, E, C) float {0,1}, combine (T, E, C) float,
+    aux_loss scalar). Priority is choice-major: every token's 1st choice
+    is placed before any 2nd choice, matching the reference gate.
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)   # (T, E)
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)             # (T, K)
+
+    # one-hot per choice: (K, T, E), then position of each (choice, token)
+    # inside its expert's queue by cumulative count in priority order
+    oh = jax.nn.one_hot(gate_idx.T, e, dtype=jnp.float32)         # (K, T, E)
+    flat = oh.reshape(top_k * t, e)
+    pos = jnp.cumsum(flat, axis=0) - flat                         # (K*T, E)
+    pos = jnp.sum(pos * flat, axis=-1).astype(jnp.int32)          # (K*T,)
+    keep = (pos < capacity) & (jnp.sum(flat, -1) > 0)
+    pos_oh = jax.nn.one_hot(pos, capacity, dtype=jnp.float32) \
+        * keep[:, None]                                           # (K*T, C)
+    # (K, T, E, C): expert one-hot x capacity one-hot
+    disp = (flat.reshape(top_k, t, e)[..., None]
+            * pos_oh.reshape(top_k, t, 1, capacity))
+    dispatch = jnp.sum(disp, axis=0)                              # (T, E, C)
+    combine = jnp.sum(disp * gate_vals.T[..., None, None], axis=0)
+
+    # load-balance aux (Switch/GShard): E * sum_e f_e * p_e, over 1st choice
+    f = jnp.mean(oh[0], axis=0)            # fraction routed to e (choice 0)
+    p = jnp.mean(probs, axis=0)            # mean router prob
+    aux = e * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+def moe_ffn_values(x2, gate_w, w_gate, w_up, w_down, top_k: int,
+                   capacity_factor: float, ep_axis: Optional[str] = None,
+                   mesh=None):
+    """Dense-dispatch MoE SwiGLU FFN. x2: (T, H); gate_w: (H, E);
+    stacked experts w_gate/w_up: (E, H, I), w_down: (E, I, H)."""
+    t, h = x2.shape
+    e = gate_w.shape[1]
+    capacity = max(int(math.ceil(top_k * t / e * capacity_factor)), 1)
+    logits = x2.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    dispatch, combine, aux = moe_gating_values(logits, top_k, capacity)
+
+    xe = jnp.einsum("tec,th->ech", dispatch.astype(x2.dtype), x2)  # (E,C,H)
+    if ep_axis is not None and mesh is not None and \
+            ep_axis in mesh.dim_names:
+        from ...distributed.mesh import shard_constraint
+        xe = shard_constraint(xe, ep_axis, None, None, mesh=mesh)
+    hgate = jnp.einsum("ech,ehi->eci", xe, w_gate.astype(xe.dtype))
+    hup = jnp.einsum("ech,ehi->eci", xe, w_up.astype(xe.dtype))
+    ho = jax.nn.silu(hgate.astype(jnp.float32)).astype(xe.dtype) * hup
+    oe = jnp.einsum("eci,eih->ech", ho, w_down.astype(xe.dtype))  # (E,C,H)
+    if ep_axis is not None and mesh is not None and \
+            ep_axis in mesh.dim_names:
+        from ...distributed.mesh import shard_constraint
+        oe = shard_constraint(oe, ep_axis, None, None, mesh=mesh)
+    out = jnp.einsum("tec,ech->th", combine.astype(oe.dtype), oe)
+    return out.astype(x2.dtype), aux
+
+
+class MoELayer(Layer):
+    """Sparse SwiGLU MoE block (+ optional dense shared experts).
+    ≙ paddle.incubate MoELayer / Qwen2-MoE & DeepSeekMoE sparse MLP [U?].
+
+    forward(x) -> (out, aux_loss); x: (..., H).
+    """
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 num_experts: int, top_k: int = 2,
+                 capacity_factor: float = 1.25,
+                 shared_intermediate_size: int = 0,
+                 ep_axis: str = "ep", name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.ep_axis = ep_axis
+        e, h, i = num_experts, hidden_size, intermediate_size
+        self.gate_weight = self.create_parameter(
+            (h, e), default_initializer=I.Normal(0.0, 0.02))
+        self.w_gate = self.create_parameter(
+            (e, h, i), default_initializer=I.XavierNormal(fan_in=h,
+                                                          fan_out=i))
+        self.w_up = self.create_parameter(
+            (e, h, i), default_initializer=I.XavierNormal(fan_in=h,
+                                                          fan_out=i))
+        self.w_down = self.create_parameter(
+            (e, i, h), default_initializer=I.XavierNormal(fan_in=i,
+                                                          fan_out=h))
+        if shared_intermediate_size:
+            from ...nn import Linear
+            self.shared_gate = Linear(h, shared_intermediate_size,
+                                      bias_attr=False)
+            self.shared_up = Linear(h, shared_intermediate_size,
+                                    bias_attr=False)
+            self.shared_down = Linear(shared_intermediate_size, h,
+                                      bias_attr=False)
+        else:
+            self.shared_gate = None
+
+    def forward(self, x):
+        from ...distributed.mesh import get_mesh
+        shape = x.shape
+        h = shape[-1]
+        mesh = get_mesh()
+        top_k, cf, ep = self.top_k, self.capacity_factor, self.ep_axis
+
+        def fn(xv, gw, wg, wu, wd):
+            x2 = xv.reshape(-1, h)
+            out, aux = moe_ffn_values(x2, gw, wg, wu, wd, top_k, cf,
+                                      ep, mesh)
+            return out.reshape(xv.shape), aux
+
+        out, aux = apply("moe_ffn", fn,
+                         (x, self.gate_weight, self.w_gate, self.w_up,
+                          self.w_down), multi_output=True)
+        if self.shared_gate is not None:
+            from ...nn import functional as F
+            out = out + self.shared_down(
+                F.silu(self.shared_gate(x)) * self.shared_up(x))
+        return out, aux
+
+
+def shard_moe(layer, mesh, ep_axis: str = "ep"):
+    """Place stacked expert params Shard(0) over the `ep` axis (the
+    reference's expert-parallel group); gate + shared experts replicate."""
+    from ...distributed.mesh import Replicate, Shard, shard_tensor
+    if ep_axis not in mesh.dim_names:
+        return layer
+    for sub in layer.sublayers(include_self=True):
+        if isinstance(sub, MoELayer):
+            for pname in ("w_gate", "w_up", "w_down"):
+                p = getattr(sub, pname)
+                if p._value.shape[0] % mesh.get_dim_size(ep_axis):
+                    continue
+                placements = [Replicate() for _ in mesh.dim_names]
+                placements[mesh.dim_names.index(ep_axis)] = Shard(0)
+                s = shard_tensor(p, mesh, placements)
+                p._value = s._value
+                p.dist_attr = s.dist_attr
+    return layer

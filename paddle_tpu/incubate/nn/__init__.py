@@ -1,0 +1,5 @@
+"""paddle_tpu.incubate.nn — fused-op python APIs.
+≙ reference «python/paddle/incubate/nn/functional/» fused ops [U]. The
+fused kernels live in paddle_tpu.ops; these are the incubate-namespace
+aliases the reference exposes."""
+from . import functional  # noqa: F401

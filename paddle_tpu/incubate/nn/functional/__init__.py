@@ -1,0 +1,13 @@
+"""≙ paddle.incubate.nn.functional fused ops [U] — aliases over the
+Pallas kernel library (paddle_tpu.ops)."""
+from ....ops.flash_attention import flash_attention  # noqa: F401
+from ....ops.rope import fused_rotary_position_embedding  # noqa: F401
+from ....ops.norm_kernels import rms_norm as fused_rms_norm  # noqa: F401
+from ....ops.norm_kernels import layer_norm as fused_layer_norm  # noqa: F401
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kw):
+    raise NotImplementedError(
+        "fused_multi_head_attention: compose q/k/v projections with "
+        "paddle_tpu.nn.functional.scaled_dot_product_attention — XLA fuses "
+        "the projections; the attention core is the Pallas flash kernel.")

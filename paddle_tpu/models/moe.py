@@ -1,0 +1,148 @@
+"""MoE causal-LM family (Qwen2-MoE / DeepSeekMoE shape) — north-star
+config #5 (BASELINE.md "DeepSeekMoE/Qwen2-MoE expert parallel"). Reuses the
+Llama attention stack; the MLP is a sparse MoELayer (shared + routed
+experts, top-k capacity routing) with expert parallelism over the `ep`
+mesh axis. ≙ PaddleNLP Qwen2-MoE recipe + reference incubate MoE
+(SURVEY.md §2.3 EP row)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+from paddle_tpu.incubate.moe import MoELayer, shard_moe
+
+from .llama import (LlamaAttention, LlamaConfig, precompute_rope,
+                    synthetic_lm_batch)
+
+__all__ = ["MoEConfig", "MoEForCausalLM", "shard_moe_model",
+           "synthetic_lm_batch"]
+
+
+@dataclass
+class MoEConfig:
+    vocab_size: int = 151936
+    hidden_size: int = 2048
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1000000.0
+    num_experts: int = 60
+    num_experts_per_tok: int = 4
+    moe_intermediate_size: int = 1408
+    shared_expert_intermediate_size: int = 5632
+    capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.001
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def qwen2_moe_a14b():
+        """Qwen2-57B-A14B shape."""
+        return MoEConfig(hidden_size=3584, num_hidden_layers=28,
+                         num_attention_heads=28, num_key_value_heads=4,
+                         num_experts=64, num_experts_per_tok=8,
+                         moe_intermediate_size=2560,
+                         shared_expert_intermediate_size=20480)
+
+    @staticmethod
+    def tiny():
+        return MoEConfig(vocab_size=512, hidden_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         num_key_value_heads=2,
+                         max_position_embeddings=128, num_experts=4,
+                         num_experts_per_tok=2, moe_intermediate_size=96,
+                         shared_expert_intermediate_size=128)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    def _as_llama(self) -> LlamaConfig:
+        return LlamaConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            intermediate_size=self.moe_intermediate_size,
+            num_hidden_layers=self.num_hidden_layers,
+            num_attention_heads=self.num_attention_heads,
+            num_key_value_heads=self.num_key_value_heads,
+            max_position_embeddings=self.max_position_embeddings,
+            rms_norm_eps=self.rms_norm_eps, rope_theta=self.rope_theta)
+
+
+class MoEDecoderLayer(nn.Layer):
+    def __init__(self, cfg: MoEConfig):
+        super().__init__()
+        lcfg = cfg._as_llama()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(lcfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   cfg.rms_norm_eps)
+        self.mlp = MoELayer(
+            cfg.hidden_size, cfg.moe_intermediate_size, cfg.num_experts,
+            top_k=cfg.num_experts_per_tok,
+            capacity_factor=cfg.capacity_factor,
+            shared_intermediate_size=cfg.shared_expert_intermediate_size)
+
+    def forward(self, x, cos, sin, attention_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), cos, sin,
+                               attention_mask)
+        mlp_out, aux = self.mlp(self.post_attention_layernorm(x))
+        return x + mlp_out, aux
+
+
+class MoEModel(nn.Layer):
+    def __init__(self, cfg: MoEConfig):
+        super().__init__()
+        self.config = cfg
+        self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = nn.LayerList(
+            [MoEDecoderLayer(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        cos, sin = precompute_rope(cfg.head_dim,
+                                   cfg.max_position_embeddings,
+                                   cfg.rope_theta)
+        self.register_buffer("rope_cos", cos, persistable=False)
+        self.register_buffer("rope_sin", sin, persistable=False)
+
+    def forward(self, input_ids, attention_mask=None):
+        x = self.embed_tokens(input_ids)
+        aux_total = None
+        for layer in self.layers:
+            x, aux = layer(x, self.rope_cos, self.rope_sin, attention_mask)
+            aux_total = aux if aux_total is None else aux_total + aux
+        return self.norm(x), aux_total
+
+
+class MoEForCausalLM(nn.Layer):
+    def __init__(self, cfg: MoEConfig | None = None):
+        super().__init__()
+        cfg = cfg or MoEConfig()
+        self.config = cfg
+        self.model = MoEModel(cfg)
+        self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                 bias_attr=False)
+
+    def forward(self, input_ids, labels=None, attention_mask=None):
+        hidden, aux = self.model(input_ids, attention_mask)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size])
+                .astype("float32"),
+                labels.reshape([-1]), ignore_index=-100)
+            loss = loss + self.config.router_aux_loss_coef * aux
+            return loss, logits
+        return logits
+
+
+def shard_moe_model(model: MoEForCausalLM, mesh) -> MoEForCausalLM:
+    """EP placements for the experts (Shard(0) over 'ep') + the llama 4D
+    recipe for attention/embeddings."""
+    from .llama import shard_llama
+    shard_llama(model, mesh)   # attention/embedding/norm placements
+    shard_moe(model, mesh, ep_axis="ep")
+    return model

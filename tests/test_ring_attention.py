@@ -1,0 +1,168 @@
+"""Context-parallel attention tests on the 8-virtual-device CPU mesh.
+≙ reference PaddleNLP ring_flash_attention tests + «test/collective/» tier
+(SURVEY.md §4): every parallelism test must pass on the fake 8-device mesh."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.ring_attention import (
+    ring_attention_values, ulysses_attention_values)
+
+rng = np.random.default_rng(11)
+
+
+def _sdpa_ref(q, k, v, causal=False):
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if h != hk:
+        k = np.repeat(k, h // hk, axis=2)
+        v = np.repeat(v, h // hk, axis=2)
+    qb = q.transpose(0, 2, 1, 3).astype(np.float64)
+    kb = k.transpose(0, 2, 1, 3).astype(np.float64)
+    vb = v.transpose(0, 2, 1, 3).astype(np.float64)
+    logits = qb @ kb.transpose(0, 1, 3, 2) / np.sqrt(d)
+    if causal:
+        mask = np.arange(sq)[:, None] + (sk - sq) >= np.arange(sk)[None, :]
+        logits = np.where(mask, logits, -1e30)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    return (w @ vb).transpose(0, 2, 1, 3).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def sep_mesh():
+    return dist.create_mesh(sep=4)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, sep_mesh, causal):
+        q = rng.normal(size=(2, 64, 4, 16)).astype(np.float32)
+        k = rng.normal(size=(2, 64, 4, 16)).astype(np.float32)
+        v = rng.normal(size=(2, 64, 4, 16)).astype(np.float32)
+        out = ring_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v), sep_mesh, "sep",
+                                    causal=causal)
+        np.testing.assert_allclose(np.asarray(out),
+                                   _sdpa_ref(q, k, v, causal),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_gqa(self, sep_mesh):
+        q = rng.normal(size=(1, 32, 4, 16)).astype(np.float32)
+        k = rng.normal(size=(1, 32, 2, 16)).astype(np.float32)
+        v = rng.normal(size=(1, 32, 2, 16)).astype(np.float32)
+        out = ring_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v), sep_mesh, "sep",
+                                    causal=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   _sdpa_ref(q, k, v, True),
+                                   rtol=2e-4, atol=2e-4)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grad_matches_reference(self, sep_mesh, causal):
+        q = rng.normal(size=(1, 32, 2, 16)).astype(np.float32)
+        k = rng.normal(size=(1, 32, 2, 16)).astype(np.float32)
+        v = rng.normal(size=(1, 32, 2, 16)).astype(np.float32)
+
+        def ring_loss(q_, k_, v_):
+            return jnp.sum(ring_attention_values(
+                q_, k_, v_, sep_mesh, "sep", causal=causal) ** 2)
+
+        def ref_loss(q_, k_, v_):
+            d = q_.shape[-1]
+            qb = jnp.swapaxes(q_, 1, 2)
+            kb = jnp.swapaxes(k_, 1, 2)
+            vb = jnp.swapaxes(v_, 1, 2)
+            logits = (qb @ jnp.swapaxes(kb, -1, -2)) / np.sqrt(d)
+            if causal:
+                s = logits.shape[-1]
+                logits = jnp.where(jnp.tril(jnp.ones((s, s), bool)),
+                                   logits, -1e30)
+            w = jax.nn.softmax(logits, -1)
+            return jnp.sum(jnp.swapaxes(w @ vb, 1, 2) ** 2)
+
+        args = (jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        g_ring = jax.grad(ring_loss, (0, 1, 2))(*args)
+        g_ref = jax.grad(ref_loss, (0, 1, 2))(*args)
+        for gr, gx in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(gr), np.asarray(gx),
+                                       rtol=5e-3, atol=1e-4)
+
+    def test_jit_and_sharded_inputs(self, sep_mesh):
+        """Ring attention under jit with sequence-sharded device inputs."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        q = rng.normal(size=(1, 64, 2, 16)).astype(np.float32)
+        sh = NamedSharding(sep_mesh.jax_mesh, P(None, "sep", None, None))
+        qd = jax.device_put(jnp.asarray(q), sh)
+
+        @jax.jit
+        def f(q_):
+            return ring_attention_values(q_, q_, q_, sep_mesh, "sep",
+                                         causal=True)
+
+        out = f(qd)
+        np.testing.assert_allclose(np.asarray(out),
+                                   _sdpa_ref(q, q, q, True),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_no_axis_falls_back(self):
+        q = rng.normal(size=(1, 32, 2, 16)).astype(np.float32)
+        out = ring_attention_values(jnp.asarray(q), jnp.asarray(q),
+                                    jnp.asarray(q), None, "sep", True)
+        np.testing.assert_allclose(np.asarray(out), _sdpa_ref(q, q, q, True),
+                                   rtol=2e-4, atol=2e-4)
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, sep_mesh, causal):
+        q = rng.normal(size=(2, 64, 4, 16)).astype(np.float32)
+        k = rng.normal(size=(2, 64, 4, 16)).astype(np.float32)
+        v = rng.normal(size=(2, 64, 4, 16)).astype(np.float32)
+        out = ulysses_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                       jnp.asarray(v), sep_mesh, "sep",
+                                       causal=causal)
+        np.testing.assert_allclose(np.asarray(out),
+                                   _sdpa_ref(q, k, v, causal),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_gqa_expand(self, sep_mesh):
+        # hk=2 < sep=4: kv heads expand to full h before the alltoall
+        q = rng.normal(size=(1, 32, 4, 16)).astype(np.float32)
+        k = rng.normal(size=(1, 32, 2, 16)).astype(np.float32)
+        v = rng.normal(size=(1, 32, 2, 16)).astype(np.float32)
+        out = ulysses_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                       jnp.asarray(v), sep_mesh, "sep",
+                                       causal=True)
+        np.testing.assert_allclose(np.asarray(out),
+                                   _sdpa_ref(q, k, v, True),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_indivisible_heads_raises(self, sep_mesh):
+        q = rng.normal(size=(1, 32, 3, 16)).astype(np.float32)
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention_values(jnp.asarray(q), jnp.asarray(q),
+                                     jnp.asarray(q), sep_mesh, "sep")
+
+    def test_grad(self, sep_mesh):
+        q = rng.normal(size=(1, 32, 4, 16)).astype(np.float32)
+
+        def loss(q_):
+            return jnp.sum(ulysses_attention_values(
+                q_, q_, q_, sep_mesh, "sep", causal=True) ** 2)
+
+        g = jax.grad(loss)(jnp.asarray(q))
+        assert np.isfinite(np.asarray(g)).all()
+        # compare against the single-device path's grad
+        from paddle_tpu.ops.flash_attention import flash_attention_values
+
+        def ref_loss(q_):
+            return jnp.sum(flash_attention_values(q_, q_, q_,
+                                                  causal=True) ** 2)
+
+        g_ref = jax.grad(ref_loss)(jnp.asarray(q))
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=5e-3, atol=1e-4)
