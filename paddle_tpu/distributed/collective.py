@@ -21,9 +21,11 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 try:
-    from jax.experimental.shard_map import shard_map
-except ImportError:  # newer jax
-    from jax import shard_map  # type: ignore
+    from jax import shard_map  # jax >= 0.8
+    _SM_KW = {"check_vma": False}
+except ImportError:  # pragma: no cover — older jax
+    from jax.experimental.shard_map import shard_map  # type: ignore
+    _SM_KW = {"check_rep": False}
 
 from ..core.tensor import Tensor, to_tensor
 from .mesh import ProcessMesh
@@ -86,7 +88,7 @@ class Group:
             else PartitionSpec(self.axis)
         mapped = shard_map(fn, mesh=self.mesh.jax_mesh,
                            in_specs=(in_specs,), out_specs=out_specs,
-                           check_rep=False)
+                           **_SM_KW)
         return Tensor(mapped(v))
 
 

@@ -1,0 +1,126 @@
+"""SPMD pipeline parallelism — the TPU-native replacement for the
+reference's PipelineParallel.train_batch 1F1B schedule
+(«.../fleet/meta_parallel/pipeline_parallel.py», p2p_communication.py —
+SURVEY.md §2.3 PP row, §7 hard part #1).
+
+Design (circular pipelined scan, scaling-book style): stage parameters are
+STACKED along a leading (n_stages,) dim sharded over the `pp` mesh axis;
+inside one `shard_map` every device runs the same `lax.scan` over
+M + S - 1 ticks. At tick t, device s computes microbatch t - s; activations
+hop stage→stage+1 through a single `ppermute` per tick (collective_permute
+over ICI). The reference's send/recv meta-negotiation, batched isend/irecv
+and per-stage Python scheduling all collapse into this one compiled loop.
+
+Backward is `jax.grad` through the scan: XLA replays the schedule in
+reverse (the ppermute transposes to the opposite rotation), which yields
+GPipe-equivalent ordering; activation memory is bounded by rematerializing
+each tick (`jax.checkpoint` around the stage body) so only the per-tick
+carry survives — the 1F1B memory profile without hand-written scheduling.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..mesh import ProcessMesh
+
+__all__ = ["pipeline_forward", "stack_stage_params"]
+
+
+def stack_stage_params(per_stage_params):
+    """[pytree per stage] -> one pytree with leading (S,) dim (to be
+    sharded Shard(0) over 'pp')."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *per_stage_params)
+
+
+def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
+                     num_microbatches: int, axis: str = "pp",
+                     remat: bool = True, extra_args: tuple = (),
+                     param_specs=None, x_spec=None):
+    """Run the pipelined forward: y = stage_{S-1}(...stage_0(x)).
+
+    stage_fn(params_one_stage, activation, *extra) -> activation; must keep
+    the activation shape (classic transformer-stack property).
+    stacked_params: pytree, every leaf (S, ...) — sharded over `axis`.
+    x: (B, ...) global input; split into M = num_microbatches along dim 0.
+    extra_args: replicated side inputs every stage sees (rope tables etc.).
+    param_specs: optional pytree of PartitionSpec (leading entry must be
+    `axis`) to compose TP/ZeRO shardings inside the pipeline — stage_fn then
+    sees LOCAL shards and is responsible for its own collectives (psum over
+    'mp' etc.; every mesh axis name is bound inside). x_spec: optional
+    PartitionSpec for one microbatch (e.g. P('dp', None, None) to keep the
+    batch dp-sharded through the pipeline).
+    Returns y: (B, ...) final-stage output. Differentiable.
+    """
+    s_count = mesh.get_dim_size(axis)
+    m = num_microbatches
+    b = x.shape[0]
+    assert b % m == 0, f"batch {b} not divisible by microbatches {m}"
+    mb = b // m
+    xs = x.reshape(m, mb, *x.shape[1:])
+    ticks = m + s_count - 1
+
+    body = stage_fn
+    if remat:
+        body = jax.checkpoint(stage_fn)
+
+    def local_fn(params_local, xs_local, *extra):
+        # params_local leaves: (1, ...) — this device's stage; squeeze
+        params1 = jax.tree_util.tree_map(lambda l: l[0], params_local)
+        s = jax.lax.axis_index(axis)
+        perm = [(j, (j + 1) % s_count) for j in range(s_count)]
+
+        def tick(carry, t):
+            state, buf = carry
+            # stage 0 ingests microbatch t (clamped; inactive ticks are
+            # overwritten later), others take the ppermuted activation
+            x_t = jax.lax.dynamic_index_in_dim(
+                xs_local, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+            inp = jnp.where(s == 0, x_t.astype(state.dtype), state)
+            y = body(params1, inp, *extra)
+            # last stage's tick-t output is microbatch t - (S-1)
+            idx = t - (s_count - 1)
+            idx_c = jnp.clip(idx, 0, m - 1)
+            valid = (idx >= 0) & (idx < m)
+            cur = jax.lax.dynamic_index_in_dim(buf, idx_c, 0,
+                                               keepdims=False)
+            upd = jnp.where(valid, y, cur)
+            buf = jax.lax.dynamic_update_index_in_dim(buf, upd, idx_c, 0)
+            state = jax.lax.ppermute(y, axis, perm)
+            return (state, buf), None
+
+        state0 = jnp.zeros_like(xs_local[0])
+        buf0 = jnp.zeros_like(xs_local)
+        (_, buf), _ = jax.lax.scan(tick, (state0, buf0),
+                                   jnp.arange(ticks))
+        # every device filled a buffer; only the last stage's is the real
+        # output — replicate it with a masked psum
+        sel = jnp.where(s == s_count - 1, 1.0, 0.0)
+        return jax.lax.psum(buf * sel.astype(buf.dtype), axis)
+
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda l: P(axis, *([None] * (l.ndim - 1))), stacked_params)
+    if x_spec is None:
+        x_spec = P(*([None] * xs.ndim))
+    else:
+        # caller gives the per-microbatch activation spec; prepend the
+        # microbatch dim
+        x_spec = P(None, *tuple(x_spec))
+    extra_specs = tuple(P(*([None] * jnp.asarray(e).ndim))
+                        for e in extra_args)
+    out = _shard_map(local_fn, mesh=mesh.jax_mesh,
+                     in_specs=(param_specs, x_spec) + extra_specs,
+                     out_specs=x_spec,
+                     check_vma=False)(stacked_params, xs, *extra_args)
+    return out.reshape(b, *out.shape[2:])

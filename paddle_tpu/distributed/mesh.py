@@ -307,7 +307,10 @@ def shard_constraint(value, *axis_names, mesh: ProcessMesh | None = None):
 def local_map(fn, out_placements, in_placements, process_mesh,
               reshard_inputs=False):
     """≙ paddle.distributed.local_map — run fn on local shards via shard_map."""
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
     in_specs = tuple(placements_to_spec(p, process_mesh)
                      for p in in_placements)
     out_specs = tuple(placements_to_spec(p, process_mesh)
