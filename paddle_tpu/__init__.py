@@ -124,6 +124,10 @@ def __getattr__(name):
         from .hapi import Model
         globals()["Model"] = Model
         return Model
+    if name == "summary":
+        from .hapi import summary
+        globals()["summary"] = summary
+        return summary
     if name == "hapi":
         import importlib
         mod = importlib.import_module(".hapi", __name__)

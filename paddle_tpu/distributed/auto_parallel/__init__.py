@@ -1,0 +1,204 @@
+"""Semi-auto parallel API. ≙ reference «python/paddle/distributed/
+auto_parallel/» (shard_tensor/Placement/ProcessMesh + static Engine with
+completion/partition/reshard passes — SURVEY.md §2.3 "Semi-auto parallel",
+§3.3).
+
+TPU-native: this IS GSPMD. `shard_tensor` lowers to NamedSharding,
+"completion" (sharding propagation) is XLA's propagation pass, the
+partitioner is SPMD partitioning, and reshard insertion is the compiler's
+collective insertion — so the Engine below is a thin trainer that jits the
+whole train step under the mesh instead of running three Python passes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..mesh import (Partial, Placement, ProcessMesh, Replicate,  # noqa: F401
+                    Shard, dtensor_from_local, get_mesh, reshard,
+                    set_mesh, shard_layer, shard_tensor, use_mesh)
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "shard_tensor", "reshard", "shard_layer", "dtensor_from_local",
+           "get_mesh", "set_mesh", "Strategy", "Engine", "shard_optimizer",
+           "shard_dataloader", "to_static"]
+
+
+@dataclass
+class _AmpConfig:
+    enable: bool = False
+    dtype: str = "bfloat16"
+    level: str = "O1"
+
+
+@dataclass
+class _ShardingConfig:
+    enable: bool = False
+    stage: int = 1
+    degree: int = 1
+
+
+@dataclass
+class _RecomputeConfig:
+    enable: bool = False
+
+
+@dataclass
+class _PipelineConfig:
+    enable: bool = False
+    schedule_mode: str = "1F1B"
+    micro_batch_size: int = 1
+    accumulate_steps: int = 1
+
+
+@dataclass
+class Strategy:
+    """≙ auto_parallel.Strategy (config tree; SURVEY.md §5 config row)."""
+    amp: _AmpConfig = field(default_factory=_AmpConfig)
+    sharding: _ShardingConfig = field(default_factory=_ShardingConfig)
+    recompute: _RecomputeConfig = field(default_factory=_RecomputeConfig)
+    pipeline: _PipelineConfig = field(default_factory=_PipelineConfig)
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """≙ paddle.distributed.shard_optimizer: optimizer state follows the
+    param placements inside the compiled step — identity here."""
+    return optimizer
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """≙ paddle.distributed.shard_dataloader: wrap a loader so each batch
+    is shard_tensor'd onto the mesh (batch dim over 'dp'/first axis)."""
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+
+    class _Sharded:
+        def __iter__(self):
+            import paddle_tpu as paddle
+            from ...core.tensor import Tensor
+            for batch in dataloader:
+                items = batch if isinstance(batch, (list, tuple)) else \
+                    [batch]
+                out = []
+                for it in items:
+                    t = it if isinstance(it, Tensor) else \
+                        paddle.to_tensor(np.asarray(it))
+                    placements = [Replicate() for _ in mesh.dim_names]
+                    dim0 = shard_dims if isinstance(shard_dims, int) else 0
+                    placements[0] = Shard(dim0)
+                    out.append(shard_tensor(t, mesh, placements))
+                yield out if len(out) > 1 else out[0]
+
+        def __len__(self):
+            return len(dataloader)
+
+    return _Sharded()
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """≙ paddle.distributed.to_static — returns the jit-compiled trainer
+    pieces (the 'static program' equivalent is the XLA computation)."""
+    import paddle_tpu as paddle
+    step = paddle.jit.TrainStep(
+        layer, optimizer,
+        loss_fn=(lambda m, x, y: loss(m(x), y)) if loss else None)
+    return step
+
+
+class Engine:
+    """≙ auto_parallel.static.Engine (fit/evaluate/predict — SURVEY.md
+    §3.3). The completion/partition/reshard passes are XLA's; Engine just
+    owns the jitted step + data sharding + the trainer loop."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else \
+            ([metrics] if metrics else [])
+        self._strategy = strategy or Strategy()
+        self._step = None
+
+    def _ensure(self):
+        if self._step is None:
+            import paddle_tpu as paddle
+
+            def loss_fn(m, *batch):
+                *xs, y = batch
+                out = m(*xs)
+                out0 = out[0] if isinstance(out, (tuple, list)) else out
+                return self._loss(out0, y)
+
+            self._step = paddle.jit.TrainStep(self._model, self._optimizer,
+                                              loss_fn=loss_fn)
+        return self._step
+
+    def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
+            valid_data=None, log_freq=10, verbose=1):
+        from ...io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_data = DataLoader(train_data, batch_size=batch_size,
+                                    shuffle=True)
+        mesh = get_mesh()
+        history = []
+        step_fn = self._ensure()
+        for epoch in range(epochs):
+            losses = []
+            for i, batch in enumerate(train_data):
+                items = list(batch) if isinstance(batch, (list, tuple)) \
+                    else [batch]
+                loss = step_fn(*items)
+                losses.append(float(loss if not isinstance(loss, tuple)
+                                    else loss[0]))
+                if steps_per_epoch and i + 1 >= steps_per_epoch:
+                    break
+            history.append(float(np.mean(losses)))
+            if verbose:
+                print(f"[auto_parallel.Engine] epoch {epoch}: "
+                      f"loss {history[-1]:.4f}")
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, steps=None, verbose=1):
+        from ...io import DataLoader, Dataset
+        from ...core.tape import no_grad
+        if isinstance(eval_data, Dataset):
+            eval_data = DataLoader(eval_data, batch_size=batch_size)
+        losses = []
+        self._model.eval()
+        with no_grad():
+            for i, batch in enumerate(eval_data):
+                *xs, y = list(batch)
+                out = self._model(*xs)
+                out0 = out[0] if isinstance(out, (tuple, list)) else out
+                losses.append(float(self._loss(out0, y)))
+                if steps and i + 1 >= steps:
+                    break
+        self._model.train()
+        return {"loss": float(np.mean(losses))}
+
+    def predict(self, test_data, batch_size=1, steps=None):
+        from ...io import DataLoader, Dataset
+        from ...core.tape import no_grad
+        if isinstance(test_data, Dataset):
+            test_data = DataLoader(test_data, batch_size=batch_size)
+        outs = []
+        self._model.eval()
+        with no_grad():
+            for i, batch in enumerate(test_data):
+                items = list(batch) if isinstance(batch, (list, tuple)) \
+                    else [batch]
+                outs.append(self._model(*items[:1]))
+                if steps and i + 1 >= steps:
+                    break
+        self._model.train()
+        return outs
+
+    def save(self, path, training=True):
+        import paddle_tpu as paddle
+        paddle.save(self._model.state_dict(), path + ".pdparams")
+
+    def load(self, path):
+        import paddle_tpu as paddle
+        self._model.set_state_dict(paddle.load(path + ".pdparams"))

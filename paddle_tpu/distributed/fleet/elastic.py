@@ -1,0 +1,101 @@
+"""Elastic training — checkpoint-restart based.
+
+≙ reference «python/paddle/distributed/fleet/elastic/manager.py»
+(ElasticManager: ETCD membership, peer watch, scale-up/down classification,
+kill + relaunch with new ranks — SURVEY.md §5 "Failure detection").
+
+TPU-native: there is no ETCD and no per-device process set to re-rank.
+Elasticity is (1) the launch CLI's restart-on-failure loop
+(distributed.launch --elastic_level), (2) fast resume from the latest
+async sharded checkpoint (distributed.checkpoint — restore reshapes onto
+whatever mesh the restarted job has), and (3) coordinator health from
+jax.distributed. This module provides the train-loop-side helper: periodic
+checkpoints + latest-checkpoint discovery on restart.
+"""
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Optional
+
+__all__ = ["ElasticManager", "latest_checkpoint"]
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Newest step-numbered checkpoint directory under ckpt_dir, or None."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    best = None
+    best_step = -1
+    for name in os.listdir(ckpt_dir):
+        m = re.fullmatch(r"step_(\d+)", name)
+        if m and int(m.group(1)) > best_step:
+            done = os.path.join(ckpt_dir, name, ".done")
+            if os.path.exists(done):
+                best_step = int(m.group(1))
+                best = os.path.join(ckpt_dir, name)
+    return best
+
+
+class ElasticManager:
+    """Checkpoint-cadence + resume bookkeeping for an elastic train loop.
+
+    Usage::
+
+        em = ElasticManager(ckpt_dir, save_interval_steps=100)
+        start = em.resume(model, opt)      # 0 if fresh
+        for step in range(start, total):
+            loss = train_step(...)
+            em.maybe_save(step, model, opt)
+    """
+
+    def __init__(self, ckpt_dir: str, save_interval_steps: int = 100,
+                 keep_last: int = 2):
+        self.ckpt_dir = ckpt_dir
+        self.save_interval_steps = save_interval_steps
+        self.keep_last = keep_last
+        os.makedirs(ckpt_dir, exist_ok=True)
+
+    def resume(self, model, optimizer=None) -> int:
+        """Restore the newest complete checkpoint; returns the next step."""
+        from ..checkpoint import load_state_dict, load_state_dict_raw
+        path = latest_checkpoint(self.ckpt_dir)
+        if path is None:
+            return 0
+        load_state_dict(model.state_dict(), os.path.join(path, "model"))
+        if optimizer is not None and hasattr(optimizer, "set_state_dict"):
+            opt_path = os.path.join(path, "opt")
+            if os.path.isdir(opt_path):
+                # raw restore: optimizer accumulators are created lazily,
+                # so there is no target structure to reshard onto yet
+                optimizer.set_state_dict(load_state_dict_raw(opt_path))
+        return int(re.search(r"step_(\d+)$", path).group(1)) + 1
+
+    def maybe_save(self, step: int, model, optimizer=None) -> bool:
+        if (step + 1) % self.save_interval_steps:
+            return False
+        self.save(step, model, optimizer)
+        return True
+
+    def save(self, step: int, model, optimizer=None):
+        from ..checkpoint import save_state_dict
+        path = os.path.join(self.ckpt_dir, f"step_{step}")
+        save_state_dict(model.state_dict(), os.path.join(path, "model"))
+        if optimizer is not None and hasattr(optimizer, "state_dict"):
+            sd = optimizer.state_dict()
+            if sd:
+                save_state_dict(sd, os.path.join(path, "opt"))
+        with open(os.path.join(path, ".done"), "w") as f:
+            f.write(str(time.time()))
+        self._gc()
+
+    def _gc(self):
+        steps = sorted(
+            (int(m.group(1)) for m in (re.fullmatch(r"step_(\d+)", n)
+                                       for n in os.listdir(self.ckpt_dir))
+             if m))
+        for s in steps[:-self.keep_last]:
+            import shutil
+            shutil.rmtree(os.path.join(self.ckpt_dir, f"step_{s}"),
+                          ignore_errors=True)

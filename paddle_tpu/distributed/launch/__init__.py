@@ -1,0 +1,84 @@
+"""Launch CLI. ≙ reference «python/paddle/distributed/launch/» (Context +
+CollectiveController + Master rendezvous + Job/Pod/Container env injection —
+SURVEY.md §3.5).
+
+TPU-native: the TPU VM model is ONE process per host with all local chips
+attached, so there is no per-device fork/exec, no ETCD, no endpoint list:
+`jax.distributed.initialize()` (GCE metadata autodetect on TPU pods, or
+explicit --master) is the whole rendezvous. The controller reduces to: set
+env, initialize, exec the training script, propagate exit codes, and
+restart on failure when --elastic_level > 0 (checkpoint-restart elasticity,
+SURVEY.md §5 "Failure detection").
+"""
+from __future__ import annotations
+
+import os
+import runpy
+import subprocess
+import sys
+import time
+
+__all__ = ["main", "launch"]
+
+
+def _parse(argv):
+    import argparse
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a training script on this host's TPU chips "
+                    "(one process per host; multi-host via --master or TPU "
+                    "pod metadata autodetection).")
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                   help="coordinator ip:port for multi-host rendezvous")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")))
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    p.add_argument("--run_mode", default="collective")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help=">0: restart the script on failure (checkpoint-"
+                        "restart elasticity), up to --max_restart times")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--devices", default=None,
+                   help="ignored on TPU (all host chips attach to the one "
+                        "process); kept for CLI compat")
+    p.add_argument("script", help="training script (.py) to run")
+    p.add_argument("script_args", nargs="...", default=[])
+    return p.parse_args(argv)
+
+
+def _child_env(args):
+    env = dict(os.environ)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+        env["COORDINATOR_ADDRESS"] = args.master
+    env["PADDLE_NNODES"] = str(args.nnodes)
+    env["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+    env["PADDLE_TRAINER_ID"] = str(args.rank)
+    env["PADDLE_JOB_ID"] = args.job_id
+    return env
+
+
+def launch(args):
+    env = _child_env(args)
+    attempt = 0
+    while True:
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, args.script, *args.script_args], env=env)
+        if proc.returncode == 0:
+            return 0
+        attempt += 1
+        if args.elastic_level <= 0 or attempt > args.max_restart:
+            return proc.returncode
+        print(f"[launch] script exited {proc.returncode} after "
+              f"{time.time() - t0:.0f}s — restart {attempt}/"
+              f"{args.max_restart} (elastic checkpoint-restart)",
+              file=sys.stderr)
+
+
+def main(argv=None):
+    args = _parse(argv if argv is not None else sys.argv[1:])
+    return launch(args)

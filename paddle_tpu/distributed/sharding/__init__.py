@@ -1,0 +1,76 @@
+"""ZeRO parameter/grad/optimizer-state sharding API.
+
+≙ reference «python/paddle/distributed/sharding/» `group_sharded_parallel`
+(GroupShardedStage2/3 + GroupShardedOptimizerStage2,
+«.../fleet/meta_parallel/sharding/», SURVEY.md §2.3 Sharding row).
+
+TPU-native: ZeRO is a PLACEMENT, not a wrapper class — parameters (and
+therefore their grads and optimizer state, which follow the param sharding
+inside the compiled train step) are Shard()-placed over the 'sharding'
+mesh axis, and XLA's partitioner emits the reduce-scatter/all-gather
+pattern the reference implements with hand-written bucketed broadcasts.
+The stage2/stage3 distinction collapses: both are "shard the state; gather
+on use", which is exactly GSPMD semantics.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=None, segment_size=None,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None, axis="sharding"):
+    """≙ paddle.distributed.sharding.group_sharded_parallel.
+
+    level: 'os' (stage 1), 'os_g' (stage 2), 'p_g_os' (stage 3) — all map
+    to sharding the parameters over the `axis` mesh axis; optimizer state
+    and grads inherit the placement inside the compiled step.
+    """
+    from ..mesh import Replicate, Shard, get_mesh, shard_tensor
+
+    mesh = get_mesh()
+    if mesh is None or axis not in mesh.dim_names or \
+            mesh.get_dim_size(axis) == 1:
+        return model, optimizer, scaler
+
+    n = mesh.get_dim_size(axis)
+    for p in model.parameters():
+        if p._value.ndim == 0:
+            continue
+        # shard the largest divisible dim over the sharding axis
+        dims = sorted(range(p._value.ndim),
+                      key=lambda d: -p._value.shape[d])
+        target = next((d for d in dims if p._value.shape[d] % n == 0),
+                      None)
+        if target is None:
+            continue
+        existing = getattr(p, "dist_attr", None)
+        placements = (list(existing[1]) if existing
+                      else [Replicate() for _ in mesh.dim_names])
+        ax_i = mesh.dim_names.index(axis)
+        if not isinstance(placements[ax_i], Replicate):
+            continue  # already placed on this axis
+        taken = {pl.dim for pl in placements if isinstance(pl, Shard)}
+        if target in taken:
+            target = next((d for d in dims if p._value.shape[d] % n == 0
+                           and d not in taken), None)
+            if target is None:
+                continue
+        placements[ax_i] = Shard(target)
+        s = shard_tensor(p, mesh, placements)
+        p._value = s._value
+        p.dist_attr = s.dist_attr
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """≙ paddle.distributed.sharding.save_group_sharded_model — with GSPMD
+    the state_dict is already global; plain save applies."""
+    import paddle_tpu as paddle
+    paddle.save(model.state_dict(), output + ".pdparams")
+    if optimizer is not None and hasattr(optimizer, "state_dict"):
+        paddle.save(optimizer.state_dict(), output + ".pdopt")

@@ -1,0 +1,269 @@
+"""paddle_tpu.hapi — high-level trainer. ≙ reference «python/paddle/hapi/»
+(`paddle.Model.fit/evaluate/predict`, SURVEY.md §2.2 hapi row, §7 stage 8).
+
+TPU-native: `fit` compiles the whole train step once via jit.TrainStep
+(forward+backward+update donated in HBM) instead of the reference's
+per-batch dygraph dispatch; everything else (callbacks, metrics, ckpt
+cadence) is trainer bookkeeping on the host.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor, to_tensor
+from . import callbacks as cb_mod
+from .callbacks import (Callback, CallbackList, EarlyStopping,  # noqa: F401
+                        LRSchedulerCallback, ModelCheckpoint, ProgBarLogger,
+                        ScalarLogger, VisualDL)
+
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRSchedulerCallback", "ScalarLogger",
+           "VisualDL", "summary"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _metric_logs(m):
+    """Metric name()/accumulate() may return scalars or aligned lists."""
+    names = m.name()
+    names = list(names) if isinstance(names, (list, tuple)) else [names]
+    vals = m.accumulate()
+    vals = list(vals) if isinstance(vals, (list, tuple)) else [vals]
+    return dict(zip(names, vals))
+
+
+class Model:
+    """≙ paddle.Model: trainer facade over an nn.Layer.
+
+    model = paddle.Model(network)
+    model.prepare(optimizer, loss, metrics)
+    model.fit(train_loader, eval_loader, epochs=2)
+    """
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self.stop_training = False
+
+    # -- setup ---------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        self._train_step = None  # rebuilt lazily with the new opt/loss
+        return self
+
+    # -- core steps ----------------------------------------------------------
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            from ..jit import TrainStep
+
+            def loss_fn(net, *batch):
+                *xs, y = batch
+                out = net(*xs)
+                out0 = out[0] if isinstance(out, (tuple, list)) else out
+                return self._loss(out0, y), out0
+
+            self._train_step = TrainStep(self.network, self._optimizer,
+                                         loss_fn=loss_fn)
+        return self._train_step
+
+    def train_batch(self, inputs, labels=None):
+        """One jitted train step; returns ([loss], metrics-dict)."""
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        step = self._ensure_train_step()
+        res = step(*inputs, *labels)
+        if isinstance(res, tuple):
+            loss, out = res[0], res[1]
+        else:
+            loss, out = res, None
+        metrics = {}
+        for m in self._metrics:
+            if out is not None and labels:
+                m.update(m.compute(out, labels[0]))
+                metrics.update(_metric_logs(m))
+        return [float(loss)], metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..core.tape import no_grad
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        with no_grad():
+            out = self.network(*inputs)
+        out0 = out[0] if isinstance(out, (tuple, list)) else out
+        res = {}
+        if self._loss is not None and labels:
+            res["loss"] = float(self._loss(out0, labels[0]))
+        for m in self._metrics:
+            m.update(m.compute(out0, labels[0]))
+            res.update(_metric_logs(m))
+        return res, out0
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..core.tape import no_grad
+        with no_grad():
+            out = self.network(*_to_list(inputs))
+        return out
+
+    # -- loops ---------------------------------------------------------------
+    @staticmethod
+    def _unpack(batch):
+        if isinstance(batch, (tuple, list)):
+            *xs, y = batch
+            return xs, [y]
+        return [batch], []
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_data = DataLoader(train_data, batch_size=batch_size,
+                                    shuffle=shuffle, drop_last=drop_last,
+                                    num_workers=num_workers)
+        if isinstance(eval_data, Dataset):
+            eval_data = DataLoader(eval_data, batch_size=batch_size,
+                                   num_workers=num_workers)
+        cbs = CallbackList([ProgBarLogger(log_freq, verbose=verbose),
+                            LRSchedulerCallback()]
+                           + ([ModelCheckpoint(save_freq, save_dir)]
+                              if save_dir else [])
+                           + _to_list(callbacks))
+        cbs.set_model(self)
+        cbs.set_params({"epochs": epochs, "verbose": verbose,
+                        "metrics": ["loss"] + [m.name()
+                                               for m in self._metrics]})
+        cbs.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbs.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_data):
+                cbs.on_train_batch_begin(step)
+                xs, ys = self._unpack(batch)
+                losses, metrics = self.train_batch(xs, ys)
+                logs = {"loss": losses[0]}
+                logs.update(metrics)
+                cbs.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    break
+            cbs.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_data, verbose=0,
+                                          callbacks=None,
+                                          _cbs=cbs)
+                for c in cbs.callbacks:
+                    if isinstance(c, EarlyStopping) and c.stopped:
+                        self.stop_training = True
+            if self.stop_training or (num_iters is not None
+                                      and it_count >= num_iters):
+                break
+        cbs.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None, _cbs=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            eval_data = DataLoader(eval_data, batch_size=batch_size,
+                                   num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        cbs = _cbs or CallbackList(_to_list(callbacks))
+        cbs.set_model(self)
+        cbs.on_eval_begin()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(eval_data):
+            xs, ys = self._unpack(batch)
+            res, _ = self.eval_batch(xs, ys)
+            if "loss" in res:
+                losses.append(res["loss"])
+            logs = dict(res)
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        cbs.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            test_data = DataLoader(test_data, batch_size=batch_size,
+                                   num_workers=num_workers)
+        outs = []
+        for batch in test_data:
+            xs = _to_list(batch)
+            if self._loss is not None and len(xs) > 1:
+                xs = xs[:-1]  # (inputs..., label) dataset: drop the label
+            out = self.predict_batch(xs)
+            outs.append(out)
+        return outs
+
+    # -- persistence & introspection ----------------------------------------
+    def save(self, path, training=True):
+        import paddle_tpu as paddle
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        paddle.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None and \
+                hasattr(self._optimizer, "state_dict"):
+            paddle.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import paddle_tpu as paddle
+        self.network.set_state_dict(paddle.load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and os.path.exists(opt_path) and \
+                self._optimizer is not None and \
+                hasattr(self._optimizer, "set_state_dict"):
+            self._optimizer.set_state_dict(paddle.load(opt_path))
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return summary(self.network)
+
+
+def summary(network, input_size=None, dtypes=None):
+    """≙ paddle.summary — parameter-count table."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in network.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max([len(r[0]) for r in rows], default=20) + 2
+    lines = [f"{'Layer (param)':<{width}}{'Shape':<20}{'Param #':>12}"]
+    lines += [f"{n:<{width}}{str(s):<20}{c:>12,}" for n, s, c in rows]
+    lines += [f"Total params: {total:,}",
+              f"Trainable params: {trainable:,}",
+              f"Non-trainable params: {total - trainable:,}"]
+    table = "\n".join(lines)
+    print(table)
+    return {"total_params": total, "trainable_params": trainable}

@@ -64,6 +64,18 @@ class Optimizer:
         store = self._accumulators.setdefault(name, {})
         k = id(p)
         if k not in store:
+            # a restored state_dict may predate lazy creation (resume
+            # before the first step): consume the pending value if present
+            pend = getattr(self, "_pending_state", None)
+            if pend:
+                i = next((j for j, q in enumerate(self._parameter_list)
+                          if q is p), None)
+                key = f"{name}_{p.name or i}"
+                if key in pend:
+                    v = pend.pop(key)
+                    store[k] = v._value if isinstance(v, Tensor) \
+                        else jnp.asarray(v)
+                    return store[k]
             dt = dtype or (jnp.float32 if self._multi_precision
                            else p._value.dtype)
             store[k] = (jnp.zeros(p._value.shape, dt) if init is None
@@ -171,6 +183,15 @@ class Optimizer:
                 v = state_dict[key]
                 self._master_weights[id(p)] = v._value if isinstance(
                     v, Tensor) else jnp.asarray(v)
+        # stash entries for accumulators that don't exist yet (lazy
+        # creation) — consumed by _acc() on first touch
+        consumed = {f"{name}_{p.name or i}"
+                    for name in self._accumulators
+                    for i, p in enumerate(self._parameter_list)}
+        self._pending_state = {k: v for k, v in state_dict.items()
+                               if k not in consumed
+                               and k not in ("@step", "LR_Scheduler")
+                               and not k.startswith("master_")}
 
     def _wd(self, p: Parameter) -> float:
         wd = self._weight_decay

@@ -1,0 +1,91 @@
+"""hapi Model trainer tests. ≙ reference «test/legacy_test/test_model.py»
+family (Model.fit/evaluate/predict, callbacks) [U]."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.hapi import EarlyStopping, Model
+from paddle_tpu.io import Dataset
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.nn import functional as F
+from paddle_tpu.optimizer import Adam
+
+
+class _ToyDataset(Dataset):
+    """Linearly separable 2-class problem."""
+
+    def __init__(self, n=128, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.normal(size=(n, 8)).astype(np.float32)
+        # ground-truth weights shared across train/eval splits
+        w = np.random.default_rng(42).normal(size=(8,))
+        self.y = (self.x @ w > 0).astype(np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+
+
+class TestModelFit:
+    def test_fit_improves_accuracy(self, tmp_path):
+        paddle.seed(0)
+        net = _mlp()
+        model = Model(net)
+        model.prepare(Adam(learning_rate=0.01,
+                           parameters=net.parameters()),
+                      loss=nn.CrossEntropyLoss(),
+                      metrics=Accuracy())
+        train = _ToyDataset(128)
+        model.fit(train, epochs=8, batch_size=32, verbose=0)
+        logs = model.evaluate(_ToyDataset(64, seed=1), batch_size=32,
+                              verbose=0)
+        assert logs["acc"] > 0.8, logs
+
+    def test_save_load_roundtrip(self, tmp_path):
+        paddle.seed(0)
+        net = _mlp()
+        model = Model(net)
+        model.prepare(Adam(learning_rate=0.01,
+                           parameters=net.parameters()),
+                      loss=nn.CrossEntropyLoss())
+        model.fit(_ToyDataset(64), epochs=1, batch_size=32, verbose=0)
+        path = str(tmp_path / "ckpt" / "model")
+        model.save(path)
+
+        net2 = _mlp()
+        model2 = Model(net2)
+        model2.prepare(Adam(learning_rate=0.01,
+                            parameters=net2.parameters()),
+                       loss=nn.CrossEntropyLoss())
+        model2.load(path)
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(),
+                                   rtol=1e-6)
+
+    def test_early_stopping_stops(self):
+        paddle.seed(0)
+        net = _mlp()
+        model = Model(net)
+        model.prepare(Adam(learning_rate=0.0,  # frozen -> no improvement
+                           parameters=net.parameters()),
+                      loss=nn.CrossEntropyLoss())
+        es = EarlyStopping(monitor="loss", patience=1, verbose=0)
+        model.fit(_ToyDataset(32), eval_data=_ToyDataset(32, seed=2),
+                  epochs=10, batch_size=16, verbose=0, callbacks=[es])
+        assert model.stop_training
+
+    def test_predict_and_summary(self, capsys):
+        net = _mlp()
+        model = Model(net)
+        model.prepare(loss=nn.CrossEntropyLoss())
+        outs = model.predict(_ToyDataset(16), batch_size=8)
+        assert len(outs) == 2
+        info = model.summary()
+        assert info["total_params"] == 8 * 32 + 32 + 32 * 2 + 2
