@@ -133,9 +133,9 @@ def __getattr__(name):
         mod = importlib.import_module(".hapi", __name__)
         globals()["hapi"] = mod
         return mod
-    if name == "sparse":
+    if name in ("sparse", "fft", "signal", "distribution", "quantization"):
         import importlib
-        mod = importlib.import_module(".sparse", __name__)
-        globals()["sparse"] = mod
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
         return mod
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

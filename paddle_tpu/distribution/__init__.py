@@ -1,0 +1,620 @@
+"""paddle_tpu.distribution — probability distributions.
+≙ reference «python/paddle/distribution/» [U]: Distribution base +
+Normal/Uniform/Bernoulli/Categorical/Beta/Dirichlet/Exponential/Gamma/
+Geometric/Gumbel/Laplace/LogNormal/Multinomial/Poisson + kl_divergence
+registry. Sampling threads the framework's stateful RNG key
+(tensor.random.default_generator), math is jnp/jax.scipy."""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor
+from ..tensor.random import default_generator
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric",
+           "Gumbel", "Laplace", "LogNormal", "Multinomial", "Poisson",
+           "kl_divergence", "register_kl"]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value.astype(jnp.float32)
+    return jnp.asarray(np.asarray(x), jnp.float32)
+
+
+def _key():
+    return default_generator.next_key()
+
+
+def _shape(sample_shape, base):
+    return tuple(int(s) for s in sample_shape) + tuple(base)
+
+
+class Distribution:
+    """≙ paddle.distribution.Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        shape = jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        super().__init__(shape)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        eps = jax.random.normal(_key(), _shape(shape, self.batch_shape))
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+    def cdf(self, value):
+        return Tensor(jax.scipy.stats.norm.cdf(_v(value), self.loc,
+                                               self.scale))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to((self.low + self.high) / 2,
+                                       self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to((self.high - self.low) ** 2 / 12,
+                                       self.batch_shape))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self.batch_shape))
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _v(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _v(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self.batch_shape))
+        return Tensor((u < self.probs).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(v * jnp.log(jnp.clip(self.probs, 1e-12))
+                      + (1 - v) * jnp.log(jnp.clip(1 - self.probs, 1e-12)))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-12, 1 - 1e-12)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log(1 - p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None and probs is None:
+            self.logits = _v(logits)
+            self.probs = jax.nn.softmax(self.logits, -1)
+        elif probs is not None:
+            self.probs = _v(probs) / jnp.sum(_v(probs), -1, keepdims=True)
+            self.logits = jnp.log(jnp.clip(self.probs, 1e-12))
+        else:
+            raise ValueError("pass logits or probs")
+        super().__init__(self.probs.shape[:-1])
+
+    @property
+    def mean(self):
+        raise NotImplementedError("Categorical has no mean")
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(
+            _key(), self.logits, shape=_shape(shape, self.batch_shape))
+        return Tensor(out)
+
+    def log_prob(self, value):
+        v = _v(value).astype(jnp.int32)
+        lp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(jnp.take_along_axis(lp, v[..., None],
+                                          -1)[..., 0])
+
+    def probs_of(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        lp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(-jnp.sum(jnp.exp(lp) * lp, -1))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        t = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (t ** 2 * (t + 1)))
+
+    def sample(self, shape=()):
+        s = jax.random.beta(_key(), self.alpha, self.beta,
+                            _shape(shape, self.batch_shape))
+        return Tensor(s)
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(jax.scipy.stats.beta.logpdf(v, self.alpha, self.beta))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        from jax.scipy.special import betaln, digamma
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a)
+                      - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _v(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / jnp.sum(self.concentration, -1, keepdims=True))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(
+            _key(), self.concentration,
+            _shape(shape, self.batch_shape)))
+
+    def log_prob(self, value):
+        return Tensor(jax.scipy.stats.dirichlet.logpdf(
+            jnp.moveaxis(_v(value), -1, 0), self.concentration))
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        lnB = jnp.sum(gammaln(a), -1) - gammaln(a0)
+        return Tensor(lnB + (a0 - k) * digamma(a0)
+                      - jnp.sum((a - 1) * digamma(a), -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1 / self.rate ** 2)
+
+    def sample(self, shape=()):
+        e = jax.random.exponential(_key(),
+                                   _shape(shape, self.batch_shape))
+        return Tensor(e / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(jnp.where(v >= 0, jnp.log(self.rate) - self.rate * v,
+                                -jnp.inf))
+
+    def entropy(self):
+        return Tensor(1 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _v(concentration)
+        self.rate = _v(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / self.rate ** 2)
+
+    def sample(self, shape=()):
+        g = jax.random.gamma(_key(), self.concentration,
+                             _shape(shape, self.batch_shape))
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        return Tensor(jax.scipy.stats.gamma.logpdf(
+            _v(value), self.concentration, scale=1 / self.rate))
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        a = self.concentration
+        return Tensor(a - jnp.log(self.rate) + gammaln(a)
+                      + (1 - a) * digamma(a))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k >= 0. ≙ paddle.distribution.Geometric."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _v(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / self.probs ** 2)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self.batch_shape),
+                               minval=1e-7)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _v(value)
+        return Tensor(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def entropy(self):
+        p = self.probs
+        return Tensor(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * np.float32(np.euler_gamma))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            (math.pi ** 2 / 6) * self.scale ** 2, self.batch_shape))
+
+    def sample(self, shape=()):
+        g = jax.random.gumbel(_key(), _shape(shape, self.batch_shape))
+        return Tensor(self.loc + self.scale * g)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            jnp.log(self.scale) + 1 + np.float32(np.euler_gamma),
+            self.batch_shape))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(2 * self.scale ** 2,
+                                       self.batch_shape))
+
+    def sample(self, shape=()):
+        l = jax.random.laplace(_key(), _shape(shape, self.batch_shape))
+        return Tensor(self.loc + self.scale * l)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return Tensor(-jnp.abs(_v(value) - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                       self.batch_shape))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(self._normal.sample(shape)._value))
+
+    def log_prob(self, value):
+        v = _v(value)
+        lv = jnp.log(v)
+        return Tensor(self._normal.log_prob(Tensor(lv))._value - lv)
+
+    def entropy(self):
+        return Tensor(self._normal.entropy()._value + self.loc)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _v(probs) / jnp.sum(_v(probs), -1, keepdims=True)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.clip(self.probs, 1e-12))
+        draws = jax.random.categorical(
+            _key(), logits,
+            shape=(self.total_count,) + _shape(shape, self.batch_shape))
+        k = self.probs.shape[-1]
+        oh = jax.nn.one_hot(draws, k)
+        return Tensor(jnp.sum(oh, axis=0))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _v(value)
+        return Tensor(gammaln(self.total_count + 1.0)
+                      - jnp.sum(gammaln(v + 1.0), -1)
+                      + jnp.sum(v * jnp.log(jnp.clip(self.probs, 1e-12)),
+                                -1))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.poisson(
+            _key(), self.rate,
+            _shape(shape, self.batch_shape)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        v = _v(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate - gammaln(v + 1))
+
+
+# -- KL registry -------------------------------------------------------------
+_KL_TABLE = {}
+
+
+def register_kl(p_cls, q_cls):
+    """≙ paddle.distribution.register_kl decorator."""
+    def deco(fn):
+        _KL_TABLE[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_TABLE.get((type(p), type(q)))
+    if fn is None:
+        for (pc, qc), f in _KL_TABLE.items():
+            if isinstance(p, pc) and isinstance(q, qc):
+                fn = f
+                break
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence not registered for {type(p).__name__} || "
+            f"{type(q).__name__}")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    vr = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (vr + t1 - 1 - jnp.log(vr)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    res = jnp.log((q.high - q.low) / (p.high - p.low))
+    out = jnp.where((q.low <= p.low) & (p.high <= q.high), res, jnp.inf)
+    return Tensor(out)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp = jnp.clip(p.probs, 1e-12, 1 - 1e-12)
+    qq = jnp.clip(q.probs, 1e-12, 1 - 1e-12)
+    return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
+                  + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    lp = jax.nn.log_softmax(p.logits, -1)
+    lq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    d = jnp.abs(p.loc - q.loc)
+    r = p.scale / q.scale
+    return Tensor(jnp.log(q.scale / p.scale) + r * jnp.exp(-d / p.scale)
+                  + d / q.scale - 1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    from jax.scipy.special import betaln, digamma
+    pa, pb = p.alpha, p.beta
+    qa, qb = q.alpha, q.beta
+    return Tensor(betaln(qa, qb) - betaln(pa, pb)
+                  + (pa - qa) * digamma(pa) + (pb - qb) * digamma(pb)
+                  + (qa - pa + qb - pb) * digamma(pa + pb))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    from jax.scipy.special import digamma, gammaln
+    pa, pr = p.concentration, p.rate
+    qa, qr = q.concentration, q.rate
+    return Tensor((pa - qa) * digamma(pa) - gammaln(pa) + gammaln(qa)
+                  + qa * (jnp.log(pr) - jnp.log(qr)) + pa * (qr - pr) / pr)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    from jax.scipy.special import digamma, gammaln
+    a, b = p.concentration, q.concentration
+    a0 = jnp.sum(a, -1)
+    return Tensor(gammaln(a0) - jnp.sum(gammaln(a), -1)
+                  - gammaln(jnp.sum(b, -1)) + jnp.sum(gammaln(b), -1)
+                  + jnp.sum((a - b) * (digamma(a)
+                                       - digamma(a0[..., None])), -1))

@@ -1,0 +1,145 @@
+"""paddle_tpu.fft — discrete Fourier transforms.
+≙ reference «python/paddle/fft.py» [U] (tensor.fft module). All functions
+delegate to jnp.fft (XLA FFT HLO — natively supported on TPU) through the
+tape so gradients flow."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply, to_tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = (None, "backward", "ortho", "forward")
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(f"Unexpected norm: {norm!r}; expected one of "
+                         f"{_NORMS[1:]}")
+    return norm or "backward"
+
+
+def _wrap1(jfn, name):
+    def f(x, n=None, axis=-1, norm="backward", name_=None):
+        nm = _norm(norm)
+        return apply(name, lambda v: jfn(v, n=n, axis=axis, norm=nm),
+                     (_t(x),))
+    f.__name__ = name
+    f.__doc__ = f"≙ paddle.fft.{name} [U]."
+    return f
+
+
+def _wrap2(jfn, name):
+    def f(x, s=None, axes=(-2, -1), norm="backward", name_=None):
+        nm = _norm(norm)
+        return apply(name, lambda v: jfn(v, s=s, axes=tuple(axes), norm=nm),
+                     (_t(x),))
+    f.__name__ = name
+    f.__doc__ = f"≙ paddle.fft.{name} [U]."
+    return f
+
+
+def _wrapn(jfn, name):
+    def f(x, s=None, axes=None, norm="backward", name_=None):
+        nm = _norm(norm)
+        ax = tuple(axes) if axes is not None else None
+        return apply(name, lambda v: jfn(v, s=s, axes=ax, norm=nm),
+                     (_t(x),))
+    f.__name__ = name
+    f.__doc__ = f"≙ paddle.fft.{name} [U]."
+    return f
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+
+fft2 = _wrap2(jnp.fft.fft2, "fft2")
+ifft2 = _wrap2(jnp.fft.ifft2, "ifft2")
+rfft2 = _wrap2(jnp.fft.rfft2, "rfft2")
+irfft2 = _wrap2(jnp.fft.irfft2, "irfft2")
+
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """≙ paddle.fft.hfft2 — real output from Hermitian input, 2-D."""
+    nm = _norm(norm)
+    return apply("hfft2", lambda v: jnp.fft.irfftn(
+        jnp.conj(v), s=s, axes=tuple(axes), norm=nm) *
+        _hfft_scale(v, s, axes, nm), (_t(x),))
+
+
+def _hfft_scale(v, s, axes, nm):
+    # hfft(x) == irfft(conj(x)) * n (backward norm)
+    import numpy as np
+    n = s[-1] if s is not None else 2 * (v.shape[axes[-1]] - 1)
+    if nm == "backward":
+        sizes = [s[i] if s is not None else
+                 (2 * (v.shape[axes[i]] - 1) if i == len(axes) - 1
+                  else v.shape[axes[i]]) for i in range(len(axes))]
+        return float(np.prod(sizes))
+    return 1.0
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    nm = _norm(norm)
+
+    def fn(v):
+        out = jnp.fft.rfftn(v, s=s, axes=tuple(axes), norm=nm)
+        scale = 1.0
+        if nm == "backward":
+            import numpy as np
+            sizes = [s[i] if s is not None else v.shape[axes[i]]
+                     for i in range(len(axes))]
+            scale = 1.0 / float(np.prod(sizes))
+        return jnp.conj(out) * scale
+    return apply("ihfft2", fn, (_t(x),))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    ax = tuple(axes) if axes is not None else tuple(
+        range(-_t(x)._value.ndim, 0))
+    return hfft2(x, s=s, axes=ax, norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    ax = tuple(axes) if axes is not None else tuple(
+        range(-_t(x)._value.ndim, 0))
+    return ihfft2(x, s=s, axes=ax, norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.fftfreq(n, d=d).astype(dtype or jnp.float32))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d=d).astype(dtype or jnp.float32))
+
+
+def fftshift(x, axes=None, name=None):
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return apply("fftshift", lambda v: jnp.fft.fftshift(v, axes=ax),
+                 (_t(x),))
+
+
+def ifftshift(x, axes=None, name=None):
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    return apply("ifftshift", lambda v: jnp.fft.ifftshift(v, axes=ax),
+                 (_t(x),))

@@ -1,0 +1,159 @@
+"""paddle_tpu.signal — STFT/ISTFT.
+≙ reference «python/paddle/signal.py» [U]. Framing is a gather + window
+multiply + batched FFT — all XLA-native on TPU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, apply, to_tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """≙ paddle.signal.frame: slice overlapping frames along `axis`."""
+    def fn(v):
+        n = v.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        out = jnp.take(v, idx.reshape(-1), axis=axis)
+        shape = list(v.shape)
+        ax = axis % v.ndim
+        new_shape = shape[:ax] + [num, frame_length] + shape[ax + 1:]
+        out = out.reshape(new_shape)
+        # paddle layout: frame axis after data axis -> (..., frame_length, num)
+        return jnp.swapaxes(out, ax, ax + 1)
+    return apply("frame", fn, (_t(x),))
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """≙ paddle.signal.overlap_add: inverse of frame (sum overlaps)."""
+    def fn(v):
+        # v: (..., frame_length, num_frames) with axis=-1 (default layout)
+        if axis not in (-1, v.ndim - 1):
+            raise NotImplementedError("overlap_add: axis=-1 only")
+        fl = v.shape[-2]
+        num = v.shape[-1]
+        out_len = (num - 1) * hop_length + fl
+        lead = v.shape[:-2]
+        v2 = v.reshape((-1, fl, num))
+
+        def body(i, acc):
+            seg = jax.lax.dynamic_slice_in_dim(v2, i, 1, axis=2)[..., 0]
+            return jax.lax.dynamic_update_slice_in_dim(
+                acc, jax.lax.dynamic_slice_in_dim(
+                    acc, i * hop_length, fl, axis=1) + seg,
+                i * hop_length, axis=1)
+
+        acc = jnp.zeros((v2.shape[0], out_len), v.dtype)
+        acc = jax.lax.fori_loop(0, num, body, acc)
+        return acc.reshape(*lead, out_len)
+    return apply("overlap_add", fn, (_t(x),))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """≙ paddle.signal.stft. x: (B, T) or (T,) real (or complex with
+    onesided=False). Returns (B, n_fft//2+1 | n_fft, num_frames) complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xt = _t(x)
+    win_t = _t(window) if window is not None else None
+
+    def fn(v, *w):
+        if w:
+            win = w[0].astype(jnp.float32)
+        else:
+            win = jnp.ones((win_length,), jnp.float32)
+        # center-pad window to n_fft
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        squeeze = v.ndim == 1
+        if squeeze:
+            v = v[None]
+        if center:
+            v = jnp.pad(v, ((0, 0), (n_fft // 2, n_fft // 2)),
+                        mode=pad_mode)
+        n = v.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = v[:, idx]                      # (B, num, n_fft)
+        frames = frames * win[None, None, :]
+        if onesided and not jnp.iscomplexobj(frames):
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        out = jnp.swapaxes(spec, -1, -2)        # (B, freq, num)
+        return out[0] if squeeze else out
+    args = (xt,) + ((win_t,) if win_t is not None else ())
+    return apply("stft", fn, args)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """≙ paddle.signal.istft (least-squares overlap-add inversion)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xt = _t(x)
+    win_t = _t(window) if window is not None else None
+
+    def fn(v, *w):
+        if w:
+            win = w[0].astype(jnp.float32)
+        else:
+            win = jnp.ones((win_length,), jnp.float32)
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        squeeze = v.ndim == 2
+        if squeeze:
+            v = v[None]
+        spec = jnp.swapaxes(v, -1, -2)          # (B, num, freq)
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        frames = frames * win[None, None, :]
+        b, num, _ = frames.shape
+        out_len = (num - 1) * hop_length + n_fft
+
+        def body(i, carry):
+            acc, wsum = carry
+            seg = jax.lax.dynamic_slice_in_dim(frames, i, 1, axis=1)[:, 0]
+            acc = jax.lax.dynamic_update_slice_in_dim(
+                acc, jax.lax.dynamic_slice_in_dim(
+                    acc, i * hop_length, n_fft, axis=1) + seg,
+                i * hop_length, axis=1)
+            wsum = jax.lax.dynamic_update_slice_in_dim(
+                wsum, jax.lax.dynamic_slice_in_dim(
+                    wsum, i * hop_length, n_fft, axis=0) + win * win,
+                i * hop_length, axis=0)
+            return acc, wsum
+
+        acc = jnp.zeros((b, out_len), frames.dtype)
+        wsum = jnp.zeros((out_len,), jnp.float32)
+        acc, wsum = jax.lax.fori_loop(0, num, body, (acc, wsum))
+        out = acc / jnp.maximum(wsum, 1e-11)[None, :].astype(acc.dtype)
+        if center:
+            out = out[:, n_fft // 2: out_len - n_fft // 2]
+        if length is not None:
+            out = out[:, :length]
+        return out[0] if squeeze else out
+    args = (xt,) + ((win_t,) if win_t is not None else ())
+    return apply("istft", fn, args)

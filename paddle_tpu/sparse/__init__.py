@@ -1,0 +1,275 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors and ops.
+
+≙ reference «python/paddle/sparse/» + PHI `SparseCooTensor`/
+`SparseCsrTensor` kernels (SURVEY.md §2.1/§2.2). TPU-native substrate is
+jax.experimental.sparse (BCOO/BCSR): XLA lowers sparse ops to
+gather/scatter/segment-sum programs. Dense fallbacks keep semantics exact
+where BCOO lacks an op.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+import paddle_tpu as paddle
+from ..core.tensor import Tensor, to_tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "is_same_shape", "add", "subtract",
+           "multiply", "divide", "matmul", "masked_matmul", "relu",
+           "transpose", "sum", "nn"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor wrapping jax BCOO.
+    ≙ phi::SparseCooTensor («paddle/phi/core/sparse_coo_tensor.h» [U])."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle surface ------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def indices(self) -> Tensor:
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            self._bcoo.sum_duplicates()))
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    # arithmetic (dispatch to module fns)
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def T(self):
+        return transpose(self, list(range(len(self.shape)))[::-1])
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor wrapping jax BCSR.
+    ≙ phi::SparseCsrTensor [U]."""
+
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._bcsr = bcsr
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return self._bcsr.dtype
+
+    def crows(self) -> Tensor:
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._bcsr.indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcsr.data)
+
+    def nnz(self) -> int:
+        return int(self._bcsr.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=None) -> SparseCooTensor:
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(np.asarray(x))
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """≙ paddle.sparse.sparse_coo_tensor: indices (ndim, nnz), values
+    (nnz, ...)."""
+    idx = _val(indices).astype(jnp.int32)
+    vals = _val(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in np.asarray(idx.max(axis=1)))
+    bcoo = jsparse.BCOO((vals, jnp.swapaxes(idx, 0, 1)),
+                        shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """≙ paddle.sparse.sparse_csr_tensor."""
+    vals = _val(values)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        vals = vals.astype(convert_dtype(dtype))
+    bcsr = jsparse.BCSR((vals, _val(cols).astype(jnp.int32),
+                         _val(crows).astype(jnp.int32)),
+                        shape=tuple(shape))
+    return SparseCsrTensor(bcsr)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def _coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def _binary(x, y, op, name):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and \
+            isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        was_csr = isinstance(x, SparseCsrTensor)
+        xd = _coo(x)._bcoo.todense()
+        yd = _coo(y)._bcoo.todense()
+        dense = op(xd, yd)
+        out = SparseCooTensor(jsparse.BCOO.fromdense(dense))
+        return out.to_sparse_csr() if was_csr else out
+    raise TypeError(f"{name}: both operands must be sparse")
+
+
+def add(x, y, name=None):
+    return _binary(x, y, jnp.add, "add")
+
+
+def subtract(x, y, name=None):
+    return _binary(x, y, jnp.subtract, "subtract")
+
+
+def multiply(x, y, name=None):
+    return _binary(x, y, jnp.multiply, "multiply")
+
+
+def divide(x, y, name=None):
+    def _div(a, b):
+        return jnp.where(b != 0, a / jnp.where(b == 0, 1, b), 0)
+    return _binary(x, y, _div, "divide")
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (spmm) or sparse @ sparse (result dense → sparse).
+    ≙ paddle.sparse.matmul."""
+    if isinstance(y, Tensor) or isinstance(y, (np.ndarray, jnp.ndarray)):
+        yv = _val(y)
+        if isinstance(x, SparseCsrTensor):
+            out = x._bcsr @ yv
+        else:
+            out = x._bcoo @ yv
+        return Tensor(out)
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        xd = _coo(x)._bcoo.todense() if isinstance(
+            x, (SparseCooTensor, SparseCsrTensor)) else _val(x)
+        yd = _coo(y)._bcoo.todense()
+        return Tensor(xd @ yd)
+    raise TypeError("matmul: unsupported operand types")
+
+
+def masked_matmul(x, y, mask, name=None):
+    """dense @ dense with sparse output pattern (SDDMM).
+    ≙ paddle.sparse.masked_matmul."""
+    xv, yv = _val(x), _val(y)
+    m = _coo(mask)._bcoo
+    rows = m.indices[:, 0]
+    cols = m.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], jnp.swapaxes(yv, 0, 1)[cols])
+    return SparseCooTensor(jsparse.BCOO((vals, m.indices), shape=m.shape))
+
+
+def relu(x, name=None):
+    c = _coo(x)
+    out = SparseCooTensor(jsparse.BCOO(
+        (jax.nn.relu(c._bcoo.data), c._bcoo.indices), shape=c._bcoo.shape))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def transpose(x, perm, name=None):
+    c = _coo(x)
+    out = SparseCooTensor(c._bcoo.transpose(tuple(perm)))
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    c = _coo(x)
+    dense = c._bcoo.todense()
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..core.dtype import convert_dtype
+        out = out.astype(convert_dtype(dtype))
+    return Tensor(out)
+
+
+class _SparseNN:
+    """paddle.sparse.nn subset: functional relu/softmax on sparse values."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    @staticmethod
+    def functional_relu(x):
+        return relu(x)
+
+
+nn = _SparseNN()

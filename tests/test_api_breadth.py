@@ -1,0 +1,239 @@
+"""API-breadth tests: fft, signal, sparse, distribution, quantization.
+≙ reference test tiers «test/fft/», «test/sparse/», «test/distribution/»,
+«test/quantization/» [U] — NumPy/scipy-reference oracles (SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+rng = np.random.default_rng(13)
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = rng.normal(size=(4, 32)).astype(np.float32)
+        X = paddle.fft.fft(paddle.to_tensor(x))
+        back = paddle.fft.ifft(X)
+        np.testing.assert_allclose(back.numpy().real, x, atol=1e-5)
+        np.testing.assert_allclose(X.numpy(), np.fft.fft(x), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_rfft_matches_numpy(self):
+        x = rng.normal(size=(3, 16)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.fft.rfft(paddle.to_tensor(x)).numpy(),
+            np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+
+    def test_fft2_and_norms(self):
+        x = rng.normal(size=(8, 8)).astype(np.float32)
+        for norm in ("backward", "ortho", "forward"):
+            np.testing.assert_allclose(
+                paddle.fft.fft2(paddle.to_tensor(x), norm=norm).numpy(),
+                np.fft.fft2(x, norm=norm), rtol=1e-4, atol=1e-4)
+        with pytest.raises(ValueError):
+            paddle.fft.fft(paddle.to_tensor(x), norm="bogus")
+
+    def test_fftshift_freq(self):
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, d=0.5).astype(
+                                       np.float32))
+        x = rng.normal(size=(8,)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.fft.fftshift(paddle.to_tensor(x)).numpy(),
+            np.fft.fftshift(x))
+
+    def test_fft_grad(self):
+        x = paddle.to_tensor(rng.normal(size=(16,)).astype(np.float32),
+                             stop_gradient=False)
+        y = paddle.fft.rfft(x)
+        (y.abs() ** 2).sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self):
+        x = rng.normal(size=(2, 512)).astype(np.float32)
+        win = np.hanning(128).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=128,
+                                  hop_length=32,
+                                  window=paddle.to_tensor(win))
+        back = paddle.signal.istft(spec, n_fft=128, hop_length=32,
+                                   window=paddle.to_tensor(win),
+                                   length=512)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-4)
+
+    def test_frame_shapes(self):
+        x = paddle.to_tensor(rng.normal(size=(2, 100)).astype(np.float32))
+        f = paddle.signal.frame(x, frame_length=20, hop_length=10)
+        assert f.shape == [2, 20, 9]
+
+
+class TestSparse:
+    def test_coo_create_dense_roundtrip(self):
+        idx = np.array([[0, 1, 2], [1, 2, 0]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        s = paddle.sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+        d = s.to_dense().numpy()
+        want = np.zeros((3, 3), np.float32)
+        want[idx[0], idx[1]] = vals
+        np.testing.assert_array_equal(d, want)
+        assert s.nnz() == 3
+
+    def test_csr_and_conversion(self):
+        crows = np.array([0, 1, 3])
+        cols = np.array([1, 0, 2])
+        vals = np.array([5.0, 1.0, 2.0], np.float32)
+        s = paddle.sparse.sparse_csr_tensor(crows, cols, vals, [2, 3])
+        d = s.to_dense().numpy()
+        assert d[0, 1] == 5.0 and d[1, 0] == 1.0 and d[1, 2] == 2.0
+        coo = s.to_sparse_coo()
+        np.testing.assert_array_equal(coo.to_dense().numpy(), d)
+
+    def test_spmm_matches_dense(self):
+        dense = (rng.random((4, 5)) * (rng.random((4, 5)) > 0.6)).astype(
+            np.float32)
+        idx = np.array(np.nonzero(dense))
+        s = paddle.sparse.sparse_coo_tensor(idx, dense[tuple(idx)],
+                                            shape=[4, 5])
+        y = rng.normal(size=(5, 3)).astype(np.float32)
+        out = paddle.sparse.matmul(s, paddle.to_tensor(y))
+        np.testing.assert_allclose(out.numpy(), dense @ y, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_sparse_add_relu(self):
+        a = np.diag([1.0, -2.0, 3.0]).astype(np.float32)
+        idx = np.array(np.nonzero(a))
+        s = paddle.sparse.sparse_coo_tensor(idx, a[tuple(idx)], [3, 3])
+        r = paddle.sparse.relu(s)
+        np.testing.assert_array_equal(
+            r.to_dense().numpy(), np.maximum(a, 0))
+        tot = paddle.sparse.add(s, s).to_dense().numpy()
+        np.testing.assert_array_equal(tot, 2 * a)
+
+    def test_masked_matmul(self):
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        y = rng.normal(size=(4, 3)).astype(np.float32)
+        mask_d = np.eye(3, dtype=np.float32)
+        idx = np.array(np.nonzero(mask_d))
+        mask = paddle.sparse.sparse_coo_tensor(idx, mask_d[tuple(idx)],
+                                               [3, 3])
+        out = paddle.sparse.masked_matmul(paddle.to_tensor(x),
+                                          paddle.to_tensor(y), mask)
+        np.testing.assert_allclose(np.diag(out.to_dense().numpy()),
+                                   np.diag(x @ y), rtol=1e-5)
+
+
+class TestDistribution:
+    def test_normal_moments_and_kl(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+        paddle.seed(0)
+        p = Normal(0.0, 1.0)
+        q = Normal(1.0, 2.0)
+        s = p.sample((5000,))
+        assert abs(float(s.numpy().mean())) < 0.1
+        assert abs(float(s.numpy().std()) - 1.0) < 0.1
+        kl = float(kl_divergence(p, q).numpy())
+        want = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        assert abs(kl - want) < 1e-5
+        # log_prob vs scipy formula
+        lp = float(p.log_prob(paddle.to_tensor(0.5)).numpy())
+        assert abs(lp - (-0.5 * 0.25 - 0.5 * np.log(2 * np.pi))) < 1e-5
+
+    def test_categorical(self):
+        from paddle_tpu.distribution import Categorical, kl_divergence
+        paddle.seed(0)
+        c = Categorical(logits=np.log(np.array([0.2, 0.3, 0.5],
+                                               np.float32)))
+        s = c.sample((8000,)).numpy()
+        freq = np.bincount(s.astype(int), minlength=3) / len(s)
+        np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+        ent = float(c.entropy().numpy())
+        want = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+        assert abs(ent - want) < 1e-5
+        assert float(kl_divergence(c, c).numpy()) == pytest.approx(0.0,
+                                                                   abs=1e-6)
+
+    @pytest.mark.parametrize("cls,args,mean,var", [
+        ("Bernoulli", (0.3,), 0.3, 0.21),
+        ("Exponential", (2.0,), 0.5, 0.25),
+        ("Laplace", (1.0, 2.0), 1.0, 8.0),
+        ("Gamma", (3.0, 2.0), 1.5, 0.75),
+        ("Beta", (2.0, 3.0), 0.4, 0.04),
+        ("Geometric", (0.5,), 1.0, 2.0),
+        ("Poisson", (4.0,), 4.0, 4.0),
+    ])
+    def test_moments(self, cls, args, mean, var):
+        import paddle_tpu.distribution as D
+        d = getattr(D, cls)(*args)
+        assert float(d.mean.numpy()) == pytest.approx(mean, rel=1e-5)
+        assert float(d.variance.numpy()) == pytest.approx(var, rel=1e-4)
+
+    def test_sampling_statistics(self):
+        import paddle_tpu.distribution as D
+        paddle.seed(0)
+        for d, m in [(D.Gamma(3.0, 2.0), 1.5), (D.Laplace(1.0, 2.0), 1.0),
+                     (D.Gumbel(0.0, 1.0), float(np.euler_gamma))]:
+            s = d.sample((4000,)).numpy()
+            assert abs(s.mean() - m) < 0.15, (type(d).__name__, s.mean())
+
+    def test_dirichlet_multinomial(self):
+        import paddle_tpu.distribution as D
+        paddle.seed(0)
+        dd = D.Dirichlet(np.array([2.0, 3.0, 5.0], np.float32))
+        s = dd.sample((2000,)).numpy()
+        np.testing.assert_allclose(s.mean(0), [0.2, 0.3, 0.5], atol=0.03)
+        mn = D.Multinomial(10, np.array([0.5, 0.5], np.float32))
+        sm = mn.sample((500,)).numpy()
+        assert sm.sum(-1).max() == 10
+        np.testing.assert_allclose(sm.mean(0), [5, 5], atol=0.5)
+
+
+class TestQuantization:
+    def test_fake_quant_ste_grad(self):
+        from paddle_tpu.quantization import fake_quant
+        x = paddle.to_tensor(
+            rng.uniform(-0.9, 0.9, size=(8,)).astype(np.float32),
+            stop_gradient=False)
+        y = fake_quant(x, 1.0, bit_length=8)
+        # quantized values close to original at 8 bits
+        np.testing.assert_allclose(y.numpy(), x.numpy(), atol=1 / 127 + 1e-6)
+        y.sum().backward()
+        # STE: unit gradient inside the clip range
+        np.testing.assert_array_equal(x.grad.numpy(), np.ones(8,
+                                                              np.float32))
+
+    def test_qat_quantize_and_convert(self):
+        from paddle_tpu.quantization import QAT, QuantedLinear
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        x = paddle.to_tensor(rng.normal(size=(2, 8)).astype(np.float32))
+        ref = net(x).numpy()
+        qat = QAT()
+        qnet = qat.quantize(net)
+        assert isinstance(qnet[0], QuantedLinear)
+        out = qnet(x).numpy()
+        np.testing.assert_allclose(out, ref, atol=0.15)  # 8-bit error
+        # training still works through fake-quant (STE)
+        loss = (qnet(x) ** 2).sum()
+        loss.backward()
+        assert qnet[0].linear.weight.grad is not None
+        qat.convert(qnet)
+        out2 = qnet(x).numpy()
+        np.testing.assert_allclose(out2, ref, atol=0.15)
+
+    def test_ptq_calibrate_convert(self):
+        from paddle_tpu.quantization import PTQ
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 8))
+        x = paddle.to_tensor(rng.normal(size=(4, 8)).astype(np.float32))
+        ref = net(x).numpy()
+        ptq = PTQ()
+        onet = ptq.quantize(net)
+        for _ in range(3):
+            onet(x)  # calibration
+        qnet = ptq.convert(onet)
+        np.testing.assert_allclose(qnet(x).numpy(), ref, atol=0.1)
