@@ -1,0 +1,180 @@
+"""Native runtime bindings (ctypes over csrc/native.cc).
+
+≙ the reference's C++ runtime pieces this framework keeps native
+(SURVEY.md §7 design stance): the DataLoader shared-memory transport and
+the tensor serialization codec. Compiled on first use with g++ into a
+cached .so next to the package; everything degrades to pure-Python
+fallbacks if no compiler is present (paddle_tpu._native.AVAILABLE tells).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "..", "..", "csrc", "native.cc")
+_LIB_PATH = os.path.join(_HERE, "libpaddle_tpu_native.so")
+
+_lib = None
+_lock = threading.Lock()
+AVAILABLE = False
+
+
+def _build() -> bool:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return False
+    if os.path.exists(_LIB_PATH) and \
+            os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src):
+        return True
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            tmp = os.path.join(td, "native.so")
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 "-pthread", src, "-o", tmp],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _LIB_PATH)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired):
+        return False
+
+
+def _load():
+    global _lib, AVAILABLE
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not _build():
+            return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.ring_create.restype = ctypes.c_void_p
+        lib.ring_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.ring_attach.restype = ctypes.c_void_p
+        lib.ring_attach.argtypes = [ctypes.c_char_p]
+        lib.ring_push.restype = ctypes.c_int
+        lib.ring_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_uint64, ctypes.c_int]
+        lib.ring_next_len.restype = ctypes.c_int64
+        lib.ring_next_len.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.ring_pop.restype = ctypes.c_int64
+        lib.ring_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                 ctypes.c_uint64, ctypes.c_int]
+        lib.ring_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.codec_header_size.restype = ctypes.c_uint64
+        lib.codec_header_size.argtypes = [ctypes.c_int]
+        lib.codec_encode.restype = ctypes.c_uint64
+        lib.codec_encode.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                     ctypes.c_char_p, ctypes.c_void_p,
+                                     ctypes.c_int, ctypes.c_void_p]
+        lib.codec_decode.restype = ctypes.c_uint64
+        lib.codec_decode.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                     ctypes.c_char_p, ctypes.c_void_p,
+                                     ctypes.c_int * 1, ctypes.c_int]
+        lib.codec_crc32.restype = ctypes.c_uint32
+        lib.codec_crc32.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        _lib = lib
+        AVAILABLE = True
+        return lib
+
+
+class ShmRing:
+    """Multi-producer single-consumer shared-memory record ring.
+    ≙ the reference DataLoader's C++ shm tensor channel [U]."""
+
+    def __init__(self, name: str, capacity: int = 64 << 20,
+                 create: bool = True):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable (no g++?)")
+        self._lib = lib
+        self.name = name.encode()
+        if create:
+            self._h = lib.ring_create(self.name, capacity)
+        else:
+            self._h = lib.ring_attach(self.name)
+        if not self._h:
+            raise OSError(f"shm ring {'create' if create else 'attach'} "
+                          f"failed: {name}")
+        self._owner = create
+
+    def push(self, data: bytes, timeout_ms: int = 10000) -> bool:
+        rc = self._lib.ring_push(self._h, data, len(data), timeout_ms)
+        if rc == -2:
+            raise ValueError("record larger than ring capacity")
+        return rc == 0
+
+    def pop(self, timeout_ms: int = 10000):
+        n = self._lib.ring_next_len(self._h, timeout_ms)
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(int(n))
+        got = self._lib.ring_pop(self._h, buf, int(n), timeout_ms)
+        if got < 0:
+            return None
+        return buf.raw[:got]
+
+    def close(self):
+        if self._h:
+            self._lib.ring_close(self._h, 1 if self._owner else 0)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def encode_tensor(arr: np.ndarray) -> bytes:
+    """Native codec encode (crc32-protected). Falls back to .npy bytes."""
+    lib = _load()
+    arr = np.ascontiguousarray(arr)
+    if lib is None:
+        import io as _io
+        b = _io.BytesIO()
+        np.save(b, arr, allow_pickle=False)
+        return b"NPYF" + b.getvalue()
+    shape = (ctypes.c_int64 * max(arr.ndim, 1))(*arr.shape)
+    total = int(lib.codec_header_size(arr.ndim)) + arr.nbytes
+    out = ctypes.create_string_buffer(total)
+    n = lib.codec_encode(arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes,
+                         str(arr.dtype).encode()[:7], shape, arr.ndim, out)
+    return out.raw[:n]
+
+
+def decode_tensor(buf: bytes) -> np.ndarray:
+    lib = _load()
+    if buf[:4] == b"NPYF":
+        import io as _io
+        return np.load(_io.BytesIO(buf[4:]), allow_pickle=False)
+    if lib is None:
+        raise RuntimeError("native codec buffer but no native library")
+    dtype = ctypes.create_string_buffer(9)
+    shape = (ctypes.c_int64 * 8)()
+    ndim = (ctypes.c_int * 1)()
+    off = lib.codec_decode(buf, len(buf), dtype, shape, ndim, 1)
+    if off == 0:
+        raise ValueError("codec: bad magic/header")
+    if off == ctypes.c_uint64(-1).value:
+        raise ValueError("codec: crc32 mismatch (corrupt tensor payload)")
+    nd = ndim[0]
+    shp = tuple(shape[i] for i in range(nd))
+    dt = np.dtype(dtype.value.decode())
+    return np.frombuffer(buf, dtype=dt, offset=int(off),
+                         count=int(np.prod(shp)) if shp else 1
+                         ).reshape(shp).copy()
+
+
+def crc32(data: bytes) -> int:
+    lib = _load()
+    if lib is None:
+        import zlib
+        return zlib.crc32(data) & 0xFFFFFFFF
+    return int(lib.codec_crc32(data, len(data)))

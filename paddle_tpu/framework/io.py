@@ -25,12 +25,21 @@ class _TensorPayload:
         self.dtype = arr.dtype.name if arr.dtype.names is None else str(arr.dtype)
         self.shape = arr.shape
         self.data = arr.tobytes()
+        from .. import _native
+        self.crc = _native.crc32(self.data)  # C-speed integrity tag
         self.stop_gradient = t.stop_gradient
         self.is_parameter = isinstance(t, Parameter)
         self.name = t.name
 
     def restore(self) -> Tensor:
         import ml_dtypes  # noqa: F401  (registers bfloat16 with numpy)
+        crc = getattr(self, "crc", None)
+        if crc is not None:
+            from .. import _native
+            if _native.crc32(self.data) != crc:
+                raise ValueError(
+                    f"corrupt tensor payload for {self.name!r} "
+                    "(crc32 mismatch)")
         dt = np.dtype(self.dtype)
         arr = np.frombuffer(self.data, dtype=dt).reshape(self.shape)
         if self.is_parameter:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import os
 import queue
 import threading
 from typing import Any, Iterable, Iterator, Optional
@@ -316,6 +317,132 @@ class _PrefetchIterator:
         return item
 
 
+class _ShmWorkerIterator:
+    """Forked worker processes + native shared-memory ring transport.
+
+    ≙ the reference DataLoader's multiprocess workers with C++ shm tensor
+    channel («python/paddle/io/dataloader/» + shm LoDTensor transport [U]):
+    worker w computes batches w, w+N, w+2N... as numpy, serializes each
+    field through the native codec, and pushes [seq][fields] records into
+    one MPSC ring; the parent reorders by seq and materializes Tensors.
+    Falls back to the thread prefetcher when the native lib is missing.
+    """
+
+    def __init__(self, dataset, batches, collate_fn, num_workers,
+                 capacity_mb=64, timeout_ms=60000):
+        import pickle
+        import struct
+        from .. import _native
+        self._native = _native
+        self._pickle = pickle
+        self._struct = struct
+        self.dataset = dataset
+        self.batches = batches
+        self.collate_fn = collate_fn
+        self.timeout_ms = timeout_ms
+        name = f"/pdt_dl_{os.getpid()}_{id(self) & 0xFFFFFF:x}"
+        self.ring = _native.ShmRing(name, capacity=capacity_mb << 20)
+        self._expected = 0
+        self._held = {}
+        self._n = len(batches)
+        self._pids = []
+        for w in range(num_workers):
+            pid = os.fork()
+            if pid == 0:
+                try:
+                    self._worker(name, w, num_workers)
+                finally:
+                    os._exit(0)
+            self._pids.append(pid)
+
+    # -- worker side ---------------------------------------------------------
+    def _worker(self, name, w, num_workers):
+        ring = self._native.ShmRing(name, create=False)
+        for seq in range(w, self._n, num_workers):
+            idxs = self.batches[seq]
+            fields = self._to_fields(
+                [self.dataset[i] for i in idxs])
+            msg = [self._struct.pack("<Q", seq)]
+            msg.append(self._struct.pack("<I", len(fields)))
+            for tag, payload in fields:
+                msg.append(self._struct.pack("<BQ", tag, len(payload)))
+                msg.append(payload)
+            ring.push(b"".join(msg), timeout_ms=self.timeout_ms)
+
+    def _to_fields(self, samples):
+        """Collate to numpy per field; codec-encode arrays, pickle rest."""
+        sample = samples[0]
+        if isinstance(sample, (tuple, list)):
+            cols = list(zip(*samples))
+        else:
+            cols = [samples]
+        fields = []
+        for col in cols:
+            try:
+                arr = np.stack([np.asarray(c) for c in col])
+                if arr.dtype == object:
+                    raise TypeError
+                fields.append((0, self._native.encode_tensor(arr)))
+            except (TypeError, ValueError):
+                fields.append((1, self._pickle.dumps(list(col))))
+        return fields
+
+    # -- parent side ---------------------------------------------------------
+    def _decode(self, raw):
+        s = self._struct
+        seq = s.unpack_from("<Q", raw, 0)[0]
+        nf = s.unpack_from("<I", raw, 8)[0]
+        off = 12
+        fields = []
+        for _ in range(nf):
+            tag, ln = s.unpack_from("<BQ", raw, off)
+            off += 9
+            payload = raw[off:off + ln]
+            off += ln
+            if tag == 0:
+                fields.append(to_tensor(self._native.decode_tensor(payload)))
+            else:
+                fields.append(self._pickle.loads(payload))
+        return seq, (fields[0] if len(fields) == 1 else tuple(fields))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._expected >= self._n:
+            self._shutdown()
+            raise StopIteration
+        while self._expected not in self._held:
+            raw = self.ring.pop(timeout_ms=self.timeout_ms)
+            if raw is None:
+                self._shutdown()
+                raise RuntimeError(
+                    "DataLoader worker timeout/death (shm ring empty)")
+            seq, batch = self._decode(raw)
+            self._held[seq] = batch
+        out = self._held.pop(self._expected)
+        self._expected += 1
+        return out
+
+    def _shutdown(self):
+        for pid in self._pids:
+            try:
+                os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                pass
+        self._pids = []
+        try:
+            self.ring.close()
+        except Exception:
+            pass
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
 class DataLoader:
     """≙ paddle.io.DataLoader."""
 
@@ -328,6 +455,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.use_shared_memory = use_shared_memory
         self.prefetch_factor = prefetch_factor
         self._iterable_mode = isinstance(dataset, IterableDataset)
         self.batch_size = batch_size
@@ -361,6 +489,16 @@ class DataLoader:
 
     def __iter__(self):
         if self.num_workers and self.num_workers > 0:
+            if self.use_shared_memory and not self._iterable_mode and \
+                    self.collate_fn is default_collate_fn:
+                try:
+                    from .. import _native
+                    if _native._load() is not None:
+                        return _ShmWorkerIterator(
+                            self.dataset, list(self.batch_sampler),
+                            self.collate_fn, self.num_workers)
+                except OSError:
+                    pass  # shm unavailable — fall through to threads
             return _PrefetchIterator(self._gen, self.num_workers,
                                      self.prefetch_factor)
         return self._gen()

@@ -1,0 +1,141 @@
+"""Native runtime tests: codec, shm ring, multiprocess DataLoader.
+≙ reference C++ unit tests for the shm channel + save/load codec
+(SURVEY.md §2.1 rows 'Memory/allocators'/'JIT saved-model layer' analogs)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import _native as N
+from paddle_tpu.io import DataLoader, Dataset
+
+rng = np.random.default_rng(31)
+
+needs_native = pytest.mark.skipif(N._load() is None,
+                                  reason="g++/native lib unavailable")
+
+
+@needs_native
+class TestCodec:
+    @pytest.mark.parametrize("dtype", ["float32", "int32", "float64",
+                                       "uint8", "bool"])
+    def test_roundtrip(self, dtype):
+        a = (rng.random((3, 4, 5)) * 100).astype(dtype)
+        b = N.encode_tensor(a)
+        np.testing.assert_array_equal(N.decode_tensor(b), a)
+
+    def test_scalar_and_empty(self):
+        for a in (np.float32(3.5), np.zeros((0, 4), np.int32)):
+            got = N.decode_tensor(N.encode_tensor(np.asarray(a)))
+            np.testing.assert_array_equal(got, np.asarray(a))
+
+    def test_crc_detects_corruption(self):
+        b = bytearray(N.encode_tensor(np.arange(10, dtype=np.float32)))
+        b[-2] ^= 0x40
+        with pytest.raises(ValueError, match="crc32"):
+            N.decode_tensor(bytes(b))
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError, match="magic"):
+            N.decode_tensor(b"\x00" * 64)
+
+
+@needs_native
+class TestShmRing:
+    def test_push_pop_order(self):
+        ring = N.ShmRing(f"/pdt_t1_{os.getpid()}", capacity=1 << 16)
+        try:
+            for i in range(10):
+                assert ring.push(bytes([i]) * (i + 1))
+            for i in range(10):
+                msg = ring.pop(timeout_ms=1000)
+                assert msg == bytes([i]) * (i + 1)
+        finally:
+            ring.close()
+
+    def test_wraparound(self):
+        ring = N.ShmRing(f"/pdt_t2_{os.getpid()}", capacity=1 << 12)
+        try:
+            payload = bytes(1000)
+            for _ in range(20):  # > capacity total: forces wraparound
+                assert ring.push(payload, timeout_ms=1000)
+                assert ring.pop(timeout_ms=1000) == payload
+        finally:
+            ring.close()
+
+    def test_timeout_on_empty(self):
+        ring = N.ShmRing(f"/pdt_t3_{os.getpid()}", capacity=1 << 12)
+        try:
+            assert ring.pop(timeout_ms=50) is None
+        finally:
+            ring.close()
+
+    def test_too_large_record(self):
+        ring = N.ShmRing(f"/pdt_t4_{os.getpid()}", capacity=1 << 10)
+        try:
+            with pytest.raises(ValueError, match="capacity"):
+                ring.push(bytes(2048))
+        finally:
+            ring.close()
+
+    def test_cross_process(self):
+        name = f"/pdt_t5_{os.getpid()}"
+        ring = N.ShmRing(name, capacity=1 << 20)
+        try:
+            pid = os.fork()
+            if pid == 0:
+                try:
+                    w = N.ShmRing(name, create=False)
+                    for i in range(20):
+                        w.push(N.encode_tensor(
+                            np.full((8,), i, np.int32)))
+                finally:
+                    os._exit(0)
+            for i in range(20):
+                arr = N.decode_tensor(ring.pop(timeout_ms=10000))
+                assert (arr == i).all()
+            os.waitpid(pid, 0)
+        finally:
+            ring.close()
+
+
+class _ArrayDataset(Dataset):
+    def __init__(self, n=40):
+        self.x = rng.normal(size=(n, 6)).astype(np.float32)
+        self.y = np.arange(n, dtype=np.int64)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+@needs_native
+class TestMultiprocessDataLoader:
+    def test_worker_batches_match_inline(self):
+        ds = _ArrayDataset(40)
+        dl0 = DataLoader(ds, batch_size=8, num_workers=0)
+        dl2 = DataLoader(ds, batch_size=8, num_workers=2)
+        batches0 = [(x.numpy(), y.numpy()) for x, y in dl0]
+        batches2 = [(x.numpy(), y.numpy()) for x, y in dl2]
+        assert len(batches0) == len(batches2) == 5
+        for (x0, y0), (x2, y2) in zip(batches0, batches2):
+            np.testing.assert_array_equal(x0, x2)
+            np.testing.assert_array_equal(y0, y2)
+
+    def test_shuffle_with_workers_covers_all(self):
+        ds = _ArrayDataset(32)
+        dl = DataLoader(ds, batch_size=4, shuffle=True, num_workers=3)
+        seen = np.concatenate([y.numpy() for _, y in dl])
+        assert sorted(seen.tolist()) == list(range(32))
+
+
+class TestSaveIntegrity:
+    def test_save_load_crc(self, tmp_path):
+        t = paddle.to_tensor(rng.normal(size=(4, 4)).astype(np.float32))
+        p = str(tmp_path / "x.pdparams")
+        paddle.save({"w": t}, p)
+        out = paddle.load(p)
+        np.testing.assert_array_equal(out["w"].numpy(), t.numpy())
