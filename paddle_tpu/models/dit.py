@@ -1,0 +1,213 @@
+"""DiT — diffusion transformer. North-star config #4 (BASELINE.md
+"DiT/SD3 (conv+attention Pallas)"): patchify -> adaLN-zero transformer
+blocks conditioned on (timestep, class) -> unpatchify to noise prediction.
+≙ PaddleMIX DiT recipe (outside-repo zoo per SURVEY.md §1)."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+__all__ = ["DiTConfig", "DiT", "synthetic_dit_batch"]
+
+
+@dataclass
+class DiTConfig:
+    input_size: int = 32          # latent H=W
+    patch_size: int = 2
+    in_channels: int = 4
+    hidden_size: int = 1152
+    num_hidden_layers: int = 28
+    num_attention_heads: int = 16
+    mlp_ratio: float = 4.0
+    num_classes: int = 1000
+    learn_sigma: bool = True
+
+    @staticmethod
+    def xl_2():
+        return DiTConfig()
+
+    @staticmethod
+    def tiny():
+        return DiTConfig(input_size=8, patch_size=2, in_channels=4,
+                         hidden_size=64, num_hidden_layers=2,
+                         num_attention_heads=4, num_classes=10)
+
+    @property
+    def num_patches(self):
+        return (self.input_size // self.patch_size) ** 2
+
+    @property
+    def out_channels(self):
+        return self.in_channels * (2 if self.learn_sigma else 1)
+
+
+def timestep_embedding(t, dim, max_period=10000):
+    """Sinusoidal timestep embedding (B,) -> (B, dim)."""
+    import jax.numpy as jnp
+    from ..core.tensor import apply
+
+    def fn(tv):
+        half = dim // 2
+        freqs = jnp.exp(-math.log(max_period)
+                        * jnp.arange(half, dtype=jnp.float32) / half)
+        args = tv.astype(jnp.float32)[:, None] * freqs[None]
+        return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    return apply("timestep_embedding", fn, (t,))
+
+
+class TimestepEmbedder(nn.Layer):
+    def __init__(self, hidden_size, freq_dim=256):
+        super().__init__()
+        self.freq_dim = freq_dim
+        self.mlp = nn.Sequential(nn.Linear(freq_dim, hidden_size),
+                                 nn.Silu(),
+                                 nn.Linear(hidden_size, hidden_size))
+
+    def forward(self, t):
+        return self.mlp(timestep_embedding(t, self.freq_dim))
+
+
+class LabelEmbedder(nn.Layer):
+    def __init__(self, num_classes, hidden_size):
+        super().__init__()
+        # +1 slot: the classifier-free-guidance null class
+        self.embedding_table = nn.Embedding(num_classes + 1, hidden_size)
+        self.num_classes = num_classes
+
+    def forward(self, labels):
+        return self.embedding_table(labels)
+
+
+class DiTBlock(nn.Layer):
+    """adaLN-Zero block: condition c modulates scale/shift/gate of both
+    the attention and MLP branches; gates start at zero."""
+
+    def __init__(self, cfg: DiTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.norm1 = nn.LayerNorm(h, 1e-6, weight_attr=False,
+                                  bias_attr=False)
+        self.norm2 = nn.LayerNorm(h, 1e-6, weight_attr=False,
+                                  bias_attr=False)
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = h // cfg.num_attention_heads
+        self.qkv = nn.Linear(h, 3 * h)
+        self.proj = nn.Linear(h, h)
+        mh = int(h * cfg.mlp_ratio)
+        self.fc1 = nn.Linear(h, mh)
+        self.fc2 = nn.Linear(mh, h)
+        from ..nn import initializer as I
+        self.ada = nn.Linear(h, 6 * h,
+                             weight_attr=I.Constant(0.0),
+                             bias_attr=I.Constant(0.0))
+
+    def forward(self, x, c):
+        b, s = x.shape[0], x.shape[1]
+        mods = self.ada(F.silu(c))                       # (B, 6H)
+        sh1, sc1, g1, sh2, sc2, g2 = [
+            mods[:, i * x.shape[2]:(i + 1) * x.shape[2]].unsqueeze(1)
+            for i in range(6)]
+        h1 = self.norm1(x) * (1 + sc1) + sh1
+        qkv = self.qkv(h1).reshape([b, s, 3, self.num_heads, self.head_dim])
+        attn = F.scaled_dot_product_attention(qkv[:, :, 0], qkv[:, :, 1],
+                                              qkv[:, :, 2])
+        x = x + g1 * self.proj(attn.reshape([b, s, -1]))
+        h2 = self.norm2(x) * (1 + sc2) + sh2
+        x = x + g2 * self.fc2(F.gelu(self.fc1(h2), approximate=True))
+        return x
+
+
+class FinalLayer(nn.Layer):
+    def __init__(self, cfg: DiTConfig):
+        super().__init__()
+        h = cfg.hidden_size
+        self.norm = nn.LayerNorm(h, 1e-6, weight_attr=False,
+                                 bias_attr=False)
+        from ..nn import initializer as I
+        self.ada = nn.Linear(h, 2 * h, weight_attr=I.Constant(0.0),
+                             bias_attr=I.Constant(0.0))
+        self.linear = nn.Linear(
+            h, cfg.patch_size * cfg.patch_size * cfg.out_channels,
+            weight_attr=I.Constant(0.0), bias_attr=I.Constant(0.0))
+
+    def forward(self, x, c):
+        mods = self.ada(F.silu(c))
+        h = x.shape[2]
+        shift, scale = mods[:, :h].unsqueeze(1), mods[:, h:].unsqueeze(1)
+        return self.linear(self.norm(x) * (1 + scale) + shift)
+
+
+class DiT(nn.Layer):
+    """forward(x (B,C,H,W), t (B,), y (B,)) -> noise pred (B,outC,H,W)."""
+
+    def __init__(self, cfg: DiTConfig | None = None):
+        super().__init__()
+        cfg = cfg or DiTConfig()
+        self.config = cfg
+        p = cfg.patch_size
+        self.x_embedder = nn.Linear(p * p * cfg.in_channels,
+                                    cfg.hidden_size)
+        n = cfg.num_patches
+        pos = self._build_2d_sincos(cfg.hidden_size,
+                                    cfg.input_size // p)
+        self.register_buffer("pos_embed",
+                             paddle.to_tensor(pos[None].astype(np.float32)),
+                             persistable=False)
+        self.t_embedder = TimestepEmbedder(cfg.hidden_size)
+        self.y_embedder = LabelEmbedder(cfg.num_classes, cfg.hidden_size)
+        self.blocks = nn.LayerList([DiTBlock(cfg)
+                                    for _ in range(cfg.num_hidden_layers)])
+        self.final_layer = FinalLayer(cfg)
+
+    @staticmethod
+    def _build_2d_sincos(dim, grid):
+        ys, xs = np.meshgrid(np.arange(grid), np.arange(grid),
+                             indexing="ij")
+
+        def emb_1d(posv, d):
+            omega = 1.0 / 10000 ** (np.arange(d // 2) / (d / 2))
+            out = posv.reshape(-1)[:, None] * omega[None]
+            return np.concatenate([np.sin(out), np.cos(out)], axis=1)
+
+        return np.concatenate([emb_1d(ys, dim // 2), emb_1d(xs, dim // 2)],
+                              axis=1)
+
+    def _patchify(self, x):
+        p = self.config.patch_size
+        b, c, hh, ww = x.shape
+        gh, gw = hh // p, ww // p
+        x = x.reshape([b, c, gh, p, gw, p])
+        x = x.transpose([0, 2, 4, 3, 5, 1])           # B gh gw p p C
+        return x.reshape([b, gh * gw, p * p * c])
+
+    def _unpatchify(self, x):
+        cfg = self.config
+        p = cfg.patch_size
+        c = cfg.out_channels
+        b = x.shape[0]
+        g = cfg.input_size // p
+        x = x.reshape([b, g, g, p, p, c])
+        x = x.transpose([0, 5, 1, 3, 2, 4])
+        return x.reshape([b, c, g * p, g * p])
+
+    def forward(self, x, t, y):
+        h = self.x_embedder(self._patchify(x)) + self.pos_embed
+        c = self.t_embedder(t) + self.y_embedder(y)
+        for blk in self.blocks:
+            h = blk(h, c)
+        return self._unpatchify(self.final_layer(h, c))
+
+
+def synthetic_dit_batch(batch_size, cfg: DiTConfig, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(batch_size, cfg.in_channels, cfg.input_size,
+                         cfg.input_size)).astype(np.float32)
+    t = rng.integers(0, 1000, (batch_size,)).astype(np.int32)
+    y = rng.integers(0, cfg.num_classes, (batch_size,)).astype(np.int32)
+    return (paddle.to_tensor(x), paddle.to_tensor(t), paddle.to_tensor(y))

@@ -1,0 +1,136 @@
+"""GPT-2/3 family causal LM. ≙ PaddleNLP GPTModel (outside-repo zoo,
+SURVEY.md §1) built on paddle_tpu.nn: learned positional embeddings,
+pre-LayerNorm blocks, GELU MLP, causal attention through the Pallas flash
+kernel when shapes allow."""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "synthetic_lm_batch"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    dropout: float = 0.0
+    tie_word_embeddings: bool = True
+
+    @staticmethod
+    def gpt2():
+        return GPTConfig()
+
+    @staticmethod
+    def tiny():
+        return GPTConfig(vocab_size=512, hidden_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=128,
+                         max_position_embeddings=128)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.head_dim
+        self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+        self.proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self.dropout(self.proj(out.reshape([b, s, -1])))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        self.fc = nn.Linear(cfg.hidden_size, cfg.intermediate_size)
+        self.proj = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
+        self.dropout = nn.Dropout(cfg.dropout)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        h = self.proj(F.gelu(self.fc(self.ln_2(x)), approximate=True))
+        return x + self.dropout(h)
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.config = cfg
+        self.wte = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = nn.Embedding(cfg.max_position_embeddings,
+                                cfg.hidden_size)
+        self.drop = nn.Dropout(cfg.dropout)
+        self.h = nn.LayerList([GPTBlock(cfg)
+                               for _ in range(cfg.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = paddle.to_tensor(np.arange(s, dtype=np.int32)[None, :])
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for blk in self.h:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg: GPTConfig | None = None):
+        super().__init__()
+        cfg = cfg or GPTConfig()
+        self.config = cfg
+        self.transformer = GPTModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias_attr=False)
+        else:
+            self.lm_head = None
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.transformer(input_ids)
+        if self.lm_head is not None:
+            logits = self.lm_head(hidden)
+        else:
+            logits = paddle.matmul(hidden, self.transformer.wte.weight,
+                                   transpose_y=True)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size])
+                .astype("float32"),
+                labels.reshape([-1]), ignore_index=-100)
+            return loss, logits
+        return logits
+
+
+def synthetic_lm_batch(batch_size, seq_len, vocab_size, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab_size, (batch_size, seq_len + 1),
+                       dtype=np.int32)
+    return (paddle.to_tensor(ids[:, :-1]),
+            paddle.to_tensor(ids[:, 1:].astype(np.int32)))
