@@ -229,10 +229,16 @@ void ring_close(void* ring, int unlink_shm) {
 }
 
 // ---------------------------------------------------------------------------
-// tensor codec: [magic u32][crc u32][dtype u8[8]][ndim u32][shape i64*ndim]
+// tensor codec: [magic u32][crc u32][dtype u8[16]][ndim u32][shape i64*ndim]
 //               [payload]
+// The dtype field is 16 bytes (15 chars + NUL) so the longest NumPy dtype
+// names in play — "bfloat16" (this framework's default training dtype),
+// "complex128", "float128" — round-trip without truncation. v1 used 8
+// bytes and silently corrupted them; the magic was bumped so v1 blobs are
+// rejected instead of mis-decoded.
 // ---------------------------------------------------------------------------
-static const uint32_t kMagic = 0x50445054;  // "PDPT"
+static const uint32_t kMagic = 0x32445054;  // "PTD2"
+static const int kDtypeField = 16;
 
 static uint32_t crc32_update(uint32_t crc, const uint8_t* p, uint64_t n) {
   static uint32_t table[256];
@@ -252,43 +258,48 @@ static uint32_t crc32_update(uint32_t crc, const uint8_t* p, uint64_t n) {
   return ~crc;
 }
 
-uint64_t codec_header_size(int ndim) { return 4 + 4 + 8 + 4 + 8ull * ndim; }
+uint64_t codec_header_size(int ndim) {
+  return 4 + 4 + kDtypeField + 4 + 8ull * ndim;
+}
 
 // encode into out (caller sizes it via codec_header_size + data_len).
-// returns total bytes written.
+// returns total bytes written, or 0 if the dtype name does not fit the
+// header field (caller must fall back to another serialization path).
 uint64_t codec_encode(const void* data, uint64_t data_len, const char* dtype,
                       const int64_t* shape, int ndim, void* out) {
+  if (strlen(dtype) >= (size_t)kDtypeField) return 0;
   uint8_t* p = (uint8_t*)out;
   memcpy(p, &kMagic, 4);
   uint32_t crc = crc32_update(0, (const uint8_t*)data, data_len);
   memcpy(p + 4, &crc, 4);
-  char dt[8] = {0};
-  strncpy(dt, dtype, 7);
-  memcpy(p + 8, dt, 8);
+  char dt[kDtypeField] = {0};
+  strncpy(dt, dtype, kDtypeField - 1);
+  memcpy(p + 8, dt, kDtypeField);
   uint32_t nd = (uint32_t)ndim;
-  memcpy(p + 16, &nd, 4);
-  memcpy(p + 20, shape, 8ull * ndim);
-  memcpy(p + 20 + 8ull * ndim, data, data_len);
+  memcpy(p + 8 + kDtypeField, &nd, 4);
+  memcpy(p + 12 + kDtypeField, shape, 8ull * ndim);
+  memcpy(p + 12 + kDtypeField + 8ull * ndim, data, data_len);
   return codec_header_size(ndim) + data_len;
 }
 
-// parse header: fills dtype (>=8 bytes), shape (>=8 i64s), ndim; returns
+// parse header: fills dtype (>=16 bytes), shape (>=8 i64s), ndim; returns
 // payload offset, or 0 on bad magic, or -1 (as u64 max) on crc mismatch
 // when verify != 0.
 uint64_t codec_decode(const void* buf, uint64_t len, char* dtype_out,
                       int64_t* shape_out, int* ndim_out, int verify) {
   const uint8_t* p = (const uint8_t*)buf;
-  if (len < 20) return 0;
+  const uint64_t fixed = 12 + kDtypeField;
+  if (len < fixed) return 0;
   uint32_t magic;
   memcpy(&magic, p, 4);
   if (magic != kMagic) return 0;
-  memcpy(dtype_out, p + 8, 8);
+  memcpy(dtype_out, p + 8, kDtypeField);
   uint32_t nd;
-  memcpy(&nd, p + 16, 4);
-  if (nd > 8 || len < 20 + 8ull * nd) return 0;
-  memcpy(shape_out, p + 20, 8ull * nd);
+  memcpy(&nd, p + 8 + kDtypeField, 4);
+  if (nd > 8 || len < fixed + 8ull * nd) return 0;
+  memcpy(shape_out, p + 12 + kDtypeField, 8ull * nd);
   *ndim_out = (int)nd;
-  uint64_t off = 20 + 8ull * nd;
+  uint64_t off = fixed + 8ull * nd;
   if (verify) {
     uint32_t crc_stored, crc;
     memcpy(&crc_stored, p + 4, 4);

@@ -132,21 +132,41 @@ class ShmRing:
             pass
 
 
+def _npy_fallback(arr: np.ndarray) -> bytes:
+    import io as _io
+    b = _io.BytesIO()
+    np.save(b, arr, allow_pickle=False)
+    return b"NPYF" + b.getvalue()
+
+
 def encode_tensor(arr: np.ndarray) -> bytes:
     """Native codec encode (crc32-protected). Falls back to .npy bytes."""
     lib = _load()
     arr = np.ascontiguousarray(arr)
-    if lib is None:
-        import io as _io
-        b = _io.BytesIO()
-        np.save(b, arr, allow_pickle=False)
-        return b"NPYF" + b.getvalue()
+    dtype_name = str(arr.dtype).encode()
+    # header dtype field is 16 bytes (15 chars + NUL); codec_encode returns
+    # 0 when the name doesn't fit, and exotic dtypes (datetime64[ns], ...)
+    # go through the .npy path instead of being truncated
+    if lib is None or len(dtype_name) > 15:
+        return _npy_fallback(arr)
     shape = (ctypes.c_int64 * max(arr.ndim, 1))(*arr.shape)
     total = int(lib.codec_header_size(arr.ndim)) + arr.nbytes
     out = ctypes.create_string_buffer(total)
     n = lib.codec_encode(arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes,
-                         str(arr.dtype).encode()[:7], shape, arr.ndim, out)
+                         dtype_name, shape, arr.ndim, out)
+    if n == 0:
+        return _npy_fallback(arr)
     return out.raw[:n]
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # ml_dtypes names (bfloat16, float8_*) aren't resolvable via
+        # np.dtype(str) but are plain attributes of the ml_dtypes module
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
 
 
 def decode_tensor(buf: bytes) -> np.ndarray:
@@ -156,7 +176,7 @@ def decode_tensor(buf: bytes) -> np.ndarray:
         return np.load(_io.BytesIO(buf[4:]), allow_pickle=False)
     if lib is None:
         raise RuntimeError("native codec buffer but no native library")
-    dtype = ctypes.create_string_buffer(9)
+    dtype = ctypes.create_string_buffer(17)
     shape = (ctypes.c_int64 * 8)()
     ndim = (ctypes.c_int * 1)()
     off = lib.codec_decode(buf, len(buf), dtype, shape, ndim, 1)
@@ -166,7 +186,7 @@ def decode_tensor(buf: bytes) -> np.ndarray:
         raise ValueError("codec: crc32 mismatch (corrupt tensor payload)")
     nd = ndim[0]
     shp = tuple(shape[i] for i in range(nd))
-    dt = np.dtype(dtype.value.decode())
+    dt = _resolve_dtype(dtype.value.decode())
     return np.frombuffer(buf, dtype=dt, offset=int(off),
                          count=int(np.prod(shp)) if shp else 1
                          ).reshape(shp).copy()

@@ -69,9 +69,23 @@ def shard_optimizer(optimizer, shard_fn=None):
 
 
 def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
-    """≙ paddle.distributed.shard_dataloader: wrap a loader so each batch
-    is shard_tensor'd onto the mesh (batch dim over 'dp'/first axis)."""
+    """≙ paddle.distributed.shard_dataloader: wrap a loader so each batch's
+    dim 0 is sharded over the data-parallel MESH axis. `shard_dims` names
+    the mesh dimension (str name or mesh-dim index, matching the reference
+    API) — defaulting to the axis named 'dp' (or 'data'), else axis 0."""
     mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+
+    names = list(mesh.dim_names)
+    if isinstance(shard_dims, str):
+        mesh_axis = names.index(shard_dims)
+    elif isinstance(shard_dims, int):
+        mesh_axis = shard_dims
+    elif "dp" in names:
+        mesh_axis = names.index("dp")
+    elif "data" in names:
+        mesh_axis = names.index("data")
+    else:
+        mesh_axis = 0
 
     class _Sharded:
         def __iter__(self):
@@ -85,8 +99,7 @@ def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
                     t = it if isinstance(it, Tensor) else \
                         paddle.to_tensor(np.asarray(it))
                     placements = [Replicate() for _ in mesh.dim_names]
-                    dim0 = shard_dims if isinstance(shard_dims, int) else 0
-                    placements[0] = Shard(dim0)
+                    placements[mesh_axis] = Shard(0)
                     out.append(shard_tensor(t, mesh, placements))
                 yield out if len(out) > 1 else out[0]
 

@@ -26,10 +26,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+# version-conditional shard_map kwargs (check_vma vs check_rep) live in
+# collective.py; reuse them so the older-jax fallback actually works here
+from ..collective import _SM_KW, shard_map as _shard_map
 
 from ..mesh import ProcessMesh
 
@@ -122,5 +121,5 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
     out = _shard_map(local_fn, mesh=mesh.jax_mesh,
                      in_specs=(param_specs, x_spec) + extra_specs,
                      out_specs=x_spec,
-                     check_vma=False)(stacked_params, xs, *extra_args)
+                     **_SM_KW)(stacked_params, xs, *extra_args)
     return out.reshape(b, *out.shape[2:])

@@ -28,10 +28,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map  # jax >= 0.8 name
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+# version-conditional shard_map kwargs (check_vma vs check_rep) live in
+# collective.py; reuse them so the older-jax fallback actually works here
+from .collective import _SM_KW, shard_map as _shard_map
 
 from ..core.tensor import Tensor, apply
 from .mesh import ProcessMesh, get_mesh
@@ -124,7 +123,7 @@ def ring_attention_values(q, k, v, mesh: Optional[ProcessMesh] = None,
     spec = P(None, axis, None, None)
     return _shard_map(local_fn, mesh=mesh.jax_mesh,
                       in_specs=(spec, spec, spec), out_specs=spec,
-                      check_vma=False)(q, k, v)
+                      **_SM_KW)(q, k, v)
 
 
 def ulysses_attention_values(q, k, v, mesh: Optional[ProcessMesh] = None,
@@ -167,7 +166,7 @@ def ulysses_attention_values(q, k, v, mesh: Optional[ProcessMesh] = None,
     spec = P(None, axis, None, None)
     return _shard_map(local_fn, mesh=mesh.jax_mesh,
                       in_specs=(spec, spec, spec), out_specs=spec,
-                      check_vma=False)(q, k, v)
+                      **_SM_KW)(q, k, v)
 
 
 def ring_flash_attention(q: Tensor, k: Tensor, v: Tensor,

@@ -5,12 +5,22 @@ GShard/Switch gates) + the `global_scatter`/`global_gather` alltoall
 dispatch ops («paddle/fluid/operators/collective/global_scatter_op*» [U?],
 SURVEY.md §2.3 EP row).
 
-TPU-native design: dispatch/combine are dense one-hot einsums (GShard
-style, MXU-friendly, static shapes — no ragged recompilations); experts
-are ONE stacked parameter (E, ...) sharded over the `ep` mesh axis, and
-the alltoall the reference hand-codes is inserted by XLA from the
-sharding of the dispatched (E, C, d) tensor. Capacity-based top-k routing
-with the standard load-balancing auxiliary loss.
+TPU-native design — two dispatch strategies behind one MoELayer API:
+
+* capacity (dense) path: dispatch/combine are one-hot einsums (GShard
+  style, static shapes); experts are ONE stacked parameter (E, ...)
+  sharded over the `ep` mesh axis, and the alltoall the reference
+  hand-codes is inserted by XLA from the sharding of the dispatched
+  (E, C, d) tensor. O(T·E·C) dispatch memory — fine at small E, used
+  for expert-parallel execution.
+* dropless (ragged, megablox-style) path: tokens sort by expert
+  (O(T·k) memory, no token dropping, no capacity hyperparameter) and
+  the expert FFN runs as grouped matmuls — the Pallas kernel in
+  ops/grouped_matmul.py on TPU (block-padded groups), ragged_dot
+  elsewhere. This is the DeepSeekMoE-scale path (E=64+), where the
+  dense (T, E, C) tensors are catastrophic.
+
+Both use the standard load-balancing auxiliary loss.
 """
 from __future__ import annotations
 
@@ -25,7 +35,8 @@ from ...core.tensor import Tensor, apply
 from ...nn import initializer as I
 from ...nn.layer.layers import Layer
 
-__all__ = ["moe_gating_values", "moe_ffn_values", "MoELayer", "shard_moe"]
+__all__ = ["moe_gating_values", "moe_ffn_values",
+           "moe_ffn_dropless_values", "MoELayer", "shard_moe"]
 
 
 def moe_gating_values(logits, top_k: int, capacity: int):
@@ -55,11 +66,7 @@ def moe_gating_values(logits, top_k: int, capacity: int):
     dispatch = jnp.sum(disp, axis=0)                              # (T, E, C)
     combine = jnp.sum(disp * gate_vals.T[..., None, None], axis=0)
 
-    # load-balance aux (Switch/GShard): E * sum_e f_e * p_e, over 1st choice
-    f = jnp.mean(oh[0], axis=0)            # fraction routed to e (choice 0)
-    p = jnp.mean(probs, axis=0)            # mean router prob
-    aux = e * jnp.sum(f * p)
-    return dispatch, combine, aux
+    return dispatch, combine, _aux_loss(probs, gate_idx)
 
 
 def moe_ffn_values(x2, gate_w, w_gate, w_up, w_down, top_k: int,
@@ -90,6 +97,80 @@ def moe_ffn_values(x2, gate_w, w_gate, w_up, w_down, top_k: int,
     return out.astype(x2.dtype), aux
 
 
+def _aux_loss(probs, gate_idx):
+    """Switch/GShard load-balance loss: E * sum_e f_e * p_e over choice 0."""
+    e = probs.shape[-1]
+    f = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32),
+                 axis=0)
+    p = jnp.mean(probs, axis=0)
+    return e * jnp.sum(f * p)
+
+
+def moe_ffn_dropless_values(x2, gate_w, w_gate, w_up, w_down, top_k: int):
+    """Dropless sort-based MoE SwiGLU FFN (megablox-style).
+
+    x2: (T, H); gate_w: (H, E); w_gate/w_up: (E, H, I); w_down: (E, I, H).
+    Dispatch memory is O(T·k·H): tokens are gathered into expert-sorted
+    order and the expert matmuls run grouped. No capacity, no drops.
+    On TPU, rows are additionally laid out with each expert's group padded
+    to a block_m boundary so the Pallas grouped-matmul kernel applies
+    (bounded O(E·block_m·H) padding cost).
+    """
+    from ...ops import on_tpu
+    from ...ops.grouped_matmul import (DEFAULT_BLOCK, _HAS_PLTPU,
+                                       grouped_matmul_values)
+    t, h = x2.shape
+    e = gate_w.shape[1]
+    i_size = w_gate.shape[2]
+    tk = t * top_k
+
+    logits = x2.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)             # (T, K)
+
+    flat = gate_idx.reshape(-1)                   # slot f=t*K+k -> expert
+    order = jnp.argsort(flat, stable=True)        # (T*K,) expert-sorted
+    tok = order // top_k                          # source token per row
+    counts = jnp.bincount(flat, length=e)         # (E,)
+
+    block_m = DEFAULT_BLOCK
+    block_aligned = (on_tpu() and _HAS_PLTPU and h % block_m == 0
+                     and i_size % block_m == 0)
+    if block_aligned:
+        # pad each expert's group to a block_m multiple so no m-tile of
+        # the Pallas kernel straddles a group boundary
+        es = flat[order]                                       # (T*K,)
+        co = jnp.concatenate([jnp.zeros(1, counts.dtype),
+                              jnp.cumsum(counts)[:-1]])        # excl. offs
+        padded = ((counts + block_m - 1) // block_m) * block_m
+        po = jnp.concatenate([jnp.zeros(1, padded.dtype),
+                              jnp.cumsum(padded)[:-1]])
+        rank = jnp.arange(tk) - co[es]
+        pos = po[es] + rank                                    # padded row
+        m_pad = ((tk + e * block_m) // block_m + 1) * block_m  # static
+        xs = jnp.zeros((m_pad, h), x2.dtype).at[pos].set(x2[tok])
+        gs = padded
+    else:
+        pos = None
+        xs = x2[tok]                                           # (T*K, H)
+        gs = counts
+
+    hg = grouped_matmul_values(xs, w_gate.astype(xs.dtype), gs,
+                               block_aligned)
+    hu = grouped_matmul_values(xs, w_up.astype(xs.dtype), gs,
+                               block_aligned)
+    act = jax.nn.silu(hg.astype(jnp.float32)).astype(xs.dtype) * hu
+    rows = grouped_matmul_values(act, w_down.astype(xs.dtype), gs,
+                                 block_aligned)                # (M, H)
+    if pos is not None:
+        rows = rows[pos]                                       # (T*K, H)
+
+    wv = gate_vals.reshape(-1)[order].astype(jnp.float32)
+    out = jnp.zeros((t, h), jnp.float32).at[tok].add(
+        rows.astype(jnp.float32) * wv[:, None])
+    return out.astype(x2.dtype), _aux_loss(probs, gate_idx)
+
+
 class MoELayer(Layer):
     """Sparse SwiGLU MoE block (+ optional dense shared experts).
     ≙ paddle.incubate MoELayer / Qwen2-MoE & DeepSeekMoE sparse MLP [U?].
@@ -101,7 +182,7 @@ class MoELayer(Layer):
                  num_experts: int, top_k: int = 2,
                  capacity_factor: float = 1.25,
                  shared_intermediate_size: int = 0,
-                 ep_axis: str = "ep", name=None):
+                 ep_axis: str = "ep", dropless: bool = False, name=None):
         super().__init__()
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -109,6 +190,7 @@ class MoELayer(Layer):
         self.top_k = top_k
         self.capacity_factor = capacity_factor
         self.ep_axis = ep_axis
+        self.dropless = dropless
         e, h, i = num_experts, hidden_size, intermediate_size
         self.gate_weight = self.create_parameter(
             (h, e), default_initializer=I.Normal(0.0, 0.02))
@@ -138,11 +220,21 @@ class MoELayer(Layer):
         h = shape[-1]
         mesh = get_mesh()
         top_k, cf, ep = self.top_k, self.capacity_factor, self.ep_axis
+        # the dropless (sorted/ragged) layout does not compose with the
+        # ep-sharded alltoall dispatch — expert parallelism keeps the
+        # static-shape capacity path (reference EP also runs capacity)
+        ep_active = (mesh is not None and ep in mesh.dim_names
+                     and mesh.get_dim_size(ep) > 1)
+        use_dropless = self.dropless and not ep_active
 
         def fn(xv, gw, wg, wu, wd):
             x2 = xv.reshape(-1, h)
-            out, aux = moe_ffn_values(x2, gw, wg, wu, wd, top_k, cf,
-                                      ep, mesh)
+            if use_dropless:
+                out, aux = moe_ffn_dropless_values(x2, gw, wg, wu, wd,
+                                                   top_k)
+            else:
+                out, aux = moe_ffn_values(x2, gw, wg, wu, wd, top_k, cf,
+                                          ep, mesh)
             return out.reshape(xv.shape), aux
 
         out, aux = apply("moe_ffn", fn,

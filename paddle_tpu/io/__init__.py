@@ -3,9 +3,10 @@
 tensor transport, samplers, worker signal handling) [U].
 
 TPU-native design: the loader produces numpy batches on host and transfers
-once per step (device_put of the whole batch); a multiprocess prefetcher
-(fork + pipe of pickled numpy) replaces the reference's shm transport — the
-native-code shm codec is a stage-9 item (see SURVEY.md §7)."""
+once per step (device_put of the whole batch). num_workers>0 uses forked
+workers pushing codec-encoded batches through the native shared-memory ring
+(csrc/native.cc via paddle_tpu._native), with a thread prefetcher fallback
+when no compiler is available."""
 from __future__ import annotations
 
 import itertools
@@ -346,13 +347,19 @@ class _ShmWorkerIterator:
         self._held = {}
         self._n = len(batches)
         self._pids = []
+        self._worker_status = {}
         for w in range(num_workers):
             pid = os.fork()
             if pid == 0:
+                code = 0
                 try:
                     self._worker(name, w, num_workers)
+                except BaseException:
+                    import traceback
+                    traceback.print_exc()
+                    code = 1
                 finally:
-                    os._exit(0)
+                    os._exit(code)
             self._pids.append(pid)
 
     # -- worker side ---------------------------------------------------------
@@ -417,7 +424,8 @@ class _ShmWorkerIterator:
             if raw is None:
                 self._shutdown()
                 raise RuntimeError(
-                    "DataLoader worker timeout/death (shm ring empty)")
+                    "DataLoader worker timeout/death (shm ring empty); "
+                    f"worker exit statuses: {self._worker_status}")
             seq, batch = self._decode(raw)
             self._held[seq] = batch
         out = self._held.pop(self._expected)
@@ -425,12 +433,23 @@ class _ShmWorkerIterator:
         return out
 
     def _shutdown(self):
-        for pid in self._pids:
+        # SIGTERM then a BLOCKING reap: a worker abandoned mid-iteration
+        # (caller broke out of the loop early) may be blocked pushing into
+        # the ring — the signal unblocks it now instead of leaving it (and
+        # a zombie) behind for the full push timeout.
+        import signal
+        pids, self._pids = self._pids, []
+        for pid in pids:
             try:
-                os.waitpid(pid, os.WNOHANG)
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        for pid in pids:
+            try:
+                _, st = os.waitpid(pid, 0)
+                self._worker_status[pid] = st
             except ChildProcessError:
                 pass
-        self._pids = []
         try:
             self.ring.close()
         except Exception:

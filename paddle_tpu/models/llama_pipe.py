@@ -133,8 +133,7 @@ class LlamaForCausalLMPipe(nn.Layer):
                 if mesh is not None and "mp" in mesh.dim_names else 1)
         m = self.num_microbatches
 
-        def fn(ids, cos, sin, *dec):
-            emb = self.embed_tokens.weight._value
+        def fn(ids, cos, sin, emb, *dec):
             x = jnp.take(emb, ids, axis=0)
             cs = cos[:ids.shape[1]]
             sn = sin[:ids.shape[1]]
@@ -186,7 +185,8 @@ class LlamaForCausalLMPipe(nn.Layer):
         args = [a if isinstance(a, Tensor) else paddle.to_tensor(a)
                 for a in [input_ids, self.rope_cos, self.rope_sin]]
         hidden = apply("llama_pipe_stack", fn,
-                       tuple(args) + tuple(self._decoder_params()))
+                       tuple(args) + (self.embed_tokens.weight,)
+                       + tuple(self._decoder_params()))
         hidden = self.norm(hidden)
         logits = self.lm_head(hidden)
         if labels is not None:

@@ -37,6 +37,7 @@ class MoEConfig:
     moe_intermediate_size: int = 1408
     shared_expert_intermediate_size: int = 5632
     capacity_factor: float = 1.25
+    dropless: bool = False   # sort-based ragged dispatch (no token drops)
     router_aux_loss_coef: float = 0.001
     dtype: str = "bfloat16"
 
@@ -84,7 +85,7 @@ class MoEDecoderLayer(nn.Layer):
         self.mlp = MoELayer(
             cfg.hidden_size, cfg.moe_intermediate_size, cfg.num_experts,
             top_k=cfg.num_experts_per_tok,
-            capacity_factor=cfg.capacity_factor,
+            capacity_factor=cfg.capacity_factor, dropless=cfg.dropless,
             shared_intermediate_size=cfg.shared_expert_intermediate_size)
 
     def forward(self, x, cos, sin, attention_mask=None):

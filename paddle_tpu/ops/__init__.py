@@ -10,5 +10,6 @@ def on_tpu() -> bool:
 
 
 from . import flash_attention  # noqa: F401,E402
+from . import grouped_matmul  # noqa: F401,E402
 from . import norm_kernels  # noqa: F401,E402
 from . import rope  # noqa: F401,E402

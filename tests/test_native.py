@@ -25,6 +25,32 @@ class TestCodec:
         b = N.encode_tensor(a)
         np.testing.assert_array_equal(N.decode_tensor(b), a)
 
+    @pytest.mark.parametrize("dtype", ["bfloat16", "complex64",
+                                       "complex128", "float16"])
+    def test_roundtrip_long_dtype_names(self, dtype):
+        # round-1 regression: the v1 header truncated dtype names to 7
+        # chars — 'complex64' silently decoded as complex128, 'bfloat16'
+        # (the default training dtype) failed outright
+        import ml_dtypes
+        dt = np.dtype(getattr(ml_dtypes, dtype, dtype))
+        a = rng.random((4, 3)).astype(dt)
+        b = N.encode_tensor(a)
+        got = N.decode_tensor(b)
+        assert got.dtype == dt
+        np.testing.assert_array_equal(got, a)
+
+    def test_unencodable_dtype_falls_back_to_npy(self):
+        # dtype whose name exceeds the 15-char header field
+        a = np.array([1, 2], dtype="datetime64[100ns]")
+        b = N.encode_tensor(a)
+        assert b[:4] == b"NPYF"
+        np.testing.assert_array_equal(N.decode_tensor(b), a)
+
+    def test_datetime_roundtrip(self):
+        a = np.array(["2024-01-01", "2024-01-02"], dtype="datetime64[ns]")
+        np.testing.assert_array_equal(
+            N.decode_tensor(N.encode_tensor(a)), a)
+
     def test_scalar_and_empty(self):
         for a in (np.float32(3.5), np.zeros((0, 4), np.int32)):
             got = N.decode_tensor(N.encode_tensor(np.asarray(a)))

@@ -118,6 +118,22 @@ class TestLlamaPipe:
             pp_out = model(ids).numpy()
         np.testing.assert_allclose(base, pp_out, rtol=2e-4, atol=2e-4)
 
+    def test_embedding_receives_gradient(self):
+        """Round-1 regression (ADVICE high): embed_tokens was read via
+        closure inside apply(), so vjp silently froze it."""
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models.llama_pipe import (LlamaForCausalLMPipe,
+                                                  synthetic_lm_batch)
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny()
+        model = LlamaForCausalLMPipe(cfg)
+        ids, labels = synthetic_lm_batch(2, 16, cfg.vocab_size)
+        loss, _ = model(ids, labels=labels)
+        loss.backward()
+        g = model.embed_tokens.weight.grad
+        assert g is not None, "embedding got no gradient"
+        assert float(np.abs(g.numpy()).max()) > 0, "embedding grad all-zero"
+
     def test_pp_training_loss_decreases(self):
         """3D mesh (dp x pp x mp): full train step through TrainStep."""
         from paddle_tpu.models.llama import LlamaConfig
