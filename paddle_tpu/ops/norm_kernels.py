@@ -6,12 +6,15 @@ Row-blocked over (rows, hidden): one VMEM pass computes stats + normalized
 output; bwd recomputes x_hat from saved rstd (memory-light) and reduces
 dgamma/dbeta across row blocks via output accumulation.
 
-Mosaic tiling invariant: every BlockSpec here is either the whole array
-dim (weights (h,), small-n row blocks) or a multiple of BLOCK_ROWS=256
-(rstd/mean 1-D blocks) — the `n % br` guard in the *_values entry points
-routes every other shape to the XLA fallback, so no unaligned block can
-reach the compiled path. h=64 whole-dim blocks are exercised natively on
-TPU by the llama e2e path.
+Mosaic tiling: per-row stats (rstd/mean) are stored broadcast across a
+full 128-lane register as (n, LANES) arrays — the same convention as
+flash_attention.py's lse/delta residuals — because Mosaic requires the
+minor block dim to be 128-aligned and XLA tiles 1-D f32 arrays with its
+own T(1024) layout that a (block_rows,) BlockSpec cannot match (this
+exact mismatch failed compilation on v5e at (16384, 1024)). Stats are
+max-reduced back to a column on read in the bwd kernels. The `n % br`
+guard in the *_values entry points routes ragged row counts to the XLA
+fallback.
 """
 from __future__ import annotations
 
@@ -30,6 +33,8 @@ from . import on_tpu
 from ..core.tensor import Tensor, apply
 
 BLOCK_ROWS = 256
+# Stats live lane-broadcast in (n, LANES) arrays; see module docstring.
+LANES = 128
 
 
 def _interpret() -> bool:
@@ -42,7 +47,7 @@ def _rms_fwd_kernel(x_ref, w_ref, o_ref, rstd_ref, *, eps):
     ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     rstd = jax.lax.rsqrt(ms + eps)
     o_ref[:] = (x * rstd * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
-    rstd_ref[:] = rstd[:, 0]
+    rstd_ref[:] = jnp.broadcast_to(rstd, rstd_ref.shape)
 
 
 def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dw_ref, *, eps):
@@ -55,7 +60,7 @@ def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dw_ref, *, eps):
     x = x_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     w = w_ref[:].astype(jnp.float32)
-    rstd = rstd_ref[:][:, None]
+    rstd = jnp.max(rstd_ref[:], axis=-1, keepdims=True)
     xhat = x * rstd
     wg = g * w
     # dx = rstd * (wg - xhat * mean(wg * xhat))
@@ -73,9 +78,9 @@ def _rms_fwd(x2, w, eps, block_rows):
         in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
                   pl.BlockSpec((h,), lambda i: (0,))],
         out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
-                   pl.BlockSpec((block_rows,), lambda i: (i,))],
+                   pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))],
         out_shape=[jax.ShapeDtypeStruct((n, h), x2.dtype),
-                   jax.ShapeDtypeStruct((n,), jnp.float32)],
+                   jax.ShapeDtypeStruct((n, LANES), jnp.float32)],
         interpret=_interpret(),
     )(x2, w)
     return o, rstd
@@ -88,19 +93,22 @@ def _rms(x2, w, eps, block_rows):
 
 def _rms_fwd_rule(x2, w, eps, block_rows):
     o, rstd = _rms_fwd(x2, w, eps, block_rows)
-    return o, (x2, w, rstd)
+    # keep only one lane as the autograd residual (all LANES are identical);
+    # re-broadcast transiently at bwd time
+    return o, (x2, w, rstd[:, :1])
 
 
 def _rms_bwd_rule(eps, block_rows, res, g):
-    x2, w, rstd = res
+    x2, w, rstd1 = res
     n, h = x2.shape
+    rstd = jnp.broadcast_to(rstd1, (n, LANES))
     nb = pl.cdiv(n, block_rows)
     dx, dw_acc = pl.pallas_call(
         functools.partial(_rms_bwd_kernel, eps=eps),
         grid=(nb,),
         in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
                   pl.BlockSpec((h,), lambda i: (0,)),
-                  pl.BlockSpec((block_rows,), lambda i: (i,)),
+                  pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
                   pl.BlockSpec((block_rows, h), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
                    pl.BlockSpec((1, h), lambda i: (0, 0))],
@@ -145,8 +153,8 @@ def _ln_fwd_kernel(x_ref, w_ref, b_ref, o_ref, mean_ref, rstd_ref, *, eps):
     xhat = (x - mu) * rstd
     o_ref[:] = (xhat * w_ref[:].astype(jnp.float32)
                 + b_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
-    mean_ref[:] = mu[:, 0]
-    rstd_ref[:] = rstd[:, 0]
+    mean_ref[:] = jnp.broadcast_to(mu, mean_ref.shape)
+    rstd_ref[:] = jnp.broadcast_to(rstd, rstd_ref.shape)
 
 
 def _ln_bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, g_ref,
@@ -159,8 +167,8 @@ def _ln_bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, g_ref,
     x = x_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     w = w_ref[:].astype(jnp.float32)
-    mu = mean_ref[:][:, None]
-    rstd = rstd_ref[:][:, None]
+    mu = jnp.max(mean_ref[:], axis=-1, keepdims=True)
+    rstd = jnp.max(rstd_ref[:], axis=-1, keepdims=True)
     xhat = (x - mu) * rstd
     wg = g * w
     m1 = jnp.mean(wg, axis=-1, keepdims=True)
@@ -184,11 +192,11 @@ def _ln_fwd(x2, w, b, eps, block_rows):
                   pl.BlockSpec((h,), lambda i: (0,)),
                   pl.BlockSpec((h,), lambda i: (0,))],
         out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
-                   pl.BlockSpec((block_rows,), lambda i: (i,)),
-                   pl.BlockSpec((block_rows,), lambda i: (i,))],
+                   pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
+                   pl.BlockSpec((block_rows, LANES), lambda i: (i, 0))],
         out_shape=[jax.ShapeDtypeStruct((n, h), x2.dtype),
-                   jax.ShapeDtypeStruct((n,), jnp.float32),
-                   jax.ShapeDtypeStruct((n,), jnp.float32)],
+                   jax.ShapeDtypeStruct((n, LANES), jnp.float32),
+                   jax.ShapeDtypeStruct((n, LANES), jnp.float32)],
         interpret=_interpret(),
     )(x2, w, b)
     return o, mean, rstd
@@ -196,20 +204,22 @@ def _ln_fwd(x2, w, b, eps, block_rows):
 
 def _ln_fwd_rule(x2, w, b, eps, block_rows):
     o, mean, rstd = _ln_fwd(x2, w, b, eps, block_rows)
-    return o, (x2, w, mean, rstd)
+    return o, (x2, w, mean[:, :1], rstd[:, :1])
 
 
 def _ln_bwd_rule(eps, block_rows, res, g):
-    x2, w, mean, rstd = res
+    x2, w, mean1, rstd1 = res
     n, h = x2.shape
+    mean = jnp.broadcast_to(mean1, (n, LANES))
+    rstd = jnp.broadcast_to(rstd1, (n, LANES))
     nb = pl.cdiv(n, block_rows)
     dx, dw_p, db_p = pl.pallas_call(
         functools.partial(_ln_bwd_kernel, eps=eps),
         grid=(nb,),
         in_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
                   pl.BlockSpec((h,), lambda i: (0,)),
-                  pl.BlockSpec((block_rows,), lambda i: (i,)),
-                  pl.BlockSpec((block_rows,), lambda i: (i,)),
+                  pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
+                  pl.BlockSpec((block_rows, LANES), lambda i: (i, 0)),
                   pl.BlockSpec((block_rows, h), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((block_rows, h), lambda i: (i, 0)),
                    pl.BlockSpec((1, h), lambda i: (0, 0)),

@@ -1,0 +1,132 @@
+"""Real-TPU Mosaic compile gate for every Pallas kernel (VERDICT r2 item 2).
+
+≙ SURVEY.md §4 two-platform rule: every kernel must not only pass math
+checks in interpret mode but COMPILE for the attached chip. Round 2
+shipped a norm kernel whose BlockSpec Mosaic rejected — interpret-mode CI
+could not see it and the bench went to 0.0. This suite jits and EXECUTES
+each kernel (fwd AND bwd) at the bench shapes so any Mosaic layout error
+fails the suite, not the bench.
+
+Runs only under PDT_TEST_PLATFORM=tpu with a real chip attached (Mosaic
+compilation needs the TPU target); skips cleanly on the CPU CI mesh.
+Driver smoke: `PDT_TEST_PLATFORM=tpu python -m pytest tests/test_tpu_compile.py -q`.
+
+Gate mechanism: jit + EXECUTE + device_get, not AOT .lower().compile() —
+the axon remote-AOT helper is unreliable (HTTP 500 on kernels that run
+fine through the normal execution path, verified live this round), and
+execution exercises exactly the Mosaic compile that the bench path hits.
+device_get (a D2H transfer) is the sync: on the axon platform
+jax.block_until_ready returns immediately for in-flight work, so it
+would let a runtime failure escape the test.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    jax.devices()[0].platform != "tpu",
+    reason="Mosaic compile gate needs the real TPU chip",
+)
+
+# bench.py's Llama config: hidden 1024, 16 q heads / 8 kv heads, d=64,
+# batch 8, seq 2048 -> norm rows 16384 (the exact shape that failed r2)
+BENCH_B, BENCH_S, BENCH_H, BENCH_HK, BENCH_D = 8, 2048, 16, 8, 64
+BENCH_HIDDEN = 1024
+BENCH_ROWS = BENCH_B * BENCH_S
+
+
+def _compile(fn, *args):
+    """jit + run + D2H: any Mosaic rejection (trace-time or chip compile)
+    raises here."""
+    return jax.device_get(jax.jit(fn)(*args))
+
+
+class TestNormKernelsCompile:
+    def test_rms_norm_fwd_bwd_bench_shape(self):
+        from paddle_tpu.ops.norm_kernels import rms_norm_values
+
+        x = jnp.zeros((BENCH_ROWS, BENCH_HIDDEN), jnp.bfloat16)
+        w = jnp.ones((BENCH_HIDDEN,), jnp.bfloat16)
+        _compile(rms_norm_values, x, w)
+
+        def loss(x, w):
+            return rms_norm_values(x, w).astype(jnp.float32).sum()
+
+        _compile(jax.grad(loss, argnums=(0, 1)), x, w)
+
+    def test_layer_norm_fwd_bwd_bench_shape(self):
+        from paddle_tpu.ops.norm_kernels import layer_norm_values
+
+        x = jnp.zeros((BENCH_ROWS, BENCH_HIDDEN), jnp.bfloat16)
+        w = jnp.ones((BENCH_HIDDEN,), jnp.bfloat16)
+        b = jnp.zeros((BENCH_HIDDEN,), jnp.bfloat16)
+        _compile(layer_norm_values, x, w, b)
+
+        def loss(x, w, b):
+            return layer_norm_values(x, w, b).astype(jnp.float32).sum()
+
+        _compile(jax.grad(loss, argnums=(0, 1, 2)), x, w, b)
+
+    def test_rms_norm_runs_and_matches_xla(self):
+        from paddle_tpu.ops.norm_kernels import rms_norm_values
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((512, BENCH_HIDDEN)),
+                        jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal(BENCH_HIDDEN), jnp.bfloat16)
+        out = _compile(rms_norm_values, x, w)
+        xf = x.astype(jnp.float32)
+        ref = (xf * jax.lax.rsqrt(
+            jnp.mean(jnp.square(xf), -1, keepdims=True) + 1e-6)
+            * w.astype(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref), atol=0.05)
+
+
+class TestFlashAttentionCompile:
+    def _qkv(self, sq=BENCH_S, sk=BENCH_S):
+        q = jnp.zeros((BENCH_B, sq, BENCH_H, BENCH_D), jnp.bfloat16)
+        k = jnp.zeros((BENCH_B, sk, BENCH_HK, BENCH_D), jnp.bfloat16)
+        v = jnp.zeros((BENCH_B, sk, BENCH_HK, BENCH_D), jnp.bfloat16)
+        return q, k, v
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_fwd_bwd_gqa_bench_shape(self, causal):
+        from paddle_tpu.ops.flash_attention import flash_attention_values
+
+        q, k, v = self._qkv()
+        _compile(lambda q, k, v: flash_attention_values(
+            q, k, v, causal=causal), q, k, v)
+
+        def loss(q, k, v):
+            return flash_attention_values(
+                q, k, v, causal=causal).astype(jnp.float32).sum()
+
+        _compile(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
+
+class TestRopeCompile:
+    def test_fwd_bwd_bench_shape(self):
+        from paddle_tpu.ops.rope import rope_values
+
+        x = jnp.zeros((BENCH_B, BENCH_S, BENCH_H, BENCH_D), jnp.bfloat16)
+        cos = jnp.zeros((BENCH_S, BENCH_D), jnp.float32)
+        sin = jnp.zeros((BENCH_S, BENCH_D), jnp.float32)
+        _compile(rope_values, x, cos, sin)
+
+        def loss(x):
+            return rope_values(x, cos, sin).astype(jnp.float32).sum()
+
+        _compile(jax.grad(loss), x)
+
+
+class TestGroupedMatmulCompile:
+    def test_gmm_bench_shape(self):
+        from paddle_tpu.ops.grouped_matmul import gmm_pallas
+
+        # MoE-ish: 8 experts, 4096 tokens, 1024 -> 2816
+        lhs = jnp.zeros((4096, BENCH_HIDDEN), jnp.bfloat16)
+        rhs = jnp.zeros((8, BENCH_HIDDEN, 2816), jnp.bfloat16)
+        sizes = jnp.full((8,), 512, jnp.int32)
+        _compile(gmm_pallas, lhs, rhs, sizes)
