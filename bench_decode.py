@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Serving decode benchmark: KV-cache greedy generation tokens/sec.
+
+≙ the reference inference engine's decode throughput axis (SURVEY.md §1
+L10, §7 step 6). Prints ONE JSON line like bench.py (the driver contract
+is bench.py; this is the serving-side companion, run ad hoc and recorded
+in DECODE_BENCH.json).
+
+The whole generation — prefill + lax.scan decode loop — is one compiled
+XLA program (models/generation.py), so the measured number includes no
+per-token dispatch. Sync is by D2H fetch (block_until_ready is unreliable
+on the axon platform — see bench.py).
+"""
+import json
+import os
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+
+def run(on_tpu: bool) -> dict:
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=2048)
+        batch, prompt, new = 8, 512, 256
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, prompt, new = 2, 16, 16
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+    ids = paddle.to_tensor(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, prompt)).astype(np.int32))
+
+    # warmup/compile
+    toks, _ = model.generate(ids, max_new_tokens=new)
+    np.asarray(toks._value)
+
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        toks, _ = model.generate(ids, max_new_tokens=new)
+    np.asarray(toks._value)
+    dt = (time.perf_counter() - t0) / reps
+
+    tps = batch * new / dt
+    return {
+        "metric": "llama_decode_tokens_per_sec" if on_tpu
+        else "llama_decode_tokens_per_sec_cpu_ci",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,   # no reference decode number exists
+        "detail": {
+            "device": str(jax.devices()[0].device_kind),
+            "batch": batch, "prompt_len": prompt, "new_tokens": new,
+            "total_time_s": round(dt, 3),
+            "ms_per_token_step": round(dt / new * 1000, 3),
+        },
+    }
+
+
+def main():
+    sys.path.insert(0, REPO)
+    import importlib
+    bench = importlib.import_module("bench")
+    on_tpu = False
+    error = None
+    if os.environ.get("BENCH_FORCE_CPU"):
+        error = "BENCH_FORCE_CPU set"
+    else:
+        on_tpu = bench.probe_tpu()
+        if not on_tpu:
+            error = "TPU probe failed; CPU fallback"
+    if not on_tpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        result = run(on_tpu)
+    except BaseException:
+        result = {"metric": "llama_decode_tokens_per_sec", "value": 0.0,
+                  "unit": "tokens/s", "vs_baseline": 0.0,
+                  "error": traceback.format_exc(limit=5)[-1200:]}
+    if error:
+        result["error"] = error
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

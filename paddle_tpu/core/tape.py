@@ -222,8 +222,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     if create_graph:
         raise NotImplementedError(
             "create_graph=True (double grad) is not supported by the eager "
-            "tape; use the functional jax path (paddle_tpu.incubate.autograd) "
-            "for higher-order derivatives.")
+            "tape; use paddle_tpu.incubate.autograd (grad/jacobian/hessian/"
+            "jvp/vjp — functional, composable to any order) instead.")
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:

@@ -13,9 +13,20 @@ and per-stage Python scheduling all collapse into this one compiled loop.
 
 Backward is `jax.grad` through the scan: XLA replays the schedule in
 reverse (the ppermute transposes to the opposite rotation), which yields
-GPipe-equivalent ordering; activation memory is bounded by rematerializing
-each tick (`jax.checkpoint` around the stage body) so only the per-tick
-carry survives — the 1F1B memory profile without hand-written scheduling.
+GPipe-equivalent ordering; per-tick rematerialization (`jax.checkpoint`
+around the stage body) bounds residuals to one activation per tick —
+O(B·hidden) total, a GPipe-with-remat profile (NOT true 1F1B's
+S·microbatch bound, and no interleaved virtual stages yet — both remain
+future work; a functional 1F1B needs fwd/bwd tick interleaving that XLA's
+grad-of-scan does not express directly).
+
+Output handling: by default every device returns the (M, mb, ...) buffer
+and the last stage's copy is broadcast with a one-hop `ppermute` fan-out
+(cheaper than the old masked psum: no ring reduction, pure
+collective-permute traffic). Passing `reduce_fn` (e.g. the LM head + loss)
+collapses each microbatch's output to a scalar ON the last stage, so the
+cross-stage broadcast is O(M) scalars and the big buffer never exists —
+use this for training steps.
 """
 from __future__ import annotations
 
@@ -45,7 +56,11 @@ def stack_stage_params(per_stage_params):
 def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
                      num_microbatches: int, axis: str = "pp",
                      remat: bool = True, extra_args: tuple = (),
-                     param_specs=None, x_spec=None):
+                     param_specs=None, x_spec=None,
+                     reduce_fn: Optional[Callable] = None,
+                     reduce_args: tuple = (), reduce_arg_specs=None,
+                     reduce_mean_axes: tuple = (),
+                     reduce_shape: tuple = ()):
     """Run the pipelined forward: y = stage_{S-1}(...stage_0(x)).
 
     stage_fn(params_one_stage, activation, *extra) -> activation; must keep
@@ -59,7 +74,21 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
     'mp' etc.; every mesh axis name is bound inside). x_spec: optional
     PartitionSpec for one microbatch (e.g. P('dp', None, None) to keep the
     batch dp-sharded through the pipeline).
-    Returns y: (B, ...) final-stage output. Differentiable.
+    reduce_fn(y_microbatch, microbatch_index, *reduce_args) -> scalar or
+    small fixed-shape array (e.g. (loss_sum, token_count)): when given,
+    each microbatch's final-stage output reduces immediately (the
+    training-loss fusion) and the function returns the (M, *r) stacked
+    reductions instead of activations — the (M, mb, ...) output buffer
+    and its broadcast disappear, and a `lax.cond` skips the reduction
+    compute on non-final stages (each device branches on its own stage
+    id at runtime). reduce_args ride the shard_map with reduce_arg_specs
+    (default replicated); reduce_mean_axes names mesh axes (e.g. 'dp')
+    the reductions are pmean-averaged over when inputs are sharded there;
+    reduce_shape declares reduce_fn's output shape (() = scalar) — it
+    cannot be probed because reduce_fn may contain collectives only valid
+    inside the shard_map.
+    Returns y: (B, ...) final-stage output, or (M, *reduce_shape) with
+    reduce_fn. Differentiable.
     """
     s_count = mesh.get_dim_size(axis)
     m = num_microbatches
@@ -73,7 +102,11 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
     if remat:
         body = jax.checkpoint(stage_fn)
 
-    def local_fn(params_local, xs_local, *extra):
+    n_extra = len(extra_args)
+
+    def local_fn(params_local, xs_local, *rest):
+        extra = rest[:n_extra]
+        r_args = rest[n_extra:]
         # params_local leaves: (1, ...) — this device's stage; squeeze
         params1 = jax.tree_util.tree_map(lambda l: l[0], params_local)
         s = jax.lax.axis_index(axis)
@@ -91,21 +124,47 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
             idx = t - (s_count - 1)
             idx_c = jnp.clip(idx, 0, m - 1)
             valid = (idx >= 0) & (idx < m)
-            cur = jax.lax.dynamic_index_in_dim(buf, idx_c, 0,
-                                               keepdims=False)
-            upd = jnp.where(valid, y, cur)
-            buf = jax.lax.dynamic_update_index_in_dim(buf, upd, idx_c, 0)
+            if reduce_fn is not None:
+                # only the final stage's reduction matters; lax.cond lets
+                # every other device skip the (lm-head-sized) compute —
+                # the predicate is per-device so each takes its own branch
+                r = jax.lax.cond(
+                    (s == s_count - 1) & valid,
+                    lambda: reduce_fn(y, idx_c, *r_args)
+                    .astype(buf.dtype).reshape(buf.shape[1:]),
+                    lambda: buf[idx_c])
+                buf = buf.at[idx_c].set(r)
+            else:
+                cur = jax.lax.dynamic_index_in_dim(buf, idx_c, 0,
+                                                   keepdims=False)
+                upd = jnp.where(valid, y, cur)
+                buf = jax.lax.dynamic_update_index_in_dim(buf, upd,
+                                                          idx_c, 0)
             state = jax.lax.ppermute(y, axis, perm)
             return (state, buf), None
 
         state0 = jnp.zeros_like(xs_local[0])
-        buf0 = jnp.zeros_like(xs_local)
+        buf0 = (jnp.zeros((m,) + tuple(reduce_shape), jnp.float32)
+                if reduce_fn is not None else jnp.zeros_like(xs_local))
         (_, buf), _ = jax.lax.scan(tick, (state0, buf0),
                                    jnp.arange(ticks))
-        # every device filled a buffer; only the last stage's is the real
-        # output — replicate it with a masked psum
-        sel = jnp.where(s == s_count - 1, 1.0, 0.0)
-        return jax.lax.psum(buf * sel.astype(buf.dtype), axis)
+        # only the last stage holds the real output: recursive-doubling
+        # broadcast from stage S-1 — ceil(log2 S) ppermute hops, each
+        # device receives the buffer exactly once ((S-1)·|buf| total
+        # traffic, no floating-point reduction; the old masked psum was a
+        # full ring allreduce at ~2x the traffic plus adds)
+        have = {s_count - 1}
+        while len(have) < s_count:
+            srcs = sorted(have)
+            dsts = [d for d in range(s_count) if d not in have]
+            pairs = list(zip(srcs, dsts))
+            recv = jax.lax.ppermute(buf, axis, pairs)
+            keep = jnp.isin(s, jnp.asarray(srcs))
+            buf = jnp.where(keep, buf, recv)
+            have |= {d for _, d in pairs}
+        for ax in reduce_mean_axes:
+            buf = jax.lax.pmean(buf, ax)
+        return buf
 
     if param_specs is None:
         param_specs = jax.tree_util.tree_map(
@@ -118,8 +177,17 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
         x_spec = P(None, *tuple(x_spec))
     extra_specs = tuple(P(*([None] * jnp.asarray(e).ndim))
                         for e in extra_args)
+    if reduce_arg_specs is None:
+        reduce_arg_specs = tuple(P(*([None] * jnp.asarray(a).ndim))
+                                 for a in reduce_args)
+    out_spec = (P(*([None] * (1 + len(reduce_shape))))
+                if reduce_fn is not None else x_spec)
     out = _shard_map(local_fn, mesh=mesh.jax_mesh,
-                     in_specs=(param_specs, x_spec) + extra_specs,
-                     out_specs=x_spec,
-                     **_SM_KW)(stacked_params, xs, *extra_args)
+                     in_specs=(param_specs, x_spec) + extra_specs
+                     + tuple(reduce_arg_specs),
+                     out_specs=out_spec,
+                     **_SM_KW)(stacked_params, xs, *extra_args,
+                               *reduce_args)
+    if reduce_fn is not None:
+        return out                      # (M,) per-microbatch scalars
     return out.reshape(b, *out.shape[2:])

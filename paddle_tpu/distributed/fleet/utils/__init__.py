@@ -1,0 +1,152 @@
+"""fleet.utils — recompute (activation checkpointing) and helpers.
+
+≙ reference `paddle.distributed.fleet.utils.recompute`
+(«python/paddle/distributed/fleet/utils/» [U]) and the recompute
+meta-optimizer / pass (SURVEY.md §2.4). TPU-native design: the wrapped
+function becomes ONE tape op whose values-level computation is
+`jax.checkpoint`-wrapped — under `TrainStep` jit tracing XLA rematerializes
+the block's activations in the backward pass instead of saving them,
+trading FLOPs for HBM (the Llama-8B north-star memory budget depends on
+this; SURVEY.md §6).
+
+RNG: the recomputed function runs twice (fwd + recompute-in-bwd); dropout
+must see the SAME key both times (≙ reference preserve_rng_state). The key
+is snapshotted once per call and pinned inside the checkpointed region.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+from ....core.tensor import Tensor, apply
+from ....tensor.random import default_generator
+
+# string policy names -> jax.checkpoint policies (≙ the reference's
+# recompute granularity knobs: full / selective)
+_POLICIES = {
+    None: None,                       # save nothing: full recompute
+    "full": None,
+    "dots": "checkpoint_dots",
+    "dots_saveable": "checkpoint_dots",
+    "dots_with_no_batch_dims": "checkpoint_dots_with_no_batch_dims",
+    "nothing_saveable": "nothing_saveable",
+    "everything_saveable": "everything_saveable",
+}
+
+
+def _resolve_policy(name):
+    if name is None or name == "full":
+        return None
+    key = _POLICIES.get(name, name)
+    pol = getattr(jax.checkpoint_policies, key, None)
+    if pol is None:
+        raise ValueError(
+            f"unknown recompute policy {name!r}; known: "
+            f"{sorted(k for k in _POLICIES if isinstance(k, str))}")
+    return pol
+
+
+def _collect_params(function) -> list:
+    """Parameters the recomputed function depends on: a Layer's own, a bound
+    method's owner's, and any Layer/Parameter closed over by a plain
+    function — all must become differentiable tape inputs, or their grads
+    would silently vanish."""
+    from ....core.tensor import Parameter
+    from ....nn.layer.layers import Layer
+
+    found = []
+    if isinstance(function, Layer):
+        found += list(function.parameters())
+    owner = getattr(function, "__self__", None)
+    if isinstance(owner, Layer):
+        found += list(owner.parameters())
+    for cell in getattr(function, "__closure__", None) or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:  # pragma: no cover - empty cell
+            continue
+        if isinstance(v, Layer):
+            found += list(v.parameters())
+        elif isinstance(v, Parameter):
+            found.append(v)
+    out, ids = [], set()
+    for p in found:
+        if id(p) not in ids:
+            ids.add(id(p))
+            out.append(p)
+    return out
+
+
+def recompute(function: Callable, *args,
+              preserve_rng_state: bool = True,
+              use_reentrant: bool = True,
+              policy=None,
+              **kwargs) -> Any:
+    """Run `function(*args, **kwargs)` without saving its internal
+    activations; recompute them during backward.
+
+    `function` may be an `nn.Layer` (its parameters are captured as
+    differentiable inputs automatically) or any callable over Tensors.
+    Non-Tensor args/kwargs pass through statically. `policy` selects what
+    XLA may save anyway ('full' = nothing, 'dots' = matmul outputs with
+    batch dims, ...).
+    """
+    from ....nn.layer.layers import Layer
+
+    params = _collect_params(function)
+    tensor_idx = [i for i, a in enumerate(args)
+                  if isinstance(a, Tensor)]
+    tensor_args = [args[i] for i in tensor_idx]
+    inputs = params + tensor_args
+    n_p = len(params)
+
+    # pin one key for both executions (fwd trace and bwd rematerialization);
+    # the global generator still advances exactly once per recompute() call
+    key_snap = default_generator.next_key() if preserve_rng_state else None
+
+    # out_struct records the user function's real output structure (filled
+    # during any trace of values_fn, including the abstract probe below)
+    out_struct: dict = {}
+
+    def values_fn(*vals):
+        pvals, avals = vals[:n_p], vals[n_p:]
+        old_p = [p._value for p in params]
+        old_key = default_generator._key
+        try:
+            for p, v in zip(params, pvals):
+                p._value = v
+            if key_snap is not None:
+                default_generator._key = key_snap
+            new_args = list(args)
+            for i, v in zip(tensor_idx, avals):
+                new_args[i] = Tensor(v)
+            out = function(*new_args, **kwargs)
+            if isinstance(out, (tuple, list)):
+                out_struct["type"] = type(out)
+                out_vals = tuple(t._value if isinstance(t, Tensor) else t
+                                 for t in out)
+                # a 1-tuple must flow through the tape as a single output
+                # (the tape's vjp routing treats n_outputs==1 as a leaf)
+                return out_vals if len(out_vals) > 1 else out_vals[0]
+            out_struct["type"] = None
+            return out._value if isinstance(out, Tensor) else out
+        finally:
+            for p, v in zip(params, old_p):
+                p._value = v
+            default_generator._key = old_key
+
+    in_vals = [t._value for t in inputs]
+    probe = jax.eval_shape(values_fn, *in_vals)
+    multi = isinstance(probe, tuple)
+    ckpt = jax.checkpoint(values_fn, policy=_resolve_policy(policy))
+    outs = apply("recompute", ckpt, inputs, multi_output=multi)
+    kind = out_struct["type"]
+    if kind is None:
+        return outs
+    if not multi:  # user returned a 1-element tuple/list
+        return kind([outs])
+    return outs if kind is tuple else kind(outs)
+
+
+__all__ = ["recompute"]

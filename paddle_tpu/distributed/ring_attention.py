@@ -39,26 +39,35 @@ NEG_INF = -1e30
 
 
 def _chunk_attn_with_lse(q, k, v, scale, mask):
-    """One (q-chunk, kv-chunk) attention step.
+    """One (q-chunk, kv-chunk) attention step, GQA-native.
 
-    q: (B, Sq, H, D); k, v: (B, Sk, H, D); mask: (Sq, Sk) bool or None.
-    Returns (o, lse) with lse = log sum exp of the scaled logits, -inf for
-    fully-masked rows (their o rows are 0).
+    q: (B, Sq, H, D); k, v: (B, Sk, HK, D) with H a multiple of HK — the
+    kv-head group dim is folded into the einsum, so GQA never expands KV
+    in memory (the ring rotates the small (B, c, HK, D) buffers).
+    mask: (Sq, Sk) bool or None. Returns (o (B,Sq,H,D), lse (B,Sq,H))
+    with lse = -inf for fully-masked rows (their o rows are 0).
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    b, sq, h, d = q.shape
+    hk = k.shape[2]
+    g = h // hk
+    qg = q.astype(jnp.float32).reshape(b, sq, hk, g, d)
+    s = jnp.einsum("bqegd,bked->begqk", qg,
+                   k.astype(jnp.float32)) * scale        # (B,HK,G,Sq,Sk)
     if mask is not None:
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    m = jnp.max(s, axis=-1, keepdims=True)               # (B,H,Sq,1)
+        s = jnp.where(mask[None, None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)               # (B,HK,G,Sq,1)
     masked_row = m <= NEG_INF * 0.5
     p = jnp.where(s > NEG_INF * 0.5,
                   jnp.exp(s - jnp.where(masked_row, 0.0, m)), 0.0)
     l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
-    o = o / jnp.maximum(jnp.swapaxes(l, 1, 2), 1e-30)    # (B,Sq,H,D)
+    o = jnp.einsum("begqk,bked->bqegd", p,
+                   v.astype(jnp.float32))                # (B,Sq,HK,G,D)
+    l_q = jnp.transpose(l[..., 0], (0, 3, 1, 2))         # (B,Sq,HK,G)
+    o = o / jnp.maximum(l_q[..., None], 1e-30)
     lse = jnp.where(masked_row, NEG_INF,
-                    m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]  # (B,H,Sq)
-    return o, jnp.swapaxes(lse, 1, 2)                    # lse (B,Sq,H)
+                    m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]
+    lse = jnp.transpose(lse, (0, 3, 1, 2))               # (B,Sq,HK,G)
+    return o.reshape(b, sq, h, d), lse.reshape(b, sq, h)
 
 
 def _merge(o_a, lse_a, o_b, lse_b):
@@ -86,10 +95,12 @@ def ring_attention_values(q, k, v, mesh: Optional[ProcessMesh] = None,
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     hk = k.shape[2]
-    if h != hk:
-        # ring rotates KV; keep chunks head-complete by expanding GQA here
-        k = jnp.repeat(k, h // hk, axis=2)
-        v = jnp.repeat(v, h // hk, axis=2)
+    if h % hk:
+        raise ValueError(f"ring attention: q heads {h} not a multiple of "
+                         f"kv heads {hk}")
+    # GQA stays compressed: the ring rotates (B, c, HK, D) KV chunks and
+    # the chunk kernel folds the group dim into its einsum — no
+    # jnp.repeat HBM expansion (H/HK x memory and ICI traffic saved)
     c = s_global // n  # local chunk length
 
     def local_fn(ql, kl, vl):

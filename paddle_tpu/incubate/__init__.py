@@ -1,5 +1,6 @@
 """paddle_tpu.incubate — experimental APIs.
 ≙ reference «python/paddle/incubate/» (fused-op python APIs, MoE layers,
 experimental dist features — SURVEY.md §2.2)."""
+from . import autograd  # noqa: F401
 from . import moe  # noqa: F401
 from . import nn  # noqa: F401

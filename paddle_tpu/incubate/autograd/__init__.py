@@ -1,0 +1,122 @@
+"""paddle_tpu.incubate.autograd — functional higher-order autodiff.
+
+≙ reference `paddle.incubate.autograd` (jacobian / hessian / jvp / vjp over
+the prim/decomposition machinery, «paddle/fluid/primitive/» + Python API
+[U], SURVEY.md §2.1 prim row). TPU-native design: there is no prim op set
+to decompose into — every eager op here is already a JAX-traceable pure
+function, so higher-order derivatives come straight from composing
+`jax.jacfwd` / `jax.jacrev` / `jax.jvp` / `jax.vjp` over the values-level
+computation. This is the functional escape hatch the eager tape's
+first-order `backward()` points to for `create_graph`-style use.
+
+`func` takes Tensors and returns a Tensor (or tuple); extra non-Tensor
+args pass through statically.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "jacobian", "hessian", "grad"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _values(ts):
+    return [t._value if isinstance(t, Tensor) else jnp.asarray(t)
+            for t in ts]
+
+
+def _wrap(vals):
+    return jax.tree_util.tree_map(Tensor, vals)
+
+
+def _values_fn(func: Callable, n_inputs: int):
+    """Lift a Tensor->Tensor function to a values->values function."""
+    def fn(*vals):
+        out = func(*[Tensor(v) for v in vals[:n_inputs]])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+    return fn
+
+
+def vjp(func: Callable, xs, v=None):
+    """(outputs, vjp-result): reverse-mode products. ≙ incubate.autograd.vjp.
+
+    v defaults to ones like the output (scalar-loss convention)."""
+    xs = _as_list(xs)
+    fn = _values_fn(func, len(xs))
+    out_vals, vjp_fn = jax.vjp(fn, *_values(xs))
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out_vals)
+    else:
+        v_list = _as_list(v)
+        cot = tuple(_values(v_list)) if isinstance(out_vals, tuple) \
+            else _values(v_list)[0]
+    grads = vjp_fn(cot)
+    outs = _wrap(out_vals)
+    gs = _wrap(list(grads))
+    return outs, gs if len(gs) > 1 else gs[0]
+
+
+def jvp(func: Callable, xs, v=None):
+    """(outputs, jvp-result): forward-mode products. ≙ incubate.autograd.jvp."""
+    xs = _as_list(xs)
+    fn = _values_fn(func, len(xs))
+    primals = _values(xs)
+    if v is None:
+        tangents = [jnp.ones_like(p) for p in primals]
+    else:
+        tangents = _values(_as_list(v))
+    out_vals, tang_out = jax.jvp(fn, tuple(primals), tuple(tangents))
+    return _wrap(out_vals), _wrap(tang_out)
+
+
+def jacobian(func: Callable, xs, create_graph: bool = False):
+    """Full Jacobian d func / d xs (reverse mode). Single input -> one
+    Tensor; multiple inputs -> tuple. Differentiable (compose freely)."""
+    xs = _as_list(xs)
+    fn = _values_fn(func, len(xs))
+    jac = jax.jacrev(fn, argnums=tuple(range(len(xs))))(*_values(xs))
+    jac = _wrap(jac)
+    return jac if len(xs) > 1 else jac[0]
+
+
+def hessian(func: Callable, xs, create_graph: bool = False):
+    """Hessian of a scalar-output func (fwd-over-rev)."""
+    xs = _as_list(xs)
+    fn = _values_fn(func, len(xs))
+
+    def scalar_fn(*vals):
+        out = fn(*vals)
+        out0 = out[0] if isinstance(out, tuple) else out
+        if out0.ndim:
+            raise ValueError("hessian expects a scalar-output function")
+        return out0
+    h = jax.hessian(scalar_fn, argnums=tuple(range(len(xs))))(*_values(xs))
+    h = _wrap(h)
+    return h if len(xs) > 1 else h[0][0]
+
+
+def grad(func: Callable, argnums: Union[int, Sequence[int]] = 0):
+    """jax.grad over a Tensor function — returns a Tensor function.
+    Composable: grad(grad(f)) gives second derivatives (the create_graph
+    path the eager tape does not provide)."""
+    def grad_fn(*xs):
+        n = len(xs)
+        fn = _values_fn(func, n)
+
+        def scalar_fn(*vals):
+            out = fn(*vals)
+            return out[0] if isinstance(out, tuple) else out
+        g = jax.grad(scalar_fn, argnums=argnums)(*_values(xs))
+        return _wrap(g)
+    return grad_fn

@@ -14,11 +14,15 @@ TPU-native design — two dispatch strategies behind one MoELayer API:
   (E, C, d) tensor. O(T·E·C) dispatch memory — fine at small E, used
   for expert-parallel execution.
 * dropless (ragged, megablox-style) path: tokens sort by expert
-  (O(T·k) memory, no token dropping, no capacity hyperparameter) and
-  the expert FFN runs as grouped matmuls — the Pallas kernel in
-  ops/grouped_matmul.py on TPU (block-padded groups), ragged_dot
-  elsewhere. This is the DeepSeekMoE-scale path (E=64+), where the
-  dense (T, E, C) tensors are catastrophic.
+  (O(T·k) memory, no capacity hyperparameter) and the expert FFN runs
+  as grouped matmuls — the Pallas kernel in ops/grouped_matmul.py on
+  TPU (block-padded groups), ragged_dot elsewhere. This is the
+  DeepSeekMoE-scale path (E=64+), where the dense (T, E, C) tensors
+  are catastrophic. Composes with expert parallelism via a shard_map
+  all_to_all dispatch with static per-pair buffers
+  (moe_ffn_dropless_ep_values) — truly dropless on one shard; under EP
+  a generous per-pair budget bounds the exchange (see
+  ep_pair_capacity_factor).
 
 Both use the standard load-balancing auxiliary loss.
 """
@@ -106,32 +110,23 @@ def _aux_loss(probs, gate_idx):
     return e * jnp.sum(f * p)
 
 
-def moe_ffn_dropless_values(x2, gate_w, w_gate, w_up, w_down, top_k: int):
-    """Dropless sort-based MoE SwiGLU FFN (megablox-style).
+def _expert_ffn_rows(xs_in, eid, w_gate, w_up, w_down, e: int):
+    """Grouped SwiGLU FFN over rows with per-row expert ids.
 
-    x2: (T, H); gate_w: (H, E); w_gate/w_up: (E, H, I); w_down: (E, I, H).
-    Dispatch memory is O(T·k·H): tokens are gathered into expert-sorted
-    order and the expert matmuls run grouped. No capacity, no drops.
-    On TPU, rows are additionally laid out with each expert's group padded
-    to a block_m boundary so the Pallas grouped-matmul kernel applies
-    (bounded O(E·block_m·H) padding cost).
+    xs_in: (N, H); eid: (N,) int32 in [0, e) — rows that should not
+    contribute must be ZERO rows (SwiGLU with no bias maps 0 -> 0).
+    Returns (N, H) outputs in the caller's row order. Sorts by expert,
+    runs the grouped matmul (Pallas kernel when block-aligned), unsorts.
     """
     from ...ops import on_tpu
     from ...ops.grouped_matmul import (DEFAULT_BLOCK, _HAS_PLTPU,
                                        grouped_matmul_values)
-    t, h = x2.shape
-    e = gate_w.shape[1]
+    n, h = xs_in.shape
     i_size = w_gate.shape[2]
-    tk = t * top_k
 
-    logits = x2.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # (T, E)
-    probs = jax.nn.softmax(logits, axis=-1)
-    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)             # (T, K)
-
-    flat = gate_idx.reshape(-1)                   # slot f=t*K+k -> expert
-    order = jnp.argsort(flat, stable=True)        # (T*K,) expert-sorted
-    tok = order // top_k                          # source token per row
-    counts = jnp.bincount(flat, length=e)         # (E,)
+    order = jnp.argsort(eid, stable=True)         # expert-sorted row index
+    es = eid[order]                               # (N,) sorted expert ids
+    counts = jnp.bincount(eid, length=e)          # (E,)
 
     block_m = DEFAULT_BLOCK
     block_aligned = (on_tpu() and _HAS_PLTPU and h % block_m == 0
@@ -139,20 +134,19 @@ def moe_ffn_dropless_values(x2, gate_w, w_gate, w_up, w_down, top_k: int):
     if block_aligned:
         # pad each expert's group to a block_m multiple so no m-tile of
         # the Pallas kernel straddles a group boundary
-        es = flat[order]                                       # (T*K,)
         co = jnp.concatenate([jnp.zeros(1, counts.dtype),
                               jnp.cumsum(counts)[:-1]])        # excl. offs
         padded = ((counts + block_m - 1) // block_m) * block_m
         po = jnp.concatenate([jnp.zeros(1, padded.dtype),
                               jnp.cumsum(padded)[:-1]])
-        rank = jnp.arange(tk) - co[es]
+        rank = jnp.arange(n) - co[es]
         pos = po[es] + rank                                    # padded row
-        m_pad = ((tk + e * block_m) // block_m + 1) * block_m  # static
-        xs = jnp.zeros((m_pad, h), x2.dtype).at[pos].set(x2[tok])
+        m_pad = ((n + e * block_m) // block_m + 1) * block_m   # static
+        xs = jnp.zeros((m_pad, h), xs_in.dtype).at[pos].set(xs_in[order])
         gs = padded
     else:
         pos = None
-        xs = x2[tok]                                           # (T*K, H)
+        xs = xs_in[order]
         gs = counts
 
     hg = grouped_matmul_values(xs, w_gate.astype(xs.dtype), gs,
@@ -163,12 +157,99 @@ def moe_ffn_dropless_values(x2, gate_w, w_gate, w_up, w_down, top_k: int):
     rows = grouped_matmul_values(act, w_down.astype(xs.dtype), gs,
                                  block_aligned)                # (M, H)
     if pos is not None:
-        rows = rows[pos]                                       # (T*K, H)
+        rows = rows[pos]                                       # (N, H)
+    # unsort back to the caller's order
+    return jnp.zeros_like(rows).at[order].set(rows)
 
-    wv = gate_vals.reshape(-1)[order].astype(jnp.float32)
+
+def moe_ffn_dropless_values(x2, gate_w, w_gate, w_up, w_down, top_k: int):
+    """Dropless sort-based MoE SwiGLU FFN (megablox-style).
+
+    x2: (T, H); gate_w: (H, E); w_gate/w_up: (E, H, I); w_down: (E, I, H).
+    Dispatch memory is O(T·k·H): tokens are gathered into expert-sorted
+    order and the expert matmuls run grouped. No capacity, no drops.
+    """
+    t, h = x2.shape
+    e = gate_w.shape[1]
+    logits = x2.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)             # (T, K)
+
+    flat = gate_idx.reshape(-1)                   # slot f=t*K+k -> expert
+    tok = jnp.arange(t * top_k) // top_k          # source token per slot
+    rows = _expert_ffn_rows(x2[tok], flat, w_gate, w_up, w_down, e)
+    wv = gate_vals.reshape(-1).astype(jnp.float32)
     out = jnp.zeros((t, h), jnp.float32).at[tok].add(
         rows.astype(jnp.float32) * wv[:, None])
     return out.astype(x2.dtype), _aux_loss(probs, gate_idx)
+
+
+def moe_ffn_dropless_ep_values(x2, gate_w, w_gate_l, w_up_l, w_down_l,
+                               top_k: int, ep_size: int, axis_name: str,
+                               token_axes, pair_capacity: int):
+    """Per-shard body of the dropless × expert-parallel path. Runs INSIDE
+    shard_map: x2 is this program's (T_local, H) token shard; w_*_l are
+    the E/ep experts this shard owns.
+
+    ≙ the reference's `global_scatter`/`global_gather` ragged alltoall
+    dispatch (SURVEY.md §2.3 EP row), made static-shape: each (src, dst)
+    shard pair exchanges a fixed `pair_capacity`-row buffer via
+    `lax.all_to_all` over the `ep` ICI axis; tokens beyond a pair's
+    budget are dropped (generous default ≈ 2x the uniform-routing load —
+    tune with MoELayer.ep_pair_capacity_factor; the single-shard dropless
+    path drops nothing). Expert compute is the same grouped-matmul FFN;
+    a reverse all_to_all routes rows home.
+    """
+    t_l, h = x2.shape
+    e = gate_w.shape[1]
+    e_l = e // ep_size
+    cap = pair_capacity
+    n = t_l * top_k
+
+    logits = x2.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = jax.lax.top_k(probs, top_k)             # (T_l, K)
+
+    flat = gate_idx.reshape(-1)                   # (N,) global expert id
+    tok = jnp.arange(n) // top_k
+    dst = flat // e_l                             # target ep shard
+    # rank of each slot within its destination's buffer (priority = slot
+    # order, i.e. token-major / choice-minor)
+    oh = jax.nn.one_hot(dst, ep_size, dtype=jnp.int32)
+    rank = (jnp.cumsum(oh, axis=0) - oh)[jnp.arange(n), dst]
+    keep = rank < cap
+    idx = jnp.where(keep, dst * cap + rank, ep_size * cap)  # overflow slot
+
+    send_x = jnp.zeros((ep_size * cap + 1, h), x2.dtype) \
+        .at[idx].set(jnp.where(keep[:, None], x2[tok], 0))[:-1]
+    send_e = jnp.zeros((ep_size * cap + 1,), jnp.int32) \
+        .at[idx].set(flat % e_l)[:-1]
+
+    recv_x = jax.lax.all_to_all(send_x, axis_name, 0, 0, tiled=True)
+    recv_e = jax.lax.all_to_all(send_e, axis_name, 0, 0, tiled=True)
+
+    rows = _expert_ffn_rows(recv_x, jnp.clip(recv_e, 0, e_l - 1),
+                            w_gate_l, w_up_l, w_down_l, e_l)
+
+    back = jax.lax.all_to_all(rows.astype(x2.dtype), axis_name, 0, 0,
+                              tiled=True)         # (ep*cap, H)
+    slot_rows = jnp.where(keep[:, None],
+                          back[jnp.minimum(idx, ep_size * cap - 1)], 0)
+    wv = gate_vals.reshape(-1).astype(jnp.float32)
+    out = jnp.zeros((t_l, h), jnp.float32).at[tok].add(
+        slot_rows.astype(jnp.float32) * wv[:, None])
+    # aux loss: pmean the FACTORS (routed fraction f, mean prob p) across
+    # token shards before multiplying, so the scalar equals the
+    # single-shard global aux exactly (mean of per-shard products would
+    # be a biased estimator) and is replicated (out_spec P())
+    f = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32),
+                 axis=0)
+    p = jnp.mean(probs, axis=0)
+    for ax in token_axes:
+        f = jax.lax.pmean(f, ax)
+        p = jax.lax.pmean(p, ax)
+    aux = e * jnp.sum(f * p)
+    return out.astype(x2.dtype), aux
 
 
 class MoELayer(Layer):
@@ -182,7 +263,8 @@ class MoELayer(Layer):
                  num_experts: int, top_k: int = 2,
                  capacity_factor: float = 1.25,
                  shared_intermediate_size: int = 0,
-                 ep_axis: str = "ep", dropless: bool = False, name=None):
+                 ep_axis: str = "ep", dropless: bool = False,
+                 ep_pair_capacity_factor: float = 2.0, name=None):
         super().__init__()
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -191,6 +273,7 @@ class MoELayer(Layer):
         self.capacity_factor = capacity_factor
         self.ep_axis = ep_axis
         self.dropless = dropless
+        self.ep_pair_capacity_factor = ep_pair_capacity_factor
         e, h, i = num_experts, hidden_size, intermediate_size
         self.gate_weight = self.create_parameter(
             (h, e), default_initializer=I.Normal(0.0, 0.02))
@@ -218,23 +301,56 @@ class MoELayer(Layer):
         from ...distributed.mesh import get_mesh
         shape = x.shape
         h = shape[-1]
+        e = self.num_experts
         mesh = get_mesh()
         top_k, cf, ep = self.top_k, self.capacity_factor, self.ep_axis
-        # the dropless (sorted/ragged) layout does not compose with the
-        # ep-sharded alltoall dispatch — expert parallelism keeps the
-        # static-shape capacity path (reference EP also runs capacity)
         ep_active = (mesh is not None and ep in mesh.dim_names
                      and mesh.get_dim_size(ep) > 1)
-        use_dropless = self.dropless and not ep_active
+        pcf = self.ep_pair_capacity_factor
 
         def fn(xv, gw, wg, wu, wd):
             x2 = xv.reshape(-1, h)
-            if use_dropless:
+            t = x2.shape[0]
+            if self.dropless and ep_active:
+                # dropless × EP: shard_map ragged-alltoall dispatch
+                # (static per-pair buffers), ≙ global_scatter/gather
+                ep_size = mesh.get_dim_size(ep)
+                tok_axes = tuple(
+                    a for a in ("dp", ep)
+                    if a in mesh.dim_names and mesh.get_dim_size(a) > 1)
+                n_shards = int(np.prod(
+                    [mesh.get_dim_size(a) for a in tok_axes]))
+                if t % n_shards == 0 and e % ep_size == 0:
+                    try:
+                        from jax import shard_map as _shard_map
+                    except ImportError:  # pragma: no cover
+                        from jax.experimental.shard_map import \
+                            shard_map as _shard_map
+                    from jax.sharding import PartitionSpec as P
+                    t_l = t // n_shards
+                    cap = max(1, min(
+                        int(math.ceil(top_k * t_l / ep_size * pcf)),
+                        t_l * top_k))
+
+                    def body(x_l, gw_, wg_l, wu_l, wd_l):
+                        return moe_ffn_dropless_ep_values(
+                            x_l, gw_, wg_l, wu_l, wd_l, top_k, ep_size,
+                            ep, list(tok_axes), cap)
+                    mapped = _shard_map(
+                        body, mesh=mesh.jax_mesh,
+                        in_specs=(P(tok_axes, None), P(None, None),
+                                  P(ep, None, None), P(ep, None, None),
+                                  P(ep, None, None)),
+                        out_specs=(P(tok_axes, None), P()))
+                    out, aux = mapped(x2, gw, wg, wu, wd)
+                    return out.reshape(xv.shape), aux
+                # fall through to capacity path on indivisible shapes
+            elif self.dropless:
                 out, aux = moe_ffn_dropless_values(x2, gw, wg, wu, wd,
                                                    top_k)
-            else:
-                out, aux = moe_ffn_values(x2, gw, wg, wu, wd, top_k, cf,
-                                          ep, mesh)
+                return out.reshape(xv.shape), aux
+            out, aux = moe_ffn_values(x2, gw, wg, wu, wd, top_k, cf,
+                                      ep, mesh)
             return out.reshape(xv.shape), aux
 
         out, aux = apply("moe_ffn", fn,
@@ -258,6 +374,12 @@ def shard_moe(layer, mesh, ep_axis: str = "ep"):
             for pname in ("w_gate", "w_up", "w_down"):
                 p = getattr(sub, pname)
                 if p._value.shape[0] % mesh.get_dim_size(ep_axis):
+                    import warnings
+                    warnings.warn(
+                        f"shard_moe: {pname} has {p._value.shape[0]} "
+                        f"experts, not divisible by ep="
+                        f"{mesh.get_dim_size(ep_axis)}; leaving it "
+                        "replicated")
                     continue
                 placements = [Replicate() for _ in mesh.dim_names]
                 placements[mesh.dim_names.index(ep_axis)] = Shard(0)

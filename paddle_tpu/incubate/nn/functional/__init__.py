@@ -6,8 +6,70 @@ from ....ops.norm_kernels import rms_norm as fused_rms_norm  # noqa: F401
 from ....ops.norm_kernels import layer_norm as fused_layer_norm  # noqa: F401
 
 
-def fused_multi_head_attention(x, qkv_weight, linear_weight, *args, **kw):
-    raise NotImplementedError(
-        "fused_multi_head_attention: compose q/k/v projections with "
-        "paddle_tpu.nn.functional.scaled_dot_product_attention — XLA fuses "
-        "the projections; the attention core is the Pallas flash kernel.")
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """≙ paddle.incubate.nn.functional.fused_multi_head_attention [U]:
+    (pre-)LN -> fused QKV projection -> attention -> out projection ->
+    dropout -> residual -> (post-)LN, in one call. On TPU the fusion is
+    XLA's job — this composes the same ops so the compiler fuses them;
+    the attention core routes through scaled_dot_product_attention
+    (Pallas flash kernel when shapes allow).
+
+    qkv_weight: (3, num_heads, head_dim, embed_dim) paddle layout, or
+    (embed_dim, 3 * embed_dim) with transpose_qkv_wb=True.
+    """
+    import paddle_tpu as paddle
+    from .... import nn
+    from ....nn import functional as F
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention cache_kv: use the model-level KV "
+            "cache (LlamaAttention past_key_value) for decoding")
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, x.shape[-1:], weight=pre_ln_scale,
+                         bias=pre_ln_bias, epsilon=pre_ln_epsilon)
+    b, s = x.shape[0], x.shape[1]
+    e = x.shape[-1]
+    if transpose_qkv_wb:
+        if num_heads <= 0:
+            raise ValueError("num_heads required with transpose_qkv_wb")
+        h, hd = num_heads, e // num_heads
+        qkv = paddle.matmul(x, qkv_weight)          # (B, S, 3E)
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias
+        qkv = qkv.reshape([b, s, 3, h, hd])
+    else:
+        h, hd = qkv_weight.shape[1], qkv_weight.shape[2]
+        w = qkv_weight.reshape([3 * h * hd, e])
+        qkv = paddle.matmul(x, w, transpose_y=True)  # (B, S, 3*H*hd)
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias.reshape([-1])
+        qkv = qkv.reshape([b, s, 3, h, hd])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    out = F.scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask,
+        dropout_p=attn_dropout_rate if training else 0.0,
+        training=training)
+    out = out.reshape([b, s, h * hd])
+    out = paddle.matmul(out, linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    if dropout_rate and training:
+        out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], weight=ln_scale,
+                           bias=ln_bias, epsilon=ln_epsilon)
+    return out

@@ -154,15 +154,23 @@ class TrainStep:
     accumulators and master weights are inputs AND outputs of the compiled
     program, donated to keep updates in-place in HBM. The RNG key is threaded
     so dropout differs per step (≙ the reference's RNG state tracker).
+
+    `accumulate_steps=k` (≙ fleet gradient-merge meta-optimizer /
+    `pipeline_configs['accumulate_steps']`, SURVEY.md §2.4) splits the batch
+    into k micro-batches inside the ONE compiled program: each micro-loss is
+    scaled by 1/k, backward accumulates into the grads, the optimizer steps
+    once. Loss returned is the mean micro-loss. Leading dim of every input
+    must be divisible by k.
     """
 
     def __init__(self, model, optimizer=None, loss_fn=None, scaler=None,
-                 donate=True):
+                 donate=True, accumulate_steps=1):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.scaler = scaler
         self.donate = donate
+        self.accumulate_steps = int(accumulate_steps)
         self._params = [p for p in model.parameters()]
         self._buffers = list(model.buffers())
         self._jitted = None
@@ -197,18 +205,54 @@ class TrainStep:
                     opt_get_lr = opt.get_lr
                     opt.get_lr = lambda: lr
                 args = jax.tree_util.tree_map(Tensor, arg_vals)
-                if loss_fn is not None:
-                    loss = loss_fn(model, *args)
-                else:
-                    loss = model(*args)
-                aux = None
-                if isinstance(loss, (tuple, list)):
-                    loss, aux = loss[0], loss[1:]
-                if scaler is not None and scaler._enable:
-                    scaled = scaler.scale(loss)
+                k = self.accumulate_steps
+
+                def run_micro(margs):
+                    out = (loss_fn(model, *margs) if loss_fn is not None
+                           else model(*margs))
+                    a = None
+                    if isinstance(out, (tuple, list)):
+                        out, a = out[0], out[1:]
+                    scaled = out / k if k > 1 else out
+                    if scaler is not None and scaler._enable:
+                        scaled = scaler.scale(scaled)
                     scaled.backward()
+                    return out, a
+
+                if k > 1:
+                    def slice_micro(t, j):
+                        b = t.shape[0]
+                        if b % k:
+                            raise ValueError(
+                                f"accumulate_steps={k} does not divide "
+                                f"batch dim {b}")
+                        mb = b // k
+                        return t[j * mb:(j + 1) * mb]
+                    micro_losses = []
+                    micro_aux = []
+                    for j in range(k):
+                        margs = jax.tree_util.tree_map(
+                            lambda t: slice_micro(t, j), args,
+                            is_leaf=lambda x: isinstance(x, Tensor))
+                        mloss, maux = run_micro(margs)
+                        micro_losses.append(mloss._value)
+                        micro_aux.append(maux)
+                    loss = Tensor(
+                        jnp.mean(jnp.stack(micro_losses)),
+                        stop_gradient=True)
+                    # re-assemble per-example aux (logits etc.) across the
+                    # micro-batches so callers see the FULL batch, not the
+                    # last micro-batch mislabeled as the whole step
+                    aux = None
+                    if micro_aux[0] is not None:
+                        aux = jax.tree_util.tree_map(
+                            lambda *xs: Tensor(jnp.concatenate(
+                                [x._value if isinstance(x, Tensor)
+                                 else x for x in xs], axis=0)),
+                            *micro_aux,
+                            is_leaf=lambda x: isinstance(x, Tensor))
                 else:
-                    loss.backward()
+                    loss, aux = run_micro(args)
                 if opt is not None:
                     opt.step()
                     opt.get_lr = opt_get_lr
@@ -296,41 +340,11 @@ class TrainStep:
 
     def _warmup(self, *args):
         """Create optimizer state eagerly (zeros) so the jitted signature is
-        stable, then build the compiled function."""
-        opt = self.optimizer
-        if opt is not None:
-            for p in self._params:
-                if p.stop_gradient:
-                    continue
-                # instantiate the same accumulators the optimizer would
-                import jax.numpy as jnp_
-                cls = type(opt).__name__
-                if cls in ("Adam", "AdamW", "Lamb"):
-                    opt._acc("moment1", p, dtype=jnp_.float32)
-                    opt._acc("moment2", p, dtype=jnp_.float32)
-                    if getattr(opt, "_amsgrad", False):
-                        opt._acc("moment2_max", p, dtype=jnp_.float32)
-                elif cls == "Momentum":
-                    opt._acc("velocity", p,
-                             dtype=jnp_.float32 if opt._use_master(p)
-                             else p._value.dtype)
-                elif cls == "Adagrad":
-                    opt._acc("moment", p,
-                             init=jnp_.full(p._value.shape, opt._init_acc,
-                                            jnp_.float32))
-                elif cls == "Adamax":
-                    opt._acc("moment", p, dtype=jnp_.float32)
-                    opt._acc("inf_norm", p, dtype=jnp_.float32)
-                elif cls == "RMSProp":
-                    opt._acc("mean_square", p, dtype=jnp_.float32)
-                    opt._acc("momentum", p, dtype=jnp_.float32)
-                    if opt._centered:
-                        opt._acc("mean_grad", p, dtype=jnp_.float32)
-                elif cls == "Adadelta":
-                    opt._acc("avg_squared_grad", p, dtype=jnp_.float32)
-                    opt._acc("avg_squared_update", p, dtype=jnp_.float32)
-                if opt._use_master(p):
-                    opt._master(p)
+        stable, then build the compiled function. State creation is
+        optimizer-owned (`Optimizer.ensure_state`) — a new optimizer
+        subclass only overrides `_create_state` and compiled mode works."""
+        if self.optimizer is not None:
+            self.optimizer.ensure_state()
         self._jitted = self._make_pure()
 
 

@@ -1,0 +1,183 @@
+"""Autoregressive generation — the serving path's model-side half.
+
+≙ reference L10 inference engine's generation loop + PaddleNLP
+`GenerationMixin` (SURVEY.md §1 L10, §7 step 6): greedy search and
+sampling (temperature / top-k / top-p) over a static-shape KV cache.
+
+TPU-first design: the ENTIRE generation — prefill + `lax.scan` over decode
+steps — is ONE compiled XLA program (compiled once per
+(batch, prompt_len, max_new_tokens) signature and cached on the model).
+The reference drives its decode loop from C++ with per-step kernel
+launches («fused_multi_transformer» [U]); under XLA the loop body is a
+traced region, so there is no per-token dispatch at all. The KV cache is
+donated through the scan carry and updated in place in HBM.
+
+The model must implement `forward(input_ids, past_key_values=...,
+position_offset=..., use_cache=True)` returning (logits, caches) — see
+LlamaForCausalLM.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.autograd import no_grad
+from paddle_tpu.tensor.random import default_generator
+
+NEG_INF = -1e30
+
+
+def _sample_token(logits, key, strategy, temperature, top_k, top_p):
+    """logits: (B, V) f32 -> (tokens (B,), log-prob of chosen (B,))."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    if strategy == "greedy_search":
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return tok, jnp.take_along_axis(logp, tok[:, None], -1)[:, 0]
+    # sampling
+    if temperature != 1.0:
+        logits = logits / temperature
+    if top_k and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with cumulative prob >= top_p (always
+        # keep the most likely token)
+        keep_sorted = cum - probs < top_p
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1)
+        logits = jnp.where(logits < cutoff[:, None], NEG_INF, logits)
+    tok = jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    return tok, jnp.take_along_axis(logp, tok[:, None], -1)[:, 0]
+
+
+class GenerationMixin:
+    """Mixin over cache-capable causal LMs; adds `generate()`.
+
+    ≙ PaddleNLP `GenerationMixin.generate` surface (greedy_search /
+    sampling strategies; returns (ids, scores) like the reference)."""
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 decode_strategy: str = "greedy_search",
+                 temperature: float = 1.0, top_k: int = 0,
+                 top_p: float = 1.0, eos_token_id: int | None = None,
+                 max_cache_len: int | None = None, use_cache: bool = True):
+        if decode_strategy not in ("greedy_search", "sampling"):
+            raise ValueError(
+                f"decode_strategy {decode_strategy!r}: only greedy_search "
+                "and sampling are supported (beam_search: planned)")
+        cfg = self.config
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(jnp.asarray(input_ids, jnp.int32))
+        b, prompt_len = ids.shape
+        n_new = int(max_new_tokens)
+        cache_len = int(max_cache_len or min(cfg.max_position_embeddings,
+                                             prompt_len + n_new))
+        if prompt_len + n_new > cache_len:
+            raise ValueError(
+                f"prompt {prompt_len} + max_new_tokens {n_new} exceeds "
+                f"cache length {cache_len}")
+
+        params = list(self.parameters())
+        buffers = list(self.buffers())
+        key = default_generator.next_key()
+
+        sig = (b, prompt_len, n_new, cache_len, decode_strategy,
+               float(temperature), int(top_k), float(top_p), eos_token_id)
+        cache = getattr(self, "_generate_cache", None)
+        if cache is None or cache[0] != sig:
+            jitted = self._build_generate(sig)
+            self._generate_cache = (sig, jitted)
+        else:
+            jitted = cache[1]
+
+        toks, scores = jitted([p._value for p in params],
+                              [bu._value for bu in buffers],
+                              ids._value.astype(jnp.int32), key)
+        return Tensor(toks), Tensor(scores)
+
+    def _build_generate(self, sig):
+        (b, prompt_len, n_new, cache_len, strategy, temperature, top_k,
+         top_p, eos_token_id) = sig
+        cfg = self.config
+        params = list(self.parameters())
+        buffers = list(self.buffers())
+        n_layers = cfg.num_hidden_layers
+        hk = cfg.num_key_value_heads
+        hd = cfg.head_dim
+
+        def run(pv, bv, ids_v, key):
+            old_p = [p._value for p in params]
+            old_b = [bu._value for bu in buffers]
+            try:
+                for p, v in zip(params, pv):
+                    p._value = v
+                for bu, v in zip(buffers, bv):
+                    bu._value = v
+                kv_dtype = pv[0].dtype
+                with no_grad():
+                    caches = [
+                        (jnp.zeros((b, cache_len, hk, hd), kv_dtype),
+                         jnp.zeros((b, cache_len, hk, hd), kv_dtype))
+                        for _ in range(n_layers)]
+                    # ---- prefill: one causal pass over the prompt -------
+                    logits, caches_t = self.forward(
+                        Tensor(ids_v),
+                        past_key_values=[(Tensor(k), Tensor(v))
+                                         for k, v in caches],
+                        position_offset=0, use_cache=True)
+                    caches_v = tuple(
+                        (k._value, v._value) for k, v in caches_t)
+                    key0, key_rest = jax.random.split(key)
+                    tok0, lp0 = _sample_token(
+                        logits._value[:, -1], key0, strategy, temperature,
+                        top_k, top_p)
+                    fin0 = (tok0 == eos_token_id) if eos_token_id is not None \
+                        else jnp.zeros((b,), bool)
+
+                    # ---- decode: lax.scan, one token per step -----------
+                    def body(carry, _):
+                        caches_v, tok, pos, fin, k = carry
+                        k, sub = jax.random.split(k)
+                        pkv = [(Tensor(kc), Tensor(vc))
+                               for kc, vc in caches_v]
+                        step_logits, new_caches = self.forward(
+                            Tensor(tok[:, None]),
+                            past_key_values=pkv,
+                            position_offset=Tensor(pos), use_cache=True)
+                        nxt, lp = _sample_token(
+                            step_logits._value[:, 0], sub, strategy,
+                            temperature, top_k, top_p)
+                        if eos_token_id is not None:
+                            nxt = jnp.where(fin, eos_token_id, nxt)
+                            lp = jnp.where(fin, 0.0, lp)
+                            new_fin = fin | (nxt == eos_token_id)
+                        else:
+                            new_fin = fin
+                        new_caches_v = tuple(
+                            (kc._value, vc._value) for kc, vc in new_caches)
+                        return ((new_caches_v, nxt, pos + 1, new_fin, k),
+                                (nxt, lp))
+
+                    if n_new > 1:
+                        carry0 = (caches_v, tok0,
+                                  jnp.int32(prompt_len), fin0, key_rest)
+                        _, (toks, lps) = jax.lax.scan(
+                            body, carry0, None, length=n_new - 1)
+                        toks = jnp.concatenate(
+                            [tok0[:, None], toks.T], axis=1)
+                        lps = jnp.concatenate([lp0[:, None], lps.T], axis=1)
+                    else:
+                        toks, lps = tok0[:, None], lp0[:, None]
+                    return toks, lps
+            finally:
+                for p, v in zip(params, old_p):
+                    p._value = v
+                for bu, v in zip(buffers, old_b):
+                    bu._value = v
+
+        return jax.jit(run)

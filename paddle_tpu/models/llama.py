@@ -20,6 +20,7 @@ import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.nn import functional as F
 from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.models.generation import GenerationMixin
 
 
 @dataclass
@@ -35,6 +36,15 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
+    # activation checkpointing (≙ PaddleNLP recipe `recompute` toggle):
+    # rematerialize each decoder layer in backward instead of saving
+    # activations. policy: 'full' | 'dots' (save matmul outputs)
+    recompute: bool = False
+    recompute_policy: str = "full"
+    # context parallelism over the mesh's `sep` axis (≙ PaddleNLP
+    # RingFlashAttention / sep degree, SURVEY.md §2.3 CP row):
+    # None | 'ring' | 'ulysses'
+    sep_strategy: str | None = None
 
     @staticmethod
     def llama3_8b():
@@ -79,13 +89,32 @@ def precompute_rope(head_dim: int, max_len: int, theta: float):
 def apply_rope(x: Tensor, cos: Tensor, sin: Tensor, position_offset=0):
     """x: (B, S, H, D) — Pallas fused rope kernel (custom VJP = inverse
     rotation). ≙ fused_rotary_position_embedding
-    «paddle/phi/kernels/fusion/» [U]."""
+    «paddle/phi/kernels/fusion/» [U]. `position_offset` may be a traced
+    scalar (decode-time position): that routes to an XLA dynamic-slice
+    path, since a Pallas grid cannot depend on a traced offset."""
     from paddle_tpu.core.tensor import apply as _apply
     from paddle_tpu.ops.rope import rope_values
 
+    dynamic = not isinstance(position_offset, int)
+    off = (position_offset._value
+           if isinstance(position_offset, Tensor) else position_offset)
+
     def fn(v, c, s):
-        return rope_values(v, c, s, position_offset)
+        return rope_values(v, c, s, off, use_pallas=not dynamic)
     return _apply("rope", fn, (x, cos, sin))
+
+
+def _update_kv_cache(cache: Tensor, new: Tensor, offset) -> Tensor:
+    """Write `new` (B, S, HK, D) into the static cache (B, S_max, HK, D)
+    at sequence position `offset` (python int or traced scalar)."""
+    from paddle_tpu.core.tensor import apply as _apply
+    import jax
+    off = offset._value if isinstance(offset, Tensor) else offset
+
+    def fn(c, n):
+        return jax.lax.dynamic_update_slice_in_dim(
+            c, n.astype(c.dtype), off, axis=1)
+    return _apply("kv_cache_update", fn, (cache, new))
 
 
 class LlamaAttention(nn.Layer):
@@ -96,18 +125,70 @@ class LlamaAttention(nn.Layer):
         self.num_heads = cfg.num_attention_heads
         self.num_kv_heads = cfg.num_key_value_heads
         self.head_dim = hd
+        self.sep_strategy = getattr(cfg, "sep_strategy", None)
         self.q_proj = nn.Linear(h, self.num_heads * hd, bias_attr=False)
         self.k_proj = nn.Linear(h, self.num_kv_heads * hd, bias_attr=False)
         self.v_proj = nn.Linear(h, self.num_kv_heads * hd, bias_attr=False)
         self.o_proj = nn.Linear(self.num_heads * hd, h, bias_attr=False)
 
-    def forward(self, x, cos, sin, attention_mask=None):
+    def forward(self, x, cos, sin, attention_mask=None,
+                past_key_value=None, position_offset=0, use_cache=False):
+        """`past_key_value`: (k_cache, v_cache) of static shape
+        (B, S_max, HK, D); the new k/v are written at `position_offset`
+        (≙ the reference decode path «masked_multihead_attention» /
+        «fused_multi_transformer» KV-cache convention, SURVEY.md §2.1
+        fused row). Returns out, or (out, (k_cache, v_cache)) when
+        use_cache."""
         b, s = x.shape[0], x.shape[1]
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q = apply_rope(q, cos, sin, position_offset)
+        k = apply_rope(k, cos, sin, position_offset)
+        if past_key_value is not None:
+            k_cache, v_cache = past_key_value
+            k_cache = _update_kv_cache(k_cache, k, position_offset)
+            v_cache = _update_kv_cache(v_cache, v, position_offset)
+            cur_len = position_offset + s
+            if s == 1:
+                # decode: one new token attends every cached position < len;
+                # attention_mask ((B, S_cache) bool) excludes e.g. padding
+                out = F.masked_multihead_attention(
+                    q, k_cache, v_cache, seq_len=cur_len,
+                    attn_mask=attention_mask)
+            else:
+                # (chunked) prefill: end-aligned causal over the filled
+                # prefix — q row i attends keys <= i + offset (the flash
+                # kernel's native decode convention)
+                if not isinstance(position_offset, int):
+                    raise ValueError(
+                        "prefill (seq>1) needs a static position_offset")
+                mask = None
+                if attention_mask is not None:
+                    # (B, cur_len) key-validity mask -> (B,1,S,cur_len)
+                    am = attention_mask
+                    if not isinstance(am, Tensor):
+                        am = paddle.to_tensor(am)
+                    mask = am[:, :cur_len].astype("bool") \
+                        .unsqueeze(1).unsqueeze(1)
+                out = F.scaled_dot_product_attention(
+                    q, k_cache[:, :cur_len], v_cache[:, :cur_len],
+                    attn_mask=mask, is_causal=True)
+            out = self.o_proj(out.reshape([b, s, -1]))
+            if use_cache:
+                return out, (k_cache, v_cache)
+            return out
+        if self.sep_strategy is not None:
+            from paddle_tpu.distributed.mesh import get_mesh
+            mesh = get_mesh()
+            if (mesh is not None and "sep" in mesh.dim_names
+                    and mesh.get_dim_size("sep") > 1):
+                from paddle_tpu.distributed import ring_attention as ra
+                attn_fn = (ra.ulysses_flash_attention
+                           if self.sep_strategy == "ulysses"
+                           else ra.ring_flash_attention)
+                out = attn_fn(q, k, v, causal=True)
+                return self.o_proj(out.reshape([b, s, -1]))
         out = F.scaled_dot_product_attention(q, k, v,
                                              attn_mask=attention_mask,
                                              is_causal=True)
@@ -137,10 +218,20 @@ class LlamaDecoderLayer(nn.Layer):
                                                    cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x, cos, sin, attention_mask=None):
-        x = x + self.self_attn(self.input_layernorm(x), cos, sin,
-                               attention_mask)
+    def forward(self, x, cos, sin, attention_mask=None,
+                past_key_value=None, position_offset=0, use_cache=False):
+        attn = self.self_attn(self.input_layernorm(x), cos, sin,
+                              attention_mask,
+                              past_key_value=past_key_value,
+                              position_offset=position_offset,
+                              use_cache=use_cache)
+        new_kv = None
+        if use_cache and past_key_value is not None:
+            attn, new_kv = attn
+        x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x))
+        if use_cache and past_key_value is not None:
+            return x, new_kv
         return x
 
 
@@ -158,14 +249,36 @@ class LlamaModel(nn.Layer):
         self.register_buffer("rope_cos", cos, persistable=False)
         self.register_buffer("rope_sin", sin, persistable=False)
 
-    def forward(self, input_ids, attention_mask=None):
+    def forward(self, input_ids, attention_mask=None,
+                past_key_values=None, position_offset=0, use_cache=False):
         x = self.embed_tokens(input_ids)
-        for layer in self.layers:
-            x = layer(x, self.rope_cos, self.rope_sin, attention_mask)
+        if past_key_values is not None:
+            new_caches = []
+            for layer, kv in zip(self.layers, past_key_values):
+                out = layer(x, self.rope_cos, self.rope_sin, attention_mask,
+                            past_key_value=kv,
+                            position_offset=position_offset,
+                            use_cache=use_cache)
+                if use_cache:
+                    x, new_kv = out
+                    new_caches.append(new_kv)
+                else:
+                    x = out
+            x = self.norm(x)
+            return (x, new_caches) if use_cache else x
+        if self.config.recompute and self.training:
+            from paddle_tpu.distributed.fleet.utils import recompute
+            for layer in self.layers:
+                x = recompute(layer, x, self.rope_cos, self.rope_sin,
+                              attention_mask,
+                              policy=self.config.recompute_policy)
+        else:
+            for layer in self.layers:
+                x = layer(x, self.rope_cos, self.rope_sin, attention_mask)
         return self.norm(x)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, cfg: LlamaConfig | None = None):
         super().__init__()
         cfg = cfg or LlamaConfig.llama3_8b()
@@ -177,20 +290,32 @@ class LlamaForCausalLM(nn.Layer):
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                      bias_attr=False)
 
-    def forward(self, input_ids, labels=None, attention_mask=None):
-        hidden = self.model(input_ids, attention_mask)
+    def _logits(self, hidden):
         if self.lm_head is not None:
-            logits = self.lm_head(hidden)
+            return self.lm_head(hidden)
+        return paddle.matmul(hidden, self.model.embed_tokens.weight,
+                             transpose_y=True)
+
+    def forward(self, input_ids, labels=None, attention_mask=None,
+                past_key_values=None, position_offset=0, use_cache=False):
+        out = self.model(input_ids, attention_mask,
+                         past_key_values=past_key_values,
+                         position_offset=position_offset,
+                         use_cache=use_cache)
+        caches = None
+        if use_cache and past_key_values is not None:
+            hidden, caches = out
         else:
-            logits = paddle.matmul(hidden,
-                                   self.model.embed_tokens.weight,
-                                   transpose_y=True)
+            hidden = out
+        logits = self._logits(hidden)
         if labels is not None:
             loss = F.cross_entropy(
                 logits.reshape([-1, self.config.vocab_size])
                 .astype("float32"),
                 labels.reshape([-1]), ignore_index=-100)
             return loss, logits
+        if caches is not None:
+            return logits, caches
         return logits
 
 

@@ -132,8 +132,20 @@ class LlamaForCausalLMPipe(nn.Layer):
         mp_n = (mesh.get_dim_size("mp")
                 if mesh is not None and "mp" in mesh.dim_names else 1)
         m = self.num_microbatches
+        # training with pp: fuse norm+head+CE into the pipeline's last
+        # stage (reduce_fn) — the (M, mb, S, H) output buffer and its
+        # cross-stage broadcast collapse to (M,) scalars; logits are never
+        # materialized (returned as None)
+        fused = use_pp and labels is not None
+        if labels is not None and not isinstance(labels, Tensor):
+            labels = paddle.to_tensor(labels)
 
-        def fn(ids, cos, sin, emb, *dec):
+        def fn(ids, cos, sin, emb, *rest):
+            if fused:
+                norm_w, head_w, lab = rest[0], rest[1], rest[2]
+                dec = rest[3:]
+            else:
+                dec = rest
             x = jnp.take(emb, ids, axis=0)
             cs = cos[:ids.shape[1]]
             sn = sin[:ids.shape[1]]
@@ -158,7 +170,8 @@ class LlamaForCausalLMPipe(nn.Layer):
                     "wup": P("pp", None, None, mp),
                     "wdown": P("pp", None, mp, None),
                 }
-                dp = "dp" if "dp" in mesh.dim_names else None
+                dp = ("dp" if "dp" in mesh.dim_names
+                      and mesh.get_dim_size("dp") > 1 else None)
 
                 def stage_fn(sp, act, cs_, sn_):
                     for li in range(L // s_count):
@@ -169,6 +182,71 @@ class LlamaForCausalLMPipe(nn.Layer):
                             cfg.num_key_value_heads // mp_n,
                             "mp" if mp_n > 1 else None)
                     return act
+
+                if fused:
+                    b = ids.shape[0]
+                    lab_r = lab.reshape(m, b // m, lab.shape[1])
+                    v_glob = cfg.vocab_size
+
+                    def reduce_fn(y, idx, nw, hw, lr):
+                        # per-microbatch (loss_sum, valid_count): the
+                        # caller computes the GLOBAL token mean, so
+                        # ignore_index imbalance across microbatches / dp
+                        # shards cannot skew the weighting. The lm head is
+                        # mp-sharded (hw: (H, V/mp) local shard); the
+                        # logsumexp and the picked logit are assembled
+                        # with pmax/psum over 'mp'.
+                        from paddle_tpu.ops.norm_kernels import \
+                            rms_norm_values
+                        yn = rms_norm_values(y, nw, cfg.rms_norm_eps)
+                        lg = (yn @ hw.astype(yn.dtype)).astype(
+                            jnp.float32)            # (mb, S, V_local)
+                        lg = lg.reshape(-1, lg.shape[-1])
+                        lmb = jax.lax.dynamic_index_in_dim(
+                            lr, idx, 0, keepdims=False).reshape(-1)
+                        valid = lmb != -100
+                        v_loc = lg.shape[-1]
+                        # max-shift is gradient-neutral; stop_gradient
+                        # keeps pmax (no differentiation rule) out of the
+                        # autodiff graph without changing the lse grad
+                        m_loc = jax.lax.stop_gradient(
+                            jnp.max(lg, axis=-1))
+                        if mp_n > 1:
+                            m_glob = jax.lax.pmax(m_loc, "mp")
+                        else:
+                            m_glob = m_loc
+                        z = jnp.sum(jnp.exp(lg - m_glob[:, None]), -1)
+                        if mp_n > 1:
+                            z = jax.lax.psum(z, "mp")
+                            off = jax.lax.axis_index("mp") * v_loc
+                        else:
+                            off = 0
+                        lse = m_glob + jnp.log(z)
+                        li = jnp.maximum(lmb, 0) - off
+                        in_rng = (li >= 0) & (li < v_loc)
+                        picked = jnp.take_along_axis(
+                            lg, jnp.clip(li, 0, v_loc - 1)[:, None],
+                            -1)[:, 0] * in_rng
+                        if mp_n > 1:
+                            picked = jax.lax.psum(picked, "mp")
+                        per_tok = jnp.where(valid, lse - picked, 0.0)
+                        return jnp.stack([jnp.sum(per_tok),
+                                          valid.sum().astype(jnp.float32)])
+
+                    stats = pipeline_forward(
+                        stage_fn, staged, x, mesh, m, axis="pp",
+                        extra_args=(cs, sn), param_specs=specs,
+                        x_spec=P(dp, None, None),
+                        reduce_fn=reduce_fn,
+                        reduce_args=(norm_w, head_w, lab_r),
+                        reduce_arg_specs=(P(None), P(None, mp),
+                                          P(None, dp, None)),
+                        reduce_mean_axes=("dp",) if dp else (),
+                        reduce_shape=(2,))
+                    # (M, 2) per-microbatch (sum, count) — dp-pmean'd,
+                    # which preserves the sum/count ratio
+                    return jnp.sum(stats[:, 0]) / jnp.maximum(
+                        jnp.sum(stats[:, 1]), 1.0)
 
                 x = pipeline_forward(
                     stage_fn, staged, x, mesh, m, axis="pp",
@@ -184,14 +262,19 @@ class LlamaForCausalLMPipe(nn.Layer):
 
         args = [a if isinstance(a, Tensor) else paddle.to_tensor(a)
                 for a in [input_ids, self.rope_cos, self.rope_sin]]
+        if fused:
+            loss = apply("llama_pipe_fused", fn,
+                         tuple(args) + (self.embed_tokens.weight,
+                                        self.norm.weight,
+                                        self.lm_head.weight, labels)
+                         + tuple(self._decoder_params()))
+            return loss, None
         hidden = apply("llama_pipe_stack", fn,
                        tuple(args) + (self.embed_tokens.weight,)
                        + tuple(self._decoder_params()))
         hidden = self.norm(hidden)
         logits = self.lm_head(hidden)
         if labels is not None:
-            labels = labels if isinstance(labels, Tensor) \
-                else paddle.to_tensor(labels)
             loss = F.cross_entropy(
                 logits.reshape([-1, cfg.vocab_size]).astype("float32"),
                 labels.reshape([-1]), ignore_index=-100)

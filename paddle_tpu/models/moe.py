@@ -51,6 +51,16 @@ class MoEConfig:
                          shared_expert_intermediate_size=20480)
 
     @staticmethod
+    def small():
+        """~8x160M single-host training shape."""
+        return MoEConfig(vocab_size=32000, hidden_size=768,
+                         num_hidden_layers=8, num_attention_heads=12,
+                         num_key_value_heads=4,
+                         max_position_embeddings=2048, num_experts=8,
+                         num_experts_per_tok=2, moe_intermediate_size=512,
+                         shared_expert_intermediate_size=1024)
+
+    @staticmethod
     def tiny():
         return MoEConfig(vocab_size=512, hidden_size=64,
                          num_hidden_layers=2, num_attention_heads=4,

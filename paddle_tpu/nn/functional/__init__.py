@@ -1,7 +1,8 @@
 """nn.functional namespace. ≙ reference «python/paddle/nn/functional/__init__.py» [U]."""
 from .activation import *  # noqa: F401,F403
 from .attention import (scaled_dot_product_attention, flash_attention,  # noqa: F401
-                        flash_attn_unpadded, sequence_mask)
+                        flash_attn_unpadded, masked_multihead_attention,
+                        sequence_mask)
 from .common import *  # noqa: F401,F403
 from .conv import (conv1d, conv2d, conv3d, conv1d_transpose,  # noqa: F401
                    conv2d_transpose, conv3d_transpose)

@@ -116,33 +116,90 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q, max_seqlen_k, scale=None, dropout=0.0,
                         causal=False, return_softmax=False, name=None):
     """Varlen flash attention: ragged batch packed as one sequence with
-    cumulative offsets. XLA path materializes a block mask; the Pallas splash
-    kernel consumes the same segment-id form."""
+    cumulative offsets (≙ FlashAttnVarlenKernel, SURVEY.md §2.1). Routed
+    through the segment-ids Pallas kernel (ops.flash_varlen); the B=1
+    packing with shared q/k cu_seqlens makes global end-aligned causality
+    identical to per-segment causality."""
+    from ...ops.flash_varlen import (flash_attention_varlen_values,
+                                     segments_from_cu_seqlens)
     q, k, v = _t(query), _t(key), _t(value)
     cq = _t(cu_seqlens_q)._value
     ck = _t(cu_seqlens_k)._value
 
     def fn(qq, kk, vv):
-        # qq: (total_q, H, D). Build segment ids from cu_seqlens.
-        tq = qq.shape[0]
-        tk = kk.shape[0]
-        seg_q = jnp.cumsum(
-            jnp.zeros(tq, jnp.int32).at[cq[1:-1]].add(1))
-        seg_k = jnp.cumsum(
-            jnp.zeros(tk, jnp.int32).at[ck[1:-1]].add(1))
-        d = qq.shape[-1]
-        s = scale if scale is not None else 1.0 / math.sqrt(d)
-        logits = jnp.einsum("qhd,khd->hqk", qq, kk).astype(jnp.float32) * s
-        mask = seg_q[:, None] == seg_k[None, :]
-        if causal:
-            pos_q = jnp.arange(tq) - jnp.take(cq, seg_q)
-            pos_k = jnp.arange(tk) - jnp.take(ck, seg_k)
+        # qq: (total_q, H, D) -> (1, total_q, H, D) packed batch
+        tq, tk = qq.shape[0], kk.shape[0]
+        seg_q = segments_from_cu_seqlens(cq, tq)
+        seg_k = segments_from_cu_seqlens(ck, tk)
+        if causal and tq != tk:
+            # differing q/k packings: per-segment positions needed; the
+            # global-causal kernel doesn't apply — masked XLA path
+            d = qq.shape[-1]
+            s = scale if scale is not None else 1.0 / math.sqrt(d)
+            hq, hk2 = qq.shape[1], kk.shape[1]
+            if hq != hk2:
+                kk = jnp.repeat(kk, hq // hk2, axis=1)
+                vv = jnp.repeat(vv, hq // hk2, axis=1)
+            logits = jnp.einsum("qhd,khd->hqk", qq, kk,
+                                preferred_element_type=jnp.float32) * s
+            mask = (seg_q[:, None] == seg_k[None, :]) & \
+                (seg_q[:, None] >= 0)
+            pos_q = jnp.arange(tq) - jnp.take(cq, jnp.maximum(seg_q, 0))
+            pos_k = jnp.arange(tk) - jnp.take(ck, jnp.maximum(seg_k, 0))
             mask = mask & (pos_q[:, None] >= pos_k[None, :])
-        logits = jnp.where(mask[None], logits, -1e30)
-        p = jax.nn.softmax(logits, -1).astype(qq.dtype)
-        return jnp.einsum("hqk,khd->qhd", p, vv)
+            logits = jnp.where(mask[None], logits, -1e30)
+            p = jax.nn.softmax(logits, -1)
+            p = jnp.where(jnp.any(mask, -1)[None, :, None], p, 0.0)
+            return jnp.einsum("hqk,khd->qhd", p.astype(qq.dtype), vv)
+        out = flash_attention_varlen_values(
+            qq[None], kk[None], vv[None], seg_q[None], seg_k[None],
+            causal=causal, scale=scale)
+        return out[0]
     out = apply("flash_attn_unpadded", fn, (q, k, v))
     return out, None
+
+
+def masked_multihead_attention(query, k_cache, v_cache, seq_len,
+                               scale=None, attn_mask=None, name=None):
+    """Decode-time attention over a static KV cache.
+
+    ≙ reference `masked_multihead_attention` decode kernel
+    («paddle/phi/kernels/fusion/» [U]) re-designed for the functional KV
+    cache: q (B, S, H, D) — S is typically 1 — attends cache positions
+    with END-aligned causality: q row i sees cache[t] iff
+    t <= seq_len - S + i (for S=1: every t < seq_len). GQA native (H may
+    be a multiple of the cache's HK). `seq_len` may be traced (decode
+    position inside a scan). Softmax in fp32. `attn_mask`: optional
+    (B, T_cache) bool — False positions (e.g. left padding written into
+    the cache) are excluded.
+    """
+    q, kc, vc = _t(query), _t(k_cache), _t(v_cache)
+    sl = seq_len._value if isinstance(seq_len, Tensor) else seq_len
+    am = None
+    if attn_mask is not None:
+        am = attn_mask._value if isinstance(attn_mask, Tensor) \
+            else jnp.asarray(attn_mask)
+
+    def fn(qq, kk, vv):
+        b, s, h, d = qq.shape
+        t, hk = kk.shape[1], kk.shape[2]
+        g = h // hk
+        sc = scale if scale is not None else 1.0 / math.sqrt(d)
+        qh = qq.reshape(b, s, hk, g, d)
+        logits = jnp.einsum(
+            "bskgd,btkd->bkgst", qh, kk,
+            preferred_element_type=jnp.float32) * sc
+        kpos = jnp.arange(t)
+        qpos = sl - s + jnp.arange(s)
+        mask = (kpos[None, :] <= qpos[:, None])[None, None, None]
+        if am is not None:
+            pad = am.astype(bool)[:, None, None, None, :]  # (B,1,1,1,T)
+            mask = mask & pad
+        logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1).astype(vv.dtype)
+        out = jnp.einsum("bkgst,btkd->bskgd", p, vv)
+        return out.reshape(b, s, h, d)
+    return apply("masked_multihead_attention", fn, (q, kc, vc))
 
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):

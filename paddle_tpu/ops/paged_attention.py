@@ -1,0 +1,202 @@
+"""Paged attention — serving decode kernel over a block-table KV cache.
+
+≙ reference serving-path attention: «masked_multihead_attention» +
+«fused_multi_transformer» decode kernels and the paged-KV design the
+L10 inference engine needs (SURVEY.md §1 L10, §7 step 6 "paged attention
+(serving)"). TPU-native design: the KV cache lives in fixed-size pages
+(HK, num_pages, page_size, D); each sequence owns a row of page indices
+(block table). The Pallas kernel walks a sequence's pages with the block
+table SCALAR-PREFETCHED, so the page index feeds the BlockSpec index_map
+and Mosaic double-buffers page fetches from HBM — the TPU equivalent of
+vLLM's gather-free paged attention. Online softmax accumulates across
+pages in VMEM scratch; pages past the sequence's context length are
+masked (their DMA still runs — grid shapes are static — but a cheaper
+`pl.when` skips the FLOPs).
+
+Decode only (q = 1 token/sequence); no VJP — serving has no backward.
+Forward-parity is tested against a NumPy oracle and the contiguous-cache
+`masked_multihead_attention` functional.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from . import on_tpu
+from ..core.tensor import Tensor, apply
+
+NEG_INF = -1e30
+LANES = 128
+DEFAULT_PAGE_SIZE = 16
+
+
+def _interpret() -> bool:
+    return not on_tpu()
+
+
+def _paged_kernel(ctx_ref, bt_ref,          # scalar-prefetched
+                  q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, scale, page_size):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    i = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    ctx = ctx_ref[b]
+
+    @pl.when(i * page_size < ctx)
+    def _page():
+        q = q_ref[0, 0].astype(jnp.float32)          # (G, D)
+        k = k_ref[0, 0].astype(jnp.float32)          # (page_size, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (G, page_size)
+        pos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(pos < ctx, s, NEG_INF)
+        m_prev = m_ref[:, :1]                         # (G, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                        # (G, page_size)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, -1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (G, D)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == n_pages - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def paged_attention_values(q, k_pages, v_pages, context_lens, block_tables,
+                           scale=None):
+    """q: (B, H, D); k_pages/v_pages: (HK, P, page_size, D);
+    context_lens: (B,) int32; block_tables: (B, pages_per_seq) int32.
+    Returns (B, H, D)."""
+    b, h, d = q.shape
+    hk, _, page_size, _ = k_pages.shape
+    g = h // hk
+    pps = block_tables.shape[1]
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    if _interpret():
+        return _paged_xla(q, k_pages, v_pages, context_lens, block_tables,
+                          sc)
+
+    qh = q.reshape(b, hk, g, d)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hk, pps),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda bb, hh, ii, ctx, bt:
+                         (bb, hh, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda bb, hh, ii, ctx, bt:
+                         (hh, bt[bb, ii], 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), lambda bb, hh, ii, ctx, bt:
+                         (hh, bt[bb, ii], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d), lambda bb, hh, ii, ctx, bt:
+                               (bb, hh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((g, LANES), jnp.float32),
+            pltpu.VMEM((g, LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=sc, page_size=page_size),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hk, g, d), q.dtype),
+    )(context_lens, block_tables, qh, k_pages, v_pages)
+    return out.reshape(b, h, d)
+
+
+def _paged_xla(q, k_pages, v_pages, context_lens, block_tables, scale):
+    """Reference/CI path: gather the block table back to a contiguous
+    cache, then masked attention. Semantically identical to the kernel."""
+    b, h, d = q.shape
+    hk, _, page_size, _ = k_pages.shape
+    g = h // hk
+    pps = block_tables.shape[1]
+    s_max = pps * page_size
+    # gather: (HK, B, pps, page, D) -> (B, pps, page, HK, D) -> contiguous
+    kg = jnp.transpose(k_pages[:, block_tables], (1, 2, 3, 0, 4))
+    vg = jnp.transpose(v_pages[:, block_tables], (1, 2, 3, 0, 4))
+    kc = kg.reshape(b, s_max, hk, d)
+    vc = vg.reshape(b, s_max, hk, d)
+    qh = q.reshape(b, hk, g, d)
+    logits = jnp.einsum("bkgd,btkd->bkgt", qh, kc,
+                        preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(s_max)
+    mask = pos[None, :] < context_lens[:, None]       # (B, S_max)
+    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1).astype(vc.dtype)
+    out = jnp.einsum("bkgt,btkd->bkgd", p, vc)
+    return out.reshape(b, h, d)
+
+
+def paged_attention(q: Tensor, k_pages: Tensor, v_pages: Tensor,
+                    context_lens: Tensor, block_tables: Tensor,
+                    scale=None) -> Tensor:
+    """Eager/tape entry. Decode-only: output has no grad path."""
+    cl = context_lens._value if isinstance(context_lens, Tensor) \
+        else jnp.asarray(context_lens, jnp.int32)
+    bt = block_tables._value if isinstance(block_tables, Tensor) \
+        else jnp.asarray(block_tables, jnp.int32)
+
+    def fn(qq, kk, vv):
+        return paged_attention_values(qq, kk, vv, cl, bt, scale)
+    return apply("paged_attention", fn, (q, k_pages, v_pages))
+
+
+class PagedKVCache:
+    """Page-pool KV cache for serving (one per layer).
+
+    ≙ the inference engine's cache manager role (SURVEY.md §1 L10): a
+    fixed pool of (page_size x D) pages per KV head plus per-sequence
+    block tables. `append` writes one token per sequence and returns the
+    updated cache (functional — jit/donation friendly).
+    """
+
+    def __init__(self, num_kv_heads, head_dim, num_pages, page_size=16,
+                 dtype=jnp.bfloat16):
+        self.page_size = page_size
+        self.k_pages = jnp.zeros((num_kv_heads, num_pages, page_size,
+                                  head_dim), dtype)
+        self.v_pages = jnp.zeros_like(self.k_pages)
+
+    def append(self, k, v, block_tables, positions):
+        """k/v: (B, HK, D) one token per sequence; positions: (B,) global
+        position of the new token; block_tables: (B, pps)."""
+        page_idx = jnp.take_along_axis(
+            block_tables, (positions // self.page_size)[:, None],
+            axis=1)[:, 0]                              # (B,)
+        slot = positions % self.page_size              # (B,)
+        kp, vp = self.k_pages, self.v_pages
+        # scatter one row per (sequence, kv head)
+        kp = kp.at[:, page_idx, slot].set(jnp.swapaxes(k, 0, 1))
+        vp = vp.at[:, page_idx, slot].set(jnp.swapaxes(v, 0, 1))
+        new = PagedKVCache.__new__(PagedKVCache)
+        new.page_size = self.page_size
+        new.k_pages, new.v_pages = kp, vp
+        return new

@@ -38,8 +38,8 @@ def _rope_kernel(x1_ref, x2_ref, cos_ref, sin_ref, r1_ref, r2_ref, *, sign):
 
 def _rope_apply(x, cos, sin, sign, block_s):
     b, seq, h, d = x.shape
-    bs = min(block_s, seq)
-    if seq % bs or (_interpret() and not _FORCE_PALLAS):
+    bs = min(block_s, seq) if block_s else 0
+    if not bs or seq % bs or (_interpret() and not _FORCE_PALLAS):
         # XLA fallback for ragged sequence lengths
         c = cos[None, :, None, :].astype(jnp.float32)
         s = (sin * sign)[None, :, None, :].astype(jnp.float32)
@@ -78,8 +78,14 @@ def _rope_bwd(block_s, res, g):
 _rope.defvjp(_rope_fwd, _rope_bwd)
 
 
-def rope_values(x, cos, sin, position_offset=0, block_s=BLOCK_S):
-    """x: (B, S, H, D); cos/sin: (max_len, D/2)."""
+def rope_values(x, cos, sin, position_offset=0, block_s=BLOCK_S,
+                use_pallas=True):
+    """x: (B, S, H, D); cos/sin: (max_len, D/2). `position_offset` may be
+    traced (decode position); pass use_pallas=False then — a Pallas grid
+    cannot help at S=1 and the XLA fallback (same rotation, same inverse-
+    rotation VJP) handles it. block_s=0 also forces the XLA path."""
+    if not use_pallas:
+        block_s = 0
     seq = x.shape[1]
     if isinstance(position_offset, int) and \
             position_offset + seq > cos.shape[0]:

@@ -96,6 +96,24 @@ class Optimizer:
         return self._multi_precision and p._value.dtype in (
             jnp.float16, jnp.bfloat16)
 
+    def _create_state(self, p: Parameter) -> None:
+        """Create this optimizer's accumulators for `p` (zeros), exactly the
+        ones `_update_param` touches. Subclasses override; base = stateless
+        (SGD). Must stay in sync with `_update_param`'s `_acc` calls."""
+
+    def ensure_state(self, p: Parameter | None = None) -> None:
+        """Instantiate all optimizer state (accumulators + master weights)
+        for `p` — or every trainable param — ahead of the first step(), so
+        a compiled train step sees a stable state signature from step 0.
+        State creation is optimizer-owned: a new optimizer subclass only
+        has to override `_create_state` and compiled mode follows."""
+        ps = ([p] if p is not None
+              else [q for q in self._parameter_list if not q.stop_gradient])
+        for q in ps:
+            self._create_state(q)
+            if self._use_master(q):
+                self._master(q)
+
     # -- grad plumbing -------------------------------------------------------
     def _grads(self):
         out = []
@@ -236,6 +254,11 @@ class Momentum(Optimizer):
         self._momentum = momentum
         self._nesterov = use_nesterov
 
+    def _create_state(self, p):
+        self._acc("velocity", p,
+                  dtype=jnp.float32 if self._use_master(p)
+                  else p._value.dtype)
+
     def _update_param(self, p, g):
         lr = self.get_lr()
         wd = self._wd(p)
@@ -266,6 +289,12 @@ class Adam(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
         self._amsgrad = amsgrad
+
+    def _create_state(self, p):
+        self._acc("moment1", p, dtype=jnp.float32)
+        self._acc("moment2", p, dtype=jnp.float32)
+        if self._amsgrad:
+            self._acc("moment2_max", p, dtype=jnp.float32)
 
     def _adam_core(self, p, g, decoupled_wd=0.0, coupled_wd=0.0):
         lr = self.get_lr()
@@ -334,6 +363,10 @@ class Adamax(Optimizer):
                          False, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
+    def _create_state(self, p):
+        self._acc("moment", p, dtype=jnp.float32)
+        self._acc("inf_norm", p, dtype=jnp.float32)
+
     def _update_param(self, p, g):
         lr = self.get_lr()
         g = g.astype(jnp.float32)
@@ -360,6 +393,10 @@ class Adagrad(Optimizer):
         self._epsilon = epsilon
         self._init_acc = initial_accumulator_value
 
+    def _create_state(self, p):
+        self._acc("moment", p,
+                  init=jnp.full(p._value.shape, self._init_acc, jnp.float32))
+
     def _update_param(self, p, g):
         lr = self.get_lr()
         g = g.astype(jnp.float32)
@@ -382,6 +419,10 @@ class Adadelta(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          False, name)
         self._epsilon, self._rho = epsilon, rho
+
+    def _create_state(self, p):
+        self._acc("avg_squared_grad", p, dtype=jnp.float32)
+        self._acc("avg_squared_update", p, dtype=jnp.float32)
 
     def _update_param(self, p, g):
         lr = self.get_lr()
@@ -408,6 +449,12 @@ class RMSProp(Optimizer):
                          False, name)
         self._rho, self._epsilon = rho, epsilon
         self._momentum, self._centered = momentum, centered
+
+    def _create_state(self, p):
+        self._acc("mean_square", p, dtype=jnp.float32)
+        self._acc("momentum", p, dtype=jnp.float32)
+        if self._centered:
+            self._acc("mean_grad", p, dtype=jnp.float32)
 
     def _update_param(self, p, g):
         lr = self.get_lr()
@@ -443,6 +490,10 @@ class Lamb(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._wd_value = lamb_weight_decay
         self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _create_state(self, p):
+        self._acc("moment1", p, dtype=jnp.float32)
+        self._acc("moment2", p, dtype=jnp.float32)
 
     def _update_param(self, p, g):
         lr = self.get_lr()

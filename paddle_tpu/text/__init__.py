@@ -1,0 +1,216 @@
+"""paddle_tpu.text — tokenization + tokenized-dataset tier.
+
+≙ the reference ecosystem's dataset/tokenizer layer (PaddleNLP tokenizers
+and `paddle.text` datasets — outside-repo model zoo per SURVEY.md §1, and
+the §2.2 vision/audio/text row). Offline-first design: a byte-level
+tokenizer (no vocab files, 256+special ids — every string round-trips), a
+whitespace/word tokenizer with a built vocab, and block datasets that
+deterministically produce the LM / MLM batch shapes the north-star
+recipes need, from either a file-backed token stream (np.memmap over a
+.bin of uint16/uint32 ids, or raw .txt) or a synthetic generator.
+"""
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["ByteTokenizer", "WordTokenizer", "Vocab", "LMBlockDataset",
+           "MLMBlockDataset", "SyntheticTokens", "FileTokens",
+           "encode_file"]
+
+
+class ByteTokenizer:
+    """UTF-8 byte-level tokenizer: ids 0..255 are bytes; specials follow.
+    No files, no OOV, exact round-trip — the offline-friendly default."""
+
+    def __init__(self, specials=("<pad>", "<unk>", "<s>", "</s>",
+                                 "<mask>")):
+        self.specials = list(specials)
+        self._special_ids = {s: 256 + i for i, s in enumerate(specials)}
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.specials)
+
+    @property
+    def pad_id(self):
+        return self._special_ids.get("<pad>")
+
+    @property
+    def mask_id(self):
+        return self._special_ids.get("<mask>")
+
+    @property
+    def bos_id(self):
+        return self._special_ids.get("<s>")
+
+    @property
+    def eos_id(self):
+        return self._special_ids.get("</s>")
+
+    def encode(self, text: str, add_bos=False, add_eos=False):
+        ids = list(text.encode("utf-8"))
+        if add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        if add_eos and self.eos_id is not None:
+            ids = ids + [self.eos_id]
+        return np.asarray(ids, np.int32)
+
+    def decode(self, ids) -> str:
+        bs = bytes(int(i) for i in np.asarray(ids).ravel() if 0 <= i < 256)
+        return bs.decode("utf-8", errors="replace")
+
+
+class Vocab:
+    """token <-> id table with specials first. ≙ paddlenlp Vocab [U?]."""
+
+    def __init__(self, tokens: Iterable[str],
+                 specials=("<pad>", "<unk>", "<s>", "</s>", "<mask>")):
+        self.itos = list(specials) + [t for t in tokens
+                                      if t not in set(specials)]
+        self.stoi = {t: i for i, t in enumerate(self.itos)}
+        self.unk_id = self.stoi.get("<unk>", 0)
+
+    def __len__(self):
+        return len(self.itos)
+
+    def __getitem__(self, tok: str) -> int:
+        return self.stoi.get(tok, self.unk_id)
+
+
+class WordTokenizer:
+    """Whitespace/word tokenizer over a built Vocab."""
+
+    def __init__(self, vocab: Vocab, lowercase: bool = True):
+        self.vocab = vocab
+        self.lowercase = lowercase
+
+    @staticmethod
+    def build(texts: Iterable[str], max_vocab: int = 30000,
+              lowercase: bool = True) -> "WordTokenizer":
+        from collections import Counter
+        c: Counter = Counter()
+        for t in texts:
+            c.update((t.lower() if lowercase else t).split())
+        toks = [w for w, _ in c.most_common(max_vocab)]
+        return WordTokenizer(Vocab(toks), lowercase)
+
+    @property
+    def vocab_size(self):
+        return len(self.vocab)
+
+    @property
+    def pad_id(self):
+        return self.vocab.stoi.get("<pad>")
+
+    @property
+    def mask_id(self):
+        return self.vocab.stoi.get("<mask>")
+
+    def encode(self, text: str):
+        t = text.lower() if self.lowercase else text
+        return np.asarray([self.vocab[w] for w in t.split()], np.int32)
+
+    def decode(self, ids):
+        return " ".join(self.vocab.itos[int(i)] for i in np.asarray(
+            ids).ravel() if 0 <= int(i) < len(self.vocab))
+
+
+# -- token sources -----------------------------------------------------------
+class SyntheticTokens:
+    """Deterministic synthetic token stream (CI / smoke runs)."""
+
+    def __init__(self, vocab_size: int, length: int, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        self.ids = rng.integers(0, vocab_size, length, dtype=np.int32)
+        self.vocab_size = vocab_size
+
+
+class FileTokens:
+    """File-backed token stream.
+
+    .bin → zero-copy np.memmap of uint16/uint32 ids (dtype by header-less
+    convention: uint16 when vocab fits, else uint32 — pass `dtype`);
+    .txt → tokenized on load with the given tokenizer.
+    """
+
+    def __init__(self, path: str, tokenizer=None, dtype=None):
+        if path.endswith(".bin"):
+            dt = dtype or np.uint16
+            self.ids = np.memmap(path, dtype=dt, mode="r")
+            self.vocab_size = int(self.ids.max()) + 1 if len(self.ids) \
+                else 0
+        else:
+            tok = tokenizer or ByteTokenizer()
+            with open(path, "r", encoding="utf-8") as f:
+                self.ids = tok.encode(f.read())
+            self.vocab_size = tok.vocab_size
+
+
+def encode_file(src_txt: str, dst_bin: str, tokenizer=None,
+                dtype=np.uint16) -> int:
+    """Tokenize a text file to a flat .bin of ids; returns token count."""
+    tok = tokenizer or ByteTokenizer()
+    with open(src_txt, "r", encoding="utf-8") as f:
+        ids = tok.encode(f.read())
+    np.asarray(ids, dtype).tofile(dst_bin)
+    return len(ids)
+
+
+# -- block datasets ----------------------------------------------------------
+class LMBlockDataset(Dataset):
+    """Next-token-prediction blocks: item = (input [S], label [S]) from a
+    flat token stream (label = input shifted by one)."""
+
+    def __init__(self, source, seq_len: int):
+        self.ids = np.asarray(source.ids, np.int32)
+        self.seq_len = seq_len
+        self.n = max((len(self.ids) - 1) // seq_len, 0)
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        s = self.seq_len
+        chunk = self.ids[i * s: i * s + s + 1]
+        return chunk[:-1].copy(), chunk[1:].copy()
+
+
+class MLMBlockDataset(Dataset):
+    """BERT-style masked-LM blocks: item = (masked_input [S], labels [S])
+    with labels = -100 except at masked positions (the 80/10/10 rule)."""
+
+    def __init__(self, source, seq_len: int, mask_id: int,
+                 vocab_size: Optional[int] = None, mask_prob: float = 0.15,
+                 seed: int = 0, ignore_label: int = -100):
+        self.ids = np.asarray(source.ids, np.int32)
+        self.seq_len = seq_len
+        self.mask_id = mask_id
+        self.vocab_size = vocab_size or source.vocab_size
+        self.mask_prob = mask_prob
+        self.seed = seed
+        self.ignore = ignore_label
+        self.n = max(len(self.ids) // seq_len, 0)
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(self.seed * 1_000_003 + i)
+        s = self.seq_len
+        block = self.ids[i * s:(i + 1) * s].copy()
+        labels = np.full(s, self.ignore, np.int32)
+        pick = rng.random(s) < self.mask_prob
+        if not pick.any():
+            pick[rng.integers(0, s)] = True
+        labels[pick] = block[pick]
+        r = rng.random(s)
+        block[pick & (r < 0.8)] = self.mask_id
+        rand = pick & (r >= 0.8) & (r < 0.9)
+        block[rand] = rng.integers(0, self.vocab_size,
+                                   rand.sum(), dtype=np.int32)
+        return block, labels

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""BERT MLM fine-tune — north-star config #1 (single chip).
+
+≙ BASELINE.json configs[0] / SURVEY.md §6 + §7 step 4: tokenized data →
+DataLoader → BertForMaskedLM → AdamW → one compiled TrainStep per batch.
+
+    python recipes/bert_mlm.py --steps 50                 # synthetic
+    python recipes/bert_mlm.py --data corpus.txt --size base
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from recipes.common import RecipeResult, run_train, std_parser, \
+    token_source  # noqa: E402
+
+
+def main(argv=None):
+    p = std_parser("BERT MLM fine-tune (single chip)")
+    p.add_argument("--size", choices=["tiny", "base"], default="base")
+    args = p.parse_args(argv)
+
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.models.bert import BertConfig, BertForMaskedLM
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.optimizer.lr import LinearWarmup
+    from paddle_tpu.text import ByteTokenizer, MLMBlockDataset
+
+    cfg = BertConfig.base() if args.size == "base" else BertConfig.tiny()
+    paddle.seed(args.seed)
+    model = BertForMaskedLM(cfg)
+
+    tok = ByteTokenizer()
+    src = token_source(args, min(cfg.vocab_size, tok.vocab_size))
+    ds = MLMBlockDataset(src, args.seq_len, mask_id=tok.mask_id,
+                         vocab_size=min(cfg.vocab_size, tok.vocab_size),
+                         seed=args.seed)
+    loader = DataLoader(ds, batch_size=args.batch_size, shuffle=True,
+                        drop_last=True)
+
+    sched = LinearWarmup(args.lr, warmup_steps=min(10, args.steps),
+                         start_lr=0.0, end_lr=args.lr)
+    opt = AdamW(learning_rate=sched, parameters=model.parameters(),
+                weight_decay=0.01)
+    step = paddle.jit.TrainStep(
+        model, opt, loss_fn=lambda m, x, y: m(x, labels=y)[0],
+        accumulate_steps=args.accumulate_steps)
+
+    def step_and_sched(x, y):
+        loss = step(paddle.to_tensor(x), paddle.to_tensor(y))
+        sched.step()
+        return loss
+
+    final = run_train(step_and_sched, loader, args.steps, args.log_every)
+    if args.save:
+        paddle.save(model.state_dict(), args.save)
+        print(f"saved {args.save}")
+    return RecipeResult(final, args.steps)
+
+
+if __name__ == "__main__":
+    r = main()
+    print(f"final loss {r.final_loss:.4f}")

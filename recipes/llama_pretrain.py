@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Llama causal-LM pretraining — north-star config #2 (single chip → DP,
+the bench.py shape). ≙ BASELINE.json configs[1] / SURVEY.md §6.
+
+    python recipes/llama_pretrain.py --steps 20                # synthetic
+    python recipes/llama_pretrain.py --size bench --recompute \
+        --accumulate-steps 4
+    python recipes/llama_pretrain.py --mesh dp=2,sharding=4    # 8-dev CPU
+
+`--mesh` shards the step over a device mesh (GSPMD; batch on dp,
+ZeRO on sharding, Megatron placements on mp).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from recipes.common import RecipeResult, run_train, std_parser, \
+    token_source  # noqa: E402
+
+
+def parse_mesh(spec: str):
+    axes = {}
+    for part in spec.split(","):
+        k, v = part.split("=")
+        axes[k.strip()] = int(v)
+    return axes
+
+
+def main(argv=None):
+    p = std_parser("Llama causal-LM pretraining")
+    p.add_argument("--size", choices=["tiny", "small", "bench"],
+                   default="small")
+    p.add_argument("--recompute", action="store_true")
+    p.add_argument("--bf16", action="store_true")
+    p.add_argument("--mesh", type=str, default=None,
+                   help="e.g. dp=2,sharding=2,mp=2")
+    args = p.parse_args(argv)
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM, \
+        shard_llama
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.text import LMBlockDataset
+
+    if args.size == "bench":
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_hidden_layers=16,
+                          num_attention_heads=16, num_key_value_heads=8,
+                          max_position_embeddings=2048)
+    elif args.size == "small":
+        cfg = LlamaConfig.small()
+    else:
+        cfg = LlamaConfig.tiny()
+    cfg.recompute = args.recompute
+
+    paddle.seed(args.seed)
+    model = LlamaForCausalLM(cfg)
+    if args.bf16:
+        model.to(dtype="bfloat16")
+
+    src = token_source(args, cfg.vocab_size)
+    ds = LMBlockDataset(src, args.seq_len)
+    loader = DataLoader(ds, batch_size=args.batch_size, shuffle=True,
+                        drop_last=True)
+
+    mesh = None
+    if args.mesh:
+        mesh = dist.create_mesh(**parse_mesh(args.mesh))
+
+    def build_step():
+        opt = AdamW(learning_rate=args.lr,
+                    parameters=model.parameters(), weight_decay=0.01,
+                    multi_precision=args.bf16)
+        return paddle.jit.TrainStep(
+            model, opt, loss_fn=lambda m, x, y: m(x, labels=y)[0],
+            accumulate_steps=args.accumulate_steps)
+
+    if mesh is not None:
+        with dist.use_mesh(mesh):
+            shard_llama(model, mesh)
+            step = build_step()
+            pl = [dist.Shard(0)] + [dist.Replicate()] * (
+                len(mesh.dim_names) - 1)
+
+            def step_fn(x, y):
+                return step(
+                    dist.shard_tensor(paddle.to_tensor(x), mesh, pl),
+                    dist.shard_tensor(paddle.to_tensor(y), mesh, pl))
+            final = run_train(step_fn, loader, args.steps, args.log_every)
+    else:
+        step = build_step()
+
+        def step_fn(x, y):
+            return step(paddle.to_tensor(x), paddle.to_tensor(y))
+        final = run_train(step_fn, loader, args.steps, args.log_every)
+
+    if args.save:
+        paddle.save(model.state_dict(), args.save)
+        print(f"saved {args.save}")
+    return RecipeResult(final, args.steps)
+
+
+if __name__ == "__main__":
+    r = main()
+    print(f"final loss {r.final_loss:.4f}")

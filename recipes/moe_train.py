@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""MoE causal-LM training — north-star config #5 (DeepSeekMoE/Qwen2-MoE
+style expert parallelism). ≙ BASELINE.json configs[4] / SURVEY.md §6.
+
+    python recipes/moe_train.py --steps 10                    # synthetic
+    python recipes/moe_train.py --mesh dp=2,ep=4 --dropless   # 8-dev CPU
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from recipes.common import RecipeResult, run_train, std_parser, \
+    token_source  # noqa: E402
+from recipes.llama_pretrain import parse_mesh  # noqa: E402
+
+
+def main(argv=None):
+    p = std_parser("MoE causal-LM training (expert parallel)")
+    p.add_argument("--size", choices=["tiny", "small"], default="tiny")
+    p.add_argument("--dropless", action="store_true")
+    p.add_argument("--mesh", type=str, default=None,
+                   help="e.g. dp=2,ep=4")
+    args = p.parse_args(argv)
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.models.moe import (MoEConfig, MoEForCausalLM,
+                                       shard_moe_model)
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.text import LMBlockDataset
+
+    cfg = MoEConfig.tiny() if args.size == "tiny" else MoEConfig.small()
+    cfg.dropless = args.dropless
+    paddle.seed(args.seed)
+    model = MoEForCausalLM(cfg)
+
+    src = token_source(args, cfg.vocab_size)
+    ds = LMBlockDataset(src, args.seq_len)
+    loader = DataLoader(ds, batch_size=args.batch_size, shuffle=True,
+                        drop_last=True)
+
+    mesh = dist.create_mesh(**parse_mesh(args.mesh)) if args.mesh else None
+
+    def build_step():
+        opt = AdamW(learning_rate=args.lr,
+                    parameters=model.parameters(), weight_decay=0.01)
+        return paddle.jit.TrainStep(
+            model, opt, loss_fn=lambda m, x, y: m(x, labels=y)[0],
+            accumulate_steps=args.accumulate_steps)
+
+    if mesh is not None:
+        with dist.use_mesh(mesh):
+            shard_moe_model(model, mesh)
+            step = build_step()
+            pl = [dist.Shard(0)] + [dist.Replicate()] * (
+                len(mesh.dim_names) - 1)
+
+            def step_fn(x, y):
+                return step(
+                    dist.shard_tensor(paddle.to_tensor(x), mesh, pl),
+                    dist.shard_tensor(paddle.to_tensor(y), mesh, pl))
+            final = run_train(step_fn, loader, args.steps, args.log_every)
+    else:
+        step = build_step()
+
+        def step_fn(x, y):
+            return step(paddle.to_tensor(x), paddle.to_tensor(y))
+        final = run_train(step_fn, loader, args.steps, args.log_every)
+
+    if args.save:
+        paddle.save(model.state_dict(), args.save)
+        print(f"saved {args.save}")
+    return RecipeResult(final, args.steps)
+
+
+if __name__ == "__main__":
+    r = main()
+    print(f"final loss {r.final_loss:.4f}")

@@ -1,0 +1,201 @@
+"""Varlen/packed flash attention vs NumPy oracle over random packings.
+≙ SURVEY.md §2.1 FlashAttention row (varlen variants); VERDICT r2 item 5."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.flash_varlen import (flash_attention_varlen,
+                                         flash_attention_varlen_values,
+                                         segments_from_cu_seqlens)
+
+
+def _random_packing(rng, b, s, max_segs=4):
+    """Random segment ids per batch row: contiguous runs, tail padding."""
+    seg = np.full((b, s), -1, np.int32)
+    for i in range(b):
+        n = rng.integers(1, max_segs + 1)
+        cuts = np.sort(rng.choice(np.arange(1, s), n - 1, replace=False)) \
+            if n > 1 else np.array([], np.int64)
+        bounds = np.concatenate([[0], cuts, [rng.integers(s // 2, s + 1)]])
+        bounds = np.sort(bounds)
+        for j in range(len(bounds) - 1):
+            seg[i, bounds[j]:bounds[j + 1]] = j
+    return seg
+
+
+def _oracle(q, k, v, seg_q, seg_k, causal):
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    hk = k.shape[2]
+    g = h // hk
+    if g > 1:
+        k = np.repeat(k, g, axis=2)
+        v = np.repeat(v, g, axis=2)
+    out = np.zeros_like(q, dtype=np.float32)
+    for bi in range(b):
+        for hi in range(h):
+            logits = (q[bi, :, hi].astype(np.float32)
+                      @ k[bi, :, hi].astype(np.float32).T) / np.sqrt(d)
+            mask = (seg_q[bi][:, None] == seg_k[bi][None, :]) & \
+                (seg_q[bi][:, None] >= 0)
+            if causal:
+                pos_q = np.arange(sq)[:, None] + (sk - sq)
+                mask &= pos_q >= np.arange(sk)[None, :]
+            logits = np.where(mask, logits, -1e30)
+            valid = mask.any(-1)
+            e = np.exp(logits - logits.max(-1, keepdims=True))
+            p = e / np.maximum(e.sum(-1, keepdims=True), 1e-30)
+            p = np.where(valid[:, None], p, 0.0)
+            out[bi, :, hi] = p @ v[bi, :, hi].astype(np.float32)
+    return out
+
+
+class TestVarlenParity:
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_random_packings(self, causal, seed):
+        rng = np.random.default_rng(seed)
+        b, s, h, hk, d = 2, 256, 4, 2, 32
+        q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+        k = rng.standard_normal((b, s, hk, d)).astype(np.float32)
+        v = rng.standard_normal((b, s, hk, d)).astype(np.float32)
+        seg = _random_packing(rng, b, s)
+        out = flash_attention_varlen_values(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+            jnp.asarray(seg), jnp.asarray(seg), causal=causal)
+        ref = _oracle(q, k, v, seg, seg, causal)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_unaligned_falls_back(self):
+        rng = np.random.default_rng(3)
+        b, s, h, d = 1, 100, 2, 16   # s not a block multiple
+        q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+        seg = _random_packing(rng, b, s)
+        out = flash_attention_varlen_values(
+            jnp.asarray(q), jnp.asarray(q), jnp.asarray(q),
+            jnp.asarray(seg), jnp.asarray(seg), causal=True)
+        ref = _oracle(q, q, q, seg, seg, True)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_grads_confined_to_segments(self):
+        """dk for keys in segment A must be unaffected by queries in
+        segment B: cross-segment leakage would show up here."""
+        rng = np.random.default_rng(4)
+        b, s, h, d = 1, 256, 2, 32
+        seg = np.zeros((b, s), np.int32)
+        seg[:, 128:] = 1
+        q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+        k = rng.standard_normal((b, s, h, d)).astype(np.float32)
+        v = rng.standard_normal((b, s, h, d)).astype(np.float32)
+
+        def loss_fn(qq, kk, vv, w):
+            # weight only segment-0 outputs
+            out = flash_attention_varlen_values(
+                qq, kk, vv, jnp.asarray(seg), jnp.asarray(seg),
+                causal=True)
+            return jnp.sum(out[:, :128] * w)
+
+        w = rng.standard_normal((b, 128, h, d)).astype(np.float32)
+        dq, dk, dv = jax.grad(loss_fn, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(w))
+        # segment-1 keys/values got zero gradient
+        np.testing.assert_allclose(np.asarray(dk[:, 128:]), 0.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(dv[:, 128:]), 0.0, atol=1e-6)
+        assert float(jnp.abs(dk[:, :128]).max()) > 0
+
+    def test_grad_matches_xla_reference(self):
+        rng = np.random.default_rng(5)
+        b, s, h, d = 1, 256, 2, 32
+        seg = _random_packing(rng, b, s)
+        q = rng.standard_normal((b, s, h, d)).astype(np.float32)
+        k = rng.standard_normal((b, s, h, d)).astype(np.float32)
+        v = rng.standard_normal((b, s, h, d)).astype(np.float32)
+        from paddle_tpu.ops.flash_varlen import _varlen_xla
+
+        def f_kernel(qq, kk, vv):
+            return flash_attention_varlen_values(
+                qq, kk, vv, jnp.asarray(seg), jnp.asarray(seg),
+                causal=True).astype(jnp.float32).sum()
+
+        def f_ref(qq, kk, vv):
+            return _varlen_xla(qq, kk, vv, jnp.asarray(seg),
+                               jnp.asarray(seg), 1.0 / np.sqrt(d),
+                               True).astype(jnp.float32).sum()
+
+        g1 = jax.grad(f_kernel, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for a, bb in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(bb),
+                                       rtol=2e-4, atol=2e-4)
+
+
+class TestCuSeqlens:
+    def test_segments_from_cu_seqlens(self):
+        seg = segments_from_cu_seqlens(jnp.asarray([0, 3, 5, 8]), 10)
+        np.testing.assert_array_equal(
+            np.asarray(seg), [0, 0, 0, 1, 1, 2, 2, 2, -1, -1])
+
+    def test_flash_attn_unpadded_routes_kernel(self):
+        from paddle_tpu.nn import functional as F
+        rng = np.random.default_rng(6)
+        total, h, d = 256, 2, 32
+        cu = np.array([0, 100, 256], np.int32)
+        q = rng.standard_normal((total, h, d)).astype(np.float32)
+        out, _ = F.flash_attn_unpadded(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            paddle.to_tensor(cu), paddle.to_tensor(cu), 156, 156,
+            causal=True)
+        seg = np.asarray(segments_from_cu_seqlens(jnp.asarray(cu), total))
+        ref = _oracle(q[None], q[None], q[None], seg[None], seg[None],
+                      True)[0]
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=2e-4,
+                                   atol=2e-4)
+
+
+class TestPackedTraining:
+    def test_packed_batch_train_step(self):
+        """Packed two-documents-per-row batch trains through the varlen
+        kernel: loss decreases and grads flow."""
+        from paddle_tpu import nn
+
+        class PackedAttn(nn.Layer):
+            def __init__(self, h=32, heads=2):
+                super().__init__()
+                self.qkv = nn.Linear(h, 3 * h)
+                self.out = nn.Linear(h, h)
+                self.heads = heads
+
+            def forward(self, x, seg):
+                b, s, hdim = x.shape
+                qkv = self.qkv(x).reshape([b, s, 3, self.heads,
+                                           hdim // self.heads])
+                q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+                o = flash_attention_varlen(q, k, v, seg, seg, causal=True)
+                return self.out(o.reshape([b, s, hdim]))
+
+        paddle.seed(0)
+        rng = np.random.default_rng(7)
+        model = PackedAttn()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 128, 32)).astype(np.float32))
+        y = paddle.to_tensor(
+            rng.standard_normal((2, 128, 32)).astype(np.float32))
+        seg = np.zeros((2, 128), np.int32)
+        seg[:, 64:] = 1
+        seg_t = paddle.to_tensor(seg)
+        losses = []
+        for _ in range(5):
+            loss = ((model(x, seg_t) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]

@@ -275,3 +275,97 @@ class TestDroplessMoE:
         np.testing.assert_allclose(np.asarray(o_padded),
                                    np.asarray(o_plain), rtol=1e-4,
                                    atol=1e-4)
+
+
+class TestDroplessEP:
+    """Dropless × expert parallelism: shard_map all_to_all dispatch
+    (VERDICT r2 item 6; SURVEY.md §2.3 EP row, §7 hard part 3)."""
+
+    def _layer_out(self, mesh, dropless, x, seed=0, **kw):
+        paddle.seed(seed)
+        layer = MoELayer(32, 64, num_experts=8, top_k=2, dropless=dropless,
+                         **kw)
+        if mesh is not None:
+            shard_moe(layer, mesh)
+            with dist.use_mesh(mesh):
+                xt = dist.shard_tensor(
+                    paddle.to_tensor(x), mesh,
+                    [dist.Shard(0)] + [dist.Replicate()] *
+                    (len(mesh.dim_names) - 1))
+                out, aux = layer(xt)
+                return (np.asarray(out._value), float(aux),
+                        layer)
+        out, aux = layer(paddle.to_tensor(x))
+        return np.asarray(out._value), float(aux), layer
+
+    def test_ep_matches_single_shard_dropless(self):
+        """Generous pair capacity => no EP drops => bitwise-tolerant parity
+        with the single-shard dropless path (same params via same seed)."""
+        x = rng.standard_normal((16, 32)).astype(np.float32)
+        ref, aux_ref, _ = self._layer_out(None, True, x, seed=5)
+        mesh = dist.create_mesh(dp=2, ep=4)
+        got, aux_got, _ = self._layer_out(mesh, True, x, seed=5,
+                                          ep_pair_capacity_factor=100.0)
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        assert abs(aux_ref - aux_got) < 1e-4
+
+    def test_ep_dropless_training_step(self):
+        """Dropless MoE model trains end-to-end on a dp×ep mesh."""
+        from paddle_tpu.models.moe import (MoEConfig, MoEForCausalLM,
+                                           shard_moe_model,
+                                           synthetic_lm_batch)
+        from paddle_tpu.optimizer import AdamW
+
+        mesh = dist.create_mesh(dp=2, ep=4)
+        paddle.seed(0)
+        cfg = MoEConfig.tiny()
+        cfg.dropless = True
+        model = MoEForCausalLM(cfg)
+        with dist.use_mesh(mesh):
+            shard_moe_model(model, mesh)
+            opt = AdamW(learning_rate=1e-3,
+                        parameters=model.parameters())
+            ids, labels = synthetic_lm_batch(4, 32, cfg.vocab_size)
+            pl = [dist.Shard(0), dist.Replicate()]
+            ids = dist.shard_tensor(ids, mesh, pl)
+            labels = dist.shard_tensor(labels, mesh, pl)
+            step = paddle.jit.TrainStep(
+                model, opt, loss_fn=lambda m, x, y: m(x, labels=y)[0])
+            losses = [float(step(ids, labels)) for _ in range(4)]
+        assert losses[-1] < losses[0], losses
+        assert np.isfinite(losses).all()
+
+    def test_ep_dropless_grads_flow_to_all_expert_shards(self):
+        x = rng.standard_normal((16, 32)).astype(np.float32)
+        mesh = dist.create_mesh(ep=4)
+        paddle.seed(2)
+        layer = MoELayer(32, 64, num_experts=8, top_k=2, dropless=True,
+                         ep_pair_capacity_factor=100.0)
+        shard_moe(layer, mesh)
+        with dist.use_mesh(mesh):
+            out, aux = layer(paddle.to_tensor(x))
+            (out.astype("float32").sum() + aux).backward()
+        g = layer.w_gate.grad
+        assert g is not None
+        # routing reaches several experts -> every ep shard got gradient
+        gnorm = np.asarray(
+            jnp.sqrt(jnp.sum(jnp.square(g._value), axis=(1, 2))))
+        assert (gnorm > 0).sum() >= 4, gnorm
+
+    def test_tight_pair_capacity_drops_but_stays_finite(self):
+        x = rng.standard_normal((16, 32)).astype(np.float32)
+        mesh = dist.create_mesh(ep=4)
+        got, aux, _ = self._layer_out(mesh, True, x, seed=7,
+                                      ep_pair_capacity_factor=0.25)
+        assert np.isfinite(got).all()
+        assert np.isfinite(aux)
+
+    def test_shard_moe_warns_on_indivisible(self):
+        import warnings as w
+        mesh = dist.create_mesh(ep=4)
+        paddle.seed(0)
+        layer = MoELayer(16, 32, num_experts=6, top_k=2)  # 6 % 4 != 0
+        with w.catch_warnings(record=True) as rec:
+            w.simplefilter("always")
+            shard_moe(layer, mesh)
+        assert any("not divisible" in str(r.message) for r in rec)

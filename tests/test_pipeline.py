@@ -157,3 +157,75 @@ class TestLlamaPipe:
             losses = [float(step(ids, labels)) for _ in range(4)]
         assert losses[-1] < losses[0], losses
         assert np.isfinite(losses).all()
+
+
+class TestFusedLossPipeline:
+    """reduce_fn loss fusion: the (M, mb, S, H) output buffer collapses to
+    (M,) scalars (VERDICT r2 item 7 — memory numbers + loss parity)."""
+
+    def test_fused_loss_matches_eager_and_logs_memory(self):
+        import jax
+        from paddle_tpu.models.llama import (LlamaConfig,
+                                             LlamaForCausalLM)
+        from paddle_tpu.models.llama_pipe import (LlamaForCausalLMPipe,
+                                                  synthetic_lm_batch)
+
+        # vocab-heavy config: the (B, S, V) logits buffer dominates temp
+        # memory, so the fused path's win is measurable
+        cfg = LlamaConfig(vocab_size=8192, hidden_size=64,
+                          intermediate_size=128, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=128)
+        paddle.seed(0)
+        eager = LlamaForCausalLM(cfg)
+        pipe = LlamaForCausalLMPipe(cfg, num_microbatches=2)
+        pipe.load_from_unstacked(eager)
+        ids, labels = synthetic_lm_batch(4, 64, cfg.vocab_size)
+
+        ref = float(eager(ids, labels=labels)[0])
+
+        mesh = dist.create_mesh(pp=2, mp=2)
+        with dist.use_mesh(mesh):
+            loss, logits = pipe(ids, labels=labels)
+            assert logits is None, "fused path must not materialize logits"
+            got = float(loss)
+        assert abs(got - ref) < 2e-2, (got, ref)
+
+        # compiled-memory comparison: fused (M,) scalars vs full buffer
+        def mem_of(fused):
+            params = [p._value for p in pipe.parameters()]
+
+            import jax.numpy as jnp
+
+            def run(pv, x, y):
+                old = [p._value for p in pipe.parameters()]
+                for p, v in zip(pipe.parameters(), pv):
+                    p._value = v
+                try:
+                    if fused:
+                        return pipe(paddle.Tensor(x),
+                                    labels=paddle.Tensor(y))[0]._value
+                    # unfused LOSS step: full (B, S, V) logits out of the
+                    # pipeline, then CE — the apples-to-apples baseline
+                    lg = pipe(paddle.Tensor(x))._value.astype(
+                        jnp.float32).reshape(-1, cfg.vocab_size)
+                    lab = y.reshape(-1)
+                    lse = jax.scipy.special.logsumexp(lg, axis=-1)
+                    picked = jnp.take_along_axis(
+                        lg, jnp.maximum(lab, 0)[:, None], -1)[:, 0]
+                    return jnp.mean(lse - picked)
+                finally:
+                    for p, v in zip(pipe.parameters(), old):
+                        p._value = v
+            with dist.use_mesh(mesh):
+                c = jax.jit(run).lower(
+                    params, ids._value, labels._value).compile()
+            m = c.memory_analysis()
+            return getattr(m, "temp_size_in_bytes", None)
+
+        fused_b, full_b = mem_of(True), mem_of(False)
+        print(f"\npipeline compiled temp memory: fused-loss={fused_b} "
+              f"bytes, full-logits-buffer={full_b} bytes")
+        if fused_b is not None and full_b is not None:
+            # fused path must not pay the (B, S, V) logits cost
+            assert fused_b < full_b, (fused_b, full_b)

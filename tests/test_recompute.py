@@ -1,0 +1,215 @@
+"""Recompute (activation checkpointing) + gradient accumulation +
+optimizer-owned state creation. ≙ SURVEY.md §2.4 recompute/gradient-merge
+meta-optimizer rows; VERDICT r2 items 4 and 10."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet.utils import recompute
+from paddle_tpu.nn import functional as F
+
+
+class SmallMLP(nn.Layer):
+    def __init__(self, h=32):
+        super().__init__()
+        self.fc1 = nn.Linear(h, 4 * h)
+        self.fc2 = nn.Linear(4 * h, h)
+
+    def forward(self, x):
+        return self.fc2(F.gelu(self.fc1(x)))
+
+
+def _grads(model):
+    return {n: np.asarray(p.grad._value)
+            for n, p in model.named_parameters() if p.grad is not None}
+
+
+class TestRecompute:
+    def test_grad_parity_vs_plain(self):
+        paddle.seed(0)
+        mlp = SmallMLP()
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((8, 32), np.float32))
+
+        loss = mlp(x).astype("float32").sum()
+        loss.backward()
+        ref = _grads(mlp)
+        ref_loss = float(loss)
+        for p in mlp.parameters():
+            p.grad = None
+
+        out = recompute(mlp, x)
+        loss2 = out.astype("float32").sum()
+        loss2.backward()
+        got = _grads(mlp)
+
+        assert abs(float(loss2) - ref_loss) < 1e-5
+        assert set(got) == set(ref)
+        for k in ref:
+            np.testing.assert_allclose(got[k], ref[k], rtol=1e-5, atol=1e-6)
+
+    def test_input_grad_flows(self):
+        paddle.seed(0)
+        mlp = SmallMLP()
+        x = paddle.to_tensor(
+            np.random.default_rng(1).standard_normal((4, 32), np.float32),
+            stop_gradient=False)
+        loss = recompute(mlp, x).sum()
+        loss.backward()
+        assert x.grad is not None
+        assert x.grad.shape == x.shape
+
+    def test_tuple_output(self):
+        paddle.seed(0)
+        lin = nn.Linear(8, 8)
+
+        def fn(a):
+            y = lin(a)
+            return y, y * 2
+
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        y1, y2 = recompute(fn, x)
+        (y1.sum() + y2.sum()).backward()
+        assert lin.weight.grad is not None
+
+    def test_policy_dots(self):
+        paddle.seed(0)
+        mlp = SmallMLP()
+        x = paddle.to_tensor(np.ones((2, 32), np.float32))
+        loss = recompute(mlp, x, policy="dots").sum()
+        loss.backward()
+        assert mlp.fc1.weight.grad is not None
+
+    def test_unknown_policy_raises(self):
+        mlp = SmallMLP()
+        x = paddle.to_tensor(np.ones((2, 32), np.float32))
+        with pytest.raises(ValueError):
+            recompute(mlp, x, policy="bogus")
+
+    def test_inside_trainstep(self):
+        """Recompute must compose with whole-step jit (the real use)."""
+        from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                             synthetic_lm_batch)
+        cfg = LlamaConfig.tiny()
+        cfg.recompute = True
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        ids, labels = synthetic_lm_batch(2, 64, cfg.vocab_size)
+        step = paddle.jit.TrainStep(
+            model, opt, loss_fn=lambda m, x, y: m(x, labels=y)[0])
+        l0 = float(step(ids, labels))
+        for _ in range(3):
+            l1 = float(step(ids, labels))
+        assert l1 < l0
+
+    def test_recompute_matches_plain_llama_loss(self):
+        """Same seed => identical loss with and without recompute (no
+        dropout in llama, so the RNG snapshot does not perturb parity)."""
+        from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                             synthetic_lm_batch)
+        losses = []
+        for rc in (False, True):
+            cfg = LlamaConfig.tiny()
+            cfg.recompute = rc
+            paddle.seed(7)
+            model = LlamaForCausalLM(cfg)
+            ids, labels = synthetic_lm_batch(2, 64, cfg.vocab_size)
+            loss = model(ids, labels=labels)[0]
+            loss.backward()
+            losses.append(float(loss))
+        assert abs(losses[0] - losses[1]) < 1e-5
+
+
+class TestGradAccumulation:
+    def test_k4_matches_k1(self):
+        """accumulate_steps=4 over one batch == one big-batch step."""
+        from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                             synthetic_lm_batch)
+        results = []
+        for k in (1, 4):
+            cfg = LlamaConfig.tiny()
+            paddle.seed(3)
+            model = LlamaForCausalLM(cfg)
+            opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                         parameters=model.parameters())
+            ids, labels = synthetic_lm_batch(8, 32, cfg.vocab_size)
+            step = paddle.jit.TrainStep(
+                model, opt, loss_fn=lambda m, x, y: m(x, labels=y)[0],
+                accumulate_steps=k)
+            losses = [float(step(ids, labels)) for _ in range(3)]
+            w = np.asarray(
+                model.model.layers[0].self_attn.q_proj.weight._value,
+                np.float32)
+            results.append((losses, w))
+        (l1, w1), (l4, w4) = results
+        np.testing.assert_allclose(l1, l4, rtol=2e-4)
+        np.testing.assert_allclose(w1, w4, rtol=2e-3, atol=1e-5)
+
+    def test_indivisible_batch_raises(self):
+        from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                             synthetic_lm_batch)
+        cfg = LlamaConfig.tiny()
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+        ids, labels = synthetic_lm_batch(3, 32, cfg.vocab_size)
+        step = paddle.jit.TrainStep(
+            model, opt, loss_fn=lambda m, x, y: m(x, labels=y)[0],
+            accumulate_steps=2)
+        with pytest.raises(ValueError):
+            step(ids, labels)
+
+
+class TestEnsureState:
+    """Optimizer-owned state creation replaces TrainStep's class-name
+    table: every optimizer must run compiled from step 0."""
+
+    @pytest.mark.parametrize("make_opt", [
+        lambda ps: paddle.optimizer.SGD(0.1, parameters=ps),
+        lambda ps: paddle.optimizer.Momentum(0.1, parameters=ps),
+        lambda ps: paddle.optimizer.Adam(parameters=ps),
+        lambda ps: paddle.optimizer.AdamW(parameters=ps),
+        lambda ps: paddle.optimizer.Adam(parameters=ps, amsgrad=True),
+        lambda ps: paddle.optimizer.Adamax(parameters=ps),
+        lambda ps: paddle.optimizer.Adagrad(0.1, parameters=ps),
+        lambda ps: paddle.optimizer.Adadelta(parameters=ps),
+        lambda ps: paddle.optimizer.RMSProp(0.01, parameters=ps),
+        lambda ps: paddle.optimizer.RMSProp(0.01, parameters=ps,
+                                            centered=True, momentum=0.9),
+        lambda ps: paddle.optimizer.Lamb(0.01, parameters=ps),
+    ])
+    def test_compiled_step_updates(self, make_opt):
+        paddle.seed(0)
+        mlp = SmallMLP(16)
+        opt = make_opt(mlp.parameters())
+        x = paddle.to_tensor(
+            np.random.default_rng(0).standard_normal((4, 16), np.float32))
+        y = paddle.to_tensor(
+            np.random.default_rng(1).standard_normal((4, 16), np.float32))
+        step = paddle.jit.TrainStep(
+            mlp, opt, loss_fn=lambda m, a, b: ((m(a) - b) ** 2).mean())
+        before = np.asarray(mlp.fc1.weight._value).copy()
+        l0 = float(step(x, y))
+        for _ in range(4):
+            l1 = float(step(x, y))
+        after = np.asarray(mlp.fc1.weight._value)
+        assert not np.allclose(before, after), "params never updated"
+        assert l1 < l0
+
+    def test_ensure_state_matches_lazy(self):
+        """ensure_state pre-creates exactly what _update_param would."""
+        paddle.seed(0)
+        mlp = SmallMLP(16)
+        opt = paddle.optimizer.AdamW(parameters=mlp.parameters(),
+                                     multi_precision=True)
+        mlp.to(dtype="bfloat16")
+        opt.ensure_state()
+        names = set(opt._accumulators)
+        assert names == {"moment1", "moment2"}
+        n_train = len([p for p in mlp.parameters() if not p.stop_gradient])
+        assert len(opt._accumulators["moment1"]) == n_train
+        assert len(opt._master_weights) == n_train

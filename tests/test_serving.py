@@ -1,0 +1,257 @@
+"""Serving path (L10): KV-cache generation, masked_multihead_attention,
+paged attention. ≙ SURVEY.md §1 L10 + §7 step 6; VERDICT r2 item 3."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nn import functional as F
+from paddle_tpu.ops.paged_attention import (PagedKVCache,
+                                            paged_attention_values)
+
+
+def _mha_oracle(q, k, v, seq_len):
+    """NumPy decode attention oracle: q (B,1,H,D), cache (B,T,HK,D)."""
+    b, s, h, d = q.shape
+    hk = k.shape[2]
+    g = h // hk
+    q = q.astype(np.float32).reshape(b, s, hk, g, d)
+    k = k.astype(np.float32)
+    v = v.astype(np.float32)
+    logits = np.einsum("bskgd,btkd->bkgst", q, k) / np.sqrt(d)
+    t = k.shape[1]
+    mask = np.arange(t)[None, :] <= (seq_len - s + np.arange(s))[:, None]
+    logits = np.where(mask[None, None, None], logits, -1e30)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bkgst,btkd->bskgd", p, v).reshape(b, s, h, d)
+
+
+class TestMaskedMHA:
+    @pytest.mark.parametrize("hk", [4, 2])
+    def test_matches_oracle(self, hk):
+        rng = np.random.default_rng(0)
+        b, t, h, d = 2, 32, 4, 16
+        q = rng.standard_normal((b, 1, h, d)).astype(np.float32)
+        k = rng.standard_normal((b, t, hk, d)).astype(np.float32)
+        v = rng.standard_normal((b, t, hk, d)).astype(np.float32)
+        seq_len = 20
+        out = F.masked_multihead_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            seq_len=seq_len)
+        ref = _mha_oracle(q, k, v, seq_len)
+        np.testing.assert_allclose(np.asarray(out._value), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_traced_seq_len(self):
+        rng = np.random.default_rng(1)
+        b, t, h, d = 1, 16, 2, 8
+        q = rng.standard_normal((b, 1, h, d)).astype(np.float32)
+        k = rng.standard_normal((b, t, h, d)).astype(np.float32)
+        v = rng.standard_normal((b, t, h, d)).astype(np.float32)
+
+        def fn(sl):
+            return F.masked_multihead_attention(
+                paddle.to_tensor(q), paddle.to_tensor(k),
+                paddle.to_tensor(v), seq_len=paddle.Tensor(sl))._value
+        out = jax.jit(fn)(jnp.int32(10))
+        ref = _mha_oracle(q, k, v, 10)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestPagedAttention:
+    def _setup(self, b=3, h=4, hk=2, d=16, page=8, pps=4, seed=0):
+        rng = np.random.default_rng(seed)
+        n_pages = b * pps + 2
+        q = rng.standard_normal((b, h, d)).astype(np.float32)
+        k_pages = rng.standard_normal((hk, n_pages, page, d)).astype(
+            np.float32)
+        v_pages = rng.standard_normal((hk, n_pages, page, d)).astype(
+            np.float32)
+        # distinct non-contiguous pages per sequence
+        perm = rng.permutation(n_pages)[:b * pps]
+        block_tables = perm.reshape(b, pps).astype(np.int32)
+        context_lens = rng.integers(1, page * pps + 1, (b,)).astype(
+            np.int32)
+        return q, k_pages, v_pages, context_lens, block_tables
+
+    def _oracle(self, q, k_pages, v_pages, context_lens, block_tables):
+        b, h, d = q.shape
+        hk, _, page, _ = k_pages.shape
+        pps = block_tables.shape[1]
+        outs = []
+        for i in range(b):
+            kc = k_pages[:, block_tables[i]].reshape(hk, pps * page, d)
+            vc = v_pages[:, block_tables[i]].reshape(hk, pps * page, d)
+            kc = np.swapaxes(kc, 0, 1)[None]   # (1, T, HK, D)
+            vc = np.swapaxes(vc, 0, 1)[None]
+            o = _mha_oracle(q[i][None, None], kc, vc,
+                            int(context_lens[i]))
+            outs.append(o[0, 0])
+        return np.stack(outs)
+
+    def test_matches_oracle(self):
+        args = self._setup()
+        out = paged_attention_values(*[jnp.asarray(a) for a in args])
+        ref = self._oracle(*args)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_gqa_and_min_context(self):
+        args = self._setup(b=2, h=8, hk=2, d=32, page=16, pps=2, seed=3)
+        q, kp, vp, cl, bt = args
+        cl = np.array([1, 32], np.int32)  # one-token and full contexts
+        out = paged_attention_values(jnp.asarray(q), jnp.asarray(kp),
+                                     jnp.asarray(vp), jnp.asarray(cl),
+                                     jnp.asarray(bt))
+        ref = self._oracle(q, kp, vp, cl, bt)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_cache_append(self):
+        b, hk, d, page = 2, 2, 8, 4
+        cache = PagedKVCache(hk, d, num_pages=8, page_size=page,
+                             dtype=jnp.float32)
+        bt = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+        k = jnp.ones((b, hk, d))
+        v = jnp.full((b, hk, d), 2.0)
+        cache = cache.append(k, v, bt, jnp.asarray([0, 5], jnp.int32))
+        # seq 0 pos 0 -> page 0 slot 0; seq 1 pos 5 -> page 3 slot 1
+        assert float(cache.k_pages[0, 0, 0, 0]) == 1.0
+        assert float(cache.v_pages[0, 3, 1, 0]) == 2.0
+        assert float(cache.k_pages[0, 0, 1, 0]) == 0.0
+
+
+class TestGenerate:
+    def _model(self, seed=0):
+        cfg = LlamaConfig.tiny()
+        paddle.seed(seed)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return cfg, m
+
+    def test_greedy_matches_eager_refeed(self):
+        """Greedy KV-cache decode == argmax over full re-forward each
+        step (the VERDICT 'greedy-decode parity test vs eager forward')."""
+        cfg, model = self._model()
+        ids = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 12)).astype(np.int32)
+        toks, scores = model.generate(paddle.to_tensor(ids),
+                                      max_new_tokens=6)
+        cur = ids.copy()
+        for _ in range(6):
+            logits = model(paddle.to_tensor(cur))
+            nxt = np.asarray(jnp.argmax(logits._value[:, -1], -1),
+                             np.int32)
+            cur = np.concatenate([cur, nxt[:, None]], 1)
+        np.testing.assert_array_equal(np.asarray(toks._value),
+                                      cur[:, 12:])
+        assert scores.shape == [2, 6]
+
+    def test_eos_padding(self):
+        cfg, model = self._model()
+        ids = np.random.default_rng(1).integers(
+            0, cfg.vocab_size, (1, 8)).astype(np.int32)
+        # find the first greedy token, use it as eos => all later = eos
+        toks, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=5)
+        first = int(np.asarray(toks._value)[0, 0])
+        toks2, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                                  eos_token_id=first)
+        got = np.asarray(toks2._value)[0]
+        assert got[0] == first
+        assert all(t == first for t in got[1:])
+
+    def test_sampling_reproducible_with_seed(self):
+        cfg, model = self._model()
+        ids = np.random.default_rng(2).integers(
+            0, cfg.vocab_size, (2, 8)).astype(np.int32)
+        paddle.seed(42)
+        a, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                              decode_strategy="sampling", top_k=20,
+                              temperature=0.9)
+        paddle.seed(42)
+        b, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                              decode_strategy="sampling", top_k=20,
+                              temperature=0.9)
+        np.testing.assert_array_equal(np.asarray(a._value),
+                                      np.asarray(b._value))
+
+    def test_top_p_keeps_top_token(self):
+        cfg, model = self._model()
+        ids = np.random.default_rng(3).integers(
+            0, cfg.vocab_size, (1, 8)).astype(np.int32)
+        # top_p -> 0 degenerates to greedy
+        greedy, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4)
+        samp, _ = model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                                 decode_strategy="sampling", top_p=1e-9)
+        np.testing.assert_array_equal(np.asarray(greedy._value),
+                                      np.asarray(samp._value))
+
+    def test_cache_overflow_raises(self):
+        cfg, model = self._model()
+        ids = np.zeros((1, 8), np.int32)
+        with pytest.raises(ValueError):
+            model.generate(paddle.to_tensor(ids), max_new_tokens=4,
+                           max_cache_len=10)
+
+    def test_chunked_prefill_matches_full(self):
+        """Two-chunk prefill through the cache == one-shot prefill
+        (exercises the end-aligned causal convention with offset > 0)."""
+        cfg, model = self._model()
+        rng = np.random.default_rng(4)
+        ids = rng.integers(0, cfg.vocab_size, (1, 16)).astype(np.int32)
+        hk, hd = cfg.num_key_value_heads, cfg.head_dim
+        n_l = cfg.num_hidden_layers
+        caches = [(paddle.to_tensor(np.zeros((1, 32, hk, hd), np.float32)),
+                   paddle.to_tensor(np.zeros((1, 32, hk, hd), np.float32)))
+                  for _ in range(n_l)]
+        with paddle.no_grad():
+            l1, caches = model(paddle.to_tensor(ids[:, :8]),
+                               past_key_values=caches, position_offset=0,
+                               use_cache=True)
+            l2, caches = model(paddle.to_tensor(ids[:, 8:]),
+                               past_key_values=caches, position_offset=8,
+                               use_cache=True)
+            full = model(paddle.to_tensor(ids))
+        np.testing.assert_allclose(
+            np.asarray(l2._value[:, -1]),
+            np.asarray(full._value[:, -1]), rtol=2e-3, atol=2e-3)
+
+
+class TestAttentionMaskWithCache:
+    def test_padding_mask_excludes_cached_positions(self):
+        """Left-padding written into the cache must get zero weight."""
+        rng = np.random.default_rng(9)
+        b, t, h, d = 2, 16, 2, 8
+        q = rng.standard_normal((b, 1, h, d)).astype(np.float32)
+        k = rng.standard_normal((b, t, h, d)).astype(np.float32)
+        v = rng.standard_normal((b, t, h, d)).astype(np.float32)
+        pad = np.ones((b, t), bool)
+        pad[0, :4] = False                       # seq 0: first 4 are pad
+        out_m = F.masked_multihead_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            seq_len=12, attn_mask=paddle.to_tensor(pad))
+        # reference: zero out padded keys by giving them -inf manually
+        k2 = k.copy()
+        ref = _mha_oracle(q, np.where(pad[:, :, None, None], k, -1e4),
+                          v, 12)
+        # cheaper check: masked positions have no influence — perturb them
+        k_pert = k.copy()
+        k_pert[0, :4] += 100.0
+        v_pert = v.copy()
+        v_pert[0, :4] += 100.0
+        out_p = F.masked_multihead_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k_pert),
+            paddle.to_tensor(v_pert), seq_len=12,
+            attn_mask=paddle.to_tensor(pad))
+        np.testing.assert_allclose(np.asarray(out_m._value),
+                                   np.asarray(out_p._value), atol=1e-6)
+        # and unmasked output differs from masked (mask has an effect)
+        out_nomask = F.masked_multihead_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k_pert),
+            paddle.to_tensor(v_pert), seq_len=12)
+        assert not np.allclose(np.asarray(out_m._value),
+                               np.asarray(out_nomask._value))

@@ -121,6 +121,38 @@ class TestRopeCompile:
         _compile(jax.grad(loss), x)
 
 
+class TestVarlenFlashCompile:
+    def test_fwd_bwd_packed_bench_shape(self):
+        from paddle_tpu.ops.flash_varlen import flash_attention_varlen_values
+
+        q = jnp.zeros((BENCH_B, BENCH_S, BENCH_H, BENCH_D), jnp.bfloat16)
+        k = jnp.zeros((BENCH_B, BENCH_S, BENCH_HK, BENCH_D), jnp.bfloat16)
+        seg = jnp.zeros((BENCH_B, BENCH_S), jnp.int32)
+
+        def loss(q, k, v):
+            return flash_attention_varlen_values(
+                q, k, v, seg, seg, causal=True).astype(jnp.float32).sum()
+
+        _compile(lambda q, k, v: flash_attention_varlen_values(
+            q, k, v, seg, seg, causal=True), q, k, k)
+        _compile(jax.grad(loss, argnums=(0, 1, 2)), q, k, k)
+
+
+class TestPagedAttentionCompile:
+    def test_decode_shape(self):
+        from paddle_tpu.ops.paged_attention import paged_attention_values
+
+        b, pages_per_seq, page = 8, 128, 16   # 2048-token contexts
+        q = jnp.zeros((b, BENCH_H, BENCH_D), jnp.bfloat16)
+        kp = jnp.zeros((BENCH_HK, b * pages_per_seq, page, BENCH_D),
+                       jnp.bfloat16)
+        bt = jnp.arange(b * pages_per_seq, dtype=jnp.int32).reshape(
+            b, pages_per_seq)
+        cl = jnp.full((b,), 2000, jnp.int32)
+        _compile(lambda q, kp, vp: paged_attention_values(
+            q, kp, vp, cl, bt), q, kp, kp)
+
+
 class TestGroupedMatmulCompile:
     def test_gmm_bench_shape(self):
         from paddle_tpu.ops.grouped_matmul import gmm_pallas
