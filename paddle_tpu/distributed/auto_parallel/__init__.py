@@ -144,17 +144,26 @@ class Engine:
                 out0 = out[0] if isinstance(out, (tuple, list)) else out
                 return self._loss(out0, y)
 
-            self._step = paddle.jit.TrainStep(self._model, self._optimizer,
-                                              loss_fn=loss_fn)
+            self._step = paddle.jit.TrainStep(
+                self._model, self._optimizer, loss_fn=loss_fn,
+                accumulate_steps=self._strategy.pipeline.accumulate_steps
+                if self._strategy.pipeline.enable else 1)
         return self._step
 
     def fit(self, train_data, epochs=1, batch_size=1, steps_per_epoch=None,
             valid_data=None, log_freq=10, verbose=1):
         from ...io import DataLoader, Dataset
-        if isinstance(train_data, Dataset):
-            train_data = DataLoader(train_data, batch_size=batch_size,
-                                    shuffle=True)
         mesh = get_mesh()
+        if isinstance(train_data, Dataset):
+            # a ragged tail batch cannot be Shard(0) over the dp axis —
+            # drop it when running on a mesh
+            train_data = DataLoader(train_data, batch_size=batch_size,
+                                    shuffle=True,
+                                    drop_last=mesh is not None)
+        if mesh is not None:
+            # mesh-aware input sharding: batches arrive Shard(0) over the
+            # data axis (≙ the reference Engine's dataloader sharding)
+            train_data = shard_dataloader(train_data, mesh)
         history = []
         step_fn = self._ensure()
         for epoch in range(epochs):

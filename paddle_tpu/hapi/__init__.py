@@ -24,6 +24,15 @@ __all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
            "VisualDL", "summary"]
 
 
+def _cat_batches(items):
+    """Concatenate loader batches (numpy arrays or Tensors) along dim 0."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    arrs = [np.asarray(it._value) if isinstance(it, Tensor)
+            else np.asarray(it) for it in items]
+    return np.concatenate(arrs, axis=0)
+
+
 def _to_list(x):
     if x is None:
         return []
@@ -75,8 +84,9 @@ class Model:
                 out0 = out[0] if isinstance(out, (tuple, list)) else out
                 return self._loss(out0, y), out0
 
-            self._train_step = TrainStep(self.network, self._optimizer,
-                                         loss_fn=loss_fn)
+            self._train_step = TrainStep(
+                self.network, self._optimizer, loss_fn=loss_fn,
+                accumulate_steps=getattr(self, "_accumulate_steps", 1))
         return self._train_step
 
     def train_batch(self, inputs, labels=None):
@@ -133,9 +143,19 @@ class Model:
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None):
         from ..io import DataLoader, Dataset
+        accum = max(int(accumulate_grad_batches), 1)
+        if accum != getattr(self, "_accumulate_steps", 1):
+            # gradient-merge ≙ fleet meta-optimizer (SURVEY.md §2.4),
+            # Paddle semantics: N loader batches merge into ONE optimizer
+            # step (effective batch = N x batch_size). The N batches are
+            # concatenated and the compiled TrainStep micro-batches them
+            # back internally, so peak activation memory stays one batch.
+            self._accumulate_steps = accum
+            self._train_step = None
         if isinstance(train_data, Dataset):
             train_data = DataLoader(train_data, batch_size=batch_size,
-                                    shuffle=shuffle, drop_last=drop_last,
+                                    shuffle=shuffle,
+                                    drop_last=drop_last or accum > 1,
                                     num_workers=num_workers)
         if isinstance(eval_data, Dataset):
             eval_data = DataLoader(eval_data, batch_size=batch_size,
@@ -156,9 +176,20 @@ class Model:
                 m.reset()
             cbs.on_epoch_begin(epoch)
             logs = {}
+            buf = []
             for step, batch in enumerate(train_data):
                 cbs.on_train_batch_begin(step)
                 xs, ys = self._unpack(batch)
+                if accum > 1:
+                    buf.append((xs, ys))
+                    if len(buf) < accum:
+                        cbs.on_train_batch_end(step, logs)
+                        continue
+                    xs = [_cat_batches([b[0][i] for b in buf])
+                          for i in range(len(xs))]
+                    ys = [_cat_batches([b[1][i] for b in buf])
+                          for i in range(len(ys))]
+                    buf = []
                 losses, metrics = self.train_batch(xs, ys)
                 logs = {"loss": losses[0]}
                 logs.update(metrics)
@@ -166,6 +197,8 @@ class Model:
                 it_count += 1
                 if num_iters is not None and it_count >= num_iters:
                     break
+            # a partial accumulation window at epoch end is dropped
+            # (gradient-merge convention; matches drop_last)
             cbs.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_data, verbose=0,

@@ -320,3 +320,76 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
         v = v.reshape(n, h, w, groups, c // groups)
         return v.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
     return apply("channel_shuffle", fn, (_t(x),))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """≙ paddle.nn.functional.affine_grid [U]: 2-D affine sampling grids.
+    theta: (N, 2, 3); out_shape: [N, C, H, W] -> grid (N, H, W, 2) in
+    normalized [-1, 1] coordinates (x, y)."""
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def fn(th):
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, w)
+            ys = jnp.linspace(-1.0, 1.0, h)
+        else:
+            xs = (jnp.arange(w) * 2 + 1) / w - 1.0
+            ys = (jnp.arange(h) * 2 + 1) / h - 1.0
+        gx, gy = jnp.meshgrid(xs, ys)               # (H, W)
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)   # (H, W, 3)
+        # (N,2,3) @ (H,W,3) -> (N,H,W,2)
+        return jnp.einsum("nij,hwj->nhwi", th.astype(jnp.float32), base)
+    return apply("affine_grid", fn, (_t(theta),))
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """≙ paddle.nn.functional.grid_sample [U]: sample x (N, C, H, W) at
+    normalized grid (N, Hg, Wg, 2) locations ((x, y) in [-1, 1]).
+    Supported: mode bilinear|nearest, padding_mode zeros|border."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample: unsupported mode {mode!r} "
+                         "(bilinear | nearest)")
+    if padding_mode not in ("zeros", "border"):
+        raise ValueError(f"grid_sample: unsupported padding_mode "
+                         f"{padding_mode!r} (zeros | border)")
+
+    def fn(v, g):
+        nb, c, h, w = v.shape
+        gx = g[..., 0].astype(jnp.float32)
+        gy = g[..., 1].astype(jnp.float32)
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def fetch(ix, iy):
+            # gather with border clamp; zeros mode masks after
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            out = v[jnp.arange(nb)[:, None, None, None],
+                    jnp.arange(c)[None, :, None, None],
+                    iyc[:, None], ixc[:, None]]      # (N, C, Hg, Wg)
+            if padding_mode == "zeros":
+                inside = ((ix >= 0) & (ix <= w - 1)
+                          & (iy >= 0) & (iy <= h - 1))
+                out = out * inside[:, None]
+            return out
+
+        if mode == "nearest":
+            return fetch(jnp.round(fx).astype(jnp.int32),
+                         jnp.round(fy).astype(jnp.int32)).astype(v.dtype)
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = fx - x0
+        wy = fy - y0
+        out = (fetch(x0, y0) * ((1 - wx) * (1 - wy))[:, None]
+               + fetch(x1, y0) * (wx * (1 - wy))[:, None]
+               + fetch(x0, y1) * ((1 - wx) * wy)[:, None]
+               + fetch(x1, y1) * (wx * wy)[:, None])
+        return out.astype(v.dtype)
+    return apply("grid_sample", fn, (_t(x), _t(grid)))

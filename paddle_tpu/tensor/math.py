@@ -477,3 +477,37 @@ def frexp(x, name=None):
 
 def signbit(x, name=None):
     return apply("signbit", jnp.signbit, (_t(x),))
+
+
+def sgn(x, name=None):
+    """≙ paddle.sgn: sign for real, unit-phase for complex [U]."""
+    def fn(v):
+        if jnp.iscomplexobj(v):
+            mag = jnp.abs(v)
+            return jnp.where(mag == 0, 0.0 + 0.0j, v / mag)
+        return jnp.sign(v)
+    return apply("sgn", fn, (_t(x),))
+
+
+def sinc(x, name=None):
+    """≙ paddle.sinc (normalized sinc) [U]."""
+    return apply("sinc", jnp.sinc, (_t(x),))
+
+
+def inverse(x, name=None):
+    """≙ paddle.inverse — alias of linalg.inv over batched matrices [U]."""
+    return apply("inverse", jnp.linalg.inv, (_t(x),))
+
+
+def pdist(x, p=2.0, name=None):
+    """≙ paddle.pdist: condensed pairwise distances of (N, D) rows [U]."""
+    def fn(v):
+        n = v.shape[0]
+        iu, ju = jnp.triu_indices(n, k=1)
+        d = v[iu] - v[ju]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(d * d, axis=-1))
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d), axis=-1)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    return apply("pdist", fn, (_t(x),))

@@ -237,3 +237,52 @@ class TestQuantization:
             onet(x)  # calibration
         qnet = ptq.convert(onet)
         np.testing.assert_allclose(qnet(x).numpy(), ref, atol=0.1)
+
+
+class TestRound3Ops:
+    """Ops added in round 3 (op-surface growth, VERDICT r2 item 9):
+    parity vs torch/numpy oracles."""
+
+    def test_sgn_sinc_inverse_pdist(self):
+        import torch.nn.functional as TF
+        import torch
+        a = np.random.default_rng(1).standard_normal((4, 4)).astype(
+            np.float32)
+        np.testing.assert_allclose(
+            np.asarray(paddle.inverse(paddle.to_tensor(a))._value),
+            np.linalg.inv(a), atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(paddle.sinc(paddle.to_tensor(a))._value),
+            np.sinc(a), atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(paddle.sgn(paddle.to_tensor(a))._value),
+            np.sign(a))
+        pts = np.random.default_rng(2).standard_normal((5, 3)).astype(
+            np.float32)
+        np.testing.assert_allclose(
+            np.asarray(paddle.pdist(paddle.to_tensor(pts))._value),
+            TF.pdist(torch.tensor(pts)).numpy(), atol=1e-5)
+
+    @pytest.mark.parametrize("align_corners", [True, False])
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    def test_grid_sample_affine_grid_vs_torch(self, align_corners, mode):
+        import torch
+        import torch.nn.functional as TF
+        from paddle_tpu.nn import functional as F
+        x = np.random.default_rng(0).standard_normal(
+            (2, 3, 8, 8)).astype(np.float32)
+        theta = np.asarray(
+            [[[1.0, 0.1, 0.2], [0.0, 0.9, -0.1]]] * 2, np.float32)
+        g_ref = TF.affine_grid(torch.tensor(theta), (2, 3, 6, 6),
+                               align_corners=align_corners).numpy()
+        g_got = np.asarray(F.affine_grid(
+            paddle.to_tensor(theta), [2, 3, 6, 6],
+            align_corners=align_corners)._value)
+        np.testing.assert_allclose(g_got, g_ref, atol=1e-5)
+        o_ref = TF.grid_sample(torch.tensor(x), torch.tensor(g_ref),
+                               mode=mode, padding_mode="zeros",
+                               align_corners=align_corners).numpy()
+        o_got = np.asarray(F.grid_sample(
+            paddle.to_tensor(x), paddle.to_tensor(g_ref), mode=mode,
+            align_corners=align_corners)._value)
+        np.testing.assert_allclose(o_got, o_ref, atol=1e-4)

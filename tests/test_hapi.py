@@ -89,3 +89,65 @@ class TestModelFit:
         assert len(outs) == 2
         info = model.summary()
         assert info["total_params"] == 8 * 32 + 32 + 32 * 2 + 2
+
+
+class TestAccumulateGradBatches:
+    def test_fit_with_accumulation(self):
+        """accumulate_grad_batches = Paddle gradient-merge: N loader
+        batches merge into ONE optimizer step (was silently ignored
+        before round 3). Ragged datasets must not crash (tail drops)."""
+        from paddle_tpu.io import Dataset
+
+        class DS(Dataset):
+            def __init__(self):
+                r = np.random.default_rng(0)
+                self.x = r.normal(size=(32, 8)).astype(np.float32)
+                w = r.normal(size=(8, 1)).astype(np.float32)
+                self.y = self.x @ w
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+            def __len__(self):
+                return 32
+
+        paddle.seed(0)
+        net = nn.Linear(8, 1)
+        model = Model(net)
+        model.prepare(optimizer=paddle.optimizer.Adam(
+            learning_rate=0.05, parameters=net.parameters()),
+            loss=nn.MSELoss())
+        model.fit(DS(), batch_size=8, epochs=25, verbose=0,
+                  accumulate_grad_batches=2)
+        assert model._train_step.accumulate_steps == 2
+        # one optimizer step per 2 loader batches => 32/8/2 = 2 steps/epoch
+        assert model._optimizer._step_count == 25 * 2
+        res = model.evaluate(DS(), batch_size=8, verbose=0)
+        assert res["loss"] < 1.0
+
+    def test_fit_accumulation_ragged_dataset(self):
+        """30 samples, batch 8, accum 2: ragged tail dropped, no crash."""
+        from paddle_tpu.io import Dataset
+
+        class DS(Dataset):
+            def __init__(self):
+                r = np.random.default_rng(1)
+                self.x = r.normal(size=(30, 8)).astype(np.float32)
+                self.y = r.normal(size=(30, 1)).astype(np.float32)
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+            def __len__(self):
+                return 30
+
+        paddle.seed(0)
+        net = nn.Linear(8, 1)
+        model = Model(net)
+        model.prepare(optimizer=paddle.optimizer.Adam(
+            learning_rate=0.01, parameters=net.parameters()),
+            loss=nn.MSELoss())
+        model.fit(DS(), batch_size=8, epochs=2, verbose=0,
+                  accumulate_grad_batches=2)
+        # 30 // 8 = 3 full batches -> 1 merged step per epoch
+        assert model._optimizer._step_count == 2
