@@ -40,6 +40,7 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
+from . import audio  # noqa: F401
 from . import vision  # noqa: F401
 
 from .device import (get_device, set_device, is_compiled_with_cuda,  # noqa: F401

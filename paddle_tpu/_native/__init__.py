@@ -17,7 +17,15 @@ import threading
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "..", "..", "csrc", "native.cc")
+# development source of truth is the repo-root csrc/; an installed wheel
+# only has the package-data copy (paddle_tpu/_native/csrc/, kept in sync
+# by tests/test_native.py)
+_SRC_CANDIDATES = (
+    os.path.join(_HERE, "..", "..", "csrc", "native.cc"),
+    os.path.join(_HERE, "csrc", "native.cc"),
+)
+_SRC = next((p for p in _SRC_CANDIDATES if os.path.exists(p)),
+            _SRC_CANDIDATES[0])
 _LIB_PATH = os.path.join(_HERE, "libpaddle_tpu_native.so")
 
 _lib = None

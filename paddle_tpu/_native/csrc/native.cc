@@ -1,0 +1,316 @@
+// paddle_tpu native runtime pieces (C ABI, bound from Python via ctypes).
+//
+// ≙ reference native components this replaces (SURVEY.md §2.1):
+//  * shm ring  — the DataLoader's shared-memory tensor transport
+//                («python/paddle/io/» multiprocess workers + C++ shm
+//                LoDTensor channel [U]): a multi-producer single-consumer
+//                byte ring in POSIX shared memory, process-shared mutex +
+//                condvars, variable-length records.
+//  * codec     — the tensor serialization codec behind paddle.save
+//                («python/paddle/framework/io.py» + C++ SaveLoadTensor
+//                [U]): header(magic, dtype, ndim, shape) + raw payload +
+//                crc32, written/parsed natively.
+//
+// Build: g++ -O2 -shared -fPIC -pthread (see paddle_tpu/_native).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// shm ring
+// ---------------------------------------------------------------------------
+struct RingHeader {
+  uint64_t capacity;   // payload bytes available
+  uint64_t head;       // write offset (mod capacity)
+  uint64_t tail;       // read offset (mod capacity)
+  uint64_t used;       // bytes in flight
+  pthread_mutex_t mu;
+  pthread_cond_t not_empty;
+  pthread_cond_t not_full;
+};
+
+struct Ring {
+  RingHeader* h;
+  uint8_t* data;
+  uint64_t map_len;
+  char name[256];
+  int owner;
+};
+
+static void ring_now(struct timespec* ts, int timeout_ms) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += (long)(timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+void* ring_create(const char* name, uint64_t capacity) {
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t map_len = sizeof(RingHeader) + capacity;
+  if (ftruncate(fd, (off_t)map_len) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  RingHeader* h = (RingHeader*)mem;
+  h->capacity = capacity;
+  h->head = h->tail = h->used = 0;
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
+  pthread_mutex_init(&h->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&h->not_empty, &ca);
+  pthread_cond_init(&h->not_full, &ca);
+  Ring* r = new Ring();
+  r->h = h;
+  r->data = (uint8_t*)mem + sizeof(RingHeader);
+  r->map_len = map_len;
+  snprintf(r->name, sizeof(r->name), "%s", name);
+  r->owner = 1;
+  return r;
+}
+
+void* ring_attach(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Ring* r = new Ring();
+  r->h = (RingHeader*)mem;
+  r->data = (uint8_t*)mem + sizeof(RingHeader);
+  r->map_len = (uint64_t)st.st_size;
+  snprintf(r->name, sizeof(r->name), "%s", name);
+  r->owner = 0;
+  return r;
+}
+
+static int ring_lock(RingHeader* h) {
+  int rc = pthread_mutex_lock(&h->mu);
+  if (rc == EOWNERDEAD) {  // a worker died holding the lock
+    pthread_mutex_consistent(&h->mu);
+    return 0;
+  }
+  return rc;
+}
+
+static void ring_copy_in(Ring* r, const uint8_t* src, uint64_t len) {
+  RingHeader* h = r->h;
+  uint64_t off = h->head % h->capacity;
+  uint64_t first = len < h->capacity - off ? len : h->capacity - off;
+  memcpy(r->data + off, src, first);
+  if (len > first) memcpy(r->data, src + first, len - first);
+  h->head += len;
+}
+
+static void ring_copy_out(Ring* r, uint8_t* dst, uint64_t len) {
+  RingHeader* h = r->h;
+  uint64_t off = h->tail % h->capacity;
+  uint64_t first = len < h->capacity - off ? len : h->capacity - off;
+  memcpy(dst, r->data + off, first);
+  if (len > first) memcpy(dst + first, r->data, len - first);
+  h->tail += len;
+}
+
+// push one [len u64][payload] record; blocks until space or timeout.
+// returns 0 ok, -1 timeout/error, -2 record larger than capacity.
+int ring_push(void* ring, const void* buf, uint64_t len, int timeout_ms) {
+  Ring* r = (Ring*)ring;
+  RingHeader* h = r->h;
+  uint64_t need = len + 8;
+  if (need > h->capacity) return -2;
+  struct timespec ts;
+  ring_now(&ts, timeout_ms);
+  if (ring_lock(h) != 0) return -1;
+  while (h->capacity - h->used < need) {
+    if (pthread_cond_timedwait(&h->not_full, &h->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  ring_copy_in(r, (const uint8_t*)&len, 8);
+  ring_copy_in(r, (const uint8_t*)buf, len);
+  h->used += need;
+  pthread_cond_signal(&h->not_empty);
+  pthread_mutex_unlock(&h->mu);
+  return 0;
+}
+
+// peek next record length; blocks until a record arrives or timeout.
+// returns length, or -1 on timeout.
+int64_t ring_next_len(void* ring, int timeout_ms) {
+  Ring* r = (Ring*)ring;
+  RingHeader* h = r->h;
+  struct timespec ts;
+  ring_now(&ts, timeout_ms);
+  if (ring_lock(h) != 0) return -1;
+  while (h->used < 8) {
+    if (pthread_cond_timedwait(&h->not_empty, &h->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  uint64_t len;
+  uint64_t off = h->tail % h->capacity;
+  uint64_t first = 8 < h->capacity - off ? 8 : h->capacity - off;
+  memcpy(&len, r->data + off, first);
+  if (first < 8)
+    memcpy((uint8_t*)&len + first, r->data, 8 - first);
+  pthread_mutex_unlock(&h->mu);
+  return (int64_t)len;
+}
+
+// pop one record into out (must hold >= max bytes); returns payload length
+// or -1 timeout or -3 if record larger than max (record is dropped).
+int64_t ring_pop(void* ring, void* out, uint64_t max, int timeout_ms) {
+  Ring* r = (Ring*)ring;
+  RingHeader* h = r->h;
+  struct timespec ts;
+  ring_now(&ts, timeout_ms);
+  if (ring_lock(h) != 0) return -1;
+  while (h->used < 8) {
+    if (pthread_cond_timedwait(&h->not_empty, &h->mu, &ts) == ETIMEDOUT) {
+      pthread_mutex_unlock(&h->mu);
+      return -1;
+    }
+  }
+  uint64_t len;
+  ring_copy_out(r, (uint8_t*)&len, 8);
+  int64_t ret;
+  if (len > max) {  // drop
+    h->tail += len;
+    ret = -3;
+  } else {
+    ring_copy_out(r, (uint8_t*)out, len);
+    ret = (int64_t)len;
+  }
+  h->used -= len + 8;
+  pthread_cond_signal(&h->not_full);
+  pthread_mutex_unlock(&h->mu);
+  return ret;
+}
+
+void ring_close(void* ring, int unlink_shm) {
+  Ring* r = (Ring*)ring;
+  munmap((void*)r->h, r->map_len);
+  if (unlink_shm) shm_unlink(r->name);
+  delete r;
+}
+
+// ---------------------------------------------------------------------------
+// tensor codec: [magic u32][crc u32][dtype u8[16]][ndim u32][shape i64*ndim]
+//               [payload]
+// The dtype field is 16 bytes (15 chars + NUL) so the longest NumPy dtype
+// names in play — "bfloat16" (this framework's default training dtype),
+// "complex128", "float128" — round-trip without truncation. v1 used 8
+// bytes and silently corrupted them; the magic was bumped so v1 blobs are
+// rejected instead of mis-decoded.
+// ---------------------------------------------------------------------------
+static const uint32_t kMagic = 0x32445054;  // "PTD2"
+static const int kDtypeField = 16;
+
+static uint32_t crc32_update(uint32_t crc, const uint8_t* p, uint64_t n) {
+  static uint32_t table[256];
+  static std::atomic<int> init{0};
+  if (!init.load(std::memory_order_acquire)) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init.store(1, std::memory_order_release);
+  }
+  crc = ~crc;
+  for (uint64_t i = 0; i < n; i++)
+    crc = table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+uint64_t codec_header_size(int ndim) {
+  return 4 + 4 + kDtypeField + 4 + 8ull * ndim;
+}
+
+// encode into out (caller sizes it via codec_header_size + data_len).
+// returns total bytes written, or 0 if the dtype name does not fit the
+// header field (caller must fall back to another serialization path).
+uint64_t codec_encode(const void* data, uint64_t data_len, const char* dtype,
+                      const int64_t* shape, int ndim, void* out) {
+  if (strlen(dtype) >= (size_t)kDtypeField) return 0;
+  uint8_t* p = (uint8_t*)out;
+  memcpy(p, &kMagic, 4);
+  uint32_t crc = crc32_update(0, (const uint8_t*)data, data_len);
+  memcpy(p + 4, &crc, 4);
+  char dt[kDtypeField] = {0};
+  strncpy(dt, dtype, kDtypeField - 1);
+  memcpy(p + 8, dt, kDtypeField);
+  uint32_t nd = (uint32_t)ndim;
+  memcpy(p + 8 + kDtypeField, &nd, 4);
+  memcpy(p + 12 + kDtypeField, shape, 8ull * ndim);
+  memcpy(p + 12 + kDtypeField + 8ull * ndim, data, data_len);
+  return codec_header_size(ndim) + data_len;
+}
+
+// parse header: fills dtype (>=16 bytes), shape (>=8 i64s), ndim; returns
+// payload offset, or 0 on bad magic, or -1 (as u64 max) on crc mismatch
+// when verify != 0.
+uint64_t codec_decode(const void* buf, uint64_t len, char* dtype_out,
+                      int64_t* shape_out, int* ndim_out, int verify) {
+  const uint8_t* p = (const uint8_t*)buf;
+  const uint64_t fixed = 12 + kDtypeField;
+  if (len < fixed) return 0;
+  uint32_t magic;
+  memcpy(&magic, p, 4);
+  if (magic != kMagic) return 0;
+  memcpy(dtype_out, p + 8, kDtypeField);
+  uint32_t nd;
+  memcpy(&nd, p + 8 + kDtypeField, 4);
+  if (nd > 8 || len < fixed + 8ull * nd) return 0;
+  memcpy(shape_out, p + 12 + kDtypeField, 8ull * nd);
+  *ndim_out = (int)nd;
+  uint64_t off = fixed + 8ull * nd;
+  if (verify) {
+    uint32_t crc_stored, crc;
+    memcpy(&crc_stored, p + 4, 4);
+    crc = crc32_update(0, p + off, len - off);
+    if (crc != crc_stored) return (uint64_t)-1;
+  }
+  return off;
+}
+
+uint32_t codec_crc32(const void* data, uint64_t len) {
+  return crc32_update(0, (const uint8_t*)data, len);
+}
+
+}  // extern "C"

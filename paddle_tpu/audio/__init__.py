@@ -1,0 +1,10 @@
+"""paddle_tpu.audio — audio feature extraction.
+
+≙ reference «python/paddle/audio/» (features: Spectrogram, MelSpectrogram,
+LogMelSpectrogram, MFCC; functional: window/mel helpers) [U]. Built on the
+framework's own stft (paddle_tpu.signal) so the whole pipeline jits —
+feature extraction can run on-device inside the train step instead of the
+CPU data loader.
+"""
+from . import features  # noqa: F401
+from . import functional  # noqa: F401

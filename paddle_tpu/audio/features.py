@@ -1,0 +1,96 @@
+"""audio.features — Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC
+layers. ≙ reference «python/paddle/audio/features/layers.py» [U]. Each is an
+nn.Layer whose forward jits (stft is the framework's own)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..nn.layer.layers import Layer
+from .. import signal as _signal
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer("window",
+                             AF.get_window(window, self.win_length),
+                             persistable=False)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length,
+                            self.win_length, self.window,
+                            center=self.center, pad_mode=self.pad_mode)
+        p = self.power
+
+        def fn(s):
+            mag = jnp.abs(s)
+            return mag if p == 1.0 else mag ** p
+        return apply("spec_power", fn, (spec,))
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, n_mels=64,
+                 f_min=50.0, f_max=None, htk=False, norm="slaney"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power)
+        fb = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                     htk, norm)
+        from ..core.tensor import to_tensor
+        self.register_buffer("fbank", to_tensor(fb), persistable=False)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)      # (..., freq, time)
+
+        def fn(s, fb):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+        return apply("mel", fn, (spec, self.fbank))
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, n_mels=64,
+                 f_min=50.0, f_max=None, ref_value=1.0, amin=1e-10,
+                 top_db=None):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                  window, power, n_mels, f_min, f_max)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 n_mels=64, f_min=50.0, f_max=None, top_db=None):
+        super().__init__()
+        self.logmel = LogMelSpectrogram(sr, n_fft, hop_length, None,
+                                        "hann", 2.0, n_mels, f_min, f_max,
+                                        top_db=top_db)
+        from ..core.tensor import to_tensor
+        self.register_buffer(
+            "dct", to_tensor(AF.create_dct(n_mfcc, n_mels)),
+            persistable=False)
+
+    def forward(self, x):
+        lm = self.logmel(x)             # (..., n_mels, time)
+
+        def fn(v, d):
+            return jnp.einsum("mk,...mt->...kt", d, v)
+        return apply("mfcc", fn, (lm, self.dct))

@@ -1,0 +1,120 @@
+"""audio.functional — mel filterbanks, dct, window helpers.
+≙ reference «python/paddle/audio/functional/» [U]."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, to_tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "create_dct", "power_to_db",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Scalar/array Hz -> mel (Slaney by default, HTK optional)."""
+    f = np.asarray(freq, np.float64)
+    if htk:
+        out = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, out)
+    return out.item() if np.isscalar(freq) or out.ndim == 0 else out
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = np.asarray(mel, np.float64)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        out = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = np.where(m >= min_log_mel,
+                       min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                       out)
+    return out.item() if np.isscalar(mel) or out.ndim == 0 else out
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                       n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return np.linspace(0, sr / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """(n_mels, 1 + n_fft//2) triangular mel filterbank."""
+    f_max = f_max or sr / 2
+    fft_f = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    fb = np.maximum(0.0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        fb *= enorm[:, None]
+    return fb.astype(np.float32)
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """(n_mels, n_mfcc) DCT-II basis."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[None, :]
+    basis = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(2)
+        basis *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return basis.astype(np.float32)
+
+
+def power_to_db(x, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """10*log10(x/ref) with floor; Tensor in, Tensor out."""
+    t = x if isinstance(x, Tensor) else to_tensor(x)
+
+    def fn(v):
+        db = 10.0 * jnp.log10(jnp.maximum(v, amin))
+        db = db - 10.0 * jnp.log10(jnp.maximum(jnp.asarray(ref_value),
+                                               amin))
+        if top_db is not None:
+            db = jnp.maximum(db, jnp.max(db) - top_db)
+        return db
+    return apply("power_to_db", fn, (t,))
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True):
+    """hann/hamming/blackman/ones as a Tensor."""
+    n = win_length
+    m = n if fftbins else n - 1
+    x = np.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * x / m)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * x / m)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * x / m)
+             + 0.08 * np.cos(4 * np.pi * x / m))
+    elif window in ("ones", "rect", "boxcar"):
+        w = np.ones(n)
+    else:
+        raise ValueError(f"unknown window {window!r}")
+    return to_tensor(w.astype(np.float32))

@@ -61,22 +61,47 @@ def _child_env(args):
     return env
 
 
+def _run_logged(cmd, env, log_path):
+    """Run cmd streaming combined stdout/stderr to BOTH the console and
+    `log_path` (≙ the reference launcher's per-rank log capture,
+    «.../launch/job/container.py» [U])."""
+    if log_path is None:
+        return subprocess.run(cmd, env=env).returncode
+    with open(log_path, "ab") as f:
+        proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+        for line in proc.stdout:
+            sys.stdout.buffer.write(line)
+            sys.stdout.buffer.flush()
+            f.write(line)
+            f.flush()
+        return proc.wait()
+
+
 def launch(args):
     env = _child_env(args)
+    log_path = None
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+        log_path = os.path.join(
+            args.log_dir, f"{args.job_id}.rank{args.rank}.log")
     attempt = 0
     while True:
         t0 = time.time()
-        proc = subprocess.run(
-            [sys.executable, args.script, *args.script_args], env=env)
-        if proc.returncode == 0:
+        rc = _run_logged([sys.executable, args.script, *args.script_args],
+                         env, log_path)
+        if rc == 0:
             return 0
         attempt += 1
         if args.elastic_level <= 0 or attempt > args.max_restart:
-            return proc.returncode
-        print(f"[launch] script exited {proc.returncode} after "
-              f"{time.time() - t0:.0f}s — restart {attempt}/"
-              f"{args.max_restart} (elastic checkpoint-restart)",
-              file=sys.stderr)
+            return rc
+        msg = (f"[launch] script exited {rc} after "
+               f"{time.time() - t0:.0f}s — restart {attempt}/"
+               f"{args.max_restart} (elastic checkpoint-restart)")
+        print(msg, file=sys.stderr)
+        if log_path:
+            with open(log_path, "a") as f:
+                f.write(msg + "\n")
 
 
 def main(argv=None):

@@ -1,9 +1,33 @@
 """≙ paddle.incubate.nn.functional fused ops [U] — aliases over the
-Pallas kernel library (paddle_tpu.ops)."""
+Pallas kernel library (paddle_tpu.ops) plus compositions XLA fuses."""
 from ....ops.flash_attention import flash_attention  # noqa: F401
+from ....ops.flash_varlen import flash_attention_varlen  # noqa: F401
+from ....ops.paged_attention import paged_attention  # noqa: F401
 from ....ops.rope import fused_rotary_position_embedding  # noqa: F401
 from ....ops.norm_kernels import rms_norm as fused_rms_norm  # noqa: F401
 from ....ops.norm_kernels import layer_norm as fused_layer_norm  # noqa: F401
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """≙ paddle.incubate.nn.functional.fused_bias_dropout_residual_layer_norm
+    [U]: LayerNorm(residual + dropout(x + bias)). The reference fuses this
+    as one CUDA kernel; under XLA the composition fuses into the
+    surrounding matmuls, and the LayerNorm core is the Pallas kernel via
+    nn.functional.layer_norm.
+    """
+    from ....nn import functional as F
+    if bias is not None:
+        x = x + bias
+    if dropout_rate:
+        # F.dropout owns the training/inference behavior per `mode`
+        # (downscale_in_infer scales by (1-p) at inference)
+        x = F.dropout(x, p=dropout_rate, training=training, mode=mode)
+    y = residual + x
+    return F.layer_norm(y, y.shape[-1:], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
 
 
 def fused_multi_head_attention(x, qkv_weight, linear_weight,

@@ -211,3 +211,134 @@ def synthetic_dit_batch(batch_size, cfg: DiTConfig, seed=0):
     t = rng.integers(0, 1000, (batch_size,)).astype(np.int32)
     y = rng.integers(0, cfg.num_classes, (batch_size,)).astype(np.int32)
     return (paddle.to_tensor(x), paddle.to_tensor(t), paddle.to_tensor(y))
+
+
+class GaussianDiffusion:
+    """Diffusion training loss + DDIM sampler for DiT.
+
+    ≙ the reference DiT/SD3 recipe's diffusion utilities (north-star
+    config #4, BASELINE.md; the reference keeps them in the model zoo).
+    TPU-first: the WHOLE sampler is one `lax.scan` over timesteps inside
+    one compiled XLA program — no per-step Python dispatch; the model's
+    eager layers trace cleanly inside the scan (same mechanism as
+    models/generation.py).
+    """
+
+    def __init__(self, num_timesteps: int = 1000, beta_start: float = 1e-4,
+                 beta_end: float = 0.02):
+        self.num_timesteps = num_timesteps
+        betas = np.linspace(beta_start, beta_end, num_timesteps,
+                            dtype=np.float64)
+        alphas = 1.0 - betas
+        self.alphas_cumprod = np.cumprod(alphas).astype(np.float32)
+
+    def training_loss(self, model: DiT, x0, t, y, noise=None):
+        """MSE between predicted and true noise at timesteps t."""
+        from paddle_tpu.core.tensor import Tensor, apply
+        import jax.numpy as jnp
+        ac = paddle.to_tensor(self.alphas_cumprod)
+        if noise is None:
+            from paddle_tpu.tensor.random import default_generator
+            import jax
+            key = default_generator.next_key()
+            noise = Tensor(jax.random.normal(
+                key, tuple(x0.shape), jnp.float32))
+
+        def q_sample(x0v, nv, tv, acv):
+            a = acv[tv][:, None, None, None]
+            return jnp.sqrt(a) * x0v + jnp.sqrt(1.0 - a) * nv
+        xt = apply("q_sample", q_sample, (x0, noise, t, ac))
+        pred = model(xt, t, y)
+        c = x0.shape[1]
+        eps = pred[:, :c] if pred.shape[1] != c else pred
+        return ((eps - noise) ** 2).mean()
+
+    def ddim_sample(self, model: DiT, batch_size: int, y,
+                    num_steps: int = 50, eta: float = 0.0,
+                    seed: int | None = None):
+        """DDIM sampler (eta=0 deterministic; eta>0 adds the stochastic
+        sigma_t term, eta=1 ~ DDPM): x_T ~ N(0,I) -> x_0, one compiled
+        program. `seed` pins the noise; None draws from the global
+        generator. The jitted program is cached on the model per
+        (batch, steps, eta) signature."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+        cfg = model.config
+        params = list(model.parameters())
+        buffers = list(model.buffers())
+        ts = np.linspace(self.num_timesteps - 1, 0, num_steps) \
+            .round().astype(np.int32)
+        ac = jnp.asarray(self.alphas_cumprod)
+        y_v = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        c = cfg.in_channels
+        eta = float(eta)
+
+        def run(pv, bv, key):
+            old_p = [p._value for p in params]
+            old_b = [b._value for b in buffers]
+            try:
+                for p, v in zip(params, pv):
+                    p._value = v
+                for b, v in zip(buffers, bv):
+                    b._value = v
+                k_init, k_loop = jax.random.split(key)
+                x = jax.random.normal(
+                    k_init,
+                    (batch_size, c, cfg.input_size, cfg.input_size),
+                    jnp.float32)
+
+                def step(carry, i):
+                    x, k = carry
+                    t_cur = jnp.asarray(ts)[i]
+                    t_prev = jnp.where(i + 1 < num_steps,
+                                       jnp.asarray(ts)[
+                                           jnp.minimum(i + 1,
+                                                       num_steps - 1)],
+                                       -1)
+                    tb = jnp.full((batch_size,), t_cur, jnp.int32)
+                    pred = model(Tensor(x), Tensor(tb),
+                                 Tensor(y_v))._value
+                    eps = pred[:, :c] if pred.shape[1] != c else pred
+                    a_t = ac[t_cur]
+                    a_p = jnp.where(t_prev >= 0,
+                                    ac[jnp.maximum(t_prev, 0)], 1.0)
+                    x0 = (x - jnp.sqrt(1 - a_t) * eps) / jnp.sqrt(a_t)
+                    sigma = eta * jnp.sqrt(
+                        jnp.clip((1 - a_p) / jnp.clip(1 - a_t, 1e-12)
+                                 * (1 - a_t / a_p), 0.0))
+                    dir_coef = jnp.sqrt(jnp.clip(1 - a_p - sigma ** 2,
+                                                 0.0))
+                    x_next = jnp.sqrt(a_p) * x0 + dir_coef * eps
+                    if eta > 0.0:
+                        k, sub = jax.random.split(k)
+                        noise = jax.random.normal(sub, x.shape, x.dtype)
+                        x_next = x_next + jnp.where(t_prev >= 0,
+                                                    sigma, 0.0) * noise
+                    return (x_next, k), None
+
+                (x, _), _ = jax.lax.scan(step, (x, k_loop),
+                                         jnp.arange(num_steps))
+                return x
+            finally:
+                for p, v in zip(params, old_p):
+                    p._value = v
+                for b, v in zip(buffers, old_b):
+                    b._value = v
+
+        from paddle_tpu.tensor.random import default_generator
+        import jax.random as jrandom
+        key = (jrandom.key(seed) if seed is not None
+               else default_generator.next_key())
+        sig = (batch_size, num_steps, eta, cfg.input_size, c,
+               tuple(y_v.shape))
+        cache = getattr(model, "_ddim_cache", None)
+        if cache is None or cache[0] != sig:
+            jitted = jax.jit(run)
+            model._ddim_cache = (sig, jitted)
+        else:
+            jitted = cache[1]
+        with paddle.no_grad():
+            out = jitted([p._value for p in params],
+                         [b._value for b in buffers], key)
+        return paddle.Tensor(out)

@@ -12,6 +12,7 @@ import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.nn import functional as F
+from paddle_tpu.models.generation import GenerationMixin
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "synthetic_lm_batch"]
 
@@ -43,6 +44,11 @@ class GPTConfig:
     def head_dim(self):
         return self.hidden_size // self.num_attention_heads
 
+    @property
+    def num_key_value_heads(self):
+        # MHA: the KV cache is full-width (GenerationMixin contract)
+        return self.num_attention_heads
+
 
 class GPTAttention(nn.Layer):
     def __init__(self, cfg: GPTConfig):
@@ -53,12 +59,33 @@ class GPTAttention(nn.Layer):
         self.proj = nn.Linear(cfg.hidden_size, cfg.hidden_size)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, x):
+    def forward(self, x, past_key_value=None, position_offset=0,
+                use_cache=False):
+        from .llama import _update_kv_cache
         b, s = x.shape[0], x.shape[1]
         qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
         q = qkv[:, :, 0]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
+        if past_key_value is not None:
+            k_cache, v_cache = past_key_value
+            k_cache = _update_kv_cache(k_cache, k, position_offset)
+            v_cache = _update_kv_cache(v_cache, v, position_offset)
+            cur_len = position_offset + s
+            if s == 1:
+                out = F.masked_multihead_attention(
+                    q, k_cache, v_cache, seq_len=cur_len)
+            else:
+                if not isinstance(position_offset, int):
+                    raise ValueError(
+                        "prefill (seq>1) needs a static position_offset")
+                out = F.scaled_dot_product_attention(
+                    q, k_cache[:, :cur_len], v_cache[:, :cur_len],
+                    is_causal=True)
+            out = self.dropout(self.proj(out.reshape([b, s, -1])))
+            if use_cache:
+                return out, (k_cache, v_cache)
+            return out
         out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         return self.dropout(self.proj(out.reshape([b, s, -1])))
 
@@ -73,10 +100,20 @@ class GPTBlock(nn.Layer):
         self.proj = nn.Linear(cfg.intermediate_size, cfg.hidden_size)
         self.dropout = nn.Dropout(cfg.dropout)
 
-    def forward(self, x):
-        x = x + self.attn(self.ln_1(x))
+    def forward(self, x, past_key_value=None, position_offset=0,
+                use_cache=False):
+        attn = self.attn(self.ln_1(x), past_key_value=past_key_value,
+                         position_offset=position_offset,
+                         use_cache=use_cache)
+        new_kv = None
+        if use_cache and past_key_value is not None:
+            attn, new_kv = attn
+        x = x + attn
         h = self.proj(F.gelu(self.fc(self.ln_2(x)), approximate=True))
-        return x + self.dropout(h)
+        x = x + self.dropout(h)
+        if use_cache and past_key_value is not None:
+            return x, new_kv
+        return x
 
 
 class GPTModel(nn.Layer):
@@ -91,16 +128,35 @@ class GPTModel(nn.Layer):
                                for _ in range(cfg.num_hidden_layers)])
         self.ln_f = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, past_key_values=None, position_offset=0,
+                use_cache=False):
+        from paddle_tpu.core.tensor import Tensor
         s = input_ids.shape[1]
         pos = paddle.to_tensor(np.arange(s, dtype=np.int32)[None, :])
+        if not isinstance(position_offset, int) or position_offset != 0:
+            off = (position_offset if isinstance(position_offset, Tensor)
+                   else paddle.to_tensor(np.int32(position_offset)))
+            pos = pos + off.astype("int32")
         x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        if past_key_values is not None:
+            new_caches = []
+            for blk, kv in zip(self.h, past_key_values):
+                out = blk(x, past_key_value=kv,
+                          position_offset=position_offset,
+                          use_cache=use_cache)
+                if use_cache:
+                    x, new_kv = out
+                    new_caches.append(new_kv)
+                else:
+                    x = out
+            x = self.ln_f(x)
+            return (x, new_caches) if use_cache else x
         for blk in self.h:
             x = blk(x)
         return self.ln_f(x)
 
 
-class GPTForCausalLM(nn.Layer):
+class GPTForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, cfg: GPTConfig | None = None):
         super().__init__()
         cfg = cfg or GPTConfig()
@@ -112,8 +168,16 @@ class GPTForCausalLM(nn.Layer):
         else:
             self.lm_head = None
 
-    def forward(self, input_ids, labels=None):
-        hidden = self.transformer(input_ids)
+    def forward(self, input_ids, labels=None, past_key_values=None,
+                position_offset=0, use_cache=False):
+        out = self.transformer(input_ids, past_key_values=past_key_values,
+                               position_offset=position_offset,
+                               use_cache=use_cache)
+        caches = None
+        if use_cache and past_key_values is not None:
+            hidden, caches = out
+        else:
+            hidden = out
         if self.lm_head is not None:
             logits = self.lm_head(hidden)
         else:
@@ -125,6 +189,8 @@ class GPTForCausalLM(nn.Layer):
                 .astype("float32"),
                 labels.reshape([-1]), ignore_index=-100)
             return loss, logits
+        if caches is not None:
+            return logits, caches
         return logits
 
 

@@ -31,9 +31,15 @@ def linear(x, weight, bias=None, name=None):
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None, rng_key=None):
     """Dropout. Stateful key draw in eager; under jit pass `rng_key` (the
-    jit-side plumbing is handled by paddle_tpu.jit via the rng tracker)."""
+    jit-side plumbing is handled by paddle_tpu.jit via the rng tracker).
+    mode ≙ paddle: 'upscale_in_train' (train scales kept values by
+    1/(1-p), inference = identity) or 'downscale_in_infer' (train drops
+    without scaling, inference multiplies by (1-p))."""
     if not training or p == 0.0:
-        return _t(x)
+        if training or p == 0.0 or mode != "downscale_in_infer":
+            return _t(x)
+        return apply("dropout",
+                     lambda v: (v * (1.0 - p)).astype(v.dtype), (_t(x),))
     if p == 1.0:
         return apply("dropout", lambda v: jnp.zeros_like(v), (_t(x),))
     k = rng_key if rng_key is not None else default_generator.next_key()

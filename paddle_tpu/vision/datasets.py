@@ -1,0 +1,232 @@
+"""Vision datasets. ≙ reference «python/paddle/vision/datasets/» (MNIST,
+Cifar10/100, DatasetFolder, FakeData-style synthetic) [U].
+
+Offline-first design (this image has no network): the classes parse the
+STANDARD local file formats — MNIST idx, CIFAR python pickles, image
+directory trees — from a user-supplied path instead of downloading, and
+`FakeData` provides deterministic synthetic images so every recipe and
+test runs with zero data files.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder"]
+
+
+def _maybe_gzip_open(path):
+    return gzip.open(path, "rb") if path.endswith(".gz") else \
+        open(path, "rb")
+
+
+def _read_idx(path):
+    """Parse MNIST idx files (ubyte images/labels; .gz transparent)."""
+    with _maybe_gzip_open(path) as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        data = np.frombuffer(f.read(), np.uint8)
+    return data.reshape(dims)
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files.
+
+    image_path/label_path point at (optionally gzipped) idx files, e.g.
+    train-images-idx3-ubyte.gz. mode selects conventional filenames when
+    only a directory is given via `root`.
+    """
+
+    _FILES = {
+        "train": ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        "test": ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, root=None,
+                 backend: str = "cv2", download: bool = False):
+        if download:
+            raise RuntimeError(
+                "offline environment: place the idx files locally and "
+                "pass image_path/label_path (or root)")
+        if root is not None and image_path is None:
+            img, lab = self._FILES[mode]
+            for suffix in ("", ".gz"):
+                p = os.path.join(root, img + suffix)
+                if os.path.exists(p):
+                    image_path = p
+                    label_path = os.path.join(root, lab + suffix)
+                    break
+        if image_path is None or label_path is None:
+            raise FileNotFoundError(
+                "MNIST: provide image_path/label_path or a root directory "
+                "containing the idx files")
+        self.images = _read_idx(image_path)        # (N, 28, 28) uint8
+        self.labels = _read_idx(label_path).astype(np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+
+class FashionMNIST(MNIST):
+    """Same idx format, different corpus."""
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the local python-pickle archive directory (the
+    extracted cifar-10-batches-py/) or a single batch file."""
+
+    _TRAIN = [f"data_batch_{i}" for i in range(1, 6)]
+    _TEST = ["test_batch"]
+    _SUBDIR = "cifar-10-batches-py"
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None, root=None,
+                 backend: str = "cv2", download: bool = False):
+        if download:
+            raise RuntimeError(
+                "offline environment: extract cifar-10-batches-py locally "
+                "and pass data_file or root")
+        names = self._TRAIN if mode == "train" else self._TEST
+        files = []
+        if data_file is not None:
+            files = [data_file]
+        elif root is not None:
+            sub = os.path.join(root, self._SUBDIR)
+            base = sub if os.path.isdir(sub) else root
+            files = [os.path.join(base, n) for n in names
+                     if os.path.exists(os.path.join(base, n))]
+        if not files:
+            raise FileNotFoundError("Cifar10: no batch files found")
+        xs, ys = [], []
+        for fp in files:
+            with open(fp, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            xs.append(np.asarray(d[b"data"], np.uint8))
+            ys.extend(d.get(b"labels", d.get(b"fine_labels")))
+        self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, np.int64)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, i):
+        img = self.images[i]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[i]
+
+
+class Cifar100(Cifar10):
+    _TRAIN = ["train"]
+    _TEST = ["test"]
+    _SUBDIR = "cifar-100-python"
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic images (≙ torchvision FakeData): the
+    offline stand-in every vision recipe/test can run on."""
+
+    def __init__(self, size=1000, image_shape=(3, 32, 32), num_classes=10,
+                 transform: Optional[Callable] = None, seed: int = 0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __len__(self):
+        return self.size
+
+    def __getitem__(self, i):
+        rng = np.random.default_rng(self.seed * 1_000_003 + i)
+        img = rng.integers(0, 256, self.image_shape,
+                           dtype=np.uint8).astype(np.float32) / 255.0
+        label = int(rng.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+_IMG_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image tree (≙ paddle.vision DatasetFolder):
+    root/class_x/xxx.png -> (image, class_index). Requires PIL."""
+
+    def __init__(self, root: str, transform: Optional[Callable] = None,
+                 extensions=_IMG_EXTS, loader: Optional[Callable] = None):
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(cdir, fn),
+                                         self.class_to_idx[c]))
+        if not self.samples:
+            raise FileNotFoundError(f"no images under {root}")
+        self.transform = transform
+        self.loader = loader or self._pil_loader
+
+    @staticmethod
+    def _pil_loader(path):
+        from PIL import Image
+        with Image.open(path) as im:
+            return np.asarray(im.convert("RGB"))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        path, target = self.samples[i]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+
+class ImageFolder(DatasetFolder):
+    """Flat/recursive image directory without labels (label = 0)."""
+
+    def __init__(self, root: str, transform: Optional[Callable] = None,
+                 extensions=_IMG_EXTS, loader: Optional[Callable] = None):
+        self.samples = []
+        for dirpath, dirnames, files in os.walk(root):
+            dirnames.sort()  # deterministic traversal across filesystems
+            for fn in sorted(files):
+                if fn.lower().endswith(tuple(extensions)):
+                    self.samples.append((os.path.join(dirpath, fn), 0))
+        if not self.samples:
+            raise FileNotFoundError(f"no images under {root}")
+        self.transform = transform
+        self.loader = loader or self._pil_loader
+        self.class_to_idx = {}
+
+    def __getitem__(self, i):
+        path, _ = self.samples[i]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img

@@ -1,0 +1,13 @@
+"""Build script. ≙ reference «setup.py» / «paddle_build.sh» (SURVEY.md §1
+L0) collapsed to a thin shim: the heavy lifting (CUDA kernels, codegen,
+third-party builds) does not exist here — XLA is prebuilt, the Pallas
+kernels are Python, and the one native piece (csrc/native.cc: shared-memory
+ring transport + tensor codec) compiles on first import via
+paddle_tpu._native (no pybind11; ctypes over a plain .so).
+
+    pip wheel .          # build a wheel
+    pip install -e .     # editable install
+"""
+from setuptools import setup
+
+setup()

@@ -1,0 +1,79 @@
+"""Audio feature tier vs librosa-convention NumPy oracles.
+≙ SURVEY.md §2.2 vision/audio/text row («python/paddle/audio/»)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import functional as AF
+from paddle_tpu.audio.features import (LogMelSpectrogram, MFCC,
+                                       MelSpectrogram, Spectrogram)
+
+
+class TestFunctional:
+    def test_mel_hz_roundtrip(self):
+        for htk in (False, True):
+            f = np.asarray([0.0, 440.0, 1000.0, 8000.0])
+            back = AF.mel_to_hz(AF.hz_to_mel(f, htk), htk)
+            np.testing.assert_allclose(back, f, rtol=1e-6, atol=1e-3)
+
+    def test_fbank_shape_and_partition(self):
+        fb = AF.compute_fbank_matrix(16000, 512, n_mels=40)
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        # every mel filter has some support
+        assert (fb.sum(axis=1) > 0).all()
+
+    def test_dct_orthonormal(self):
+        d = AF.create_dct(13, 40)           # (40, 13)
+        gram = d.T @ d
+        np.testing.assert_allclose(gram, np.eye(13), atol=1e-5)
+
+    def test_get_window_matches_numpy(self):
+        w = np.asarray(AF.get_window("hann", 16)._value)
+        ref = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(16) / 16)
+        np.testing.assert_allclose(w, ref, atol=1e-6)
+
+    def test_power_to_db(self):
+        x = paddle.to_tensor(np.asarray([1.0, 10.0, 100.0], np.float32))
+        db = np.asarray(AF.power_to_db(x, top_db=None)._value)
+        np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-5)
+
+
+class TestFeatures:
+    def _sig(self, n=4000, sr=16000):
+        t = np.arange(n) / sr
+        return (np.sin(2 * np.pi * 440 * t)
+                + 0.5 * np.sin(2 * np.pi * 880 * t)).astype(np.float32)
+
+    def test_spectrogram_peak_at_tone(self):
+        sr, n_fft = 16000, 512
+        spec = Spectrogram(n_fft=n_fft)(
+            paddle.to_tensor(self._sig()[None]))
+        s = np.asarray(spec._value)[0]      # (257, T)
+        peak_bin = s.mean(axis=1).argmax()
+        assert abs(peak_bin - round(440 * n_fft / sr)) <= 1
+
+    def test_mel_and_logmel_shapes(self):
+        x = paddle.to_tensor(self._sig()[None])
+        mel = MelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert mel.shape[1] == 40
+        lm = LogMelSpectrogram(sr=16000, n_fft=512, n_mels=40)(x)
+        assert lm.shape == mel.shape
+        assert np.isfinite(np.asarray(lm._value)).all()
+
+    def test_mfcc_shape(self):
+        x = paddle.to_tensor(self._sig()[None])
+        m = MFCC(sr=16000, n_mfcc=13, n_fft=512, n_mels=40)(x)
+        assert m.shape[1] == 13
+        assert np.isfinite(np.asarray(m._value)).all()
+
+    def test_jit_compatible(self):
+        """Feature extraction traces under jit (on-device pipeline)."""
+        import jax
+        layer = MelSpectrogram(sr=16000, n_fft=256, n_mels=16)
+        x = self._sig(2000)
+
+        def fn(v):
+            return layer(paddle.Tensor(v))._value
+        out = jax.jit(fn)(x[None])
+        assert np.isfinite(np.asarray(out)).all()

@@ -191,3 +191,92 @@ class TestElasticManager:
         kept = sorted(n for n in os.listdir(tmp_path)
                       if n.startswith("step_"))
         assert kept == ["step_3", "step_4"], kept
+
+
+class TestLaunchLogCapture:
+    def test_log_capture_and_elastic_restart(self, tmp_path):
+        """launch CLI captures per-rank logs and restarts on failure
+        (≙ reference launcher log capture + elastic restart)."""
+        from paddle_tpu.distributed.launch import launch
+
+        script = tmp_path / "train.py"
+        marker = tmp_path / "attempts"
+        script.write_text(
+            "import os, sys\n"
+            f"p = {str(marker)!r}\n"
+            "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+            "open(p, 'w').write(str(n + 1))\n"
+            "print(f'attempt {n}', flush=True)\n"
+            "sys.exit(0 if n >= 1 else 3)\n")
+
+        class A:
+            pass
+
+        a = A()
+        a.master = None
+        a.nnodes = 1
+        a.rank = 0
+        a.job_id = "t"
+        a.log_dir = str(tmp_path / "logs")
+        a.elastic_level = 1
+        a.max_restart = 2
+        a.script = str(script)
+        a.script_args = []
+        rc = launch(a)
+        assert rc == 0
+        log = (tmp_path / "logs" / "t.rank0.log").read_text()
+        assert "attempt 0" in log and "attempt 1" in log
+        assert "restart 1/2" in log
+
+
+class TestMultiHostRendezvous:
+    def test_two_rank_launch_rendezvous_allgather(self, tmp_path):
+        """Two `launch` invocations (simulating two hosts) rendezvous via
+        jax.distributed using the env the launcher injects, then
+        allgather across processes — the multi-host path SURVEY §2.1
+        'Comm contexts + store' row maps to jax's coordinator service."""
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "addr = os.environ['COORDINATOR_ADDRESS']\n"
+            "n = int(os.environ['PADDLE_TRAINERS_NUM'])\n"
+            "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+            "jax.distributed.initialize(coordinator_address=addr,\n"
+            "                           num_processes=n, process_id=rank)\n"
+            "assert jax.process_count() == 2\n"
+            "import jax.numpy as jnp\n"
+            "from jax.experimental import multihost_utils\n"
+            "g = multihost_utils.process_allgather(\n"
+            "    jnp.ones(2) * (rank + 1))\n"
+            "assert g.tolist() == [[1.0, 1.0], [2.0, 2.0]], g\n"
+            "print(f'rank {rank} rendezvous ok', flush=True)\n")
+
+        import socket
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["JAX_PLATFORMS"] = "cpu"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--master", f"127.0.0.1:{port}", "--nnodes", "2",
+             "--rank", str(i), "--log_dir", str(tmp_path / "logs"),
+             "--job_id", "rdv", str(script)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, text=True, cwd=str(tmp_path))
+            for i in range(2)]
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=180)
+            outs.append(out)
+            assert p.returncode == 0, out[-1500:]
+        assert "rank 0 rendezvous ok" in outs[0] + outs[1]
+        # per-rank logs captured by the launcher
+        assert (tmp_path / "logs" / "rdv.rank0.log").exists()
+        assert (tmp_path / "logs" / "rdv.rank1.log").exists()

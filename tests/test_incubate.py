@@ -117,3 +117,35 @@ class TestFusedMHA:
             dropout_rate=0.0, attn_dropout_rate=0.0)
         assert out.shape == [2, 8, e]
         assert np.isfinite(np.asarray(out._value)).all()
+
+
+class TestFusedBiasDropoutResidualLN:
+    def test_matches_composition(self):
+        from paddle_tpu.incubate.nn.functional import \
+            fused_bias_dropout_residual_layer_norm
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 6, 16)).astype(np.float32)
+        res = rng.standard_normal((2, 6, 16)).astype(np.float32)
+        b = rng.standard_normal(16).astype(np.float32)
+        out = fused_bias_dropout_residual_layer_norm(
+            paddle.to_tensor(x), paddle.to_tensor(res),
+            bias=paddle.to_tensor(b), dropout_rate=0.0)
+        y = res + x + b
+        mu = y.mean(-1, keepdims=True)
+        var = y.var(-1, keepdims=True)
+        ref = (y - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(np.asarray(out._value), ref,
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_grads_flow(self):
+        from paddle_tpu.incubate.nn.functional import \
+            fused_bias_dropout_residual_layer_norm
+        x = paddle.to_tensor(np.ones((2, 4, 8), np.float32),
+                             stop_gradient=False)
+        r = paddle.to_tensor(np.ones((2, 4, 8), np.float32) * 0.5,
+                             stop_gradient=False)
+        paddle.seed(0)
+        out = fused_bias_dropout_residual_layer_norm(
+            x, r, dropout_rate=0.3)
+        out.astype("float32").sum().backward()
+        assert x.grad is not None and r.grad is not None

@@ -165,3 +165,17 @@ class TestSaveIntegrity:
         paddle.save({"w": t}, p)
         out = paddle.load(p)
         np.testing.assert_array_equal(out["w"].numpy(), t.numpy())
+
+
+def test_packaged_native_source_in_sync():
+    """The wheel ships paddle_tpu/_native/csrc/native.cc; it must stay
+    byte-identical to the development copy at the repo root."""
+    import paddle_tpu._native as N
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "csrc", "native.cc")
+    pkg = os.path.join(os.path.dirname(os.path.abspath(N.__file__)),
+                       "csrc", "native.cc")
+    with open(root, "rb") as a, open(pkg, "rb") as b:
+        assert a.read() == b.read(), (
+            "csrc/native.cc and paddle_tpu/_native/csrc/native.cc have "
+            "drifted — copy the root file over the package copy")

@@ -261,3 +261,22 @@ class TestAttentionFunctional:
         np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
         ls = F.log_softmax(paddle.to_tensor(x), axis=-1).numpy()
         np.testing.assert_allclose(np.exp(ls), s, rtol=1e-4, atol=1e-6)
+
+
+class TestDropoutModes:
+    def test_downscale_in_infer_scales_at_inference(self):
+        """paddle semantics: downscale_in_infer multiplies by (1-p) at
+        inference (was silently identity before round 3)."""
+        from paddle_tpu.nn import functional as F
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        out = F.dropout(x, p=0.25, training=False,
+                        mode="downscale_in_infer")
+        np.testing.assert_allclose(np.asarray(out._value), 0.75)
+        # upscale_in_train is identity at inference
+        out2 = F.dropout(x, p=0.25, training=False)
+        np.testing.assert_allclose(np.asarray(out2._value), 1.0)
+        # train-mode downscale keeps raw values (no 1/(1-p))
+        paddle.seed(0)
+        out3 = np.asarray(F.dropout(x, p=0.5, training=True,
+                                    mode="downscale_in_infer")._value)
+        assert set(np.unique(out3)).issubset({0.0, 1.0})

@@ -1,0 +1,133 @@
+"""Vision datasets (offline file-format parsers + synthetic).
+≙ SURVEY.md §2.2 vision row («python/paddle/vision/datasets/»)."""
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.datasets import (Cifar10, DatasetFolder, FakeData,
+                                        ImageFolder, MNIST)
+
+
+def _write_idx_images(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000803))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+def _write_idx_labels(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x00000801))
+        f.write(struct.pack(">I", len(arr)))
+        f.write(arr.astype(np.uint8).tobytes())
+
+
+class TestMNIST:
+    def test_parses_idx_files(self, tmp_path):
+        rng = np.random.default_rng(0)
+        imgs = rng.integers(0, 256, (10, 28, 28), dtype=np.uint8)
+        labs = rng.integers(0, 10, 10, dtype=np.uint8)
+        ip = str(tmp_path / "train-images-idx3-ubyte")
+        lp = str(tmp_path / "train-labels-idx1-ubyte")
+        _write_idx_images(ip, imgs)
+        _write_idx_labels(lp, labs)
+        ds = MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == 10
+        x, y = ds[3]
+        np.testing.assert_array_equal(x, imgs[3])
+        assert y == labs[3]
+        # root-directory resolution + gz transparency
+        gz = str(tmp_path / "gz")
+        os.makedirs(gz)
+        with open(ip, "rb") as f, gzip.open(
+                os.path.join(gz, "train-images-idx3-ubyte.gz"), "wb") as g:
+            g.write(f.read())
+        with open(lp, "rb") as f, gzip.open(
+                os.path.join(gz, "train-labels-idx1-ubyte.gz"), "wb") as g:
+            g.write(f.read())
+        ds2 = MNIST(root=gz)
+        np.testing.assert_array_equal(ds2[3][0], imgs[3])
+
+    def test_download_raises_offline(self):
+        with pytest.raises(RuntimeError):
+            MNIST(download=True)
+
+
+class TestCifar:
+    def test_parses_pickle_batches(self, tmp_path):
+        rng = np.random.default_rng(1)
+        data = rng.integers(0, 256, (8, 3072), dtype=np.uint8)
+        labels = list(rng.integers(0, 10, 8))
+        fp = tmp_path / "data_batch_1"
+        with open(fp, "wb") as f:
+            pickle.dump({b"data": data, b"labels": labels}, f)
+        ds = Cifar10(data_file=str(fp))
+        assert len(ds) == 8
+        x, y = ds[2]
+        assert x.shape == (3, 32, 32)
+        np.testing.assert_array_equal(x.ravel(), data[2])
+        assert y == labels[2]
+
+
+class TestFakeData:
+    def test_deterministic_and_transforms(self):
+        ds = FakeData(size=5, image_shape=(3, 8, 8), num_classes=4)
+        x1, y1 = ds[2]
+        x2, y2 = ds[2]
+        np.testing.assert_array_equal(x1, x2)
+        assert y1 == y2 and 0 <= y1 < 4
+        ds_t = FakeData(size=5, image_shape=(3, 8, 8),
+                        transform=lambda im: im * 2)
+        np.testing.assert_allclose(ds_t[2][0], x1 * 2)
+
+    def test_trains_resnet_smoke(self):
+        from paddle_tpu.io import DataLoader
+        from paddle_tpu.vision.models import resnet18
+        paddle.seed(0)
+        model = resnet18(num_classes=4)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        ds = FakeData(size=8, image_shape=(3, 32, 32), num_classes=4)
+        loader = DataLoader(ds, batch_size=4)
+        from paddle_tpu.nn import functional as F
+        for x, y in loader:
+            loss = F.cross_entropy(model(paddle.to_tensor(np.asarray(x))),
+                                   paddle.to_tensor(np.asarray(y)))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            break
+        assert np.isfinite(float(loss))
+
+
+class TestFolders:
+    def _tree(self, tmp_path):
+        from PIL import Image
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(2):
+                arr = np.full((6, 6, 3), 40 * i, np.uint8)
+                Image.fromarray(arr).save(d / f"{i}.png")
+        return tmp_path
+
+    def test_dataset_folder(self, tmp_path):
+        root = self._tree(tmp_path)
+        ds = DatasetFolder(str(root))
+        assert len(ds) == 4
+        assert ds.class_to_idx == {"cat": 0, "dog": 1}
+        img, y = ds[0]
+        assert img.shape == (6, 6, 3)
+        assert y in (0, 1)
+
+    def test_image_folder(self, tmp_path):
+        root = self._tree(tmp_path)
+        ds = ImageFolder(str(root))
+        assert len(ds) == 4
+        assert ds[0].shape == (6, 6, 3)
