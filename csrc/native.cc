@@ -154,7 +154,14 @@ int ring_push(void* ring, const void* buf, uint64_t len, int timeout_ms) {
   ring_now(&ts, timeout_ms);
   if (ring_lock(h) != 0) return -1;
   while (h->capacity - h->used < need) {
-    if (pthread_cond_timedwait(&h->not_full, &h->mu, &ts) == ETIMEDOUT) {
+    int wrc = pthread_cond_timedwait(&h->not_full, &h->mu, &ts);
+    if (wrc == EOWNERDEAD) {
+      // the peer died while we waited; we own the mutex — mark it
+      // consistent (same recovery as ring_lock) and re-check the predicate
+      pthread_mutex_consistent(&h->mu);
+      continue;
+    }
+    if (wrc != 0) {  // ETIMEDOUT or hard error
       pthread_mutex_unlock(&h->mu);
       return -1;
     }
@@ -176,7 +183,14 @@ int64_t ring_next_len(void* ring, int timeout_ms) {
   ring_now(&ts, timeout_ms);
   if (ring_lock(h) != 0) return -1;
   while (h->used < 8) {
-    if (pthread_cond_timedwait(&h->not_empty, &h->mu, &ts) == ETIMEDOUT) {
+    int wrc = pthread_cond_timedwait(&h->not_empty, &h->mu, &ts);
+    if (wrc == EOWNERDEAD) {
+      // the peer died while we waited; we own the mutex — mark it
+      // consistent (same recovery as ring_lock) and re-check the predicate
+      pthread_mutex_consistent(&h->mu);
+      continue;
+    }
+    if (wrc != 0) {  // ETIMEDOUT or hard error
       pthread_mutex_unlock(&h->mu);
       return -1;
     }
@@ -200,7 +214,14 @@ int64_t ring_pop(void* ring, void* out, uint64_t max, int timeout_ms) {
   ring_now(&ts, timeout_ms);
   if (ring_lock(h) != 0) return -1;
   while (h->used < 8) {
-    if (pthread_cond_timedwait(&h->not_empty, &h->mu, &ts) == ETIMEDOUT) {
+    int wrc = pthread_cond_timedwait(&h->not_empty, &h->mu, &ts);
+    if (wrc == EOWNERDEAD) {
+      // the peer died while we waited; we own the mutex — mark it
+      // consistent (same recovery as ring_lock) and re-check the predicate
+      pthread_mutex_consistent(&h->mu);
+      continue;
+    }
+    if (wrc != 0) {  // ETIMEDOUT or hard error
       pthread_mutex_unlock(&h->mu);
       return -1;
     }

@@ -60,7 +60,8 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
                      reduce_fn: Optional[Callable] = None,
                      reduce_args: tuple = (), reduce_arg_specs=None,
                      reduce_mean_axes: tuple = (),
-                     reduce_shape: tuple = ()):
+                     reduce_shape: tuple = (),
+                     virtual_chunks: int = 1):
     """Run the pipelined forward: y = stage_{S-1}(...stage_0(x)).
 
     stage_fn(params_one_stage, activation, *extra) -> activation; must keep
@@ -87,16 +88,32 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
     reduce_shape declares reduce_fn's output shape (() = scalar) — it
     cannot be probed because reduce_fn may contain collectives only valid
     inside the shard_map.
+
+    virtual_chunks=V > 1 enables the INTERLEAVED virtual pipeline
+    (≙ reference `PipelineParallelWithInterleave`, SURVEY.md §2.3 PP
+    row): stacked_params leaves are (S, V, ...) — device s owns the V
+    model chunks {v*S + s}, each 1/V of a contiguous stage — and the
+    activation makes V laps around the SAME ring (chunk v's stage S-1
+    hands to chunk v+1's stage 0 via the one ppermute). Per-tick work
+    drops to 1/V of a fat stage, shrinking the fill/drain bubble from
+    (S-1) fat-stage units to ~(S-1)/V-ish: ticks go (M + S - 1) ->
+    (M + V*S - 1) at 1/V the cost each. Constraint: M <= S (the
+    conflict-free schedule; run multiple rounds for larger batches).
     Returns y: (B, ...) final-stage output, or (M, *reduce_shape) with
     reduce_fn. Differentiable.
     """
     s_count = mesh.get_dim_size(axis)
     m = num_microbatches
+    v_chunks = int(virtual_chunks)
     b = x.shape[0]
     assert b % m == 0, f"batch {b} not divisible by microbatches {m}"
+    if v_chunks > 1 and m > s_count:
+        raise ValueError(
+            f"interleaved pipeline needs num_microbatches ({m}) <= pp "
+            f"degree ({s_count}); run multiple rounds for larger batches")
     mb = b // m
     xs = x.reshape(m, mb, *x.shape[1:])
-    ticks = m + s_count - 1
+    ticks = m + v_chunks * s_count - 1
 
     body = stage_fn
     if remat:
@@ -107,21 +124,41 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
     def local_fn(params_local, xs_local, *rest):
         extra = rest[:n_extra]
         r_args = rest[n_extra:]
-        # params_local leaves: (1, ...) — this device's stage; squeeze
+        # params_local leaves: (1, ...) — this device's stage (or
+        # (1, V, ...) — its V interleaved chunks); squeeze the shard dim
         params1 = jax.tree_util.tree_map(lambda l: l[0], params_local)
         s = jax.lax.axis_index(axis)
         perm = [(j, (j + 1) % s_count) for j in range(s_count)]
 
         def tick(carry, t):
             state, buf = carry
-            # stage 0 ingests microbatch t (clamped; inactive ticks are
-            # overwritten later), others take the ppermuted activation
-            x_t = jax.lax.dynamic_index_in_dim(
-                xs_local, jnp.clip(t, 0, m - 1), 0, keepdims=False)
-            inp = jnp.where(s == 0, x_t.astype(state.dtype), state)
-            y = body(params1, inp, *extra)
-            # last stage's tick-t output is microbatch t - (S-1)
-            idx = t - (s_count - 1)
+            if v_chunks > 1:
+                # interleave schedule: at tick t this device runs chunk
+                # v for microbatch t - v*S - s (at most one valid (m, v)
+                # since M <= S); garbage flows on inactive ticks and is
+                # never recorded
+                rel = t - s
+                v = jnp.clip(rel // s_count, 0, v_chunks - 1)
+                m_i = rel - v * s_count
+                x_t = jax.lax.dynamic_index_in_dim(
+                    xs_local, jnp.clip(m_i, 0, m - 1), 0, keepdims=False)
+                inp = jnp.where((s == 0) & (v == 0),
+                                x_t.astype(state.dtype), state)
+                params_t = jax.tree_util.tree_map(
+                    lambda l: jax.lax.dynamic_index_in_dim(
+                        l, v, 0, keepdims=False), params1)
+            else:
+                # stage 0 ingests microbatch t (clamped; inactive ticks
+                # are overwritten later), others take the ppermuted
+                # activation
+                x_t = jax.lax.dynamic_index_in_dim(
+                    xs_local, jnp.clip(t, 0, m - 1), 0, keepdims=False)
+                inp = jnp.where(s == 0, x_t.astype(state.dtype), state)
+                params_t = params1
+            y = body(params_t, inp, *extra)
+            # the final (stage, chunk)'s tick-t output is microbatch
+            # t - (V-1)*S - (S-1)
+            idx = t - (v_chunks - 1) * s_count - (s_count - 1)
             idx_c = jnp.clip(idx, 0, m - 1)
             valid = (idx >= 0) & (idx < m)
             if reduce_fn is not None:

@@ -267,14 +267,16 @@ class GaussianDiffusion:
         cfg = model.config
         params = list(model.parameters())
         buffers = list(model.buffers())
-        ts = np.linspace(self.num_timesteps - 1, 0, num_steps) \
+        ts_np = np.linspace(self.num_timesteps - 1, 0, num_steps) \
             .round().astype(np.int32)
-        ac = jnp.asarray(self.alphas_cumprod)
         y_v = y._value if isinstance(y, Tensor) else jnp.asarray(y)
         c = cfg.in_channels
         eta = float(eta)
 
-        def run(pv, bv, key):
+        # labels, the noise schedule and the timestep grid are jit
+        # ARGUMENTS (not closure constants), so the cached program stays
+        # valid across different y / GaussianDiffusion instances
+        def run(pv, bv, key, y_in, ac, ts):
             old_p = [p._value for p in params]
             old_b = [b._value for b in buffers]
             try:
@@ -290,15 +292,14 @@ class GaussianDiffusion:
 
                 def step(carry, i):
                     x, k = carry
-                    t_cur = jnp.asarray(ts)[i]
+                    t_cur = ts[i]
                     t_prev = jnp.where(i + 1 < num_steps,
-                                       jnp.asarray(ts)[
-                                           jnp.minimum(i + 1,
-                                                       num_steps - 1)],
+                                       ts[jnp.minimum(i + 1,
+                                                      num_steps - 1)],
                                        -1)
                     tb = jnp.full((batch_size,), t_cur, jnp.int32)
                     pred = model(Tensor(x), Tensor(tb),
-                                 Tensor(y_v))._value
+                                 Tensor(y_in))._value
                     eps = pred[:, :c] if pred.shape[1] != c else pred
                     a_t = ac[t_cur]
                     a_p = jnp.where(t_prev >= 0,
@@ -340,5 +341,8 @@ class GaussianDiffusion:
             jitted = cache[1]
         with paddle.no_grad():
             out = jitted([p._value for p in params],
-                         [b._value for b in buffers], key)
+                         [b._value for b in buffers], key,
+                         jnp.asarray(y_v),
+                         jnp.asarray(self.alphas_cumprod),
+                         jnp.asarray(ts_np))
         return paddle.Tensor(out)

@@ -85,11 +85,13 @@ class LlamaForCausalLMPipe(nn.Layer):
     """
 
     def __init__(self, cfg: LlamaConfig | None = None,
-                 num_microbatches: int = 1):
+                 num_microbatches: int = 1,
+                 virtual_pipeline_degree: int = 1):
         super().__init__()
         cfg = cfg or LlamaConfig.llama3_8b()
         self.config = cfg
         self.num_microbatches = num_microbatches
+        self.virtual_pipeline_degree = virtual_pipeline_degree
         h = cfg.hidden_size
         hd = cfg.head_dim
         nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
@@ -155,26 +157,40 @@ class LlamaForCausalLMPipe(nn.Layer):
                     pipeline_forward
                 s_count = mesh.get_dim_size("pp")
                 L = cfg.num_hidden_layers
-                assert L % s_count == 0, (L, s_count)
-                staged = {k: v.reshape(s_count, L // s_count, *v.shape[1:])
-                          for k, v in params.items()}
+                vp = self.virtual_pipeline_degree
+                assert L % (s_count * vp) == 0, (L, s_count, vp)
+                per = L // (s_count * vp)
+                if vp > 1:
+                    # interleaved: staged[s, v] = layers of global chunk
+                    # v*S + s -> reshape (V, S, per, ...) then swap to
+                    # (S, V, per, ...)
+                    staged = {k: v.reshape(vp, s_count, per, *v.shape[1:])
+                              .swapaxes(0, 1)
+                              for k, v in params.items()}
+                else:
+                    staged = {k: v.reshape(s_count, per, *v.shape[1:])
+                              for k, v in params.items()}
                 mp = "mp" if mp_n > 1 else None
+                pad = (None,) * (1 if vp > 1 else 0)
                 specs = {
-                    "ln1": P("pp", None, None),
-                    "ln2": P("pp", None, None),
-                    "wq": P("pp", None, None, mp),
-                    "wk": P("pp", None, None, mp),
-                    "wv": P("pp", None, None, mp),
-                    "wo": P("pp", None, mp, None),
-                    "wgate": P("pp", None, None, mp),
-                    "wup": P("pp", None, None, mp),
-                    "wdown": P("pp", None, mp, None),
+                    "ln1": P("pp", *pad, None, None),
+                    "ln2": P("pp", *pad, None, None),
+                    "wq": P("pp", *pad, None, None, mp),
+                    "wk": P("pp", *pad, None, None, mp),
+                    "wv": P("pp", *pad, None, None, mp),
+                    "wo": P("pp", *pad, None, mp, None),
+                    "wgate": P("pp", *pad, None, None, mp),
+                    "wup": P("pp", *pad, None, None, mp),
+                    "wdown": P("pp", *pad, None, mp, None),
                 }
                 dp = ("dp" if "dp" in mesh.dim_names
                       and mesh.get_dim_size("dp") > 1 else None)
 
                 def stage_fn(sp, act, cs_, sn_):
-                    for li in range(L // s_count):
+                    # works for both fat stages (per = L/S layers) and
+                    # interleaved chunks (per = L/(S*V)): the pipeline
+                    # hands this fn exactly one stage's/chunk's layers
+                    for li in range(sp["ln1"].shape[0]):
                         lp = {k: v[li] for k, v in sp.items()}
                         act = _layer_values(
                             lp, act, cs_, sn_, cfg,
@@ -237,6 +253,7 @@ class LlamaForCausalLMPipe(nn.Layer):
                         stage_fn, staged, x, mesh, m, axis="pp",
                         extra_args=(cs, sn), param_specs=specs,
                         x_spec=P(dp, None, None),
+                        virtual_chunks=vp,
                         reduce_fn=reduce_fn,
                         reduce_args=(norm_w, head_w, lab_r),
                         reduce_arg_specs=(P(None), P(None, mp),
@@ -251,7 +268,7 @@ class LlamaForCausalLMPipe(nn.Layer):
                 x = pipeline_forward(
                     stage_fn, staged, x, mesh, m, axis="pp",
                     extra_args=(cs, sn), param_specs=specs,
-                    x_spec=P(dp, None, None))
+                    x_spec=P(dp, None, None), virtual_chunks=vp)
             else:
                 def body(act, lp):
                     return _layer_values(

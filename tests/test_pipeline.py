@@ -229,3 +229,131 @@ class TestFusedLossPipeline:
         if fused_b is not None and full_b is not None:
             # fused path must not pay the (B, S, V) logits cost
             assert fused_b < full_b, (fused_b, full_b)
+
+
+class TestInterleavedPipeline:
+    """Interleaved virtual pipeline (≙ PipelineParallelWithInterleave,
+    VERDICT r2 weak 3 / SURVEY §2.3 PP row): V chunks per device over the
+    same ring; oracle = sequential execution of the V*S chunks."""
+
+    def _chunks(self, n, h=16, hid=32):
+        return [(jnp.asarray(rng.normal(size=(h, hid)).astype(np.float32)
+                             * 0.3),
+                 jnp.asarray(rng.normal(size=(hid, h)).astype(np.float32)
+                             * 0.3)) for _ in range(n)]
+
+    def _stack_interleaved(self, chunks, s, v):
+        # staged[s][v] = global chunk v*S + s
+        def leaf(i):
+            return jnp.stack(
+                [jnp.stack([chunks[vv * s + ss][i] for vv in range(v)])
+                 for ss in range(s)])
+        return (leaf(0), leaf(1))
+
+    @pytest.mark.parametrize("micro", [2, 4])
+    def test_matches_sequential(self, pp_mesh, micro):
+        s, v = 4, 2
+        chunks = self._chunks(s * v)
+        stacked = self._stack_interleaved(chunks, s, v)
+        x = jnp.asarray(rng.normal(size=(8, 5, 16)).astype(np.float32))
+        y = pipeline_forward(_mlp_stage, stacked, x, pp_mesh, micro,
+                             virtual_chunks=v)
+        ref = x
+        for c in chunks:
+            ref = _mlp_stage(c, ref)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_too_many_microbatches_raises(self, pp_mesh):
+        chunks = self._chunks(8)
+        stacked = self._stack_interleaved(chunks, 4, 2)
+        x = jnp.asarray(rng.normal(size=(8, 5, 16)).astype(np.float32))
+        with pytest.raises(ValueError):
+            pipeline_forward(_mlp_stage, stacked, x, pp_mesh, 8,
+                             virtual_chunks=2)
+
+    def test_grads_match_sequential(self, pp_mesh):
+        s, v = 4, 2
+        chunks = self._chunks(s * v)
+        stacked = self._stack_interleaved(chunks, s, v)
+        x = jnp.asarray(rng.normal(size=(4, 5, 16)).astype(np.float32))
+
+        def loss_pipe(st, xx):
+            return jnp.sum(pipeline_forward(
+                _mlp_stage, st, xx, pp_mesh, 4,
+                virtual_chunks=v).astype(jnp.float32) ** 2)
+
+        def loss_seq(cs, xx):
+            ref = xx
+            for c in cs:
+                ref = _mlp_stage(c, ref)
+            return jnp.sum(ref.astype(jnp.float32) ** 2)
+
+        g_pipe = jax.grad(loss_pipe)(stacked, x)
+        g_seq = jax.grad(loss_seq)(chunks, x)
+        # map sequential chunk grads into the (S, V, ...) layout
+        for i in range(2):
+            got = np.asarray(g_pipe[i])
+            for ss in range(s):
+                for vv in range(v):
+                    np.testing.assert_allclose(
+                        got[ss, vv], np.asarray(g_seq[vv * s + ss][i]),
+                        rtol=3e-4, atol=3e-4)
+
+    def test_interleaved_with_reduce_fn(self, pp_mesh):
+        s, v = 4, 2
+        chunks = self._chunks(s * v)
+        stacked = self._stack_interleaved(chunks, s, v)
+        x = jnp.asarray(rng.normal(size=(4, 5, 16)).astype(np.float32))
+
+        def reduce_fn(y, idx):
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        out = pipeline_forward(_mlp_stage, stacked, x, pp_mesh, 4,
+                               virtual_chunks=v, reduce_fn=reduce_fn)
+        ref = x
+        for c in chunks:
+            ref = _mlp_stage(c, ref)
+        ref_r = np.asarray(
+            [float(jnp.sum(ref[i:i + 1].astype(jnp.float32) ** 2))
+             for i in range(4)])
+        np.testing.assert_allclose(np.asarray(out), ref_r, rtol=2e-4)
+
+
+class TestLlamaPipeInterleaved:
+    def test_interleaved_matches_scan(self, pp_mesh):
+        """V=2 interleaved llama pipe == no-pp scan decoder."""
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models.llama_pipe import LlamaForCausalLMPipe
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny()
+        cfg.num_hidden_layers = 8      # 4 stages x 2 chunks x 1 layer
+        model = LlamaForCausalLMPipe(cfg, num_microbatches=2,
+                                     virtual_pipeline_degree=2)
+        ids = paddle.to_tensor(
+            (np.arange(64, dtype=np.int32) % cfg.vocab_size).reshape(2, 32))
+        model.eval()
+        base = model(ids).numpy()
+        with dist.use_mesh(pp_mesh):
+            out = model(ids).numpy()
+        np.testing.assert_allclose(base, out, rtol=2e-4, atol=2e-4)
+
+    def test_interleaved_fused_loss_trains(self, pp_mesh):
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models.llama_pipe import (LlamaForCausalLMPipe,
+                                                  synthetic_lm_batch)
+        from paddle_tpu.optimizer import AdamW
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny()
+        cfg.num_hidden_layers = 8
+        model = LlamaForCausalLMPipe(cfg, num_microbatches=2,
+                                     virtual_pipeline_degree=2)
+        with dist.use_mesh(pp_mesh):
+            opt = AdamW(learning_rate=1e-3,
+                        parameters=model.parameters())
+            ids, labels = synthetic_lm_batch(2, 32, cfg.vocab_size)
+            step = paddle.jit.TrainStep(
+                model, opt, loss_fn=lambda mm, x, y: mm(x, labels=y)[0])
+            losses = [float(step(ids, labels)) for _ in range(4)]
+        assert losses[-1] < losses[0], losses
+        assert np.isfinite(losses).all()

@@ -166,3 +166,74 @@ class TestUlyssesAttention:
         g_ref = jax.grad(ref_loss)(jnp.asarray(q))
         np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
                                    rtol=5e-3, atol=1e-4)
+
+
+class TestZigzagRing:
+    """Load-balanced causal ring (VERDICT r2 weak 4): every rank does ~2
+    full sub-block attentions per tick instead of rank r idling n-r-1
+    ticks."""
+
+    def _qkv(self, b=2, s=64, h=4, hk=4, d=16, seed=0):
+        r = np.random.default_rng(seed)
+        return (r.standard_normal((b, s, h, d)).astype(np.float32),
+                r.standard_normal((b, s, hk, d)).astype(np.float32),
+                r.standard_normal((b, s, hk, d)).astype(np.float32))
+
+    def test_matches_reference(self, sep_mesh):
+        from paddle_tpu.nn.functional.attention import _sdpa_xla
+        q, k, v = self._qkv()
+        with dist.use_mesh(sep_mesh):
+            out = ring_attention_values(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                sep_mesh, causal=True, balance="zigzag")
+        ref = _sdpa_xla(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                        causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_gqa(self, sep_mesh):
+        from paddle_tpu.nn.functional.attention import _sdpa_xla
+        q, k, v = self._qkv(h=4, hk=2, seed=1)
+        with dist.use_mesh(sep_mesh):
+            out = ring_attention_values(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                sep_mesh, causal=True, balance="zigzag")
+        ref = _sdpa_xla(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                        causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_grad_matches_contiguous_ring(self, sep_mesh):
+        q, k, v = self._qkv(seed=2)
+
+        def loss_zig(qq, kk, vv):
+            with dist.use_mesh(sep_mesh):
+                o = ring_attention_values(qq, kk, vv, sep_mesh,
+                                          causal=True, balance="zigzag")
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        def loss_ring(qq, kk, vv):
+            with dist.use_mesh(sep_mesh):
+                o = ring_attention_values(qq, kk, vv, sep_mesh,
+                                          causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        g1 = jax.grad(loss_zig, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        g2 = jax.grad(loss_ring, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-4, atol=3e-4)
+
+    def test_noncausal_ignores_balance(self, sep_mesh):
+        q, k, v = self._qkv(seed=3)
+        with dist.use_mesh(sep_mesh):
+            a = ring_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), sep_mesh,
+                                      causal=False, balance="zigzag")
+            b = ring_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), sep_mesh,
+                                      causal=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
