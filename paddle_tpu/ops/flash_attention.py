@@ -39,6 +39,13 @@ from ..core.tensor import Tensor, apply
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
+# Measured on the v5e (block sweep, round 3): per-grid-step overhead — not
+# MXU flops — dominates below ~(512, 512); (1024, 1024) is 3.2x faster fwd
+# and 3.5x faster bwd than (128, 128) at the bench shape (B8 S2048 H16 D64)
+# and beats both the stock jax flash kernel and splash defaults. Blocks are
+# therefore chosen as the largest power-of-two divisor of the sequence
+# length up to MAX_BLOCK, with a VMEM guard for large head dims.
+MAX_BLOCK = 1024
 NEG_INF = -1e30
 # Per-row scalars (lse, delta) are stored broadcast across a full 128-lane
 # vector register: Mosaic requires the minor block dim to be 128-aligned, so
@@ -63,6 +70,29 @@ def can_use_flash(q_shape, k_shape, dtype) -> bool:
     b, sq, h, d = q_shape
     sk = k_shape[1]
     return _aligned(sq, sk, d, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+
+
+def _auto_block(n: int, d: int, other: int = MAX_BLOCK) -> int:
+    """Largest power-of-two divisor of n in [128, MAX_BLOCK], shrunk while
+    the fp32 logits tile + operand blocks would overflow ~12 MB of VMEM.
+    Non-128-divisible n gets min(128, n) — the shape the XLA fallback
+    handles (callers gate on `_aligned`)."""
+    if n % 128:
+        return min(128, n)
+    b = 128
+    while b * 2 <= min(n, MAX_BLOCK) and n % (b * 2) == 0:
+        b *= 2
+    while b > 128 and b * other * 8 + (b + 2 * other) * d * 4 > 12e6:
+        b //= 2
+    return b
+
+
+def _compiler_params(*sem):
+    """Mosaic grid semantics ('parallel' dims may be reordered/partitioned;
+    the accumulation dim must stay 'arbitrary'). None in interpret mode."""
+    if _interpret() or not _HAS_PLTPU:
+        return None
+    return pltpu.CompilerParams(dimension_semantics=tuple(sem))
 
 
 def _causal_mask(s, qi, ki, block_q, block_k, offset):
@@ -163,6 +193,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, group):
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
+        compiler_params=_compiler_params("parallel", "parallel",
+                                         "arbitrary"),
         interpret=_interpret(),
     )(q, k, v)
     return o, lse
@@ -297,6 +329,8 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, group):
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=_compiler_params("parallel", "parallel",
+                                         "arbitrary"),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
 
@@ -328,6 +362,8 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, group):
         ],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=_compiler_params("parallel", "parallel",
+                                         "arbitrary"),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
@@ -374,8 +410,8 @@ def flash_attention_values(q, k, v, causal=False, scale=None,
     sk = k.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    bq = block_q or min(DEFAULT_BLOCK_Q, sq)
-    bk = block_k or min(DEFAULT_BLOCK_K, sk)
+    bq = block_q or _auto_block(sq, d)
+    bk = block_k or _auto_block(sk, d)
     if not _aligned(sq, sk, d, bq, bk) or h % hk:
         # blocked kernel can't tile this shape — XLA fallback, identical math
         return _attention_xla(q, k, v, float(scale), bool(causal))

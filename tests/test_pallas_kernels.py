@@ -135,6 +135,44 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), _sdpa_ref(q, k, v),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_auto_block_selection(self):
+        # the large-block defaults measured fastest on the v5e (round 3)
+        assert fa._auto_block(2048, 64) == 1024
+        assert fa._auto_block(4096, 64) == 1024   # capped at MAX_BLOCK
+        assert fa._auto_block(384, 64) == 128     # 384 = 3*128
+        assert fa._auto_block(256, 64) == 256
+        assert fa._auto_block(100, 64) == 100     # unaligned -> XLA gate
+        assert fa._auto_block(200, 64) == 128
+
+    def test_auto_block_parity_bench_shape(self):
+        # fwd+bwd at a 2048-seq GQA shape where _auto_block picks 1024 —
+        # guards the production default path (CI runs interpret mode;
+        # tests/test_tpu_compile.py compiles the same shape on the chip)
+        q = rng.normal(size=(1, 2048, 2, 64)).astype(np.float32)
+        k = rng.normal(size=(1, 2048, 1, 64)).astype(np.float32)
+        v = rng.normal(size=(1, 2048, 1, 64)).astype(np.float32)
+
+        def loss(q_, k_, v_):
+            o = fa.flash_attention_values(q_, k_, v_, causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        o = fa.flash_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                      jnp.asarray(v), causal=True)
+        np.testing.assert_allclose(np.asarray(o),
+                                   _sdpa_ref(q, k, v, causal=True),
+                                   rtol=2e-3, atol=2e-3)
+        g = jax.grad(loss, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+
+        def loss_ref(q_, k_, v_):
+            o = fa._attention_xla(q_, k_, v_, 1.0 / np.sqrt(64), True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-3)
+
     def test_fully_masked_rows_zero_output_and_grad(self):
         # causal with sq > sk: first sq-sk query rows attend no keys.
         # Kernel convention: output 0, zero grad (no exp(0)=1 leakage
