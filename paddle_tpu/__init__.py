@@ -41,6 +41,7 @@ from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
 from . import audio  # noqa: F401
+from . import geometric  # noqa: F401
 from . import vision  # noqa: F401
 
 from .device import (get_device, set_device, is_compiled_with_cuda,  # noqa: F401

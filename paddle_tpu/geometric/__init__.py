@@ -1,0 +1,172 @@
+"""paddle_tpu.geometric — graph learning ops.
+
+≙ reference «python/paddle/geometric/» [U] (segment ops + graph
+message-passing send/recv, SURVEY.md §2.2 Python-API row). TPU-first
+design: everything lowers to `jax.ops.segment_*` scatter-reductions,
+which XLA compiles to efficient sorted-segment kernels; there is no
+dynamic shape anywhere as long as `out_size`/`num_segments` is given
+(mandatory under jit — eager falls back to `max(ids) + 1`, which incurs
+a D2H sync, exactly like the reference's dynamic-shape GPU kernels).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply, to_tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "send_u_recv", "send_ue_recv", "send_uv",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _num_segments(ids: Tensor, out_size) -> int:
+    if out_size is not None:
+        return int(out_size)
+    if not ids.shape[0]:
+        return 0
+    try:
+        # eager-only path: concretize (D2H sync)
+        return int(np.asarray(ids._value).max()) + 1
+    except jax.errors.TracerArrayConversionError:
+        raise ValueError(
+            "segment op under jit needs a static segment count: XLA has no "
+            "dynamic output shapes. Use send_u_recv(..., out_size=N) or "
+            "call the segment op outside the traced region.") from None
+
+
+_SEG = {
+    "sum": jax.ops.segment_sum,
+    "mean": jax.ops.segment_sum,     # divided by counts below
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+def _segment(op_name, data, segment_ids, n, reduce):
+    def fn(d, ids):
+        out = _SEG[reduce](d, ids, num_segments=n)
+        if reduce == "mean":
+            cnt = jax.ops.segment_sum(jnp.ones(ids.shape, d.dtype), ids,
+                                      num_segments=n)
+            out = out / jnp.maximum(cnt, 1).reshape(
+                (-1,) + (1,) * (d.ndim - 1))
+        if reduce in ("min", "max"):
+            # empty segments come back +/-inf; the reference zeroes them
+            cnt = jax.ops.segment_sum(jnp.ones(ids.shape, jnp.int32), ids,
+                                      num_segments=n)
+            mask = (cnt > 0).reshape((-1,) + (1,) * (d.ndim - 1))
+            out = jnp.where(mask, out, jnp.zeros_like(out))
+        return out
+    return apply(op_name, fn, (data, segment_ids))
+
+
+def segment_sum(data, segment_ids, name=None):
+    """≙ paddle.geometric.segment_sum. segment_ids must be sorted in the
+    reference; here any order works (scatter-add)."""
+    data, segment_ids = _t(data), _t(segment_ids)
+    return _segment("segment_sum", data, segment_ids,
+                    _num_segments(segment_ids, None), "sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    data, segment_ids = _t(data), _t(segment_ids)
+    return _segment("segment_mean", data, segment_ids,
+                    _num_segments(segment_ids, None), "mean")
+
+
+def segment_min(data, segment_ids, name=None):
+    data, segment_ids = _t(data), _t(segment_ids)
+    return _segment("segment_min", data, segment_ids,
+                    _num_segments(segment_ids, None), "min")
+
+
+def segment_max(data, segment_ids, name=None):
+    data, segment_ids = _t(data), _t(segment_ids)
+    return _segment("segment_max", data, segment_ids,
+                    _num_segments(segment_ids, None), "max")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Graph message passing: gather x at src_index, scatter-reduce onto
+    dst_index. ≙ paddle.geometric.send_u_recv («python/paddle/geometric/
+    message_passing/send_recv.py» [U])."""
+    if reduce_op not in _SEG:
+        raise ValueError(f"reduce_op must be one of {list(_SEG)}, "
+                         f"got {reduce_op}")
+    x, src_index, dst_index = _t(x), _t(src_index), _t(dst_index)
+    n = out_size if out_size is not None else x.shape[0]
+
+    def fn(v, src, dst):
+        msg = jnp.take(v, src, axis=0)
+        out = _SEG[reduce_op](msg, dst, num_segments=n)
+        if reduce_op == "mean":
+            cnt = jax.ops.segment_sum(jnp.ones(dst.shape, v.dtype), dst,
+                                      num_segments=n)
+            out = out / jnp.maximum(cnt, 1).reshape(
+                (-1,) + (1,) * (v.ndim - 1))
+        if reduce_op in ("min", "max"):
+            cnt = jax.ops.segment_sum(jnp.ones(dst.shape, jnp.int32), dst,
+                                      num_segments=n)
+            mask = (cnt > 0).reshape((-1,) + (1,) * (v.ndim - 1))
+            out = jnp.where(mask, out, jnp.zeros_like(out))
+        return out
+    return apply("send_u_recv", fn, (x, src_index, dst_index))
+
+
+_MSG = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Node features x combined with edge features y along each edge, then
+    scatter-reduced. ≙ paddle.geometric.send_ue_recv."""
+    if message_op not in _MSG:
+        raise ValueError(f"message_op must be one of {list(_MSG)}")
+    if reduce_op not in _SEG:
+        raise ValueError(f"reduce_op must be one of {list(_SEG)}")
+    x, y = _t(x), _t(y)
+    src_index, dst_index = _t(src_index), _t(dst_index)
+    n = out_size if out_size is not None else x.shape[0]
+
+    def fn(v, e, src, dst):
+        msg = _MSG[message_op](jnp.take(v, src, axis=0), e)
+        out = _SEG[reduce_op](msg, dst, num_segments=n)
+        if reduce_op == "mean":
+            cnt = jax.ops.segment_sum(jnp.ones(dst.shape, msg.dtype), dst,
+                                      num_segments=n)
+            out = out / jnp.maximum(cnt, 1).reshape(
+                (-1,) + (1,) * (msg.ndim - 1))
+        if reduce_op in ("min", "max"):
+            cnt = jax.ops.segment_sum(jnp.ones(dst.shape, jnp.int32), dst,
+                                      num_segments=n)
+            mask = (cnt > 0).reshape((-1,) + (1,) * (msg.ndim - 1))
+            out = jnp.where(mask, out, jnp.zeros_like(out))
+        return out
+    return apply("send_ue_recv", fn, (x, y, src_index, dst_index))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoint features (no reduction):
+    out[e] = x[src[e]] (op) y[dst[e]]. ≙ paddle.geometric.send_uv."""
+    if message_op not in _MSG:
+        raise ValueError(f"message_op must be one of {list(_MSG)}")
+    x, y = _t(x), _t(y)
+    src_index, dst_index = _t(src_index), _t(dst_index)
+
+    def fn(a, b, src, dst):
+        return _MSG[message_op](jnp.take(a, src, axis=0),
+                                jnp.take(b, dst, axis=0))
+    return apply("send_uv", fn, (x, y, src_index, dst_index))

@@ -265,3 +265,48 @@ def dist(x, y, p=2, name=None):
             return jnp.sum(d != 0).astype(a.dtype)
         return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
     return apply("dist", fn, (_t(x), _t(y)))
+
+
+# -- round-3 breadth additions (Paddle 3.x linalg surface) -------------------
+def lu_solve(b, lu_data, lu_pivots, trans="N", name=None):
+    """≙ paddle.linalg.lu_solve: solve A x = b from lu() factors [U]."""
+    tcode = {"N": 0, "T": 1, "H": 2}[trans]
+
+    def fn(bb, lu_, piv):
+        return jax.scipy.linalg.lu_solve(
+            (lu_, piv.astype(jnp.int32) - 1), bb, trans=tcode)
+    return apply("lu_solve", fn, (_t(b), _t(lu_data), _t(lu_pivots)))
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """≙ paddle.linalg.cholesky_inverse: inverse of A from its Cholesky
+    factor [U]."""
+    def fn(l):
+        eye = jnp.eye(l.shape[-1], dtype=l.dtype)
+        return jax.scipy.linalg.cho_solve((l, not upper), eye)
+    return apply("cholesky_inverse", fn, (_t(x),))
+
+
+def matrix_transpose(x, name=None):
+    """≙ paddle.linalg.matrix_transpose (swap last two dims) [U]."""
+    return apply("matrix_transpose",
+                 lambda v: jnp.swapaxes(v, -1, -2), (_t(x),))
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """≙ paddle.linalg.ormqr: multiply y by Q from a householder-packed
+    qr (geqrf-style x, tau) [U]. Built from householder_product — XLA has
+    no direct ormqr primitive; Q is materialized (fine for the moderate
+    sizes this API sees)."""
+    def fn(a, t, b):
+        m, k = a.shape[-2], t.shape[-1]
+        # pad packed reflectors to (m, m) / tau to (m,) so the product is
+        # the FULL orthogonal Q (extra zero-tau reflectors are identity)
+        a_full = jnp.zeros(a.shape[:-1] + (m,), a.dtype) \
+            .at[..., :, :a.shape[-1]].set(a)
+        t_full = jnp.zeros(t.shape[:-1] + (m,), t.dtype) \
+            .at[..., :k].set(t)
+        q = jax.lax.linalg.householder_product(a_full, t_full)
+        qm = jnp.swapaxes(q, -1, -2) if transpose else q
+        return qm @ b if left else b @ qm
+    return apply("ormqr", fn, (_t(x), _t(tau), _t(y)))

@@ -638,3 +638,79 @@ def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
                                  weights=w)
     return (Tensor(jnp.asarray(hist)),
             [Tensor(jnp.asarray(e)) for e in edges])
+
+
+# -- round-3 breadth additions (Paddle 3.x surface) --------------------------
+def block_diag(inputs, name=None):
+    """≙ paddle.block_diag: block-diagonal matrix from a list of 2-D
+    tensors [U]."""
+    mats = [_t(m) for m in inputs]
+
+    def fn(*ms):
+        ms = [jnp.atleast_2d(m) for m in ms]
+        rows = sum(m.shape[0] for m in ms)
+        cols = sum(m.shape[1] for m in ms)
+        out = jnp.zeros((rows, cols), ms[0].dtype)
+        r = c = 0
+        for m in ms:
+            out = out.at[r:r + m.shape[0], c:c + m.shape[1]].set(m)
+            r += m.shape[0]
+            c += m.shape[1]
+        return out
+    return apply("block_diag", fn, tuple(mats))
+
+
+def cartesian_prod(x, name=None):
+    """≙ paddle.cartesian_prod: cartesian product of 1-D tensors [U]."""
+    ts = [_t(v) for v in x]
+
+    def fn(*vs):
+        grids = jnp.meshgrid(*vs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    return apply("cartesian_prod", fn, tuple(ts))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """≙ paddle.diagonal_scatter: write y onto the selected diagonal
+    of x [U]."""
+    def fn(v, s):
+        n1, n2 = v.shape[axis1 % v.ndim], v.shape[axis2 % v.ndim]
+        if offset >= 0:
+            dlen = min(n1, n2 - offset)
+            i1 = jnp.arange(dlen)
+            i2 = jnp.arange(dlen) + offset
+        else:
+            dlen = min(n1 + offset, n2)
+            i1 = jnp.arange(dlen) - offset
+            i2 = jnp.arange(dlen)
+        # transpose the two diagonal dims last (matching jnp.diagonal's
+        # output layout, which is what `y` must be shaped like), write the
+        # diagonal with .at[], untranspose
+        perm = [d for d in range(v.ndim)
+                if d not in (axis1 % v.ndim, axis2 % v.ndim)] \
+            + [axis1 % v.ndim, axis2 % v.ndim]
+        inv = [perm.index(d) for d in range(v.ndim)]
+        vt = jnp.transpose(v, perm)          # (..., n1, n2)
+        vt = vt.at[..., i1, i2].set(s.astype(v.dtype))
+        return jnp.transpose(vt, inv)
+    return apply("diagonal_scatter", fn, (_t(x), _t(y)))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    """≙ paddle.select_scatter: write `values` into x at `index` along
+    `axis` [U]."""
+    def fn(v, s):
+        idx = [builtins_slice(None)] * v.ndim
+        idx[axis % v.ndim] = index
+        return v.at[tuple(idx)].set(s.astype(v.dtype))
+    return apply("select_scatter", fn, (_t(x), _t(values)))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """≙ paddle.slice_scatter [U]."""
+    def fn(v, s):
+        idx = [builtins_slice(None)] * v.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax % v.ndim] = builtins_slice(st, en, sd)
+        return v.at[tuple(idx)].set(s.astype(v.dtype))
+    return apply("slice_scatter", fn, (_t(x), _t(value)))

@@ -119,6 +119,7 @@ bitwise_and = _binary("bitwise_and", jnp.bitwise_and)
 bitwise_or = _binary("bitwise_or", jnp.bitwise_or)
 bitwise_xor = _binary("bitwise_xor", jnp.bitwise_xor)
 bitwise_not = _unary("bitwise_not", jnp.bitwise_not)
+bitwise_invert = bitwise_not  # paddle 3.x alias
 bitwise_left_shift = _binary("bitwise_left_shift", jnp.left_shift)
 bitwise_right_shift = _binary("bitwise_right_shift", jnp.right_shift)
 
@@ -511,3 +512,80 @@ def pdist(x, p=2.0, name=None):
             return jnp.max(jnp.abs(d), axis=-1)
         return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
     return apply("pdist", fn, (_t(x),))
+
+
+# -- round-3 breadth additions (Paddle 3.x surface) --------------------------
+def float_power(x, y, name=None):
+    """≙ paddle.float_power — always computes in float64-compat fp32
+    (closest TPU-native: fp32) [U]."""
+    def fn(a, b=None):
+        a = a.astype(jnp.float32)
+        b = (b.astype(jnp.float32) if b is not None
+             else jnp.float32(y))
+        return a ** b
+    if isinstance(y, Tensor):
+        return apply("float_power", fn, (_t(x), y))
+    return apply("float_power", lambda a: fn(a), (_t(x),))
+
+
+def positive(x, name=None):
+    """≙ paddle.positive (identity on numeric tensors) [U]."""
+    return apply("positive", lambda v: +v, (_t(x),))
+
+
+def isposinf(x, name=None):
+    return apply("isposinf", jnp.isposinf, (_t(x),))
+
+
+def isneginf(x, name=None):
+    return apply("isneginf", jnp.isneginf, (_t(x),))
+
+
+def isreal(x, name=None):
+    return apply("isreal", jnp.isreal, (_t(x),))
+
+
+def gammainc(x, y, name=None):
+    """≙ paddle.gammainc — regularized lower incomplete gamma P(x, y)."""
+    return apply("gammainc", jax.scipy.special.gammainc, (_t(x), _t(y)))
+
+
+def gammaincc(x, y, name=None):
+    """≙ paddle.gammaincc — regularized upper incomplete gamma Q(x, y)."""
+    return apply("gammaincc", jax.scipy.special.gammaincc, (_t(x), _t(y)))
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """≙ paddle.cumulative_trapezoid [U]."""
+    def cumtrap(yy, xx=None):
+        yl = jax.lax.slice_in_dim(yy, 0, yy.shape[axis] - 1, axis=axis)
+        yr = jax.lax.slice_in_dim(yy, 1, yy.shape[axis], axis=axis)
+        if xx is not None:
+            xl = jax.lax.slice_in_dim(xx, 0, xx.shape[axis] - 1, axis=axis)
+            xr = jax.lax.slice_in_dim(xx, 1, xx.shape[axis], axis=axis)
+            step = xr - xl
+        else:
+            step = dx if dx is not None else 1.0
+        return jnp.cumsum((yl + yr) * 0.5 * step, axis=axis)
+    if x is not None:
+        return apply("cumulative_trapezoid",
+                     lambda a, b: cumtrap(a, b), (_t(y), _t(x)))
+    return apply("cumulative_trapezoid", cumtrap, (_t(y),))
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """≙ paddle.linalg.vecdot / paddle.vecdot [U]."""
+    return apply("vecdot",
+                 lambda a, b: jnp.vecdot(a, b, axis=axis), (_t(x), _t(y)))
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    """≙ paddle.histogram_bin_edges [U]."""
+    lo, hi = float(min), float(max)
+
+    def fn(v):
+        l, h = lo, hi
+        if l == 0.0 and h == 0.0:
+            l, h = jnp.min(v), jnp.max(v)
+        return jnp.linspace(l, h, bins + 1, dtype=jnp.float32)
+    return apply("histogram_bin_edges", fn, (_t(input),))

@@ -2,5 +2,6 @@
 ≙ reference «python/paddle/vision/» [U]. The DiT/SD3 north-star models live in
 paddle_tpu.models; this module provides the torchvision-like utility surface."""
 from . import datasets  # noqa: F401
+from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from .models import ResNet, resnet18, resnet50  # noqa: F401

@@ -1,0 +1,422 @@
+"""paddle_tpu.vision.ops — detection/vision operators.
+
+≙ reference «python/paddle/vision/ops.py» + PHI detection kernels
+(«paddle/phi/kernels/*/nms_kernel*», «roi_align_kernel*»,
+«deformable_conv_kernel*» [U]; SURVEY.md §2.2 vision row). TPU-first
+notes per op:
+
+* `nms` — iterative suppression is sequential by nature; implemented as a
+  `lax.while_loop` over a boolean keep-mask (static shapes, jittable).
+  The returned index list is eager-only (dynamic length), matching the
+  reference's dynamic output; under jit use the mask helper `_nms_mask`.
+* `roi_align` / `roi_pool` — bilinear gather + mean/max over a static
+  (out_h, out_w, samples) grid: pure gather/reduce, MXU-free but
+  vectorized over ROIs via vmap.
+* `deform_conv2d` — offset-guided bilinear gather to an im2col patch
+  tensor, then ONE big matmul (the MXU does the work; the reference's
+  CUDA kernel interleaves gather+mac instead).
+* box utils (`box_coder`, `box_area`, `box_iou`) — elementwise.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, apply, to_tensor
+
+__all__ = ["nms", "box_area", "box_iou", "box_coder", "roi_align",
+           "roi_pool", "deform_conv2d", "DeformConv2D", "RoIAlign",
+           "RoIPool"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+# ---------------------------------------------------------------------------
+# boxes
+# ---------------------------------------------------------------------------
+def box_area(boxes):
+    """(N, 4) xyxy -> (N,) areas."""
+    def fn(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return apply("box_area", fn, (_t(boxes),))
+
+
+def _iou_matrix(a, b):
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / jnp.maximum(area_a[:, None] + area_b[None, :] - inter,
+                               1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU: (N, 4) x (M, 4) -> (N, M)."""
+    return apply("box_iou", _iou_matrix, (_t(boxes1), _t(boxes2)))
+
+
+def _nms_mask_values(boxes, scores, iou_threshold):
+    """Greedy NMS as a jittable fixed-shape program. Returns a bool keep
+    mask; equivalent to suppressing in descending-score order."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = jnp.take(boxes, order, axis=0)
+    iou = _iou_matrix(b, b)
+
+    def body(i, keep):
+        # suppress j > i iff keep[i] and iou(i, j) > thr
+        sup = (iou[i] > iou_threshold) & keep[i] \
+            & (jnp.arange(n) > i)
+        return keep & ~sup
+
+    keep_sorted = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    # unsort back to input order
+    inv = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n))
+    return jnp.take(keep_sorted, inv)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """≙ paddle.vision.ops.nms. Returns kept indices sorted by descending
+    score (dynamic length — eager only, like the reference's GPU op;
+    use the mask from inside jit)."""
+    boxes_t = _t(boxes)
+    if scores is None:
+        scores_t = to_tensor(np.arange(boxes_t.shape[0], 0, -1,
+                                       dtype=np.float32))
+    else:
+        scores_t = _t(scores)
+
+    if category_idxs is not None:
+        # per-category NMS: offset boxes per category so they never overlap
+        cat = _t(category_idxs)
+
+        def shift(b, c):
+            off = c.astype(b.dtype)[:, None] * (
+                jnp.max(b) - jnp.min(b) + 1.0)
+            return b + off
+        boxes_for_iou = apply("nms_cat_shift", shift, (boxes_t, cat))
+    else:
+        boxes_for_iou = boxes_t
+
+    keep = apply(
+        "nms_mask",
+        lambda b, s: _nms_mask_values(b, s, float(iou_threshold)),
+        (boxes_for_iou, scores_t))
+    keep_np = np.asarray(keep._value)
+    scores_np = np.asarray(scores_t._value)
+    idx = np.nonzero(keep_np)[0]
+    idx = idx[np.argsort(-scores_np[idx], kind="stable")]
+    if top_k is not None:
+        idx = idx[:top_k]
+    return to_tensor(idx.astype(np.int64))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """≙ paddle.vision.ops.box_coder (encode/decode between corner boxes
+    and center-size offsets)."""
+    pb, tb = _t(prior_box), _t(target_box)
+    pbv = _t(prior_box_var) if not np.isscalar(prior_box_var) \
+        and not isinstance(prior_box_var, (list, tuple)) else prior_box_var
+    norm = 1.0 if box_normalized else 0.0
+
+    def dims(p):
+        w = p[..., 2] - p[..., 0] + (1.0 - norm)
+        h = p[..., 3] - p[..., 1] + (1.0 - norm)
+        cx = p[..., 0] + w * 0.5
+        cy = p[..., 1] + h * 0.5
+        return w, h, cx, cy
+
+    def var_of(p_shape):
+        if isinstance(pbv, (int, float)):
+            return jnp.full(p_shape[:-1] + (4,), float(pbv))
+        if isinstance(pbv, (list, tuple)):
+            return jnp.broadcast_to(jnp.asarray(pbv, jnp.float32),
+                                    p_shape[:-1] + (4,))
+        return None
+
+    if code_type == "encode_center_size":
+        def fn(p, t, *v):
+            pw, ph, pcx, pcy = dims(p[None, :, :])      # (1, M, 4) dims
+            tw, th, tcx, tcy = dims(t[:, None, :])      # (N, 1, 4) dims
+            out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                             jnp.log(tw / pw), jnp.log(th / ph)], axis=-1)
+            vv = v[0][None, :, :] if v else var_of(out.shape)
+            return out / vv if vv is not None else out
+        args = (pb, tb) + ((pbv,) if isinstance(pbv, Tensor) else ())
+        return apply("box_encode", fn, args)
+    elif code_type == "decode_center_size":
+        def fn(p, t, *v):
+            if axis == 0:
+                pq = p[None, :, :]
+            else:
+                pq = p[:, None, :]
+            pw, ph, pcx, pcy = dims(pq)
+            vv = v[0] if v else var_of(t.shape)
+            if vv is not None:
+                if isinstance(pbv, Tensor):
+                    vv = vv[None, :, :] if axis == 0 else vv[:, None, :]
+                t = t * vv
+            ocx = t[..., 0] * pw + pcx
+            ocy = t[..., 1] * ph + pcy
+            ow = jnp.exp(t[..., 2]) * pw
+            oh = jnp.exp(t[..., 3]) * ph
+            return jnp.stack([ocx - ow * 0.5, ocy - oh * 0.5,
+                              ocx + ow * 0.5 - (1.0 - norm),
+                              ocy + oh * 0.5 - (1.0 - norm)], axis=-1)
+        args = (pb, tb) + ((pbv,) if isinstance(pbv, Tensor) else ())
+        return apply("box_decode", fn, args)
+    raise ValueError(f"unknown code_type {code_type}")
+
+
+# ---------------------------------------------------------------------------
+# roi ops
+# ---------------------------------------------------------------------------
+def _bilinear(feat, y, x):
+    """feat (C, H, W); y/x arbitrary same-shaped coords -> (C, *coords)."""
+    c, h, w = feat.shape
+    y0 = jnp.clip(jnp.floor(y), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    ly = jnp.clip(y - y0, 0.0, 1.0)
+    lx = jnp.clip(x - x0, 0.0, 1.0)
+    y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+    x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+
+    def g(yi, xi):
+        return feat[:, yi, xi]                        # (C, *coords)
+
+    out = (g(y0i, x0i) * ((1 - ly) * (1 - lx))
+           + g(y0i, x1i) * ((1 - ly) * lx)
+           + g(y1i, x0i) * (ly * (1 - lx))
+           + g(y1i, x1i) * (ly * lx))
+    # outside the feature map entirely -> 0 (reference convention)
+    valid = (y > -1) & (y < h) & (x > -1) & (x < w)
+    return jnp.where(valid[None], out, 0.0)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """≙ paddle.vision.ops.roi_align («paddle/phi/kernels/*/roi_align*»
+    [U]). x (N, C, H, W); boxes (R, 4) xyxy; boxes_num (N,) ROIs per
+    image."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    x_t, boxes_t, bn_t = _t(x), _t(boxes), _t(boxes_num)
+    # static per-image box batch index (host-computed, like the reference's
+    # lod/boxes_num handling)
+    bn = np.asarray(bn_t._value)
+    batch_idx = np.repeat(np.arange(bn.shape[0]), bn)
+
+    def fn(feat, bxs):
+        off = 0.5 if aligned else 0.0
+        s = sampling_ratio if sampling_ratio > 0 else 2
+
+        def one(b_idx, box):
+            x1, y1, x2, y2 = (box * spatial_scale) - off
+            rw = jnp.maximum(x2 - x1, 1e-10 if aligned else 1.0)
+            rh = jnp.maximum(y2 - y1, 1e-10 if aligned else 1.0)
+            bh, bw = rh / oh, rw / ow
+            iy = (jnp.arange(oh)[:, None, None, None]
+                  * jnp.ones((1, ow, s, s)))
+            ix = (jnp.arange(ow)[None, :, None, None]
+                  * jnp.ones((oh, 1, s, s)))
+            sy = (jnp.arange(s)[None, None, :, None] + 0.5) / s
+            sx = (jnp.arange(s)[None, None, None, :] + 0.5) / s
+            yy = y1 + (iy + sy) * bh
+            xx = x1 + (ix + sx) * bw
+            vals = _bilinear(feat[b_idx], yy, xx)     # (C, oh, ow, s, s)
+            return vals.mean(axis=(-1, -2))           # (C, oh, ow)
+
+        return jax.vmap(one)(jnp.asarray(batch_idx), bxs)
+    return apply("roi_align", fn, (x_t, boxes_t))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """≙ paddle.vision.ops.roi_pool (max pooling per bin, quantized
+    coords)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    x_t, boxes_t, bn_t = _t(x), _t(boxes), _t(boxes_num)
+    bn = np.asarray(bn_t._value)
+    batch_idx = np.repeat(np.arange(bn.shape[0]), bn)
+    H, W = x_t.shape[2], x_t.shape[3]
+
+    def fn(feat, bxs):
+        def one(b_idx, box):
+            x1 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
+            y1 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
+            x2 = jnp.round(box[2] * spatial_scale).astype(jnp.int32)
+            y2 = jnp.round(box[3] * spatial_scale).astype(jnp.int32)
+            rh = jnp.maximum(y2 - y1 + 1, 1)
+            rw = jnp.maximum(x2 - x1 + 1, 1)
+            ys = jnp.arange(H)
+            xs = jnp.arange(W)
+
+            def binmax(i, j):
+                hstart = y1 + (i * rh) // oh
+                hend = y1 + ((i + 1) * rh + oh - 1) // oh
+                wstart = x1 + (j * rw) // ow
+                wend = x1 + ((j + 1) * rw + ow - 1) // ow
+                m = ((ys[:, None] >= hstart) & (ys[:, None] < hend)
+                     & (xs[None, :] >= wstart) & (xs[None, :] < wend)
+                     & (ys[:, None] < H) & (xs[None, :] < W))
+                sel = jnp.where(m[None], feat[b_idx], -jnp.inf)
+                out = sel.max(axis=(1, 2))
+                return jnp.where(jnp.any(m), out, 0.0)
+            ii = jnp.arange(oh)
+            jj = jnp.arange(ow)
+            grid = jax.vmap(lambda i: jax.vmap(
+                lambda j: binmax(i, j))(jj))(ii)      # (oh, ow, C)
+            return jnp.transpose(grid, (2, 0, 1))
+        return jax.vmap(one)(jnp.asarray(batch_idx), bxs)
+    return apply("roi_pool", fn, (x_t, boxes_t))
+
+
+# ---------------------------------------------------------------------------
+# deformable conv
+# ---------------------------------------------------------------------------
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """≙ paddle.vision.ops.deform_conv2d (DCNv1; DCNv2 when mask given).
+    TPU design: bilinear-gather the deformed im2col patches, then one
+    (N*OH*OW, C*KH*KW) @ (C*KH*KW, Cout) matmul on the MXU."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+    x_t, off_t, w_t = _t(x), _t(offset), _t(weight)
+    args = [x_t, off_t, w_t]
+    if mask is not None:
+        args.append(_t(mask))
+    if bias is not None:
+        args.append(_t(bias))
+    has_mask = mask is not None
+    has_bias = bias is not None
+
+    def fn(xv, ov, wv, *rest):
+        mv = rest[0] if has_mask else None
+        bv = rest[-1] if has_bias else None
+        n, c, h, w = xv.shape
+        cout, cin_g, kh, kw = wv.shape
+        oh = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (w + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        dg = deformable_groups
+        # offsets (N, 2*dg*kh*kw, OH, OW) in (dy, dx) pairs
+        ov2 = ov.reshape(n, dg, kh * kw, 2, oh, ow)
+
+        base_y = (jnp.arange(oh) * st[0] - pd[0])
+        base_x = (jnp.arange(ow) * st[1] - pd[1])
+        ky = jnp.arange(kh) * dl[0]
+        kx = jnp.arange(kw) * dl[1]
+        # sampling positions (dg, kh*kw, OH, OW)
+        yy = (base_y[None, None, :, None]
+              + ky.repeat(kw)[None, :, None, None]
+              + ov2[:, :, :, 0])
+        xx = (base_x[None, None, None, :]
+              + jnp.tile(kx, kh)[None, :, None, None]
+              + ov2[:, :, :, 1])
+
+        cg = c // dg
+
+        def per_image(feat, y_i, x_i, m_i):
+            # feat (C,H,W); y_i/x_i (dg, khkw, OH, OW)
+            def per_dg(fg, yg, xg):
+                return _bilinear(fg, yg, xg)          # (cg, khkw, OH, OW)
+            vals = jax.vmap(per_dg)(feat.reshape(dg, cg, h, w), y_i, x_i)
+            vals = vals.reshape(c, kh * kw, oh, ow)
+            if m_i is not None:
+                vals = vals * m_i.reshape(dg, 1, kh * kw, oh, ow) \
+                    .repeat(cg, axis=1).reshape(c, kh * kw, oh, ow)
+            return vals
+
+        ms = (mv.reshape(n, dg, kh * kw, oh, ow) if mv is not None
+              else [None] * n)
+        cols = jax.vmap(per_image)(xv, yy, xx,
+                                   ms if mv is not None else None) \
+            if mv is not None else jax.vmap(
+                lambda f, a, b: per_image(f, a, b, None))(xv, yy, xx)
+        # cols (N, C, khkw, OH, OW) -> (N*OH*OW, C*khkw) matmul
+        cols = jnp.transpose(cols, (0, 3, 4, 1, 2)).reshape(
+            n * oh * ow, c * kh * kw)
+        wmat = wv.reshape(cout, cin_g * kh * kw)
+        if groups == 1:
+            out = cols @ wmat.T
+        else:
+            cols_g = cols.reshape(n * oh * ow, groups,
+                                  cin_g * kh * kw)
+            w_g = wmat.reshape(groups, cout // groups, cin_g * kh * kw)
+            out = jnp.einsum("bgk,gok->bgo", cols_g, w_g).reshape(
+                n * oh * ow, cout)
+        out = out.reshape(n, oh, ow, cout).transpose(0, 3, 1, 2)
+        if bv is not None:
+            out = out + bv.reshape(1, cout, 1, 1)
+        return out.astype(xv.dtype)
+    return apply("deform_conv2d", fn, tuple(args))
+
+
+# ---------------------------------------------------------------------------
+# layer wrappers
+# ---------------------------------------------------------------------------
+from ..nn.layer.layers import Layer  # noqa: E402
+
+
+class DeformConv2D(Layer):
+    """≙ paddle.vision.ops.DeformConv2D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        from ..nn import initializer as init
+        fan_in = in_channels * ks[0] * ks[1]
+        bound = 1.0 / np.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, ks[0], ks[1]),
+            default_initializer=init.Uniform(-bound, bound))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_channels,), is_bias=True,
+                default_initializer=init.Uniform(-bound, bound))
+        self._stride, self._padding, self._dilation = stride, padding, \
+            dilation
+        self._dg, self._groups = deformable_groups, groups
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self._stride, self._padding, self._dilation,
+                             self._dg, self._groups, mask)
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._o, self._s = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._o, self._s)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._o, self._s = output_size, spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._o, self._s)

@@ -286,3 +286,129 @@ class TestRound3Ops:
             paddle.to_tensor(x), paddle.to_tensor(g_ref), mode=mode,
             align_corners=align_corners)._value)
         np.testing.assert_allclose(o_got, o_ref, atol=1e-4)
+
+
+class TestRound3Breadth:
+    """Round-3 op additions: scatter variants, block_diag, special fns,
+    linalg extensions. NumPy/scipy oracles (SURVEY.md §4 OpTest)."""
+
+    def test_block_diag_and_cartesian_prod(self):
+        a = rng.normal(size=(2, 3)).astype(np.float32)
+        b = rng.normal(size=(1, 2)).astype(np.float32)
+        out = paddle.block_diag([paddle.to_tensor(a), paddle.to_tensor(b)])
+        import scipy.linalg as sl
+        np.testing.assert_allclose(out.numpy(), sl.block_diag(a, b))
+
+        u = np.array([1, 2], np.int32)
+        v = np.array([3, 4, 5], np.int32)
+        cp = paddle.cartesian_prod([paddle.to_tensor(u), paddle.to_tensor(v)])
+        ref = np.array([[i, j] for i in u for j in v], np.int32)
+        np.testing.assert_array_equal(cp.numpy(), ref)
+
+    def test_scatter_variants(self):
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        d = rng.normal(size=(4,)).astype(np.float32)
+        out = paddle.diagonal_scatter(paddle.to_tensor(x),
+                                      paddle.to_tensor(d))
+        ref = x.copy()
+        np.fill_diagonal(ref, d)
+        np.testing.assert_allclose(out.numpy(), ref)
+
+        row = rng.normal(size=(5,)).astype(np.float32)
+        out2 = paddle.select_scatter(paddle.to_tensor(x),
+                                     paddle.to_tensor(row), axis=0, index=2)
+        ref2 = x.copy()
+        ref2[2] = row
+        np.testing.assert_allclose(out2.numpy(), ref2)
+
+        blk = rng.normal(size=(4, 2)).astype(np.float32)
+        out3 = paddle.slice_scatter(paddle.to_tensor(x),
+                                    paddle.to_tensor(blk), axes=[1],
+                                    starts=[1], ends=[5], strides=[2])
+        ref3 = x.copy()
+        ref3[:, 1:5:2] = blk
+        np.testing.assert_allclose(out3.numpy(), ref3)
+
+    def test_special_functions(self):
+        import scipy.special as sp
+        a = rng.uniform(0.5, 3.0, (6,)).astype(np.float32)
+        b = rng.uniform(0.5, 3.0, (6,)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.gammainc(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            sp.gammainc(a, b), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.gammaincc(paddle.to_tensor(a),
+                             paddle.to_tensor(b)).numpy(),
+            sp.gammaincc(a, b), rtol=1e-5)
+        x = np.array([np.inf, -np.inf, 1.0], np.float32)
+        assert paddle.isposinf(paddle.to_tensor(x)).numpy().tolist() == \
+            [True, False, False]
+        assert paddle.isneginf(paddle.to_tensor(x)).numpy().tolist() == \
+            [False, True, False]
+        np.testing.assert_allclose(
+            paddle.float_power(paddle.to_tensor(np.array([2.0, 3.0])),
+                               2).numpy(), [4.0, 9.0])
+
+    def test_cumulative_trapezoid_and_vecdot(self):
+        y = rng.normal(size=(3, 8)).astype(np.float32)
+        x = np.sort(rng.normal(size=(3, 8)).astype(np.float32), axis=-1)
+        out = paddle.cumulative_trapezoid(paddle.to_tensor(y),
+                                          paddle.to_tensor(x))
+        try:
+            from scipy.integrate import cumulative_trapezoid as ct
+            np.testing.assert_allclose(out.numpy(), ct(y, x, axis=-1),
+                                       rtol=1e-4, atol=1e-5)
+        except ImportError:
+            assert out.shape == [3, 7]
+        a = rng.normal(size=(4, 3)).astype(np.float32)
+        b = rng.normal(size=(4, 3)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.vecdot(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            (a * b).sum(-1), rtol=1e-5)
+
+    def test_linalg_extensions(self):
+        a = rng.normal(size=(4, 4)).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        l = np.linalg.cholesky(spd)
+        inv = paddle.linalg.cholesky_inverse(paddle.to_tensor(l))
+        np.testing.assert_allclose(inv.numpy(), np.linalg.inv(spd),
+                                   rtol=1e-3, atol=1e-4)
+
+        bvec = rng.normal(size=(4, 2)).astype(np.float32)
+        lu_t, piv = paddle.linalg.lu(paddle.to_tensor(spd))
+        x = paddle.linalg.lu_solve(paddle.to_tensor(bvec), lu_t, piv)
+        np.testing.assert_allclose(spd @ x.numpy(), bvec, rtol=1e-3,
+                                   atol=1e-3)
+
+        m = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.linalg.matrix_transpose(paddle.to_tensor(m)).numpy(),
+            m.swapaxes(-1, -2))
+
+    def test_ormqr(self):
+        import scipy.linalg as sl
+        a = rng.normal(size=(5, 3)).astype(np.float32)
+        c = rng.normal(size=(5, 2)).astype(np.float32)
+        # LAPACK geqrf packed (qr, tau) from scipy; numpy's complete-mode Q
+        # comes from the same reflectors (orgqr), so it is the exact oracle
+        (qr_, tau), _r = sl.qr(a, mode="raw")
+        out = paddle.linalg.ormqr(
+            paddle.to_tensor(np.ascontiguousarray(qr_, np.float32)),
+            paddle.to_tensor(np.ascontiguousarray(tau, np.float32)),
+            paddle.to_tensor(c))
+        q = np.linalg.qr(a, mode="complete")[0]
+        np.testing.assert_allclose(out.numpy(), q @ c, rtol=1e-3,
+                                   atol=1e-3)
+
+    def test_histogram_bin_edges_and_misc(self):
+        x = rng.normal(size=(50,)).astype(np.float32)
+        e = paddle.histogram_bin_edges(paddle.to_tensor(x), bins=10)
+        ref = np.histogram_bin_edges(x, bins=10)
+        np.testing.assert_allclose(e.numpy(), ref, rtol=1e-5)
+        np.testing.assert_array_equal(
+            paddle.bitwise_invert(
+                paddle.to_tensor(np.array([0, 1], np.int32))).numpy(),
+            [-1, -2])
+        np.testing.assert_allclose(
+            paddle.positive(paddle.to_tensor(np.array([-1.0, 2.0])))
+            .numpy(), [-1.0, 2.0])

@@ -1,0 +1,209 @@
+"""paddle.geometric + paddle.vision.ops parity tests.
+≙ reference «test/legacy_test/test_segment_ops.py», «test_nms_op.py»,
+«test_roi_align_op.py», «test_deformable_conv_op.py» [U]; oracles are
+NumPy references (and torchvision-free torch ops are avoided — torch is
+CPU-only here and only used where it ships the exact op)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric as G
+from paddle_tpu.vision import ops as V
+
+rng = np.random.default_rng(3)
+
+
+class TestSegmentOps:
+    def _data(self):
+        x = rng.normal(size=(10, 4)).astype(np.float32)
+        ids = np.sort(rng.integers(0, 5, 10)).astype(np.int32)
+        return x, ids
+
+    def test_segment_sum_mean(self):
+        x, ids = self._data()
+        out = G.segment_sum(paddle.to_tensor(x), paddle.to_tensor(ids))
+        ref = np.zeros((ids.max() + 1, 4), np.float32)
+        np.add.at(ref, ids, x)
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-6)
+
+        outm = G.segment_mean(paddle.to_tensor(x), paddle.to_tensor(ids))
+        cnt = np.bincount(ids, minlength=ids.max() + 1)[:, None]
+        np.testing.assert_allclose(np.asarray(outm._value),
+                                   ref / np.maximum(cnt, 1), rtol=1e-6)
+
+    def test_segment_min_max_empty_segment(self):
+        x = np.array([[1.0], [3.0], [-2.0]], np.float32)
+        ids = np.array([0, 0, 2], np.int32)  # segment 1 empty
+        mx = np.asarray(G.segment_max(paddle.to_tensor(x),
+                                      paddle.to_tensor(ids))._value)
+        mn = np.asarray(G.segment_min(paddle.to_tensor(x),
+                                      paddle.to_tensor(ids))._value)
+        np.testing.assert_allclose(mx.ravel(), [3.0, 0.0, -2.0])
+        np.testing.assert_allclose(mn.ravel(), [1.0, 0.0, -2.0])
+
+    def test_send_u_recv(self):
+        x = rng.normal(size=(6, 3)).astype(np.float32)
+        src = np.array([0, 1, 2, 3], np.int32)
+        dst = np.array([1, 2, 1, 5], np.int32)
+        out = G.send_u_recv(paddle.to_tensor(x), paddle.to_tensor(src),
+                            paddle.to_tensor(dst), reduce_op="sum")
+        ref = np.zeros_like(x)
+        np.add.at(ref, dst, x[src])
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-6)
+
+    def test_send_u_recv_grad(self):
+        x = paddle.to_tensor(rng.normal(size=(4, 2)).astype(np.float32),
+                             stop_gradient=False)
+        src = paddle.to_tensor(np.array([0, 1, 1], np.int32))
+        dst = paddle.to_tensor(np.array([2, 3, 2], np.int32))
+        out = G.send_u_recv(x, src, dst, reduce_op="sum")
+        out.sum().backward()
+        # node 1 feeds two edges -> grad 2; nodes 2,3 feed none -> grad 0
+        np.testing.assert_allclose(np.asarray(x.grad)[:, 0], [1, 2, 0, 0])
+
+    def test_send_ue_recv_and_uv(self):
+        x = rng.normal(size=(5, 2)).astype(np.float32)
+        y = rng.normal(size=(3, 2)).astype(np.float32)
+        src = np.array([0, 2, 4], np.int32)
+        dst = np.array([1, 1, 0], np.int32)
+        out = G.send_ue_recv(paddle.to_tensor(x), paddle.to_tensor(y),
+                             paddle.to_tensor(src), paddle.to_tensor(dst),
+                             message_op="mul", reduce_op="max")
+        msg = x[src] * y
+        ref = np.zeros((5, 2), np.float32)
+        for i, d in enumerate(dst):
+            ref[d] = np.maximum(ref[d], msg[i]) if i and d in dst[:i] \
+                else msg[i]
+        # simpler oracle
+        ref = np.zeros((5, 2), np.float32)
+        filled = np.zeros(5, bool)
+        for i, d in enumerate(dst):
+            ref[d] = msg[i] if not filled[d] else np.maximum(ref[d], msg[i])
+            filled[d] = True
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-6)
+
+        uv = G.send_uv(paddle.to_tensor(x), paddle.to_tensor(x),
+                       paddle.to_tensor(src), paddle.to_tensor(dst),
+                       message_op="add")
+        np.testing.assert_allclose(np.asarray(uv._value), x[src] + x[dst],
+                                   rtol=1e-6)
+
+
+class TestNMS:
+    def test_nms_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        try:
+            import torchvision  # noqa: F401
+            have_tv = True
+        except ImportError:
+            have_tv = False
+        boxes = rng.uniform(0, 90, (30, 2)).astype(np.float32)
+        boxes = np.concatenate(
+            [boxes, boxes + rng.uniform(5, 30, (30, 2)).astype(np.float32)],
+            axis=1)
+        scores = rng.uniform(size=30).astype(np.float32)
+        idx = np.asarray(V.nms(paddle.to_tensor(boxes), 0.5,
+                               paddle.to_tensor(scores))._value)
+        if have_tv:
+            from torchvision.ops import nms as tv_nms
+            ref = tv_nms(torch.tensor(boxes), torch.tensor(scores),
+                         0.5).numpy()
+            np.testing.assert_array_equal(idx, ref)
+        else:
+            # greedy numpy reference
+            order = np.argsort(-scores)
+            keep = []
+            sup = np.zeros(30, bool)
+            for i in order:
+                if sup[i]:
+                    continue
+                keep.append(i)
+                iou = np.asarray(V.box_iou(
+                    paddle.to_tensor(boxes[i:i + 1]),
+                    paddle.to_tensor(boxes))._value)[0]
+                sup |= iou > 0.5
+                sup[i] = True
+            np.testing.assert_array_equal(idx, np.array(keep))
+
+    def test_box_iou_area(self):
+        a = np.array([[0, 0, 10, 10]], np.float32)
+        b = np.array([[5, 5, 15, 15], [20, 20, 30, 30]], np.float32)
+        iou = np.asarray(V.box_iou(paddle.to_tensor(a),
+                                   paddle.to_tensor(b))._value)
+        np.testing.assert_allclose(iou[0, 0], 25.0 / 175.0, rtol=1e-6)
+        assert iou[0, 1] == 0.0
+        ar = np.asarray(V.box_area(paddle.to_tensor(b))._value)
+        np.testing.assert_allclose(ar, [100.0, 100.0])
+
+
+class TestRoIAlign:
+    def test_matches_torchvision(self):
+        torch = pytest.importorskip("torch")
+        tv = pytest.importorskip("torchvision")
+        x = rng.normal(size=(2, 3, 16, 16)).astype(np.float32)
+        boxes = np.array([[1.0, 1.0, 9.0, 9.0], [2.0, 3.0, 14.0, 12.0],
+                          [0.0, 0.0, 15.0, 15.0]], np.float32)
+        boxes_num = np.array([2, 1], np.int32)
+        out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(boxes_num), output_size=4,
+                          spatial_scale=0.5, sampling_ratio=2,
+                          aligned=True)
+        tb = torch.tensor(
+            np.concatenate([[[0.0], [0.0], [1.0]], boxes], axis=1))
+        ref = tv.ops.roi_align(torch.tensor(x), tb, output_size=4,
+                               spatial_scale=0.5, sampling_ratio=2,
+                               aligned=True).numpy()
+        np.testing.assert_allclose(np.asarray(out._value), ref,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_roi_pool_shape_and_values(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        boxes = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+        out = V.roi_pool(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                         paddle.to_tensor(np.array([1], np.int32)),
+                         output_size=2)
+        ref = np.array([[[[5.0, 7.0], [13.0, 15.0]]]], np.float32)
+        np.testing.assert_allclose(np.asarray(out._value), ref)
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv(self):
+        tch = pytest.importorskip("torch")
+        x = rng.normal(size=(1, 4, 8, 8)).astype(np.float32)
+        w = rng.normal(size=(6, 4, 3, 3)).astype(np.float32) * 0.2
+        offset = np.zeros((1, 2 * 9, 8, 8), np.float32)
+        out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(offset),
+                              paddle.to_tensor(w), padding=1)
+        ref = tch.nn.functional.conv2d(
+            tch.tensor(x), tch.tensor(w), padding=1).numpy()
+        np.testing.assert_allclose(np.asarray(out._value), ref,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_matches_torchvision_with_offsets(self):
+        tch = pytest.importorskip("torch")
+        tv = pytest.importorskip("torchvision")
+        x = rng.normal(size=(2, 4, 6, 6)).astype(np.float32)
+        w = rng.normal(size=(5, 4, 3, 3)).astype(np.float32) * 0.2
+        off = (rng.normal(size=(2, 18, 6, 6)) * 0.7).astype(np.float32)
+        m = rng.uniform(0.2, 1.0, (2, 9, 6, 6)).astype(np.float32)
+        b = rng.normal(size=(5,)).astype(np.float32)
+        out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                              paddle.to_tensor(w), paddle.to_tensor(b),
+                              padding=1, mask=paddle.to_tensor(m))
+        ref = tv.ops.deform_conv2d(
+            tch.tensor(x), tch.tensor(off), tch.tensor(w), tch.tensor(b),
+            padding=1, mask=tch.tensor(m)).numpy()
+        np.testing.assert_allclose(np.asarray(out._value), ref,
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_layer_and_grad(self):
+        layer = V.DeformConv2D(3, 4, 3, padding=1)
+        x = paddle.to_tensor(rng.normal(size=(1, 3, 5, 5))
+                             .astype(np.float32), stop_gradient=False)
+        off = paddle.to_tensor(
+            np.zeros((1, 18, 5, 5), np.float32), stop_gradient=False)
+        out = layer(x, off)
+        assert tuple(out.shape) == (1, 4, 5, 5)
+        out.sum().backward()
+        assert x.grad is not None and np.isfinite(np.asarray(x.grad)).all()
+        assert off.grad is not None
