@@ -4,4 +4,8 @@ paddle_tpu.models; this module provides the torchvision-like utility surface."""
 from . import datasets  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
-from .models import ResNet, resnet18, resnet50  # noqa: F401
+from .models import (ResNet, resnet18, resnet34, resnet50,  # noqa: F401
+                     resnet101, resnet152, LeNet, AlexNet, alexnet, VGG,
+                     vgg11, vgg13, vgg16, vgg19, MobileNetV1, MobileNetV2,
+                     mobilenet_v1, mobilenet_v2, SqueezeNet, squeezenet1_0,
+                     squeezenet1_1, DenseNet, densenet121, densenet201)

@@ -110,3 +110,360 @@ def resnet50(pretrained=False, **kwargs):
 
 def resnet101(pretrained=False, **kwargs):
     return ResNet(BottleneckBlock, 101, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# round-3 zoo additions. ≙ reference «python/paddle/vision/models/{lenet,
+# alexnet,vgg,mobilenetv1,mobilenetv2,squeezenet,densenet}.py» [U]
+# ---------------------------------------------------------------------------
+from ..nn import AvgPool2D, Dropout, ReLU6  # noqa: E402
+
+
+class LeNet(Layer):
+    """≙ paddle.vision.models.LeNet (MNIST-shaped, 1x28x28)."""
+
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1), ReLU(),
+            MaxPool2D(2, 2),
+            Conv2D(6, 16, 5, stride=1, padding=0), ReLU(),
+            MaxPool2D(2, 2))
+        if num_classes > 0:
+            self.fc = Sequential(
+                Linear(400, 120), Linear(120, 84), Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+class AlexNet(Layer):
+    """≙ paddle.vision.models.AlexNet."""
+
+    def __init__(self, num_classes=1000, dropout=0.5):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, 2),
+            Conv2D(64, 192, 5, padding=2), ReLU(),
+            MaxPool2D(3, 2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(),
+            MaxPool2D(3, 2))
+        self.avgpool = AdaptiveAvgPool2D((6, 6))
+        self.classifier = Sequential(
+            Dropout(dropout), Linear(256 * 36, 4096), ReLU(),
+            Dropout(dropout), Linear(4096, 4096), ReLU(),
+            Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        return self.classifier(x.flatten(1))
+
+
+class VGG(Layer):
+    """≙ paddle.vision.models.VGG — features built from a cfg list."""
+
+    CFGS = {
+        11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512,
+             "M"],
+        13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+             512, 512, "M"],
+        16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512,
+             512, "M", 512, 512, 512, "M"],
+        19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512,
+             512, 512, 512, "M", 512, 512, 512, 512, "M"],
+    }
+
+    def __init__(self, features, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.features = features
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((7, 7))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(512 * 49, 4096), ReLU(), Dropout(),
+                Linear(4096, 4096), ReLU(), Dropout(),
+                Linear(4096, num_classes))
+
+    @staticmethod
+    def make_layers(cfg, batch_norm=False):
+        layers = []
+        c = 3
+        for v in cfg:
+            if v == "M":
+                layers.append(MaxPool2D(2, 2))
+            else:
+                layers.append(Conv2D(c, v, 3, padding=1))
+                if batch_norm:
+                    layers.append(BatchNorm2D(v))
+                layers.append(ReLU())
+                c = v
+        return Sequential(*layers)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def _vgg(depth, batch_norm, **kwargs):
+    return VGG(VGG.make_layers(VGG.CFGS[depth], batch_norm), **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kw):
+    return _vgg(11, batch_norm, **kw)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kw):
+    return _vgg(13, batch_norm, **kw)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kw):
+    return _vgg(16, batch_norm, **kw)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kw):
+    return _vgg(19, batch_norm, **kw)
+
+
+class _ConvBNReLU(Sequential):
+    def __init__(self, cin, cout, k, stride=1, groups=1, relu6=True):
+        p = (k - 1) // 2
+        super().__init__(
+            Conv2D(cin, cout, k, stride, p, groups=groups, bias_attr=False),
+            BatchNorm2D(cout), ReLU6() if relu6 else ReLU())
+
+
+class MobileNetV1(Layer):
+    """≙ paddle.vision.models.MobileNetV1 (depthwise-separable stacks)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return max(int(ch * scale), 8)
+        cfg = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+               (256, 256, 1), (256, 512, 2)] + [(512, 512, 1)] * 5 + \
+              [(512, 1024, 2), (1024, 1024, 1)]
+        feats = [_ConvBNReLU(3, c(32), 3, 2, relu6=False)]
+        for cin, cout, s in cfg:
+            feats.append(_ConvBNReLU(c(cin), c(cin), 3, s, groups=c(cin),
+                                     relu6=False))
+            feats.append(_ConvBNReLU(c(cin), c(cout), 1, relu6=False))
+        self.features = Sequential(*feats)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+class InvertedResidual(Layer):
+    def __init__(self, cin, cout, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(cin * expand_ratio))
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_ConvBNReLU(cin, hidden, 1))
+        layers += [
+            _ConvBNReLU(hidden, hidden, 3, stride, groups=hidden),
+            Conv2D(hidden, cout, 1, bias_attr=False),
+            BatchNorm2D(cout)]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    """≙ paddle.vision.models.MobileNetV2."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+
+        def c(ch):
+            return max(8, int(ch * scale + 4) // 8 * 8)
+        cin = c(32)
+        feats = [_ConvBNReLU(3, cin, 3, 2)]
+        for t, ch, n, s in cfg:
+            cout = c(ch)
+            for i in range(n):
+                feats.append(InvertedResidual(cin, cout,
+                                              s if i == 0 else 1, t))
+                cin = cout
+        last = c(1280) if scale > 1.0 else 1280
+        feats.append(_ConvBNReLU(cin, last, 1))
+        self.features = Sequential(*feats)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+class SqueezeNet(Layer):
+    """≙ paddle.vision.models.SqueezeNet (1.0/1.1)."""
+
+    class Fire(Layer):
+        def __init__(self, cin, squeeze, e1, e3):
+            super().__init__()
+            self.squeeze = Sequential(Conv2D(cin, squeeze, 1), ReLU())
+            self.e1 = Sequential(Conv2D(squeeze, e1, 1), ReLU())
+            self.e3 = Sequential(Conv2D(squeeze, e3, 3, padding=1), ReLU())
+
+        def forward(self, x):
+            import paddle_tpu as paddle
+            s = self.squeeze(x)
+            return paddle.concat([self.e1(s), self.e3(s)], axis=1)
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        F = SqueezeNet.Fire
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, 2), ReLU(), MaxPool2D(3, 2),
+                F(96, 16, 64, 64), F(128, 16, 64, 64),
+                F(128, 32, 128, 128), MaxPool2D(3, 2),
+                F(256, 32, 128, 128), F(256, 48, 192, 192),
+                F(384, 48, 192, 192), F(384, 64, 256, 256),
+                MaxPool2D(3, 2), F(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, 2), ReLU(), MaxPool2D(3, 2),
+                F(64, 16, 64, 64), F(128, 16, 64, 64), MaxPool2D(3, 2),
+                F(128, 32, 128, 128), F(256, 32, 128, 128),
+                MaxPool2D(3, 2), F(256, 48, 192, 192),
+                F(384, 48, 192, 192), F(384, 64, 256, 256),
+                F(512, 64, 256, 256))
+        self.classifier = Sequential(
+            Dropout(0.5), Conv2D(512, num_classes, 1), ReLU(),
+            AdaptiveAvgPool2D((1, 1)))
+
+    def forward(self, x):
+        x = self.classifier(self.features(x))
+        return x.flatten(1)
+
+
+class DenseNet(Layer):
+    """≙ paddle.vision.models.DenseNet (121/161/169/201/264)."""
+
+    CFGS = {121: (64, 32, [6, 12, 24, 16]),
+            161: (96, 48, [6, 12, 36, 24]),
+            169: (64, 32, [6, 12, 32, 32]),
+            201: (64, 32, [6, 12, 48, 32]),
+            264: (64, 32, [6, 12, 64, 48])}
+
+    class _DenseLayer(Layer):
+        def __init__(self, cin, growth, bn_size=4):
+            super().__init__()
+            self.fn = Sequential(
+                BatchNorm2D(cin), ReLU(),
+                Conv2D(cin, bn_size * growth, 1, bias_attr=False),
+                BatchNorm2D(bn_size * growth), ReLU(),
+                Conv2D(bn_size * growth, growth, 3, padding=1,
+                       bias_attr=False))
+
+        def forward(self, x):
+            import paddle_tpu as paddle
+            return paddle.concat([x, self.fn(x)], axis=1)
+
+    def __init__(self, layers=121, num_classes=1000, with_pool=True):
+        super().__init__()
+        init_c, growth, blocks = DenseNet.CFGS[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        feats = [Conv2D(3, init_c, 7, 2, 3, bias_attr=False),
+                 BatchNorm2D(init_c), ReLU(), MaxPool2D(3, 2, 1)]
+        c = init_c
+        for bi, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(DenseNet._DenseLayer(c, growth))
+                c += growth
+            if bi != len(blocks) - 1:
+                feats += [BatchNorm2D(c), ReLU(),
+                          Conv2D(c, c // 2, 1, bias_attr=False),
+                          AvgPool2D(2, 2)]
+                c //= 2
+        feats += [BatchNorm2D(c), ReLU()]
+        self.features = Sequential(*feats)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def alexnet(pretrained=False, **kw):
+    return AlexNet(**kw)
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kw):
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kw):
+    return MobileNetV2(scale=scale, **kw)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    return SqueezeNet("1.1", **kw)
+
+
+def densenet121(pretrained=False, **kw):
+    return DenseNet(121, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return DenseNet(201, **kw)
+
+
+def resnet152(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 152, **kwargs)

@@ -207,3 +207,48 @@ class TestDeformConv:
         out.sum().backward()
         assert x.grad is not None and np.isfinite(np.asarray(x.grad)).all()
         assert off.grad is not None
+
+
+class TestVisionZoo:
+    """Forward-shape + grad smoke for the round-3 model-zoo additions.
+    ≙ reference «test/legacy_test/test_vision_models.py» [U]."""
+
+    @pytest.mark.parametrize("build,shape,nclass", [
+        (lambda: paddle.vision.LeNet(num_classes=10), (2, 1, 28, 28), 10),
+        (lambda: paddle.vision.alexnet(num_classes=7), (2, 3, 63, 63), 7),
+        (lambda: paddle.vision.vgg11(num_classes=5), (1, 3, 32, 32), 5),
+        (lambda: paddle.vision.mobilenet_v1(
+            scale=0.25, num_classes=6), (2, 3, 32, 32), 6),
+        (lambda: paddle.vision.mobilenet_v2(
+            scale=0.35, num_classes=6), (2, 3, 32, 32), 6),
+        (lambda: paddle.vision.squeezenet1_1(num_classes=4),
+         (2, 3, 64, 64), 4),
+        (lambda: paddle.vision.densenet121(num_classes=3),
+         (1, 3, 32, 32), 3),
+    ])
+    def test_forward_shapes(self, build, shape, nclass):
+        paddle.seed(0)
+        m = build()
+        m.eval()
+        x = paddle.to_tensor(rng.normal(size=shape).astype(np.float32))
+        out = m(x)
+        assert tuple(out.shape) == (shape[0], nclass)
+        assert np.isfinite(np.asarray(out._value)).all()
+
+    def test_train_step_decreases_loss(self):
+        paddle.seed(0)
+        m = paddle.vision.LeNet(num_classes=10)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        x = paddle.to_tensor(rng.normal(size=(8, 1, 28, 28))
+                             .astype(np.float32))
+        y = paddle.to_tensor(rng.integers(0, 10, (8,)).astype(np.int64))
+        import paddle_tpu.nn.functional as F
+        losses = []
+        for _ in range(5):
+            loss = F.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
