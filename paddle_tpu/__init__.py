@@ -130,6 +130,10 @@ def __getattr__(name):
         from .hapi import summary
         globals()["summary"] = summary
         return summary
+    if name == "flops":
+        from .hapi import flops
+        globals()["flops"] = flops
+        return flops
     if name == "hapi":
         import importlib
         mod = importlib.import_module(".hapi", __name__)

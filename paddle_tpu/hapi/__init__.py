@@ -300,3 +300,78 @@ def summary(network, input_size=None, dtypes=None):
     table = "\n".join(lines)
     print(table)
     return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """≙ paddle.flops («python/paddle/hapi/dynamic_flops.py» [U]): count
+    multiply-accumulate FLOPs of one forward pass via forward-post hooks.
+    `input_size` is the full input shape incl. batch; returns total FLOPs
+    (Paddle convention: MACs, elementwise counted once)."""
+    import paddle_tpu as paddle
+    from ..nn import layer as L
+    from ..nn.layer.conv import _ConvNd
+    from ..nn.layer.common import Linear as _Linear
+    from ..nn.layer.norm import (BatchNorm2D, LayerNorm, _BatchNormBase)
+
+    counts = {}
+    handles = []
+
+    def count_conv(layer, inp, out):
+        w = layer.weight
+        kernel_ops = int(np.prod(w.shape[1:]))  # cin/g * kh * kw
+        bias_ops = 1 if getattr(layer, "bias", None) is not None else 0
+        n = int(np.prod(out.shape)) if not isinstance(out, (tuple, list)) \
+            else int(np.prod(out[0].shape))
+        counts[id(layer)] = counts.get(id(layer), 0) \
+            + n * (kernel_ops + bias_ops)
+
+    def count_linear(layer, inp, out):
+        w = layer.weight
+        n_out = int(np.prod(out.shape))
+        counts[id(layer)] = counts.get(id(layer), 0) + n_out * w.shape[0]
+
+    def count_norm(layer, inp, out):
+        n = int(np.prod(out.shape))
+        counts[id(layer)] = counts.get(id(layer), 0) + 2 * n
+
+    def count_act(layer, inp, out):
+        n = int(np.prod(out.shape))
+        counts[id(layer)] = counts.get(id(layer), 0) + n
+
+    table = {
+        _ConvNd: count_conv,
+        _Linear: count_linear,
+        _BatchNormBase: count_norm,
+        LayerNorm: count_norm,
+        L.activation.ReLU: count_act,
+        L.activation.Sigmoid: count_act,
+    }
+    if custom_ops:
+        table.update(custom_ops)
+
+    names = {}
+    for name, sub in net.named_sublayers():
+        for cls, fn in table.items():
+            if isinstance(sub, cls):
+                handles.append(sub.register_forward_post_hook(fn))
+                names[id(sub)] = (name, type(sub).__name__)
+                break
+
+    was_training = net.training
+    net.eval()
+    x = paddle.zeros(list(input_size), dtype="float32")
+    try:
+        net(x)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(counts.values())
+    if print_detail:
+        for lid, c in counts.items():
+            nm, cls = names.get(lid, ("?", "?"))
+            print(f"{nm:<40}{cls:<20}{c:>16,}")
+    print(f"Total Flops: {total}")
+    return total

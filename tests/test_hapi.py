@@ -151,3 +151,15 @@ class TestAccumulateGradBatches:
                   accumulate_grad_batches=2)
         # 30 // 8 = 3 full batches -> 1 merged step per epoch
         assert model._optimizer._step_count == 2
+
+
+class TestFlops:
+    def test_lenet_flops_counts_conv_and_linear(self):
+        import paddle_tpu as paddle
+        m = paddle.vision.LeNet()
+        total = paddle.flops(m, [1, 1, 28, 28])
+        # conv1: 28*28*6*(1*3*3+1); conv2: 12*12*16*(6*5*5+1); fc stack
+        assert total > 3e5 and total < 1e7
+        # batch scales activation-dependent terms linearly
+        total2 = paddle.flops(m, [2, 1, 28, 28])
+        assert total2 == 2 * total
