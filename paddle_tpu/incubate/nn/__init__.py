@@ -3,3 +3,7 @@
 fused kernels live in paddle_tpu.ops; these are the incubate-namespace
 aliases the reference exposes."""
 from . import functional  # noqa: F401
+from .layer import (FusedLinear, FusedDropoutAdd,  # noqa: F401
+                    FusedBiasDropoutResidualLayerNorm,
+                    FusedMultiHeadAttention, FusedFeedForward,
+                    FusedTransformerEncoderLayer, FusedRMSNorm)

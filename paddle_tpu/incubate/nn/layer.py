@@ -1,0 +1,232 @@
+"""incubate.nn fused layer classes.
+
+≙ reference «python/paddle/incubate/nn/layer/fused_transformer.py» [U]
+(FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer,
+FusedBiasDropoutResidualLayerNorm, FusedLinear, FusedDropoutAdd;
+SURVEY.md §2.2 incubate row). On TPU "fused" means: composed so XLA fuses
+into the surrounding program — parameters laid out exactly like the
+reference's fused kernels expect (single QKV weight, etc.)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...nn.layer.layers import Layer
+from ...nn import functional as F
+from . import functional as IF
+
+
+class FusedLinear(Layer):
+    """≙ paddle.incubate.nn.FusedLinear (cuBLASLt fused epilogue in the
+    reference; on TPU XLA fuses bias+activation into the matmul)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        from ...nn.initializer import XavierNormal, Constant
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=XavierNormal())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_features,), attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0)))
+        self._transpose = transpose_weight
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        w = self.weight
+        y = paddle.matmul(x, w, transpose_y=self._transpose)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class FusedDropoutAdd(Layer):
+    """≙ paddle.incubate.nn.FusedDropoutAdd: dropout(x) + y in one
+    fusion."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self._p, self._mode = p, mode
+
+    def forward(self, x, y):
+        return F.dropout(x, p=self._p, training=self.training,
+                         mode=self._mode) + y
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """≙ paddle.incubate.nn.FusedBiasDropoutResidualLayerNorm."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn.initializer import Constant
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), is_bias=True, default_initializer=Constant(0.0))
+        self._dropout_rate = dropout_rate
+        self._epsilon = epsilon
+
+    def forward(self, x, residual):
+        return IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, bias=self.linear_bias, ln_scale=self.ln_scale,
+            ln_bias=self.ln_bias, dropout_rate=self._dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training)
+
+
+class FusedMultiHeadAttention(Layer):
+    """≙ paddle.incubate.nn.FusedMultiHeadAttention — parameters stored in
+    the reference's fused QKV layout (3, H, D, E)."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        from ...nn.initializer import XavierNormal, Constant
+        hd = embed_dim // num_heads
+        self.num_heads = num_heads
+        self.normalize_before = normalize_before
+        self.qkv_weight = self.create_parameter(
+            (3, num_heads, hd, embed_dim), attr=qkv_weight_attr,
+            default_initializer=XavierNormal())
+        self.qkv_bias = self.create_parameter(
+            (3, num_heads, hd), attr=qkv_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), attr=linear_weight_attr,
+            default_initializer=XavierNormal())
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=linear_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.pre_ln_scale = self.create_parameter(
+            (embed_dim,), attr=pre_ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            (embed_dim,), attr=pre_ln_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=ln_scale_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), attr=ln_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self._dropout_rate = dropout_rate
+        self._attn_dropout_rate = attn_dropout_rate
+        self._epsilon = epsilon
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self._epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self._dropout_rate,
+            attn_dropout_rate=self._attn_dropout_rate,
+            ln_epsilon=self._epsilon, training=self.training,
+            num_heads=self.num_heads)
+
+
+class FusedFeedForward(Layer):
+    """≙ paddle.incubate.nn.FusedFeedForward."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        from ...nn.initializer import XavierNormal, Constant
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), attr=linear1_weight_attr,
+            default_initializer=XavierNormal())
+        self.linear1_bias = self.create_parameter(
+            (dim_feedforward,), attr=linear1_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), attr=linear2_weight_attr,
+            default_initializer=XavierNormal())
+        self.linear2_bias = self.create_parameter(
+            (d_model,), attr=linear2_bias_attr, is_bias=True,
+            default_initializer=Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            (d_model,), attr=ln1_scale_attr,
+            default_initializer=Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (d_model,), is_bias=True, default_initializer=Constant(0.0))
+        self._dropout_rate = dropout_rate
+        self._act_dropout = (dropout_rate if act_dropout_rate is None
+                             else act_dropout_rate)
+        self._act = activation
+        self._epsilon = epsilon
+        self.normalize_before = normalize_before
+
+    def forward(self, src):
+        import paddle_tpu as paddle
+        residual = src
+        if self.normalize_before:
+            src = F.layer_norm(src, src.shape[-1], self.ln_scale,
+                               self.ln_bias, self._epsilon)
+        h = paddle.matmul(src, self.linear1_weight) + self.linear1_bias
+        h = getattr(F, self._act)(h)
+        h = F.dropout(h, p=self._act_dropout, training=self.training)
+        h = paddle.matmul(h, self.linear2_weight) + self.linear2_bias
+        h = F.dropout(h, p=self._dropout_rate, training=self.training)
+        out = residual + h
+        if not self.normalize_before:
+            out = F.layer_norm(out, out.shape[-1], self.ln_scale,
+                               self.ln_bias, self._epsilon)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """≙ paddle.incubate.nn.FusedTransformerEncoderLayer = fused MHA +
+    fused FFN."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        ad = dropout_rate if attn_dropout_rate is None else \
+            attn_dropout_rate
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=ad, normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask,
+                                        cache=cache))
+
+
+class FusedRMSNorm(Layer):
+    """TPU-native extra (paddle.incubate.nn.FusedRMSNorm-alike) wrapping
+    the Pallas rms_norm kernel."""
+
+    def __init__(self, hidden_size, epsilon=1e-6):
+        super().__init__()
+        from ...nn.initializer import Constant
+        self.weight = self.create_parameter(
+            (hidden_size,), default_initializer=Constant(1.0))
+        self._epsilon = epsilon
+
+    def forward(self, x):
+        return IF.fused_rms_norm(x, self.weight, epsilon=self._epsilon)

@@ -76,6 +76,12 @@ _METHOD_NAMES = [
     "rank", "numel", "is_floating_point", "is_complex", "is_integer",
     # creation
     "tril", "triu", "diag",
+    # round-3 breadth
+    "float_power", "positive", "isposinf", "isneginf", "isreal",
+    "gammainc", "gammaincc", "cumulative_trapezoid", "vecdot",
+    "histogram_bin_edges", "bitwise_invert", "diagonal_scatter",
+    "select_scatter", "slice_scatter", "sgn", "sinc", "pdist", "renorm",
+    "vander", "combinations", "polygamma", "gammaln",
 ]
 
 
