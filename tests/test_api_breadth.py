@@ -401,10 +401,13 @@ class TestRound3Breadth:
                                    atol=1e-3)
 
     def test_histogram_bin_edges_and_misc(self):
-        x = rng.normal(size=(50,)).astype(np.float32)
+        # local generator: the shared module rng makes this data depend on
+        # test order, and an edge near 0 needs atol, not just rtol
+        x = np.random.default_rng(42).normal(size=(50,)) \
+            .astype(np.float32)
         e = paddle.histogram_bin_edges(paddle.to_tensor(x), bins=10)
         ref = np.histogram_bin_edges(x, bins=10)
-        np.testing.assert_allclose(e.numpy(), ref, rtol=1e-5)
+        np.testing.assert_allclose(e.numpy(), ref, rtol=1e-5, atol=1e-6)
         np.testing.assert_array_equal(
             paddle.bitwise_invert(
                 paddle.to_tensor(np.array([0, 1], np.int32))).numpy(),
