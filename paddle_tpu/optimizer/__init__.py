@@ -523,3 +523,307 @@ class Lamb(Optimizer):
             p._value = new.astype(p._value.dtype)
         else:
             p._value = new.astype(p._value.dtype)
+
+
+class NAdam(Optimizer):
+    """≙ paddle.optimizer.NAdam (Nesterov Adam) [U]."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon = epsilon
+        self._md = momentum_decay
+
+    def _create_state(self, p):
+        self._acc("moment1", p, dtype=jnp.float32)
+        self._acc("moment2", p, dtype=jnp.float32)
+        self._acc("mu_product", p, init=jnp.zeros((), jnp.float32),
+                  dtype=jnp.float32)
+
+    def _update_param(self, p, g):
+        lr = self.get_lr()
+        mw = self._master(p) if self._use_master(p) else p._value
+        mwf = mw.astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        cwd = self._wd(p)
+        if cwd:
+            g = g + cwd * mwf
+        b1, b2 = self._beta1, self._beta2
+        t = self._step_count
+        mu_t = b1 * (1.0 - 0.5 * 0.96 ** (t * self._md))
+        mu_t1 = b1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self._md))
+        mu_prod = self._acc("mu_product", p,
+                            init=jnp.zeros((), jnp.float32),
+                            dtype=jnp.float32)
+        # accumulator starts at 0; treat 0 as "empty" product = 1
+        mu_prod = jnp.where(mu_prod == 0, 1.0, mu_prod) * mu_t
+        self._set_acc("mu_product", p, mu_prod)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * jnp.square(g)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        # the mu coefficients live INSIDE these terms (torch NAdam form):
+        # update = ghat + mhat, NOT a second mu-weighted mix of them
+        ghat = g * (1 - mu_t) / (1 - mu_prod)
+        mhat = m * mu_t1 / (1 - mu_prod * mu_t1)
+        vhat = v / (1 - b2 ** t)
+        new = mwf - lr * (ghat + mhat) \
+            / (jnp.sqrt(vhat) + self._epsilon)
+        if self._use_master(p):
+            self._master_weights[id(p)] = new
+        p._value = new.astype(p._value.dtype)
+
+
+class RAdam(Optimizer):
+    """≙ paddle.optimizer.RAdam (rectified Adam) [U]."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-08, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1, self._beta2 = beta1, beta2
+        self._epsilon = epsilon
+
+    def _create_state(self, p):
+        self._acc("moment1", p, dtype=jnp.float32)
+        self._acc("moment2", p, dtype=jnp.float32)
+
+    def _update_param(self, p, g):
+        lr = self.get_lr()
+        mw = self._master(p) if self._use_master(p) else p._value
+        mwf = mw.astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        cwd = self._wd(p)
+        if cwd:
+            g = g + cwd * mwf
+        b1, b2 = self._beta1, self._beta2
+        t = self._step_count
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * jnp.square(g)
+        self._set_acc("moment1", p, m)
+        self._set_acc("moment2", p, v)
+        mhat = m / (1 - b1 ** t)
+        rho_inf = 2.0 / (1 - b2) - 1.0
+        rho_t = rho_inf - 2.0 * t * (b2 ** t) / (1 - b2 ** t)
+        if rho_t > 5.0:
+            vhat = jnp.sqrt(v / (1 - b2 ** t))
+            r = math.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                          / ((rho_inf - 4) * (rho_inf - 2) * rho_t))
+            new = mwf - lr * r * mhat / (vhat + self._epsilon)
+        else:
+            new = mwf - lr * mhat
+        if self._use_master(p):
+            self._master_weights[id(p)] = new
+        p._value = new.astype(p._value.dtype)
+
+
+class Rprop(Optimizer):
+    """≙ paddle.optimizer.Rprop (resilient backprop; full-batch method) [U]."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+        self._init_lr = learning_rate
+
+    def _create_state(self, p):
+        self._acc("prev_grad", p, dtype=jnp.float32)
+        store = self._accumulators.setdefault("step_size", {})
+        if id(p) not in store:
+            store[id(p)] = jnp.full(tuple(p.shape), float(self._init_lr),
+                                    jnp.float32)
+
+    def _update_param(self, p, g):
+        self._create_state(p)
+        mw = self._master(p) if self._use_master(p) else p._value
+        mwf = mw.astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        prev = self._acc("prev_grad", p, dtype=jnp.float32)
+        step = self._accumulators["step_size"][id(p)]
+        sign = jnp.sign(g * prev)
+        step = jnp.clip(jnp.where(sign > 0, step * self._eta_pos,
+                                  jnp.where(sign < 0,
+                                            step * self._eta_neg, step)),
+                        self._lr_min, self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        self._set_acc("prev_grad", p, g_eff)
+        self._accumulators["step_size"][id(p)] = step
+        new = mwf - jnp.sign(g_eff) * step
+        if self._use_master(p):
+            self._master_weights[id(p)] = new
+        p._value = new.astype(p._value.dtype)
+
+
+class ASGD(Optimizer):
+    """≙ paddle.optimizer.ASGD (averaged SGD) [U]. Keeps a running
+    average of the iterates; `d`/`y` follow the paddle formulation with a
+    fixed-size history of n gradients collapsed to the streaming form."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._batch_num = batch_num
+
+    def _create_state(self, p):
+        self._acc("d", p, dtype=jnp.float32)
+        self._acc("ys", p, dtype=jnp.float32)
+
+    def _update_param(self, p, g):
+        lr = self.get_lr()
+        mw = self._master(p) if self._use_master(p) else p._value
+        mwf = mw.astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        cwd = self._wd(p)
+        if cwd:
+            g = g + cwd * mwf
+        d = self._acc("d", p, dtype=jnp.float32)
+        ys = self._acc("ys", p, dtype=jnp.float32)
+        # streaming average over the last batch_num grads:
+        # d <- d - oldest + newest; with n=batch_num the oldest estimate
+        # is ys/n (mean), giving an exponential-window approximation
+        oldest = ys / self._batch_num
+        d = d - oldest + g
+        ys = ys - oldest + g
+        self._set_acc("d", p, d)
+        self._set_acc("ys", p, ys)
+        new = mwf - lr / self._batch_num * d
+        if self._use_master(p):
+            self._master_weights[id(p)] = new
+        p._value = new.astype(p._value.dtype)
+
+
+class LBFGS(Optimizer):
+    """≙ paddle.optimizer.LBFGS — limited-memory BFGS with strong-Wolfe
+    line search. Matches the reference's closure-based `step(closure)` API
+    («python/paddle/optimizer/lbfgs.py» [U]); eager-only by nature (the
+    line search re-evaluates the closure a data-dependent number of
+    times — exactly the reference's behavior, and not a jit target)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-07, tolerance_change=1e-09,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._max_iter = max_iter
+        self._max_eval = max_eval or max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = history_size
+        self._line_search = line_search_fn  # None | 'strong_wolfe'
+        self._s_hist: list = []
+        self._y_hist: list = []
+        self._prev_flat_grad = None
+
+    def _flat_params(self):
+        return jnp.concatenate(
+            [p._value.astype(jnp.float32).reshape(-1)
+             for p in self._parameter_list])
+
+    def _set_flat_params(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(tuple(p.shape))) if p.shape else 1
+            p._value = flat[off:off + n].reshape(tuple(p.shape)).astype(
+                p._value.dtype)
+            off += n
+
+    def _flat_grad(self):
+        gs = []
+        for p in self._parameter_list:
+            if p.grad is None:
+                gs.append(jnp.zeros(int(np.prod(tuple(p.shape))),
+                                    jnp.float32))
+            else:
+                gs.append(p.grad._value.astype(jnp.float32).reshape(-1))
+        return jnp.concatenate(gs)
+
+    def _eval(self, closure):
+        for p in self._parameter_list:
+            p.grad = None
+        loss = closure()
+        return float(loss), self._flat_grad()
+
+    def step(self, closure=None):
+        if closure is None:
+            raise ValueError("LBFGS.step needs a closure returning the "
+                             "loss (it re-evaluates the model)")
+        loss, g = self._eval(closure)
+        evals = 1
+        for _ in range(self._max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self._tol_grad:
+                break
+            # two-loop recursion
+            q = -g
+            alphas = []
+            for s, y in reversed(list(zip(self._s_hist, self._y_hist))):
+                rho = 1.0 / float(jnp.dot(y, s))
+                a = rho * float(jnp.dot(s, q))
+                alphas.append((a, rho, s, y))
+                q = q - a * y
+            if self._y_hist:
+                y_last = self._y_hist[-1]
+                s_last = self._s_hist[-1]
+                gamma = float(jnp.dot(s_last, y_last)
+                              / jnp.maximum(jnp.dot(y_last, y_last), 1e-10))
+                q = q * gamma
+            for a, rho, s, y in reversed(alphas):
+                b = rho * float(jnp.dot(y, q))
+                q = q + (a - b) * s
+            d = q
+            x0 = self._flat_params()
+            g0 = g
+            f0 = loss
+            gtd = float(jnp.dot(g, d))
+            if gtd > -1e-15:
+                break
+            t = float(self.get_lr())
+            # backtracking (armijo) line search; strong_wolfe adds the
+            # curvature check
+            ok = False
+            for _ls in range(25):
+                self._set_flat_params(x0 + t * d)
+                loss, g = self._eval(closure)
+                evals += 1
+                if loss <= f0 + 1e-4 * t * gtd:
+                    if self._line_search != "strong_wolfe" or abs(float(
+                            jnp.dot(g, d))) <= 0.9 * abs(gtd):
+                        ok = True
+                        break
+                t *= 0.5
+                if evals >= self._max_eval:
+                    break
+            if not ok:
+                self._set_flat_params(x0)
+                loss, g = self._eval(closure)
+                break
+            s = self._flat_params() - x0
+            y = g - g0
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                if len(self._s_hist) > self._history:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+            if abs(f0 - loss) < self._tol_change:
+                break
+            if evals >= self._max_eval:
+                break
+        self._step_count += 1
+        import paddle_tpu as paddle
+        return paddle.to_tensor(np.float32(loss))

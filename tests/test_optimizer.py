@@ -3,6 +3,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+import paddle_tpu
 import paddle_tpu.nn as nn
 import paddle_tpu.nn.functional as F
 from paddle_tpu.optimizer import (SGD, Adam, AdamW, Momentum, Adagrad,
@@ -222,3 +223,98 @@ class TestTrainStep:
         paddle.jit.to_static(m)
         got = m(x).numpy()
         np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+class TestRound3Optimizers:
+    """NAdam/RAdam/Rprop/ASGD/LBFGS (round-3 additions). Oracles: torch
+    (CPU) where the update rule matches, else convergence checks."""
+
+    def _quad_problem(self, opt_cls, **kw):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.array([5.0, -3.0], np.float32),
+                             stop_gradient=False)
+        w = paddle_tpu.Parameter(w._value)
+        opt = opt_cls(parameters=[w], **kw)
+        for _ in range(60):
+            loss = ((w - paddle.to_tensor(
+                np.array([1.0, 2.0], np.float32))) ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(w._value), float(loss)
+
+    def test_nadam_converges(self):
+        from paddle_tpu.optimizer import NAdam
+        w, loss = self._quad_problem(NAdam, learning_rate=0.3)
+        np.testing.assert_allclose(w, [1.0, 2.0], atol=0.3)
+
+    def test_nadam_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        from paddle_tpu.optimizer import NAdam
+        x0 = np.array([1.5, -2.0, 0.5], np.float32)
+        w = paddle_tpu.Parameter(paddle.to_tensor(x0)._value)
+        opt = NAdam(learning_rate=0.05, parameters=[w])
+        tw = torch.tensor(x0, requires_grad=True)
+        topt = torch.optim.NAdam([tw], lr=0.05)
+        for _ in range(10):
+            loss = (w ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            tl = (tw ** 2).sum()
+            topt.zero_grad()
+            tl.backward()
+            topt.step()
+        np.testing.assert_allclose(np.asarray(w._value),
+                                   tw.detach().numpy(), rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_radam_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        from paddle_tpu.optimizer import RAdam
+        x0 = np.array([1.5, -2.0, 0.5], np.float32)
+        w = paddle_tpu.Parameter(paddle.to_tensor(x0)._value)
+        opt = RAdam(learning_rate=0.05, parameters=[w])
+        tw = torch.tensor(x0, requires_grad=True)
+        topt = torch.optim.RAdam([tw], lr=0.05)
+        for _ in range(12):
+            loss = (w ** 2).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            tl = (tw ** 2).sum()
+            topt.zero_grad()
+            tl.backward()
+            topt.step()
+        np.testing.assert_allclose(np.asarray(w._value),
+                                   tw.detach().numpy(), rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_rprop_converges(self):
+        from paddle_tpu.optimizer import Rprop
+        w, loss = self._quad_problem(Rprop, learning_rate=0.01)
+        np.testing.assert_allclose(w, [1.0, 2.0], atol=0.1)
+
+    def test_asgd_converges(self):
+        from paddle_tpu.optimizer import ASGD
+        w, loss = self._quad_problem(ASGD, learning_rate=0.1)
+        np.testing.assert_allclose(w, [1.0, 2.0], atol=0.3)
+
+    def test_lbfgs_rosenbrock(self):
+        from paddle_tpu.optimizer import LBFGS
+        paddle.seed(0)
+        w = paddle_tpu.Parameter(paddle.to_tensor(
+            np.array([-1.0, 1.5], np.float32))._value)
+        opt = LBFGS(learning_rate=1.0, max_iter=30,
+                    line_search_fn="strong_wolfe", parameters=[w])
+
+        def closure():
+            x, y = w[0], w[1]
+            loss = (1 - x) ** 2 + 100 * (y - x ** 2) ** 2
+            loss.backward()
+            return loss
+
+        for _ in range(10):
+            loss = opt.step(closure)
+        np.testing.assert_allclose(np.asarray(w._value), [1.0, 1.0],
+                                   atol=1e-2)
