@@ -618,3 +618,420 @@ def _kl_dirichlet(p, q):
                   - gammaln(jnp.sum(b, -1)) + jnp.sum(gammaln(b), -1)
                   + jnp.sum((a - b) * (digamma(a)
                                        - digamma(a0[..., None])), -1))
+
+
+# -- round-3 additions -------------------------------------------------------
+class Cauchy(Distribution):
+    """≙ paddle.distribution.Cauchy [U]."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self.batch_shape),
+                               minval=1e-7, maxval=1.0 - 1e-7)
+        return Tensor(self.loc + self.scale * jnp.tan(
+            math.pi * (u - 0.5)))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z * z)))
+
+    def entropy(self):
+        e = jnp.log(4 * math.pi * self.scale)
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+    def cdf(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return Tensor(jnp.arctan(z) / math.pi + 0.5)
+
+
+class StudentT(Distribution):
+    """≙ paddle.distribution.StudentT [U]."""
+
+    def __init__(self, df, loc, scale, name=None):
+        self.df = _v(df)
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape, self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        m = jnp.where(self.df > 1, self.loc, jnp.nan)
+        return Tensor(jnp.broadcast_to(m, self.batch_shape))
+
+    @property
+    def variance(self):
+        v = jnp.where(
+            self.df > 2, self.scale ** 2 * self.df / (self.df - 2),
+            jnp.where(self.df > 1, jnp.inf, jnp.nan))
+        return Tensor(jnp.broadcast_to(v, self.batch_shape))
+
+    def sample(self, shape=()):
+        z = jax.random.t(_key(), self.df,
+                         _shape(shape, self.batch_shape))
+        return Tensor(self.loc + self.scale * z)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        d = self.df
+        z = (_v(value) - self.loc) / self.scale
+        lp = (gammaln((d + 1) / 2) - gammaln(d / 2)
+              - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+              - (d + 1) / 2 * jnp.log1p(z * z / d))
+        return Tensor(lp)
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+        d = self.df
+        e = ((d + 1) / 2 * (digamma((d + 1) / 2) - digamma(d / 2))
+             + 0.5 * jnp.log(d) + jnp.log(self.scale)
+             + gammaln(d / 2) + gammaln(0.5)
+             - gammaln((d + 1) / 2))
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+
+class MultivariateNormal(Distribution):
+    """≙ paddle.distribution.MultivariateNormal (full covariance) [U]."""
+
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _v(loc)
+        n = self.loc.shape[-1]
+        if scale_tril is not None:
+            self._tril = _v(scale_tril)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_v(covariance_matrix))
+        elif precision_matrix is not None:
+            self._tril = jnp.linalg.cholesky(
+                jnp.linalg.inv(_v(precision_matrix)))
+        else:
+            raise ValueError("one of covariance_matrix / precision_matrix "
+                             "/ scale_tril is required")
+        super().__init__(self.loc.shape[:-1], (n,))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.sum(self._tril ** 2, axis=-1))
+
+    def sample(self, shape=()):
+        z = jax.random.normal(
+            _key(), _shape(shape, self.batch_shape + self.event_shape))
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
+                                            self._tril, z))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        d = _v(value) - self.loc
+        n = self.event_shape[0]
+        # solve L y = d  ->  mahalanobis = |y|^2 (tril broadcast over the
+        # value's batch dims: triangular_solve wants matching batch ranks)
+        tril = jnp.broadcast_to(self._tril,
+                                d.shape[:-1] + self._tril.shape[-2:])
+        y = jax.scipy.linalg.solve_triangular(tril, d[..., None],
+                                              lower=True)[..., 0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1)), -1)
+        return Tensor(-0.5 * (n * math.log(2 * math.pi)
+                              + jnp.sum(y * y, -1)) - half_logdet)
+
+    def entropy(self):
+        n = self.event_shape[0]
+        half_logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1)), -1)
+        e = 0.5 * n * (1 + math.log(2 * math.pi)) + half_logdet
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+
+class Binomial(Distribution):
+    """≙ paddle.distribution.Binomial [U]."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _v(total_count)
+        self.probs = _v(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.total_count * self.probs,
+                                       self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            self.total_count * self.probs * (1 - self.probs),
+            self.batch_shape))
+
+    def sample(self, shape=()):
+        n = jnp.broadcast_to(self.total_count, self.batch_shape)
+        p = jnp.broadcast_to(self.probs, self.batch_shape)
+        out = jax.random.binomial(_key(), n, p,
+                                  shape=_shape(shape, self.batch_shape))
+        return Tensor(out)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+        k = _v(value)
+        n = self.total_count
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(gammaln(n + 1) - gammaln(k + 1) - gammaln(n - k + 1)
+                      + k * jnp.log(p) + (n - k) * jnp.log1p(-p))
+
+    def entropy(self):
+        # exact sum over the support (reference does the same)
+        n = int(np.max(np.asarray(self.total_count)))
+        ks = jnp.arange(n + 1, dtype=jnp.float32)
+        shape = (n + 1,) + tuple(1 for _ in self.batch_shape)
+        lp = self.log_prob(Tensor(ks.reshape(shape)))._value
+        return Tensor(-jnp.sum(jnp.where(jnp.isfinite(lp),
+                                         jnp.exp(lp) * lp, 0.0), 0))
+
+
+class ContinuousBernoulli(Distribution):
+    """≙ paddle.distribution.ContinuousBernoulli [U]."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _v(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _log_norm(self):
+        p = self.probs
+        lo, hi = self._lims
+        # C(p) = 2 atanh(1-2p) / (1-2p), with the removable singularity at
+        # p=1/2 handled by a Taylor cutout (the reference does the same)
+        safe = jnp.where((p < lo) | (p > hi), p, 0.25)
+        c = jnp.log(2 * jnp.abs(jnp.arctanh(1 - 2 * safe))) \
+            - jnp.log(jnp.abs(1 - 2 * safe))
+        taylor = math.log(2.0) + 4.0 / 3.0 * (p - 0.5) ** 2
+        return jnp.where((p < lo) | (p > hi), c, taylor)
+
+    @property
+    def mean(self):
+        p = self.probs
+        lo, hi = self._lims
+        safe = jnp.where((p < lo) | (p > hi), p, 0.25)
+        m = safe / (2 * safe - 1) \
+            + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        taylor = 0.5 + (p - 0.5) / 3.0
+        return Tensor(jnp.where((p < lo) | (p > hi), m, taylor))
+
+    @property
+    def variance(self):
+        # numerically: var = E[x^2] - mean^2 via the closed form
+        p = self.probs
+        lo, hi = self._lims
+        safe = jnp.where((p < lo) | (p > hi), p, 0.25)
+        t = jnp.arctanh(1 - 2 * safe)
+        v = safe * (safe - 1) / (1 - 2 * safe) ** 2 + 1 / (4 * t * t)
+        taylor = 1.0 / 12.0 - (p - 0.5) ** 2 / 3.0
+        return Tensor(jnp.where((p < lo) | (p > hi), v, taylor))
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(), _shape(shape, self.batch_shape),
+                               minval=1e-7, maxval=1 - 1e-7)
+        p = self.probs
+        lo, hi = self._lims
+        safe = jnp.where((p < lo) | (p > hi), p, 0.25)
+        s = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+             / (jnp.log(safe) - jnp.log1p(-safe)))
+        return Tensor(jnp.where((p < lo) | (p > hi), s, u))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        x = _v(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(x * jnp.log(p) + (1 - x) * jnp.log1p(-p)
+                      + self._log_norm())
+
+
+class Independent(Distribution):
+    """≙ paddle.distribution.Independent: reinterpret batch dims as event
+    dims [U]."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        r = int(reinterpreted_batch_rank)
+        self._r = r
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - r],
+                         bs[len(bs) - r:] + tuple(base.event_shape))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._value
+        return Tensor(jnp.sum(lp, axis=tuple(range(lp.ndim - self._r,
+                                                   lp.ndim))))
+
+    def entropy(self):
+        e = self.base.entropy()._value
+        return Tensor(jnp.sum(e, axis=tuple(range(e.ndim - self._r,
+                                                  e.ndim))))
+
+
+class Transform:
+    """≙ paddle.distribution.Transform base (forward/inverse +
+    log-det-jacobian) [U]."""
+
+    def forward(self, x):
+        return Tensor(self._forward(_v(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_v(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_v(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self._fldj(self._inverse(_v(y))))
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc, self.scale = _v(loc), _v(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class TransformedDistribution(Distribution):
+    """≙ paddle.distribution.TransformedDistribution [U]."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transforms = (transforms if isinstance(transforms, (list,
+                                                                 tuple))
+                           else [transforms])
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = _v(value)
+        ldj = jnp.zeros_like(y)
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            ldj = ldj + t._fldj(x)
+            y = x
+        return Tensor(self.base.log_prob(Tensor(y))._value - ldj)
+
+
+__all__ += ["Cauchy", "StudentT", "MultivariateNormal", "Binomial",
+            "ContinuousBernoulli", "Independent", "Transform",
+            "AffineTransform", "ExpTransform", "SigmoidTransform",
+            "TanhTransform", "TransformedDistribution"]
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy(p, q):
+    # closed form (Chyzak & Nielsen 2019)
+    return Tensor(jnp.log(
+        ((p.scale + q.scale) ** 2 + (p.loc - q.loc) ** 2)
+        / (4 * p.scale * q.scale)))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    n = p.event_shape[0]
+    dl = jnp.diagonal(p._tril, axis1=-2, axis2=-1)
+    dq = jnp.diagonal(q._tril, axis1=-2, axis2=-1)
+    logdet = jnp.sum(jnp.log(dq), -1) - jnp.sum(jnp.log(dl), -1)
+    m = jax.scipy.linalg.solve_triangular(
+        q._tril, p._tril, lower=True)
+    tr = jnp.sum(m * m, axis=(-2, -1))
+    d = jax.scipy.linalg.solve_triangular(
+        q._tril, (p.loc - q.loc)[..., None], lower=True)[..., 0]
+    return Tensor(logdet + 0.5 * (tr + jnp.sum(d * d, -1) - n))

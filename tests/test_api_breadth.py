@@ -415,3 +415,132 @@ class TestRound3Breadth:
         np.testing.assert_allclose(
             paddle.positive(paddle.to_tensor(np.array([-1.0, 2.0])))
             .numpy(), [-1.0, 2.0])
+
+
+class TestRound3Distributions:
+    """Cauchy/StudentT/MVN/Binomial/ContinuousBernoulli/Independent/
+    Transformed (round-3). Oracles: scipy.stats + torch.distributions."""
+
+    def test_cauchy(self):
+        import scipy.stats as st
+        from paddle_tpu.distribution import Cauchy
+        d = Cauchy(loc=1.0, scale=2.0)
+        x = np.array([-1.0, 0.5, 3.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(x)).numpy(),
+            st.cauchy.logpdf(x, 1.0, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(
+            d.cdf(paddle.to_tensor(x)).numpy(),
+            st.cauchy.cdf(x, 1.0, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy().numpy()),
+                                   st.cauchy.entropy(1.0, 2.0), rtol=1e-5)
+
+    def test_student_t(self):
+        import scipy.stats as st
+        from paddle_tpu.distribution import StudentT
+        d = StudentT(df=5.0, loc=1.0, scale=2.0)
+        x = np.array([-1.0, 0.5, 3.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(x)).numpy(),
+            st.t.logpdf(x, 5.0, 1.0, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(float(d.variance.numpy()),
+                                   st.t.var(5.0, 1.0, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy().numpy()),
+                                   st.t.entropy(5.0, 1.0, 2.0), rtol=1e-4)
+
+    def test_multivariate_normal(self):
+        import scipy.stats as st
+        from paddle_tpu.distribution import (MultivariateNormal,
+                                             kl_divergence)
+        mu = np.array([1.0, -1.0], np.float32)
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        d = MultivariateNormal(paddle.to_tensor(mu),
+                               covariance_matrix=paddle.to_tensor(cov))
+        x = np.array([[0.0, 0.0], [1.0, -1.0]], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(x)).numpy(),
+            st.multivariate_normal.logpdf(x, mu, cov), rtol=1e-4)
+        np.testing.assert_allclose(
+            float(d.entropy().numpy()),
+            st.multivariate_normal.entropy(mu, cov), rtol=1e-5)
+        # KL vs itself = 0; vs shifted > 0
+        assert abs(float(kl_divergence(d, d).numpy())) < 1e-5
+        d2 = MultivariateNormal(paddle.to_tensor(mu + 1.0),
+                                covariance_matrix=paddle.to_tensor(cov))
+        assert float(kl_divergence(d, d2).numpy()) > 0.1
+        s = d.sample((5000,))
+        np.testing.assert_allclose(s.numpy().mean(0), mu, atol=0.1)
+
+    def test_binomial(self):
+        import scipy.stats as st
+        from paddle_tpu.distribution import Binomial
+        d = Binomial(total_count=10.0, probs=0.3)
+        k = np.array([0.0, 3.0, 10.0], np.float32)
+        np.testing.assert_allclose(
+            d.log_prob(paddle.to_tensor(k)).numpy(),
+            st.binom.logpmf(k, 10, 0.3), rtol=1e-4)
+        np.testing.assert_allclose(float(d.entropy().numpy()),
+                                   st.binom.entropy(10, 0.3), rtol=1e-4)
+
+    def test_continuous_bernoulli_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        from paddle_tpu.distribution import ContinuousBernoulli
+        for p in (0.2, 0.5, 0.8):
+            d = ContinuousBernoulli(probs=p)
+            td = torch.distributions.ContinuousBernoulli(probs=p)
+            x = np.array([0.1, 0.5, 0.9], np.float32)
+            np.testing.assert_allclose(
+                d.log_prob(paddle.to_tensor(x)).numpy(),
+                td.log_prob(torch.tensor(x)).numpy(), rtol=1e-4,
+                atol=1e-5)
+            np.testing.assert_allclose(float(d.mean.numpy()),
+                                       float(td.mean), rtol=1e-4)
+
+    def test_independent_and_transformed(self):
+        torch = pytest.importorskip("torch")
+        from paddle_tpu.distribution import (Normal, Independent,
+                                             TransformedDistribution,
+                                             ExpTransform, AffineTransform)
+        base = Normal(np.zeros(3, np.float32), np.ones(3, np.float32))
+        ind = Independent(base, 1)
+        assert ind.event_shape == (3,)
+        x = np.array([0.5, -0.5, 1.0], np.float32)
+        lp = float(ind.log_prob(paddle.to_tensor(x)).numpy())
+        ref = float(torch.distributions.Independent(
+            torch.distributions.Normal(torch.zeros(3), torch.ones(3)), 1)
+            .log_prob(torch.tensor(x)))
+        np.testing.assert_allclose(lp, ref, rtol=1e-5)
+
+        # log-normal via TransformedDistribution == LogNormal
+        td = TransformedDistribution(Normal(0.0, 1.0), [ExpTransform()])
+        y = np.array([0.5, 1.0, 2.0], np.float32)
+        import scipy.stats as st
+        np.testing.assert_allclose(
+            td.log_prob(paddle.to_tensor(y)).numpy(),
+            st.lognorm.logpdf(y, 1.0), rtol=1e-4)
+        # affine: y = 2x + 1 of standard normal == N(1, 2)
+        ta = TransformedDistribution(Normal(0.0, 1.0),
+                                     [AffineTransform(1.0, 2.0)])
+        np.testing.assert_allclose(
+            ta.log_prob(paddle.to_tensor(y)).numpy(),
+            st.norm.logpdf(y, 1.0, 2.0), rtol=1e-4)
+
+    def test_transform_roundtrip_and_ldj(self):
+        from paddle_tpu.distribution import (SigmoidTransform,
+                                             TanhTransform)
+        x = np.array([-1.5, 0.0, 2.0], np.float32)
+        for T in (SigmoidTransform, TanhTransform):
+            t = T()
+            y = t.forward(paddle.to_tensor(x))
+            back = t.inverse(y)
+            np.testing.assert_allclose(back.numpy(), x, rtol=1e-4,
+                                       atol=1e-5)
+            # fldj matches numeric d/dx log|f'(x)|
+            eps = 1e-3
+            num = np.log(np.abs(
+                (t.forward(paddle.to_tensor(x + eps)).numpy()
+                 - t.forward(paddle.to_tensor(x - eps)).numpy())
+                / (2 * eps)))
+            np.testing.assert_allclose(
+                t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy(),
+                num, atol=1e-3)
