@@ -69,15 +69,123 @@ class _Utils:
 
     @staticmethod
     def weight_norm(layer, name="weight", dim=0):
-        return layer  # functional no-op shim; SpectralNorm covers the common use
+        """≙ paddle.nn.utils.weight_norm («python/paddle/nn/utils/
+        weight_norm_hook.py» [U]): reparameterize `name` as
+        g * v / ||v|| with the norm over every dim except `dim`
+        (dim=None -> one global norm), recomputed by a forward-pre-hook."""
+        import jax.numpy as jnp
+        from ..core.tensor import Parameter, Tensor
+        w = getattr(layer, name)
+        wv = w._value.astype(jnp.float32)
+
+        if dim is None:
+            axes = tuple(range(wv.ndim))
+            g0 = jnp.sqrt(jnp.sum(jnp.square(wv)))
+        else:
+            dim = dim % wv.ndim
+            axes = tuple(a for a in range(wv.ndim) if a != dim)
+            g0 = jnp.sqrt(jnp.sum(jnp.square(wv), axis=axes, keepdims=True))
+
+        g = Parameter(g0.astype(w._value.dtype))
+        v = Parameter(w._value)
+        setattr(layer, name + "_g", g)
+        setattr(layer, name + "_v", v)
+        # demote the original to a plain attribute (recomputed per call)
+        layer._parameters.pop(name, None)
+
+        orig_dtype = str(w.dtype)
+
+        def _compute():
+            # tensor-level ops so backward reaches g and v through the tape
+            vv = v.astype("float32")
+            sq = (vv * vv).sum(axis=list(axes), keepdim=dim is not None)
+            nrm = (sq + 1e-12).sqrt()
+            return (g.astype("float32") / nrm * vv).astype(orig_dtype)
+
+        def hook(lyr, inputs):
+            object.__setattr__(lyr, name, _compute())
+            return None
+
+        helper = layer.register_forward_pre_hook(hook)
+        layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+        layer._weight_norm_hooks[name] = (helper, _compute)
+        hook(layer, ())  # materialize once so `layer.weight` is valid now
+        return layer
 
     @staticmethod
     def remove_weight_norm(layer, name="weight"):
+        """≙ paddle.nn.utils.remove_weight_norm: bake the current weight
+        back into a single parameter and drop the hook."""
+        from ..core.tensor import Parameter
+        hooks = getattr(layer, "_weight_norm_hooks", {})
+        if name not in hooks:
+            return layer
+        helper, compute = hooks.pop(name)
+        helper.remove()
+        w = compute()
+        for suffix in ("_g", "_v"):
+            layer._parameters.pop(name + suffix, None)
+            try:
+                object.__delattr__(layer, name + suffix)
+            except AttributeError:
+                pass
+        setattr(layer, name, Parameter(w._value))
         return layer
 
     @staticmethod
     def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
                       dim=None):
+        """≙ paddle.nn.utils.spectral_norm: divide `name` by its largest
+        singular value, estimated by power iteration refreshed on every
+        forward (the u/v vectors persist as buffers)."""
+        import jax
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor
+        w = getattr(layer, name)
+        wv = w._value
+        d = (0 if dim is None else dim % wv.ndim)
+        mat = jnp.moveaxis(wv, d, 0).reshape(wv.shape[d], -1) \
+            .astype(jnp.float32)
+        h, ww = mat.shape
+        from ..tensor.random import default_generator
+        u0 = jax.random.normal(default_generator.next_key(), (h,))
+        u0 = u0 / (jnp.linalg.norm(u0) + eps)
+        state = {"u": u0}
+        orig_param = w
+        layer._parameters.pop(name, None)
+        object.__setattr__(layer, name + "_orig", orig_param)
+        layer._parameters[name + "_orig"] = orig_param
+
+        def hook(lyr, inputs):
+            # power iteration on constants (no grad), then sigma through
+            # tensor ops so d(loss)/d(weight_orig) includes the 1/sigma
+            # dependence — matching the reference hook's autograd shape
+            wv = orig_param._value
+            m = jnp.moveaxis(wv, d, 0).reshape(wv.shape[d], -1) \
+                .astype(jnp.float32)
+            u = state["u"]
+            for _ in range(n_power_iterations):
+                vvec = m.T @ u
+                vvec = vvec / (jnp.linalg.norm(vvec) + eps)
+                u = m @ vvec
+                u = u / (jnp.linalg.norm(u) + eps)
+            state["u"] = jax.lax.stop_gradient(u)
+            ut = Tensor(jax.lax.stop_gradient(u))
+            vt = Tensor(jax.lax.stop_gradient(vvec))
+            w_mat = orig_param.astype("float32").moveaxis(d, 0) \
+                .reshape([wv.shape[d], -1])
+            sigma = (ut.unsqueeze(0) @ (w_mat @ vt.unsqueeze(1)))
+            sigma = sigma.reshape([])
+            wt = (orig_param.astype("float32") / sigma) \
+                .astype(str(orig_param.dtype))
+            object.__setattr__(lyr, name, wt)
+            return None
+
+        helper = layer.register_forward_pre_hook(hook)
+        layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+        layer._weight_norm_hooks[name] = (helper, lambda: getattr(layer,
+                                                                  name))
+        hook(layer, ())
         return layer
 
 

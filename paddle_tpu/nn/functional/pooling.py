@@ -270,3 +270,59 @@ def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
     ks = _tuple(kernel_size, 2)
     return apply("lp_root",
                  lambda v: ((v * float(np.prod(ks))) ** (1.0 / p)), (s,))
+
+
+def _unpool_nd(x, indices, n, kernel_size, stride, padding, output_size,
+               data_format, op_name):
+    """Scatter pooled values back to their argmax positions (flat spatial
+    index convention shared with return_mask above / the reference's
+    max_pool indices)."""
+    xt, it = _t(x), _t(indices)
+    ks = (kernel_size,) * n if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ((stride,) * n if isinstance(stride, int)
+          else tuple(stride)) if stride is not None else ks
+    pd = (padding,) * n if isinstance(padding, int) else tuple(padding)
+    channels_last = not data_format.startswith("NC")
+    if channels_last:
+        raise NotImplementedError(f"{op_name}: NHWC not supported yet")
+    in_sp = tuple(xt.shape[2:])
+    if output_size is None:
+        out_sp = tuple((in_sp[d] - 1) * st[d] - 2 * pd[d] + ks[d]
+                       for d in range(n))
+    else:
+        out_sp = tuple(output_size[-n:])
+
+    def fn(v, idx):
+        b, c = v.shape[0], v.shape[1]
+        flat_out = int(np.prod(out_sp))
+        vf = v.reshape(b, c, -1)
+        ix = idx.reshape(b, c, -1).astype(jnp.int32)
+        out = jnp.zeros((b, c, flat_out), v.dtype)
+        bb = jnp.arange(b)[:, None, None]
+        cc = jnp.arange(c)[None, :, None]
+        out = out.at[bb, cc, ix].set(vf)
+        return out.reshape((b, c) + out_sp)
+    return apply(op_name, fn, (xt, it))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    """≙ paddle.nn.functional.max_unpool1d [U]."""
+    return _unpool_nd(x, indices, 1, kernel_size, stride, padding,
+                      output_size, "NCW" if data_format == "NCL"
+                      else data_format, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """≙ paddle.nn.functional.max_unpool2d [U]."""
+    return _unpool_nd(x, indices, 2, kernel_size, stride, padding,
+                      output_size, data_format, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    """≙ paddle.nn.functional.max_unpool3d [U]."""
+    return _unpool_nd(x, indices, 3, kernel_size, stride, padding,
+                      output_size, data_format, "max_unpool3d")

@@ -120,3 +120,42 @@ class LPPool2D(_Pool):
                          kernel_size=kernel_size, stride=stride,
                          padding=padding, ceil_mode=ceil_mode,
                          data_format=data_format)
+
+
+class MaxUnPool1D(Layer):
+    """≙ paddle.nn.MaxUnPool1D [U]."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._a
+        return F.max_unpool1d(x, indices, k, s, p, df, osz)
+
+
+class MaxUnPool2D(Layer):
+    """≙ paddle.nn.MaxUnPool2D [U]."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._a
+        return F.max_unpool2d(x, indices, k, s, p, df, osz)
+
+
+class MaxUnPool3D(Layer):
+    """≙ paddle.nn.MaxUnPool3D [U]."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._a
+        return F.max_unpool3d(x, indices, k, s, p, df, osz)
