@@ -1,0 +1,214 @@
+"""ERNIE family — north-star config #3 (BASELINE.md): ERNIE-4.5-style
+pretraining on a 4D hybrid (dp x sharding x mp, pp via llama_pipe-style
+stacking when needed) -> one GSPMD mesh.
+
+Mirrors the PaddleNLP ErnieModel surface (outside-repo zoo per SURVEY.md
+§1): BERT-style encoder plus ERNIE's task-type embedding tier, with
+ErnieForPretraining = masked-LM + sentence-order heads. TPU-first: the 4D
+placement is pure sharding annotation (`shard_ernie`); XLA inserts all
+collectives (SURVEY.md §2.3 semi-auto row)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    task_type_vocab_size: int = 16
+    use_task_id: bool = True
+    layer_norm_eps: float = 1e-12
+
+    @staticmethod
+    def base():
+        return ErnieConfig()
+
+    @staticmethod
+    def tiny():
+        return ErnieConfig(vocab_size=1024, hidden_size=128,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           intermediate_size=256,
+                           max_position_embeddings=128)
+
+
+class ErnieEmbeddings(nn.Layer):
+    """Word + position + token-type (+ task-type: the ERNIE delta over
+    BERT)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.use_task_id = cfg.use_task_id
+        if cfg.use_task_id:
+            self.task_type_embeddings = nn.Embedding(
+                cfg.task_type_vocab_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None):
+        seq_len = input_ids.shape[1]
+        pos = paddle.arange(seq_len, dtype="int32").unsqueeze(0)
+        emb = self.word_embeddings(input_ids) \
+            + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        if self.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = paddle.zeros_like(input_ids)
+            emb = emb + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, cfg: ErnieConfig | None = None):
+        super().__init__()
+        cfg = cfg or ErnieConfig.base()
+        self.config = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads,
+            cfg.intermediate_size, dropout=cfg.hidden_dropout_prob,
+            activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            layer_norm_eps=cfg.layer_norm_eps)
+        self.encoder = nn.TransformerEncoder(enc_layer,
+                                             cfg.num_hidden_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, task_type_ids)
+        mask = None
+        if attention_mask is not None:
+            mask = ((1.0 - attention_mask.astype("float32"))
+                    * -1e4).unsqueeze([1, 2])
+        seq = self.encoder(x, mask)
+        pooled = F.tanh(self.pooler(seq[:, 0]))
+        return seq, pooled
+
+
+class ErnieForPretraining(nn.Layer):
+    """MLM head (tied decoder) + sentence-order head — the ERNIE
+    pretraining objective pair."""
+
+    def __init__(self, cfg: ErnieConfig | None = None):
+        super().__init__()
+        cfg = cfg or ErnieConfig.base()
+        self.config = cfg
+        self.ernie = ErnieModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_eps)
+        self.decoder_bias = self.create_parameter((cfg.vocab_size,),
+                                                  is_bias=True)
+        self.seq_relationship = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None,
+                attention_mask=None, labels=None, sop_labels=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids, task_type_ids,
+                                 attention_mask)
+        h = self.layer_norm(F.gelu(self.transform(seq)))
+        logits = paddle.matmul(
+            h, self.ernie.embeddings.word_embeddings.weight,
+            transpose_y=True) + self.decoder_bias
+        sop_logits = self.seq_relationship(pooled)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size])
+                .astype("float32"),
+                labels.reshape([-1]), ignore_index=-100)
+            if sop_labels is not None:
+                loss = loss + F.cross_entropy(
+                    sop_logits.astype("float32"), sop_labels.reshape([-1]))
+            return loss, logits
+        return logits, sop_logits
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: ErnieConfig | None = None, num_classes: int = 2,
+                 dropout=None):
+        super().__init__()
+        cfg = cfg or ErnieConfig.base()
+        self.config = cfg
+        self.ernie = ErnieModel(cfg)
+        self.dropout = nn.Dropout(dropout if dropout is not None
+                                  else cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, task_type_ids,
+                               attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            loss = F.cross_entropy(logits.astype("float32"),
+                                   labels.reshape([-1]))
+            return loss, logits
+        return logits
+
+
+def shard_ernie(model: nn.Layer, mesh) -> nn.Layer:
+    """4D-hybrid placements for the ERNIE encoder (north-star config #3):
+    Megatron column/row on 'mp' for the attention/FFN projections, vocab
+    dim of the embedding on 'mp', ZeRO over 'sharding' on the other dim,
+    'dp' batch-only, 'sep' activations-only — all expressed as sharding
+    annotations over ONE mesh (SURVEY.md §2.3 hybrid row)."""
+    from paddle_tpu.distributed.mesh import (Replicate, Shard, shard_tensor)
+    names = mesh.dim_names
+
+    def put(p, **axis_dim):
+        placements = [Replicate() for _ in names]
+        for ax, d in axis_dim.items():
+            if ax in names and mesh.get_dim_size(ax) > 1:
+                if p._value.shape[d] % mesh.get_dim_size(ax) != 0:
+                    continue
+                placements[names.index(ax)] = Shard(d)
+        sharded = shard_tensor(p, mesh, placements)
+        p._value = sharded._value
+        p.dist_attr = sharded.dist_attr
+
+    for lname, p in model.named_parameters():
+        nm = lname.lower()
+        if p._value.ndim < 2:
+            put(p)
+        elif "word_embeddings" in nm:
+            put(p, mp=0, sharding=1)
+        elif any(k in nm for k in ("q_proj", "k_proj", "v_proj", "linear1",
+                                   "qkv")):
+            put(p, mp=1, sharding=0)       # column parallel
+        elif any(k in nm for k in ("out_proj", "linear2")):
+            put(p, mp=0, sharding=1)       # row parallel
+        else:
+            put(p, sharding=0)
+    return model
+
+
+def synthetic_ernie_batch(batch_size, seq_len, vocab_size, mask_prob=0.15,
+                          seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(5, vocab_size, (batch_size, seq_len), dtype=np.int32)
+    labels = np.full((batch_size, seq_len), -100, np.int32)
+    mask = rng.random((batch_size, seq_len)) < mask_prob
+    labels[mask] = ids[mask]
+    ids[mask] = 3
+    sop = rng.integers(0, 2, (batch_size,), dtype=np.int32)
+    return (paddle.to_tensor(ids), paddle.to_tensor(labels),
+            paddle.to_tensor(sop))

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""ERNIE-4.5-style pretraining on a 4D hybrid mesh — north-star config #3
+(BASELINE.json configs[2] / SURVEY.md §6): dp x mp x sharding (x sep)
+expressed as ONE GSPMD mesh over `shard_ernie` placements.
+
+    python recipes/ernie_4d.py --steps 10                    # synthetic, 1 dev
+    python recipes/ernie_4d.py --mesh dp=2,mp=2,sharding=2   # 8-dev CPU mesh
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from recipes.common import RecipeResult, run_train, std_parser, \
+    token_source  # noqa: E402
+from recipes.llama_pretrain import parse_mesh  # noqa: E402
+
+
+def main(argv=None):
+    p = std_parser("ERNIE pretraining (MLM + SOP) on a 4D hybrid mesh")
+    p.add_argument("--size", choices=["tiny", "base"], default="tiny")
+    p.add_argument("--mesh", type=str, default=None,
+                   help="e.g. dp=2,mp=2,sharding=2")
+    args = p.parse_args(argv)
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models.ernie import (ErnieConfig, ErnieForPretraining,
+                                         shard_ernie,
+                                         synthetic_ernie_batch)
+    from paddle_tpu.optimizer import AdamW
+
+    cfg = ErnieConfig.tiny() if args.size == "tiny" else ErnieConfig.base()
+    paddle.seed(args.seed)
+    model = ErnieForPretraining(cfg)
+
+    mesh = dist.create_mesh(**parse_mesh(args.mesh)) if args.mesh else None
+
+    def build_step():
+        opt = AdamW(learning_rate=args.lr,
+                    parameters=model.parameters(), weight_decay=0.01)
+        return paddle.jit.TrainStep(
+            model, opt,
+            loss_fn=lambda m, ids, lbl, sop: m(ids, labels=lbl,
+                                               sop_labels=sop)[0],
+            accumulate_steps=args.accumulate_steps)
+
+    def batches():
+        i = 0
+        while True:
+            yield synthetic_ernie_batch(args.batch_size, args.seq_len,
+                                        cfg.vocab_size,
+                                        seed=args.seed + i)
+            i += 1
+
+    gen = batches()
+
+    if mesh is not None:
+        with dist.use_mesh(mesh):
+            shard_ernie(model, mesh)
+            step = build_step()
+            pl = [dist.Replicate() for _ in mesh.dim_names]
+            if "dp" in mesh.dim_names:
+                pl[mesh.dim_names.index("dp")] = dist.Shard(0)
+
+            def sharded_step(ids, lbl, sop):
+                ids = dist.shard_tensor(ids, mesh, pl)
+                lbl = dist.shard_tensor(lbl, mesh, pl)
+                sop = dist.shard_tensor(sop, mesh, pl)
+                return step(ids, lbl, sop)
+
+            loss = run_train(sharded_step,
+                             (next(gen) for _ in iter(int, 1)),
+                             args.steps, args.log_every)
+    else:
+        step = build_step()
+        loss = run_train(lambda *b: step(*b),
+                         (next(gen) for _ in iter(int, 1)),
+                         args.steps, args.log_every)
+
+    if args.save:
+        paddle.save(model.state_dict(), args.save)
+    print(f"final loss: {loss:.4f}", flush=True)
+    return RecipeResult(final_loss=loss, steps=args.steps)
+
+
+if __name__ == "__main__":
+    main()

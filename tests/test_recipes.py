@@ -122,3 +122,47 @@ class TestRecipeSmoke:
         assert np.isfinite(r.final_loss)
         state = paddle.load(str(ckpt))
         assert len(state) > 0
+
+
+class TestErnie4D:
+    """North-star config #3 (ERNIE 4D hybrid). ≙ BASELINE.md configs."""
+
+    def test_ernie_model_forward_and_loss(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.ernie import (ErnieConfig,
+                                             ErnieForPretraining,
+                                             ErnieForSequenceClassification,
+                                             synthetic_ernie_batch)
+        paddle.seed(0)
+        cfg = ErnieConfig.tiny()
+        m = ErnieForPretraining(cfg)
+        ids, labels, sop = synthetic_ernie_batch(2, 32, cfg.vocab_size)
+        loss, logits = m(ids, labels=labels, sop_labels=sop)
+        assert np.isfinite(float(loss))
+        assert tuple(logits.shape) == (2, 32, cfg.vocab_size)
+
+        clf = ErnieForSequenceClassification(cfg, num_classes=3)
+        out = clf(ids)
+        assert tuple(out.shape) == (2, 3)
+
+    def test_recipe_single_device(self):
+        from recipes.ernie_4d import main
+        res = main(["--steps", "3", "--batch-size", "2", "--seq-len", "32",
+                    "--log-every", "0"])
+        assert np.isfinite(res.final_loss)
+
+    def test_recipe_4d_mesh(self):
+        from recipes.ernie_4d import main
+        res = main(["--steps", "3", "--batch-size", "4", "--seq-len", "32",
+                    "--mesh", "dp=2,mp=2,sharding=2", "--log-every", "0"])
+        assert np.isfinite(res.final_loss)
+
+    def test_4d_loss_matches_single_device(self):
+        """Convergence-parity oracle (SURVEY.md §4 TestDistBase port):
+        same seed, same data -> mesh loss == single-device loss."""
+        from recipes.ernie_4d import main
+        r1 = main(["--steps", "2", "--batch-size", "4", "--seq-len", "32",
+                   "--log-every", "0"])
+        r2 = main(["--steps", "2", "--batch-size", "4", "--seq-len", "32",
+                   "--mesh", "dp=2,mp=2,sharding=2", "--log-every", "0"])
+        assert abs(r1.final_loss - r2.final_loss) < 0.05, (r1, r2)
