@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""DiT diffusion training — north-star config #4 (DiT/SD3 style,
+BASELINE.json configs[3] / SURVEY.md §6): conv(patchify) + attention
+through the Pallas flash kernel on TPU, diffusion loss + DDIM sampling
+as single compiled XLA programs.
+
+    python recipes/dit_train.py --steps 10                 # synthetic
+    python recipes/dit_train.py --mesh dp=4,mp=2 --steps 5 # 8-dev CPU
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from recipes.common import RecipeResult, run_train, std_parser  # noqa: E402
+from recipes.llama_pretrain import parse_mesh  # noqa: E402
+
+
+def main(argv=None):
+    p = std_parser("DiT diffusion training")
+    p.add_argument("--size", choices=["tiny", "s"], default="tiny")
+    p.add_argument("--mesh", type=str, default=None, help="e.g. dp=4,mp=2")
+    p.add_argument("--sample-after", action="store_true",
+                   help="run a 10-step DDIM sample at the end")
+    args = p.parse_args(argv)
+
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models.dit import (DiT, DiTConfig, GaussianDiffusion,
+                                       synthetic_dit_batch)
+    from paddle_tpu.optimizer import AdamW
+
+    cfg = DiTConfig.tiny() if args.size == "tiny" else DiTConfig(
+        input_size=32, patch_size=4, hidden_size=384, num_hidden_layers=12,
+        num_attention_heads=6, num_classes=1000)
+    paddle.seed(args.seed)
+    model = DiT(cfg)
+    diffusion = GaussianDiffusion()
+
+    mesh = dist.create_mesh(**parse_mesh(args.mesh)) if args.mesh else None
+
+    def build_step():
+        opt = AdamW(learning_rate=args.lr,
+                    parameters=model.parameters(), weight_decay=0.0)
+        return paddle.jit.TrainStep(
+            model, opt,
+            loss_fn=lambda m, x, t, y: diffusion.training_loss(m, x, t, y),
+            accumulate_steps=args.accumulate_steps)
+
+    def batches():
+        i = 0
+        while True:
+            yield synthetic_dit_batch(args.batch_size, cfg,
+                                      seed=args.seed + i)
+            i += 1
+
+    gen = batches()
+
+    if mesh is not None:
+        with dist.use_mesh(mesh):
+            # DP-shard the batch; model params replicated (DiT-tiny fits) —
+            # 'mp' shards the attention/MLP weights when divisible
+            from paddle_tpu.distributed.mesh import (Replicate, Shard,
+                                                     shard_tensor)
+            names = mesh.dim_names
+            for lname, prm in model.named_parameters():
+                placements = [Replicate() for _ in names]
+                if prm._value.ndim == 2 and "mp" in names and \
+                        mesh.get_dim_size("mp") > 1 and \
+                        prm._value.shape[1] % mesh.get_dim_size("mp") == 0:
+                    placements[names.index("mp")] = Shard(1)
+                sh = shard_tensor(prm, mesh, placements)
+                prm._value = sh._value
+                prm.dist_attr = sh.dist_attr
+            step = build_step()
+            pl = [dist.Replicate() for _ in names]
+            if "dp" in names:
+                pl[names.index("dp")] = dist.Shard(0)
+
+            def sharded_step(x, t, y):
+                x = dist.shard_tensor(x, mesh, pl)
+                t = dist.shard_tensor(t, mesh, pl)
+                y = dist.shard_tensor(y, mesh, pl)
+                return step(x, t, y)
+
+            loss = run_train(sharded_step,
+                             (next(gen) for _ in iter(int, 1)),
+                             args.steps, args.log_every)
+    else:
+        step = build_step()
+        loss = run_train(lambda *b: step(*b),
+                         (next(gen) for _ in iter(int, 1)),
+                         args.steps, args.log_every)
+
+    if args.sample_after:
+        y = paddle.to_tensor(np.arange(min(2, cfg.num_classes),
+                                       dtype=np.int32))
+        img = diffusion.ddim_sample(model, batch_size=y.shape[0], y=y,
+                                    num_steps=10, seed=args.seed)
+        print(f"sampled {tuple(img.shape)}", flush=True)
+
+    if args.save:
+        paddle.save(model.state_dict(), args.save)
+    print(f"final loss: {loss:.4f}", flush=True)
+    return RecipeResult(final_loss=loss, steps=args.steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -166,3 +166,19 @@ class TestErnie4D:
         r2 = main(["--steps", "2", "--batch-size", "4", "--seq-len", "32",
                    "--mesh", "dp=2,mp=2,sharding=2", "--log-every", "0"])
         assert abs(r1.final_loss - r2.final_loss) < 0.05, (r1, r2)
+
+
+class TestDiTRecipe:
+    """North-star config #4 (DiT diffusion)."""
+
+    def test_single_device_with_sampling(self):
+        from recipes.dit_train import main
+        res = main(["--steps", "3", "--batch-size", "2",
+                    "--log-every", "0", "--sample-after"])
+        assert np.isfinite(res.final_loss)
+
+    def test_dp_mp_mesh(self):
+        from recipes.dit_train import main
+        res = main(["--steps", "2", "--batch-size", "4",
+                    "--mesh", "dp=4,mp=2", "--log-every", "0"])
+        assert np.isfinite(res.final_loss)
