@@ -264,6 +264,29 @@ jax.tree_util.register_pytree_node(Parameter, _tensor_flatten, _tensor_unflatten
 
 
 # -- op application (the single dispatch point) ------------------------------
+def _check_nan_inf(name: str, out_vals, multi_output: bool) -> None:
+    """FLAGS_check_nan_inf eager path: scan op outputs, raise with the op
+    name — ≙ the reference's per-kernel scan with op-level blame
+    («paddle/fluid/framework/details/nan_inf_utils*» [U?], SURVEY.md §5).
+    Traced values are skipped (can't concretize); jax_debug_nans covers
+    the compiled path."""
+    outs = out_vals if multi_output else (out_vals,)
+    for i, v in enumerate(outs):
+        if not isinstance(v, jax.Array) or isinstance(v, jax.core.Tracer):
+            continue
+        if not jnp.issubdtype(v.dtype, jnp.floating) and \
+                not jnp.issubdtype(v.dtype, jnp.complexfloating):
+            continue
+        bad = bool(jnp.any(jnp.isnan(v) | jnp.isinf(v)))
+        if bad:
+            n_nan = int(jnp.sum(jnp.isnan(v)))
+            n_inf = int(jnp.sum(jnp.isinf(v)))
+            raise RuntimeError(
+                f"FLAGS_check_nan_inf: op '{name}' output {i} "
+                f"(shape {tuple(v.shape)}, dtype {v.dtype}) contains "
+                f"{n_nan} NaN / {n_inf} Inf values")
+
+
 def apply(name: str,
           fn: Callable,
           tensors: Sequence[Tensor],
@@ -293,12 +316,24 @@ def apply(name: str,
     needs_grad = is_grad_enabled() and any(
         (not t.stop_gradient) for t in tensors)
 
-    if needs_grad:
-        out_vals, vjp_fn = jax.vjp(fn, *vals)
-        node = tape.record(name, fn, tensors, out_vals, vjp_fn, multi_output)
-    else:
-        out_vals = fn(*vals)
-        node = None
+    from ..utils import flags as _flags
+    try:
+        if needs_grad:
+            out_vals, vjp_fn = jax.vjp(fn, *vals)
+            node = tape.record(name, fn, tensors, out_vals, vjp_fn,
+                               multi_output)
+        else:
+            out_vals = fn(*vals)
+            node = None
+    except FloatingPointError as e:
+        # jax_debug_nans raised inside the op — re-raise with op-level
+        # blame (≙ reference nan_inf_utils op attribution, SURVEY.md §5)
+        raise RuntimeError(
+            f"FLAGS_check_nan_inf: op '{name}' produced non-finite "
+            f"values ({e})") from e
+
+    if _flags.check_nan_inf_enabled:
+        _check_nan_inf(name, out_vals, multi_output)
 
     def make(i, v):
         t = Tensor(v, stop_gradient=not needs_grad)

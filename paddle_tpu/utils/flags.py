@@ -53,14 +53,23 @@ def set_flags(flags: dict):
             info.on_set(info.value)
 
 
+# fast-path mirror read by core.tensor.apply on every eager op — a dict
+# lookup there would tax the hot loop even with the flag off
+check_nan_inf_enabled = False
+
+
 def _set_debug_nans(v: bool):
     import jax
+    global check_nan_inf_enabled
+    check_nan_inf_enabled = bool(v)
     jax.config.update("jax_debug_nans", v)
 
 
 # core flag set (subset of the reference's FLAGS_* that is meaningful on TPU)
 define_flag("FLAGS_check_nan_inf", False,
-            "Per-op NaN/Inf checking (jax_debug_nans underneath).",
+            "Per-op NaN/Inf scan with OP-LEVEL BLAME in eager mode "
+            "(≙ reference nan_inf_utils, SURVEY.md §5 race/NaN row); "
+            "under jit, jax_debug_nans provides the XLA-level check.",
             on_set=_set_debug_nans)
 define_flag("FLAGS_use_autotune", True, "Let XLA autotune (no-op knob).")
 define_flag("FLAGS_embedding_deterministic", 1,

@@ -308,3 +308,32 @@ class TestRandom:
         assert r.min() >= 0 and r.max() < 10
         p = paddle.randperm(10).numpy()
         assert sorted(p.tolist()) == list(range(10))
+
+
+class TestCheckNanInf:
+    """FLAGS_check_nan_inf op-level blame (SURVEY.md §5 race/NaN row;
+    VERDICT r2 'no per-op NaN blame')."""
+
+    def test_nan_blamed_with_op_name(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.array([0.0, 1.0], np.float32))
+            with pytest.raises(RuntimeError, match="op 'divide'"):
+                _ = x / x
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_inf_blamed(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            a = paddle.to_tensor(np.array([1.0], np.float32))
+            b = paddle.to_tensor(np.array([0.0], np.float32))
+            with pytest.raises(RuntimeError, match="Inf"):
+                _ = a / b
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_off_by_default_no_raise(self):
+        x = paddle.to_tensor(np.array([0.0], np.float32))
+        y = x / x
+        assert np.isnan(np.asarray(y._value)).all()
