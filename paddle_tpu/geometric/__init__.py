@@ -170,3 +170,66 @@ def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
         return _MSG[message_op](jnp.take(a, src, axis=0),
                                 jnp.take(b, dst, axis=0))
     return apply("send_uv", fn, (x, y, src_index, dst_index))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """≙ paddle.geometric.sample_neighbors (CSC graph neighbor sampling)
+    [U]. Host-side op like the reference's CPU kernel — sampling is data-
+    dependent-shaped, so it runs eagerly in numpy and is not a jit
+    target."""
+    rr = np.asarray(_t(row)._value)
+    cp = np.asarray(_t(colptr)._value)
+    nodes = np.asarray(_t(input_nodes)._value)
+    ev = np.asarray(_t(eids)._value) if eids is not None else None
+    rng = np.random.default_rng()
+    out_n, out_cnt, out_e = [], [], []
+    for n in nodes.reshape(-1):
+        lo, hi = int(cp[n]), int(cp[n + 1])
+        neigh = rr[lo:hi]
+        idx = np.arange(lo, hi)
+        if 0 <= sample_size < neigh.shape[0]:
+            pick = rng.choice(neigh.shape[0], size=sample_size,
+                              replace=False)
+            neigh, idx = neigh[pick], idx[pick]
+        out_n.append(neigh)
+        out_cnt.append(neigh.shape[0])
+        if return_eids:
+            out_e.append(ev[idx] if ev is not None else idx)
+    neighbors = to_tensor(np.concatenate(out_n) if out_n
+                          else np.zeros((0,), rr.dtype))
+    counts = to_tensor(np.asarray(out_cnt, np.int32))
+    if return_eids:
+        eout = to_tensor(np.concatenate(out_e) if out_e
+                         else np.zeros((0,), np.int64))
+        return neighbors, counts, eout
+    return neighbors, counts
+
+
+def reindex_graph(x, neighbors, count=None, value_buffer=None,
+                  index_buffer=None, name=None):
+    """≙ paddle.geometric.reindex_graph: compact the union of seed nodes
+    `x` and their `neighbors` to contiguous ids (seeds first) [U].
+    Host-side like sample_neighbors."""
+    xs = np.asarray(_t(x)._value).reshape(-1)
+    ns = np.asarray(_t(neighbors)._value).reshape(-1)
+    mapping: dict = {}
+    for v in xs:
+        mapping.setdefault(int(v), len(mapping))
+    for v in ns:
+        mapping.setdefault(int(v), len(mapping))
+    reindexed = np.asarray([mapping[int(v)] for v in ns], np.int64)
+    out_nodes = np.empty(len(mapping), xs.dtype)
+    for v, i in mapping.items():
+        out_nodes[i] = v
+    # reindex_dst: seeds repeated per their neighbor counts
+    if count is not None:
+        cnt = np.asarray(_t(count)._value).reshape(-1)
+        dst = np.repeat(np.arange(len(xs), dtype=np.int64), cnt)
+    else:
+        dst = np.zeros((0,), np.int64)
+    return (to_tensor(reindexed), to_tensor(dst), to_tensor(out_nodes))
+
+
+__all__ += ["sample_neighbors", "reindex_graph"]

@@ -26,3 +26,24 @@ def run_check():
     assert y.shape == (128, 128)
     print(f"paddle_tpu works on {d.platform}:{d.device_kind}. "
           f"{len(jax.devices())} device(s) available.")
+
+
+from . import dlpack  # noqa: F401,E402
+from . import unique_name  # noqa: F401,E402
+
+
+def require_version(min_version: str, max_version: str | None = None):
+    """≙ paddle.utils.require_version — checks the installed framework
+    version against [min, max]."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(x) for x in v.split(".")[:3] if x.isdigit())
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise RuntimeError(
+            f"requires version >= {min_version}, installed {__version__}")
+    if max_version is not None and parse(max_version) < cur:
+        raise RuntimeError(
+            f"requires version <= {max_version}, installed {__version__}")
+    return True

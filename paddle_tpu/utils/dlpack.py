@@ -1,0 +1,26 @@
+"""paddle.utils.dlpack — zero-copy tensor interop.
+
+≙ reference «python/paddle/utils/dlpack.py» [U]. Backed by jax's dlpack
+support; on CPU this is zero-copy interop with torch/numpy, across
+devices jax handles the transfer semantics.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+def to_dlpack(x: Tensor):
+    """Export a Tensor as a DLPack capsule."""
+    if not isinstance(x, Tensor):
+        raise TypeError(f"to_dlpack expects a Tensor, got {type(x)}")
+    # jax arrays implement __dlpack__ directly (the modern protocol)
+    return x._value.__dlpack__()
+
+
+def from_dlpack(capsule) -> Tensor:
+    """Import a DLPack capsule (or any object with __dlpack__) as a
+    Tensor."""
+    arr = jax.dlpack.from_dlpack(capsule)
+    return Tensor(arr)

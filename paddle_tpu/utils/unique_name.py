@@ -1,0 +1,49 @@
+"""paddle.utils.unique_name — name generation with guard scopes.
+
+≙ reference «python/paddle/utils/unique_name.py» (thin UniqueNameGenerator
+over per-prefix counters + guard() context to scope/reset) [U].
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["generate", "guard", "switch"]
+
+
+class UniqueNameGenerator:
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+        self.ids: dict = {}
+
+    def __call__(self, key: str) -> str:
+        if key not in self.ids:
+            self.ids[key] = 0
+        tmp = self.ids[key]
+        self.ids[key] += 1
+        return self.prefix + "_".join([key, str(tmp)])
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    return generator(key)
+
+
+def switch(new_generator: UniqueNameGenerator | None = None):
+    global generator
+    old = generator
+    generator = new_generator if new_generator is not None \
+        else UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    if isinstance(new_generator, str):
+        new_generator = UniqueNameGenerator(new_generator)
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

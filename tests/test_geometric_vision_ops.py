@@ -252,3 +252,33 @@ class TestVisionZoo:
             opt.clear_grad()
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+
+class TestGraphSampling:
+    def test_sample_neighbors_and_reindex(self):
+        # CSC graph: 4 nodes; col j's neighbors = row[colptr[j]:colptr[j+1]]
+        row = np.array([1, 2, 3, 0, 2, 0], np.int64)
+        colptr = np.array([0, 3, 5, 6, 6], np.int64)
+        nodes = np.array([0, 1], np.int64)
+        n, cnt = G.sample_neighbors(paddle.to_tensor(row),
+                                    paddle.to_tensor(colptr),
+                                    paddle.to_tensor(nodes))
+        np.testing.assert_array_equal(np.asarray(cnt._value), [3, 2])
+        np.testing.assert_array_equal(np.asarray(n._value),
+                                      [1, 2, 3, 0, 2])
+        # bounded sampling
+        n2, cnt2 = G.sample_neighbors(paddle.to_tensor(row),
+                                      paddle.to_tensor(colptr),
+                                      paddle.to_tensor(nodes),
+                                      sample_size=2)
+        np.testing.assert_array_equal(np.asarray(cnt2._value), [2, 2])
+
+        re, dst, uniq = G.reindex_graph(paddle.to_tensor(nodes), n,
+                                        count=cnt)
+        u = np.asarray(uniq._value)
+        assert u[0] == 0 and u[1] == 1          # seeds first
+        # reindexed neighbors map back to the originals
+        np.testing.assert_array_equal(u[np.asarray(re._value)],
+                                      np.asarray(n._value))
+        np.testing.assert_array_equal(np.asarray(dst._value),
+                                      [0, 0, 0, 1, 1])
