@@ -101,3 +101,18 @@ class PyLayer:
         if multi:
             return type(outs)(out_list)
         return out_list[0]
+
+
+def jacobian(func, xs, create_graph=False):
+    """≙ paddle.autograd.jacobian [U]. Functional form (func, xs) — the
+    tape is first-order, so the Jacobian is computed by jax.jacrev over
+    the function (incubate.autograd), not by double backward over a
+    stored graph."""
+    from ..incubate.autograd import jacobian as _j
+    return _j(func, xs, create_graph=create_graph)
+
+
+def hessian(func, xs, create_graph=False):
+    """≙ paddle.autograd.hessian [U] (functional form, see jacobian)."""
+    from ..incubate.autograd import hessian as _h
+    return _h(func, xs, create_graph=create_graph)
