@@ -95,14 +95,30 @@ def _compiler_params(*sem):
     return pltpu.CompilerParams(dimension_semantics=tuple(sem))
 
 
-def _causal_mask(s, qi, ki, block_q, block_k, offset):
+def _causal_mask(s, qi, ki, block_q, block_k, offset, window=None):
     """End-aligned causal mask on a (Bq, Bk) logits tile: q row (absolute
-    position p) sees keys <= p + offset where offset = Sk - Sq."""
+    position p) sees keys <= p + offset where offset = Sk - Sq. With
+    `window` (sliding-window / Mistral-style local attention) the band
+    narrows to keys in [p + offset - window + 1, p + offset]."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0)
     k_pos = ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1)
-    return jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+    live = q_pos + offset >= k_pos
+    if window is not None:
+        live = live & (k_pos >= q_pos + offset - (window - 1))
+    return jnp.where(live, s, NEG_INF)
+
+
+def _tile_live(qi, ki, block_q, block_k, offset, window):
+    """Predicate: does this (q-tile, k-tile) intersect the causal band?
+    Used to skip fully-masked tiles in fwd and both bwd kernels."""
+    upper = ki * block_k <= qi * block_q + block_q - 1 + offset
+    if window is None:
+        return upper
+    lower = ki * block_k + block_k - 1 >= \
+        qi * block_q + offset - (window - 1)
+    return upper & lower
 
 
 # ---------------------------------------------------------------------------
@@ -110,7 +126,7 @@ def _causal_mask(s, qi, ki, block_q, block_k, offset):
 # ---------------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr, *, scale, causal, block_q, block_k,
-                num_k_blocks, offset):
+                num_k_blocks, offset, window=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -127,7 +143,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
         if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset, window)
         m_prev = m_scr[:]                  # (Bq, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -143,8 +159,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_scr[:] = l_new
 
     if causal:
-        # skip tiles strictly above the (end-aligned) diagonal
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1 + offset)
+        # skip tiles outside the (end-aligned, possibly windowed) band
+        @pl.when(_tile_live(qi, ki, block_q, block_k, offset, window))
         def _():
             compute()
     else:
@@ -158,7 +174,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
                                       (l.shape[0], LANES))
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, group):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, group,
+               window=None):
     """q: (B*H, Sq, D); k,v: (B*HK, Sk, D) -> (o, lse[lane-broadcast])."""
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -168,7 +185,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, group):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k_blocks=nk, offset=offset)
+        block_k=block_k, num_k_blocks=nk, offset=offset, window=window)
 
     o, lse = pl.pallas_call(
         kernel,
@@ -205,7 +222,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, group):
 # ---------------------------------------------------------------------------
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *, scale, causal, block_q, block_k, num_k_blocks,
-                   offset):
+                   offset, window=None):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -221,7 +238,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset, window)
         # lse/delta arrive lane-broadcast; max over identical lanes restores
         # the (Bq, 1) column without an unsupported minor-dim slice.
         lse = jnp.max(lse_ref[0], axis=-1, keepdims=True)
@@ -239,7 +256,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when(ki * block_k <= qi * block_q + block_q - 1 + offset)
+        @pl.when(_tile_live(qi, ki, block_q, block_k, offset, window))
         def _():
             compute()
     else:
@@ -252,7 +269,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    block_q, block_k, num_q_blocks, group, offset):
+                    block_q, block_k, num_q_blocks, group, offset,
+                    window=None):
     ki = pl.program_id(1)
     t = pl.program_id(2)           # fused (group, q-block) index
     qi = t % num_q_blocks
@@ -270,7 +288,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
         if causal:
-            s = _causal_mask(s, qi, ki, block_q, block_k, offset)
+            s = _causal_mask(s, qi, ki, block_q, block_k, offset, window)
         lse = jnp.max(lse_ref[0], axis=-1, keepdims=True)
         delta = jnp.max(delta_ref[0], axis=-1, keepdims=True)
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)
@@ -287,7 +305,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32)          # (Bk, D)
 
     if causal:
-        @pl.when(qi * block_q + block_q - 1 + offset >= ki * block_k)
+        @pl.when(_tile_live(qi, ki, block_q, block_k, offset, window))
         def _():
             compute()
     else:
@@ -299,7 +317,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, group):
+def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, group,
+               window=None):
     bh, sq, d = q.shape
     bhk = k.shape[0]
     sk = k.shape[1]
@@ -314,7 +333,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, group):
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_k_blocks=nk,
-                          offset=offset),
+                          offset=offset, window=window),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -342,7 +361,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, group):
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, num_q_blocks=nq,
-                          group=group, offset=offset),
+                          group=group, offset=offset, window=window),
         grid=(bhk, nk, group * nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), q_map),
@@ -372,61 +391,90 @@ def _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, group):
 # ---------------------------------------------------------------------------
 # public op (custom vjp over (BH, S, D) + (BHK, S, D))
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, group):
-    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, group)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, group, window):
+    o, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, group,
+                      window)
     return o
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, group):
-    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, group)
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, group,
+                    window):
+    o, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, group,
+                        window)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, group, res, do):
+def _flash_bwd_rule(scale, causal, block_q, block_k, group, window, res,
+                    do):
     q, k, v, o, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, scale, causal, block_q,
-                            block_k, group)
+                            block_k, group, window)
     return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def _attention_xla(q, k, v, scale, causal):
+def _attention_xla(q, k, v, scale, causal, window=None):
     """XLA-fallback attention for shapes the blocked kernel cannot tile.
     Delegates to the canonical nn.functional reference impl (end-aligned
     causal, GQA aware) so the two paths cannot drift apart. Deferred import:
-    nn.functional.attention imports this module at load time."""
+    nn.functional.attention imports this module at load time. The windowed
+    band is materialized as an explicit bool mask here (the fallback has
+    no tile structure to exploit)."""
     from ..nn.functional.attention import _sdpa_xla
+    if window is not None:
+        sq, sk = q.shape[1], k.shape[1]
+        offset = sk - sq
+        qp = jnp.arange(sq)[:, None]
+        kp = jnp.arange(sk)[None, :]
+        band = (qp + offset >= kp) & (kp >= qp + offset - (window - 1))
+        return _sdpa_xla(q, k, v, mask=band[None, None],
+                         causal=False, scale=scale).astype(q.dtype)
     return _sdpa_xla(q, k, v, causal=causal, scale=scale).astype(q.dtype)
 
 
 def flash_attention_values(q, k, v, causal=False, scale=None,
-                           block_q=None, block_k=None):
-    """jnp-level flash attention, (B, S, H, D) layout, GQA native."""
+                           block_q=None, block_k=None, window_size=None):
+    """jnp-level flash attention, (B, S, H, D) layout, GQA native.
+    `window_size` enables sliding-window (Mistral-style local) attention:
+    q at position p attends keys in [p - window_size + 1, p] (end-aligned
+    under sq != sk). Requires causal=True. ≙ the reference flash-attn
+    window_size=(left, 0) decode convention (SURVEY.md §2.1
+    FlashAttention row)."""
     b, sq, h, d = q.shape
     hk = k.shape[2]
     sk = k.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    if window_size is not None:
+        if not causal:
+            raise ValueError("window_size requires causal=True "
+                             "(sliding-window attention is causal)")
+        window_size = int(window_size)
+        if window_size <= 0:
+            raise ValueError(f"window_size must be > 0, got {window_size}")
     bq = block_q or _auto_block(sq, d)
     bk = block_k or _auto_block(sk, d)
     if not _aligned(sq, sk, d, bq, bk) or h % hk:
         # blocked kernel can't tile this shape — XLA fallback, identical math
-        return _attention_xla(q, k, v, float(scale), bool(causal))
+        return _attention_xla(q, k, v, float(scale), bool(causal),
+                              window_size)
     group = h // hk
     # (B, S, H, D) -> (B*H, S, D)
     qb = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
     kb = jnp.swapaxes(k, 1, 2).reshape(b * hk, sk, d)
     vb = jnp.swapaxes(v, 1, 2).reshape(b * hk, sk, d)
-    ob = _flash(qb, kb, vb, float(scale), bool(causal), bq, bk, group)
+    ob = _flash(qb, kb, vb, float(scale), bool(causal), bq, bk, group,
+                window_size)
     return jnp.swapaxes(ob.reshape(b, h, sq, d), 1, 2)
 
 
 def flash_attention(q: Tensor, k: Tensor, v: Tensor, causal: bool = False,
-                    scale=None) -> Tensor:
+                    scale=None, window_size=None) -> Tensor:
     """Eager/tape entry point, (B, S, H, D)."""
     def fn(qq, kk, vv):
-        return flash_attention_values(qq, kk, vv, causal=causal, scale=scale)
+        return flash_attention_values(qq, kk, vv, causal=causal,
+                                      scale=scale, window_size=window_size)
     return apply("flash_attention", fn, (q, k, v))

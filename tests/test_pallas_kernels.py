@@ -348,3 +348,93 @@ class TestRope:
         # rotation preserves norms: grad = 2 * x
         np.testing.assert_allclose(np.asarray(g), 2 * x, rtol=1e-4,
                                    atol=1e-4)
+
+
+def _sliding_ref(q, k, v, window):
+    """NumPy oracle for causal sliding-window attention (end-aligned)."""
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if h != hk:
+        k = np.repeat(k, h // hk, axis=2)
+        v = np.repeat(v, h // hk, axis=2)
+    qb = q.transpose(0, 2, 1, 3).astype(np.float64)
+    kb = k.transpose(0, 2, 1, 3).astype(np.float64)
+    vb = v.transpose(0, 2, 1, 3).astype(np.float64)
+    logits = qb @ kb.transpose(0, 1, 3, 2) / np.sqrt(d)
+    off = sk - sq
+    qp = np.arange(sq)[:, None]
+    kp = np.arange(sk)[None, :]
+    band = (qp + off >= kp) & (kp >= qp + off - (window - 1))
+    logits = np.where(band, logits, -1e30)
+    w = np.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    return (w @ vb).transpose(0, 2, 1, 3)
+
+
+class TestSlidingWindowFlash:
+    """window_size (Mistral-style local attention) in the flash kernel —
+    SURVEY.md §2.1 FlashAttention row (block-sparse/windowed variants)."""
+
+    @pytest.mark.parametrize("window", [32, 128, 1])
+    def test_forward_matches_reference(self, window):
+        q = rng.normal(size=(1, 256, 2, 64)).astype(np.float32)
+        k = rng.normal(size=(1, 256, 1, 64)).astype(np.float32)
+        v = rng.normal(size=(1, 256, 1, 64)).astype(np.float32)
+        out = fa.flash_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                        jnp.asarray(v), causal=True,
+                                        window_size=window)
+        np.testing.assert_allclose(np.asarray(out),
+                                   _sliding_ref(q, k, v, window),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_end_aligned_window_sq_ne_sk(self):
+        q = rng.normal(size=(1, 128, 2, 32)).astype(np.float32)
+        k = rng.normal(size=(1, 256, 2, 32)).astype(np.float32)
+        v = rng.normal(size=(1, 256, 2, 32)).astype(np.float32)
+        out = fa.flash_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                        jnp.asarray(v), causal=True,
+                                        window_size=64)
+        np.testing.assert_allclose(np.asarray(out),
+                                   _sliding_ref(q, k, v, 64),
+                                   rtol=2e-3, atol=2e-3)
+
+    def test_grad_matches_xla_band(self):
+        q = rng.normal(size=(1, 128, 2, 32)).astype(np.float32)
+        k = rng.normal(size=(1, 128, 2, 32)).astype(np.float32)
+        v = rng.normal(size=(1, 128, 2, 32)).astype(np.float32)
+
+        def loss_pal(a, b, c):
+            o = fa.flash_attention_values(a, b, c, causal=True,
+                                          window_size=32)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        def loss_ref(a, b, c):
+            o = fa._attention_xla(a, b, c, 1.0 / np.sqrt(32), True,
+                                  window=32)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        gp = jax.grad(loss_pal, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        for a, b in zip(gp, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=3e-3, atol=3e-3)
+
+    def test_window_larger_than_seq_equals_causal(self):
+        q = rng.normal(size=(1, 128, 2, 32)).astype(np.float32)
+        k = rng.normal(size=(1, 128, 2, 32)).astype(np.float32)
+        v = rng.normal(size=(1, 128, 2, 32)).astype(np.float32)
+        w1 = fa.flash_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                       jnp.asarray(v), causal=True,
+                                       window_size=4096)
+        w2 = fa.flash_attention_values(jnp.asarray(q), jnp.asarray(k),
+                                       jnp.asarray(v), causal=True)
+        np.testing.assert_allclose(np.asarray(w1), np.asarray(w2),
+                                   rtol=1e-6)
+
+    def test_requires_causal(self):
+        q = jnp.zeros((1, 128, 1, 32), jnp.float32)
+        with pytest.raises(ValueError, match="causal"):
+            fa.flash_attention_values(q, q, q, causal=False,
+                                      window_size=16)

@@ -105,6 +105,20 @@ class TestFlashAttentionCompile:
 
         _compile(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
 
+    def test_sliding_window_fwd_bwd_bench_shape(self):
+        from paddle_tpu.ops.flash_attention import flash_attention_values
+
+        q, k, v = self._qkv()
+        _compile(lambda q, k, v: flash_attention_values(
+            q, k, v, causal=True, window_size=512), q, k, v)
+
+        def loss(q, k, v):
+            return flash_attention_values(
+                q, k, v, causal=True,
+                window_size=512).astype(jnp.float32).sum()
+
+        _compile(jax.grad(loss, argnums=(0, 1, 2)), q, k, v)
+
 
 class TestRopeCompile:
     def test_fwd_bwd_bench_shape(self):
