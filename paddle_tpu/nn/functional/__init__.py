@@ -1,6 +1,8 @@
 """nn.functional namespace. ≙ reference «python/paddle/nn/functional/__init__.py» [U]."""
 from .activation import *  # noqa: F401,F403
-from .attention import (scaled_dot_product_attention, flash_attention,  # noqa: F401
+from .attention import (flash_attn_qkvpacked,  # noqa: F401
+                        flash_attn_varlen_qkvpacked, sdp_kernel,
+                        scaled_dot_product_attention, flash_attention,
                         flash_attn_unpadded, masked_multihead_attention,
                         sequence_mask)
 from .common import *  # noqa: F401,F403

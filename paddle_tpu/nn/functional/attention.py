@@ -210,3 +210,39 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     return apply("sequence_mask",
                  lambda v: (jnp.arange(ml)[None, :] < v[..., None]).astype(dt),
                  (xv,))
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, fixed_seed_offset=None,
+                         rng_name="", training=True, name=None):
+    """≙ paddle.nn.functional.flash_attention.flash_attn_qkvpacked [U]:
+    qkv (B, S, 3, H, D) packed — split and route through the flash
+    path (the packed layout is an API convention, not a kernel
+    requirement; XLA folds the slices into the projections)."""
+    qkv_t = _t(qkv)
+    q = qkv_t[:, :, 0]
+    k = qkv_t[:, :, 1]
+    v = qkv_t[:, :, 2]
+    return flash_attention(q, k, v, dropout=dropout, causal=causal,
+                           return_softmax=return_softmax,
+                           training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, name=None):
+    """≙ paddle.nn.functional.flash_attention.flash_attn_varlen_qkvpacked
+    [U]: qkv (total, 3, H, D) packed varlen."""
+    qkv_t = _t(qkv)
+    return flash_attn_unpadded(
+        qkv_t[:, 0], qkv_t[:, 1], qkv_t[:, 2], cu_seqlens_q, cu_seqlens_k,
+        max_seqlen_q, max_seqlen_k, scale=scale, dropout=dropout,
+        causal=causal, return_softmax=return_softmax)
+
+
+def sdp_kernel(*args, **kwargs):
+    """≙ paddle sdp_kernel context (kernel-selection hint) — on TPU the
+    choice is shape-driven (can_use_flash); accepted for API parity."""
+    import contextlib
+    return contextlib.nullcontext()

@@ -199,3 +199,36 @@ class TestPackedTraining:
             opt.clear_grad()
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+
+rng = np.random.default_rng(21)
+
+
+class TestQKVPacked:
+    def test_qkvpacked_matches_split(self):
+        import paddle_tpu.nn.functional as F
+        qkv = paddle.to_tensor(rng.normal(size=(2, 32, 3, 2, 16))
+                               .astype(np.float32))
+        out, _ = F.flash_attn_qkvpacked(qkv, causal=True)
+        ref, _ = F.flash_attention(qkv[:, :, 0], qkv[:, :, 1],
+                                   qkv[:, :, 2], causal=True)
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   np.asarray(ref._value), rtol=1e-6)
+
+    def test_varlen_qkvpacked_matches_unpadded(self):
+        import paddle_tpu.nn.functional as F
+        total = 48
+        cu = np.array([0, 16, 48], np.int32)
+        qkv = paddle.to_tensor(rng.normal(size=(total, 3, 2, 16))
+                               .astype(np.float32))
+        out = F.flash_attn_varlen_qkvpacked(qkv, paddle.to_tensor(cu),
+                                            paddle.to_tensor(cu), 32, 32,
+                                            causal=True)
+        ref = F.flash_attn_unpadded(qkv[:, 0], qkv[:, 1], qkv[:, 2],
+                                    paddle.to_tensor(cu),
+                                    paddle.to_tensor(cu), 32, 32,
+                                    causal=True)
+        o = out[0] if isinstance(out, tuple) else out
+        r = ref[0] if isinstance(ref, tuple) else ref
+        np.testing.assert_allclose(np.asarray(o._value),
+                                   np.asarray(r._value), rtol=1e-6)
