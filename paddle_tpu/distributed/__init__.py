@@ -30,9 +30,15 @@ def __getattr__(name):
 
 
 def spawn(func, args=(), nprocs=-1, **kwargs):
-    """≙ paddle.distributed.spawn. On TPU the runtime is single-process per
-    host; spawn just calls func (the mesh provides parallelism)."""
-    func(*args)
+    """≙ paddle.distributed.spawn — multi-process worker fork with
+    jax.distributed rendezvous (see parallel.spawn). nprocs<=1 runs func
+    inline (the TPU runtime is one process per host; the mesh provides
+    chip parallelism)."""
+    if nprocs <= 1:
+        func(*args)
+        return [0]
+    from .parallel import spawn as _spawn
+    return _spawn(func, args=args, nprocs=nprocs, **kwargs)
 
 
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,

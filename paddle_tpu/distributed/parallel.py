@@ -80,3 +80,53 @@ class ParallelEnv:
     @property
     def dev_id(self) -> int:
         return 0
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """≙ paddle.distributed.spawn («python/paddle/distributed/spawn.py»
+    [U]): fork `nprocs` worker processes, each with the launcher's env-var
+    shape (PADDLE_TRAINER_ID/..., a shared coordinator port) and run
+    `func(*args)` in every rank. On this TPU-native stack each worker is
+    one jax process; `init_parallel_env()` inside `func` joins them via
+    jax.distributed. Workers inherit JAX_PLATFORMS (tests use cpu).
+
+    Returns the list of exit codes when join=True (raises on nonzero),
+    else the list of Process handles.
+    """
+    import multiprocessing as mp
+    import socket
+
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if nprocs <= 0:
+        nprocs = 1
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    master = f"127.0.0.1:{port}"
+
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_spawn_worker,
+                        args=(func, args, master, nprocs, rank),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if not join:
+        return procs
+    codes = []
+    for p in procs:
+        p.join()
+        codes.append(p.exitcode)
+    if any(codes):
+        raise RuntimeError(f"spawn: worker exit codes {codes}")
+    return codes
+
+
+def _spawn_worker(func, args, master, nprocs, rank):
+    os.environ["PADDLE_MASTER"] = master
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    init_parallel_env()
+    func(*args)

@@ -21,13 +21,28 @@ from ..core.tensor import Tensor, apply, to_tensor
 
 
 class Generator:
+    """Stateful RNG. The key materializes LAZILY: creating it eagerly at
+    import time would initialize the XLA backend during `import
+    paddle_tpu`, which breaks multi-process entry points that must call
+    jax.distributed.initialize first (paddle.distributed.spawn)."""
+
     def __init__(self, seed: int = 0):
-        self._key = jax.random.key(seed)
+        self._key_ = None
         self._seed = seed
         self._lock = threading.Lock()
 
+    @property
+    def _key(self):
+        if self._key_ is None:
+            self._key_ = jax.random.key(self._seed)
+        return self._key_
+
+    @_key.setter
+    def _key(self, value):
+        self._key_ = value
+
     def manual_seed(self, seed: int):
-        self._key = jax.random.key(seed)
+        self._key_ = jax.random.key(seed)
         self._seed = seed
         return self
 

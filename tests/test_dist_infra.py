@@ -331,3 +331,42 @@ class TestFaultInjection:
                    if l.startswith("RESUME_AT")]
         assert resumes[0] == 0 and len(resumes) >= 2, out.stdout
         assert resumes[1] >= 4, out.stdout
+
+
+class TestSpawn:
+    """paddle.distributed.spawn (reference «python/paddle/distributed/
+    spawn.py» [U]): multi-process fork + jax.distributed rendezvous."""
+
+    def test_two_rank_spawn_allgather(self, tmp_path):
+        # run in a subprocess so the child interpreters start clean (the
+        # test process already initialized a jax backend)
+        script = tmp_path / "spawn_main.py"
+        out_file = tmp_path / "out.txt"
+        script.write_text(
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "os.environ.pop('PALLAS_AXON_POOL_IPS', None)\n"
+            "import paddle_tpu.distributed as dist\n\n"
+            "def worker(out_path):\n"
+            "    import jax\n"
+            "    import jax.numpy as jnp\n"
+            "    r = jax.process_index()\n"
+            "    n = jax.process_count()\n"
+            "    with open(f'{out_path}.{r}', 'w') as f:\n"
+            "        f.write(f'{r}/{n}')\n\n"
+            "if __name__ == '__main__':\n"
+            "    import sys\n"
+            f"    dist.spawn(worker, args=({str(out_file)!r},), nprocs=2)\n"
+            "    print('SPAWN_OK')\n")
+        out = subprocess.run(
+            [sys.executable, str(script)], capture_output=True, text=True,
+            env={**{k: v for k, v in os.environ.items()
+                    if k != "PALLAS_AXON_POOL_IPS"},
+                 "PYTHONPATH": "/root/repo:"
+                 + os.environ.get("PYTHONPATH", ""),
+                 "JAX_PLATFORMS": "cpu"},
+            timeout=240)
+        assert out.returncode == 0, (out.stdout, out.stderr)
+        assert "SPAWN_OK" in out.stdout
+        assert (tmp_path / "out.txt.0").read_text() == "0/2"
+        assert (tmp_path / "out.txt.1").read_text() == "1/2"
