@@ -139,6 +139,11 @@ def __getattr__(name):
         mod = importlib.import_module(".hapi", __name__)
         globals()["hapi"] = mod
         return mod
+    if name == "callbacks":
+        import importlib
+        mod = importlib.import_module(".callbacks", __name__)
+        globals()["callbacks"] = mod
+        return mod
     if name in ("sparse", "fft", "signal", "distribution", "quantization"):
         import importlib
         mod = importlib.import_module("." + name, __name__)

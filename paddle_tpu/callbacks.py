@@ -1,0 +1,5 @@
+"""paddle.callbacks namespace — re-export of the hapi callback set.
+≙ reference «python/paddle/callbacks/» (alias tier over hapi) [U]."""
+from .hapi.callbacks import (Callback, CallbackList, EarlyStopping,  # noqa: F401
+                             LRSchedulerCallback as LRScheduler,
+                             ModelCheckpoint, ProgBarLogger, VisualDL)

@@ -139,3 +139,371 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size, interpolation)(img)
+
+
+# -- round-3 breadth ---------------------------------------------------------
+def _as_np(img):
+    return (img.numpy() if isinstance(img, Tensor)
+            else np.asarray(img)), isinstance(img, Tensor)
+
+
+def _wrap(out, was_tensor):
+    return to_tensor(np.ascontiguousarray(out)) if was_tensor else out
+
+
+def _hwc_axes(arr):
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+    return (1, 2) if chw else (0, 1)
+
+
+class RandomVerticalFlip(BaseTransform):
+    """≙ paddle.vision.transforms.RandomVerticalFlip [U]."""
+
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.random() < self.prob:
+            arr, wt = _as_np(img)
+            ha, _ = _hwc_axes(arr)
+            return _wrap(np.flip(arr, axis=ha), wt)
+        return img
+
+
+class Pad(BaseTransform):
+    """≙ paddle.vision.transforms.Pad [U]."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        if isinstance(padding, int):
+            padding = (padding,) * 4      # l, t, r, b
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = padding
+        self.fill = fill
+        self.mode = padding_mode
+
+    def _apply_image(self, img):
+        arr, wt = _as_np(img)
+        l, t, r, b = self.padding
+        ha, wa = _hwc_axes(arr)
+        pads = [(0, 0)] * arr.ndim
+        pads[ha] = (t, b)
+        pads[wa] = (l, r)
+        if self.mode == "constant":
+            out = np.pad(arr, pads, constant_values=self.fill)
+        else:
+            out = np.pad(arr, pads, mode=self.mode)
+        return _wrap(out, wt)
+
+
+class Grayscale(BaseTransform):
+    """≙ paddle.vision.transforms.Grayscale [U] (ITU-R 601 luma)."""
+
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        arr, wt = _as_np(img)
+        arr = arr.astype(np.float32)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        w = np.asarray([0.299, 0.587, 0.114], np.float32)
+        if chw:
+            g = np.tensordot(w, arr, axes=(0, 0))[None]
+            out = np.repeat(g, self.n, axis=0) if self.n > 1 else g
+        else:
+            g = arr @ w
+            g = g[..., None]
+            out = np.repeat(g, self.n, axis=-1) if self.n > 1 else g
+        return _wrap(out, wt)
+
+
+class BrightnessTransform(BaseTransform):
+    """≙ paddle.vision.transforms.BrightnessTransform [U]."""
+
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        arr, wt = _as_np(img)
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        return _wrap(np.clip(arr.astype(np.float32) * f, 0,
+                             255 if arr.dtype == np.uint8 else np.inf)
+                     .astype(arr.dtype), wt)
+
+
+class ContrastTransform(BaseTransform):
+    """≙ paddle.vision.transforms.ContrastTransform [U]."""
+
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        arr, wt = _as_np(img)
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        mean = arr.astype(np.float32).mean()
+        out = (arr.astype(np.float32) - mean) * f + mean
+        return _wrap(np.clip(out, 0,
+                             255 if arr.dtype == np.uint8 else np.inf)
+                     .astype(arr.dtype), wt)
+
+
+class SaturationTransform(BaseTransform):
+    """≙ paddle.vision.transforms.SaturationTransform [U]."""
+
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        arr, wt = _as_np(img)
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        gray = Grayscale(3)._apply_image(arr).astype(np.float32)
+        out = arr.astype(np.float32) * f + gray * (1 - f)
+        return _wrap(np.clip(out, 0,
+                             255 if arr.dtype == np.uint8 else np.inf)
+                     .astype(arr.dtype), wt)
+
+
+class HueTransform(BaseTransform):
+    """≙ paddle.vision.transforms.HueTransform [U] (HSV rotation via
+    colorsys-equivalent vectorized math)."""
+
+    def __init__(self, value, keys=None):
+        assert 0 <= value <= 0.5
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        arr, wt = _as_np(img)
+        shift = np.random.uniform(-self.value, self.value)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        x = arr.astype(np.float32)
+        if arr.dtype == np.uint8:
+            x = x / 255.0
+        if chw:
+            x = x.transpose(1, 2, 0)
+        import matplotlib.colors as mc  # rgb_to_hsv vectorized
+        hsv = mc.rgb_to_hsv(np.clip(x, 0, 1))
+        hsv[..., 0] = (hsv[..., 0] + shift) % 1.0
+        out = mc.hsv_to_rgb(hsv)
+        if chw:
+            out = out.transpose(2, 0, 1)
+        if arr.dtype == np.uint8:
+            out = (out * 255.0).round().astype(np.uint8)
+        return _wrap(out, wt)
+
+
+class ColorJitter(BaseTransform):
+    """≙ paddle.vision.transforms.ColorJitter [U] — random order of
+    brightness/contrast/saturation/hue sub-transforms."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.ts = []
+        if brightness:
+            self.ts.append(BrightnessTransform(brightness))
+        if contrast:
+            self.ts.append(ContrastTransform(contrast))
+        if saturation:
+            self.ts.append(SaturationTransform(saturation))
+        if hue:
+            self.ts.append(HueTransform(hue))
+
+    def _apply_image(self, img):
+        for i in np.random.permutation(len(self.ts)):
+            img = self.ts[i](img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    """≙ paddle.vision.transforms.RandomRotation [U] (nearest resample on
+    the host; use vision.ops for differentiable warps)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr, wt = _as_np(img)
+        angle = np.radians(np.random.uniform(*self.degrees))
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+        x = arr.transpose(1, 2, 0) if chw else arr
+        h, w = x.shape[:2]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.mgrid[0:h, 0:w]
+        ys = (yy - cy) * np.cos(angle) - (xx - cx) * np.sin(angle) + cy
+        xs = (yy - cy) * np.sin(angle) + (xx - cx) * np.cos(angle) + cx
+        yi = np.round(ys).astype(int)
+        xi = np.round(xs).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        out = np.full_like(x, self.fill)
+        out[valid] = x[np.clip(yi, 0, h - 1),
+                       np.clip(xi, 0, w - 1)][valid]
+        if chw:
+            out = out.transpose(2, 0, 1)
+        return _wrap(out, wt)
+
+
+class RandomResizedCrop(BaseTransform):
+    """≙ paddle.vision.transforms.RandomResizedCrop [U]."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        arr, wt = _as_np(img)
+        ha, wa = _hwc_axes(arr)
+        h, w = arr.shape[ha], arr.shape[wa]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                break
+        else:
+            ch, cw = min(h, w), min(h, w)
+            i, j = (h - ch) // 2, (w - cw) // 2
+        sl = [slice(None)] * arr.ndim
+        sl[ha] = slice(i, i + ch)
+        sl[wa] = slice(j, j + cw)
+        cropped = arr[tuple(sl)]
+        out = Resize(self.size)._apply_image(cropped)
+        return _wrap(np.asarray(out), wt)
+
+
+class RandomErasing(BaseTransform):
+    """≙ paddle.vision.transforms.RandomErasing [U]."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        if np.random.random() >= self.prob:
+            return img
+        arr, wt = _as_np(img)
+        arr = arr.copy()
+        ha, wa = _hwc_axes(arr)
+        h, w = arr.shape[ha], arr.shape[wa]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                sl = [slice(None)] * arr.ndim
+                sl[ha] = slice(i, i + eh)
+                sl[wa] = slice(j, j + ew)
+                arr[tuple(sl)] = self.value
+                break
+        return _wrap(arr, wt)
+
+
+class Transpose(BaseTransform):
+    """≙ paddle.vision.transforms.Transpose (HWC -> CHW by default) [U]."""
+
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        arr, wt = _as_np(img)
+        return _wrap(arr.transpose(self.order), wt)
+
+
+def hflip(img):
+    arr, wt = _as_np(img)
+    _, wa = _hwc_axes(arr)
+    return _wrap(np.flip(arr, axis=wa), wt)
+
+
+def vflip(img):
+    arr, wt = _as_np(img)
+    ha, _ = _hwc_axes(arr)
+    return _wrap(np.flip(arr, axis=ha), wt)
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)._apply_image(img)
+
+
+def crop(img, top, left, height, width):
+    arr, wt = _as_np(img)
+    ha, wa = _hwc_axes(arr)
+    sl = [slice(None)] * arr.ndim
+    sl[ha] = slice(top, top + height)
+    sl[wa] = slice(left, left + width)
+    return _wrap(arr[tuple(sl)], wt)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)._apply_image(img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)._apply_image(img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    t = RandomRotation((angle, angle), fill=fill)
+    return t._apply_image(img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr, wt = _as_np(img)
+    arr = arr if inplace else arr.copy()
+    ha, wa = _hwc_axes(arr)
+    sl = [slice(None)] * arr.ndim
+    sl[ha] = slice(i, i + h)
+    sl[wa] = slice(j, j + w)
+    arr[tuple(sl)] = v
+    return _wrap(arr, wt)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr, wt = _as_np(img)
+    out = np.clip(arr.astype(np.float32) * brightness_factor, 0,
+                  255 if arr.dtype == np.uint8 else np.inf)
+    return _wrap(out.astype(arr.dtype), wt)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr, wt = _as_np(img)
+    mean = arr.astype(np.float32).mean()
+    out = (arr.astype(np.float32) - mean) * contrast_factor + mean
+    out = np.clip(out, 0, 255 if arr.dtype == np.uint8 else np.inf)
+    return _wrap(out.astype(arr.dtype), wt)
+
+
+def adjust_hue(img, hue_factor):
+    arr, wt = _as_np(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3)
+    x = arr.astype(np.float32)
+    if arr.dtype == np.uint8:
+        x = x / 255.0
+    if chw:
+        x = x.transpose(1, 2, 0)
+    import matplotlib.colors as mc
+    hsv = mc.rgb_to_hsv(np.clip(x, 0, 1))
+    hsv[..., 0] = (hsv[..., 0] + hue_factor) % 1.0
+    out = mc.hsv_to_rgb(hsv)
+    if chw:
+        out = out.transpose(2, 0, 1)
+    if arr.dtype == np.uint8:
+        out = (out * 255.0).round().astype(np.uint8)
+    return _wrap(out, wt)

@@ -131,3 +131,60 @@ class TestFolders:
         ds = ImageFolder(str(root))
         assert len(ds) == 4
         assert ds[0].shape == (6, 6, 3)
+
+
+class TestTransformBreadth:
+    """Round-3 transform additions (≙ «python/paddle/vision/transforms»)."""
+
+    def _img(self, h=16, w=16, c=3):
+        return np.random.default_rng(0).integers(
+            0, 255, (h, w, c)).astype(np.uint8)
+
+    def test_flips_pad_grayscale(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img()
+        np.testing.assert_array_equal(T.hflip(img), img[:, ::-1])
+        np.testing.assert_array_equal(T.vflip(img), img[::-1])
+        padded = T.Pad(2)(img)
+        assert padded.shape == (20, 20, 3)
+        g = T.Grayscale()(img)
+        assert g.shape == (16, 16, 1)
+        ref = img.astype(np.float32) @ np.array([0.299, 0.587, 0.114])
+        np.testing.assert_allclose(g[..., 0], ref, rtol=1e-5)
+
+    def test_color_jitter_runs(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img()
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.1)(img)
+        assert out.shape == img.shape and out.dtype == np.uint8
+
+    def test_adjust_functions(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img()
+        b = T.adjust_brightness(img, 2.0)
+        assert b.mean() >= img.mean()
+        c = T.adjust_contrast(img, 0.0)
+        assert np.ptp(c.astype(np.float32)) <= 1.5  # collapses to mean
+        h = T.adjust_hue(img, 0.25)
+        assert h.shape == img.shape
+
+    def test_random_resized_crop_and_erasing(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img(32, 32)
+        out = T.RandomResizedCrop(16)(img)
+        assert np.asarray(out).shape[:2] == (16, 16)
+        er = T.RandomErasing(prob=1.0, value=0)(img)
+        assert (np.asarray(er) == 0).any()
+
+    def test_rotation_and_transpose(self):
+        from paddle_tpu.vision import transforms as T
+        img = self._img()
+        r = T.rotate(img, 90)
+        assert r.shape == img.shape
+        t = T.Transpose()(img)
+        assert t.shape == (3, 16, 16)
+
+    def test_callbacks_namespace(self):
+        import paddle_tpu as paddle
+        assert hasattr(paddle.callbacks, "EarlyStopping")
+        assert hasattr(paddle.callbacks, "ModelCheckpoint")
