@@ -326,3 +326,60 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
     """≙ paddle.nn.functional.max_unpool3d [U]."""
     return _unpool_nd(x, indices, 3, kernel_size, stride, padding,
                       output_size, data_format, "max_unpool3d")
+
+
+def _fractional_pool_nd(x, n, output_size, kernel_size, random_u, op_name):
+    """Fractional max pooling (Graham 2014): pseudo-random bin boundaries
+    alpha = in/out, boundary_i = ceil(alpha * (i + u)). ≙ paddle
+    fractional_max_pool2d/3d [U]."""
+    xt = _t(x)
+    in_sp = tuple(xt.shape[2:])
+    out_sp = ((output_size,) * n if isinstance(output_size, int)
+              else tuple(output_size))
+    u = float(np.random.uniform(0, 1)) if random_u is None \
+        else float(random_u)
+    if not (0 < u < 1):
+        u = 0.5
+
+    def bounds(in_d, out_d):
+        alpha = in_d / out_d
+        idx = np.arange(out_d + 1, dtype=np.float64)
+        b = np.ceil(alpha * (idx + u)).astype(np.int64) - int(
+            np.ceil(alpha * u))
+        b = np.clip(b, 0, in_d)
+        b[0], b[-1] = 0, in_d
+        return b
+
+    bs = [bounds(in_sp[d], out_sp[d]) for d in range(n)]
+
+    def fn(v):
+        b, c = v.shape[0], v.shape[1]
+        out = v
+        # pool one spatial dim at a time: segment-max over the boundary
+        # partition (static boundaries -> static shapes)
+        for d in range(n):
+            bb = bs[d]
+            pieces = [
+                out[(slice(None),) * (2 + d)
+                    + (slice(int(bb[i]), int(bb[i + 1])),)].max(
+                    axis=2 + d, keepdims=True)
+                for i in range(out_sp[d])]
+            out = jnp.concatenate(pieces, axis=2 + d)
+        return out
+    return apply(op_name, fn, (xt,))
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """≙ paddle.nn.functional.fractional_max_pool2d [U]."""
+    out = _fractional_pool_nd(x, 2, output_size, kernel_size, random_u,
+                              "fractional_max_pool2d")
+    return (out, None) if return_mask else out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """≙ paddle.nn.functional.fractional_max_pool3d [U]."""
+    out = _fractional_pool_nd(x, 3, output_size, kernel_size, random_u,
+                              "fractional_max_pool3d")
+    return (out, None) if return_mask else out

@@ -159,3 +159,29 @@ class MaxUnPool3D(Layer):
     def forward(self, x, indices):
         k, s, p, df, osz = self._a
         return F.max_unpool3d(x, indices, k, s, p, df, osz)
+
+
+class FractionalMaxPool2D(Layer):
+    """≙ paddle.nn.FractionalMaxPool2D [U]."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, m = self._a
+        return F.fractional_max_pool2d(x, o, k, u, m)
+
+
+class FractionalMaxPool3D(Layer):
+    """≙ paddle.nn.FractionalMaxPool3D [U]."""
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        o, k, u, m = self._a
+        return F.fractional_max_pool3d(x, o, k, u, m)
