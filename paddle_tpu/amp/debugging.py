@@ -1,0 +1,103 @@
+"""paddle.amp.debugging — numerics debugging utilities.
+
+≙ reference «python/paddle/amp/debugging.py» [U] (check_numerics,
+collect operator stats, TensorCheckerConfig). The per-op blame machinery
+is the framework-wide FLAGS_check_nan_inf path (core.tensor.apply); these
+helpers give the explicit-call surface.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["check_numerics", "enable_operator_stats_collection",
+           "disable_operator_stats_collection",
+           "collect_operator_stats", "DebugMode", "TensorCheckerConfig",
+           "enable_tensor_checker", "disable_tensor_checker"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=False, debug_mode=DebugMode.
+                 CHECK_NAN_INF_AND_ABORT, **kwargs):
+        self.enable = enable
+        self.debug_mode = debug_mode
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Raise if tensor holds NaN/Inf (≙ paddle.amp.debugging.
+    check_numerics). Returns (num_nan, num_inf, num_zero) tensors like
+    the reference."""
+    t = tensor if isinstance(tensor, Tensor) else Tensor(jnp.asarray(
+        tensor))
+    v = t._value
+    n_nan = jnp.sum(jnp.isnan(v)).astype(jnp.int64)
+    n_inf = jnp.sum(jnp.isinf(v)).astype(jnp.int64)
+    n_zero = jnp.sum(v == 0).astype(jnp.int64)
+    if int(n_nan) or int(n_inf):
+        raise RuntimeError(
+            f"check_numerics: {op_type or 'tensor'} {var_name} contains "
+            f"{int(n_nan)} NaN / {int(n_inf)} Inf")
+    return Tensor(n_nan), Tensor(n_inf), Tensor(n_zero)
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    """Flip the framework-wide per-op NaN scan on (FLAGS_check_nan_inf)."""
+    from ..utils.flags import set_flags
+    if config.enable:
+        set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    from ..utils.flags import set_flags
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+# operator stats: counts of ops executed per dtype between enable/disable
+_op_stats: dict | None = None
+
+
+def enable_operator_stats_collection():
+    global _op_stats
+    _op_stats = {}
+    from ..core import tensor as _ct
+
+    def observer(name, tensors):
+        if _op_stats is not None:
+            dt = (str(tensors[0]._value.dtype) if tensors else "-")
+            key = f"{name}:{dt}"
+            _op_stats[key] = _op_stats.get(key, 0) + 1
+
+    # every op module binds `apply` by reference, so the observer lives
+    # INSIDE core.tensor.apply (module-level hook), not a monkeypatch
+    _ct._op_observer = observer
+
+
+def disable_operator_stats_collection():
+    global _op_stats
+    from ..core import tensor as _ct
+    _ct._op_observer = None
+    stats, _op_stats = _op_stats, None
+    if stats:
+        print("op call counts (op:dtype -> n):")
+        for k in sorted(stats):
+            print(f"  {k:<40}{stats[k]:>8}")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()

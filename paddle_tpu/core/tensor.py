@@ -287,6 +287,11 @@ def _check_nan_inf(name: str, out_vals, multi_output: bool) -> None:
                 f"{n_nan} NaN / {n_inf} Inf values")
 
 
+# optional per-op observer (amp.debugging operator-stats collection);
+# a module-level hook because every op module binds `apply` by reference
+_op_observer = None
+
+
 def apply(name: str,
           fn: Callable,
           tensors: Sequence[Tensor],
@@ -295,6 +300,8 @@ def apply(name: str,
     any input requires grad. ≙ reference generated `*_ad_func` + PHI dispatch
     (SURVEY.md §3.1) collapsed into one function — kernel selection is XLA's
     job on TPU."""
+    if _op_observer is not None:
+        _op_observer(name, tensors)
     vals = [t._value for t in tensors]
 
     # AMP autocast: cast float inputs per op lists (≙ eager AMP insertion,

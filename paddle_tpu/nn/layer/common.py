@@ -274,3 +274,15 @@ class ChannelShuffle(Layer):
 
     def forward(self, x):
         return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class Unflatten(Layer):
+    """≙ paddle.nn.Unflatten [U]."""
+
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        return x.unflatten(self.axis, self.shape)

@@ -396,3 +396,28 @@ class TestFractionalPool:
         out.sum().backward()
         g = np.asarray(x.grad)
         assert g.sum() == 16.0  # one max per bin
+
+
+class TestAmpDebugging:
+    def test_check_numerics_and_stats(self):
+        from paddle_tpu.amp import debugging as dbg
+        t = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        n_nan, n_inf, n_zero = dbg.check_numerics(t)
+        assert int(n_zero._value) == 1
+        bad = paddle.to_tensor(np.array([np.nan], np.float32))
+        with pytest.raises(RuntimeError, match="NaN"):
+            dbg.check_numerics(bad, "op", "x")
+        dbg.enable_operator_stats_collection()
+        _ = t + t
+        _ = t + t
+        _ = t * t
+        stats = dbg.disable_operator_stats_collection()
+        assert stats["add:float32"] == 2
+        assert stats["multiply:float32"] == 1
+        from paddle_tpu.core import tensor as ct
+        assert ct._op_observer is None
+
+    def test_unflatten_layer(self):
+        u = nn.Unflatten(1, [2, 3])
+        x = paddle.to_tensor(np.zeros((4, 6), np.float32))
+        assert tuple(u(x).shape) == (4, 2, 3)
