@@ -1,0 +1,103 @@
+"""HuggingFace checkpoint conversion — load HF Llama/Mistral-family
+weights into paddle_tpu models.
+
+≙ the reference ecosystem's checkpoint converters (PaddleNLP
+`convert_*_from_hf`, outside-repo zoo per SURVEY.md §1): a user switching
+from the reference stack brings HF-format weights; this maps them onto
+the TPU-native model with NUMERICAL parity (tested against transformers'
+own forward in tests/test_hf_convert.py).
+
+Two representation deltas handled here:
+
+* Linear layout: HF/torch stores (out, in); paddle Linear is (in, out)
+  -> transpose.
+* RoPE convention: HF applies rotate-half (pairs (i, i + d/2) within a
+  head); this framework uses the interleaved convention (pairs
+  (2i, 2i+1)). q/k projection OUTPUT rows are permuted per head so the
+  rotation pairs line up — attention logits are invariant because q and
+  k receive the same permutation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def _interleave_rows(w: np.ndarray, num_heads: int) -> np.ndarray:
+    """Permute rows (out_features, in) from HF half-split rope layout to
+    interleaved: per head, row order [0, d/2, 1, d/2+1, ...]."""
+    out, hidden = w.shape
+    hd = out // num_heads
+    half = hd // 2
+    idx = np.empty(hd, np.int64)
+    idx[0::2] = np.arange(half)
+    idx[1::2] = np.arange(half) + half
+    w = w.reshape(num_heads, hd, hidden)
+    return w[:, idx, :].reshape(out, hidden)
+
+
+def convert_llama_from_hf(state_dict, config) -> dict:
+    """Map an HF LlamaForCausalLM state_dict (torch tensors or numpy) to
+    this framework's LlamaForCausalLM state-dict naming/layout.
+
+    `config`: paddle_tpu LlamaConfig (head counts drive the rope
+    permutation)."""
+    def np_of(t):
+        try:
+            return t.detach().cpu().numpy()
+        except AttributeError:
+            return np.asarray(t)
+
+    H = config.num_attention_heads
+    HK = config.num_key_value_heads
+    out = {}
+    for name, t in state_dict.items():
+        v = np_of(t)
+        if name == "model.embed_tokens.weight":
+            out["model.embed_tokens.weight"] = v
+        elif name == "lm_head.weight":
+            out["lm_head.weight"] = v.T
+        elif name == "model.norm.weight":
+            out["model.norm.weight"] = v
+        elif name.endswith("input_layernorm.weight") or \
+                name.endswith("post_attention_layernorm.weight"):
+            out[name] = v
+        elif name.endswith("self_attn.q_proj.weight"):
+            out[name] = _interleave_rows(v, H).T
+        elif name.endswith("self_attn.k_proj.weight"):
+            out[name] = _interleave_rows(v, HK).T
+        elif name.endswith((
+                "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+                "mlp.gate_proj.weight", "mlp.up_proj.weight",
+                "mlp.down_proj.weight")):
+            out[name] = v.T
+        elif name.endswith("rotary_emb.inv_freq"):
+            continue  # recomputed from config
+        else:
+            # bias terms and any future keys: transpose 2-D, pass 1-D
+            out[name] = v.T if v.ndim == 2 else v
+    return out
+
+
+def load_llama_from_hf(model, hf_state_dict) -> None:
+    """Convert + copy into an existing paddle_tpu LlamaForCausalLM
+    in-place (dtype-cast to each parameter's dtype)."""
+    import jax.numpy as jnp
+
+    converted = convert_llama_from_hf(hf_state_dict, model.config)
+    params = dict(model.named_parameters())
+    missing = []
+    for name, v in converted.items():
+        if name not in params:
+            missing.append(name)
+            continue
+        p = params[name]
+        if tuple(p.shape) != tuple(v.shape):
+            raise ValueError(
+                f"shape mismatch for {name}: model {tuple(p.shape)} vs "
+                f"checkpoint {tuple(v.shape)}")
+        p._value = jnp.asarray(v).astype(p._value.dtype)
+    if missing:
+        raise ValueError(f"checkpoint keys not in model: {missing[:5]}"
+                         f"{'...' if len(missing) > 5 else ''}")
