@@ -335,3 +335,57 @@ uint32_t codec_crc32(const void* data, uint64_t len) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// byte-level BPE encoder (paddle_tpu.text.BPETokenizer fast path).
+// The reference keeps its tokenizer hot loop native (faster-tokenizers
+// C++); here: greedy lowest-rank merging over raw bytes. Merge table:
+// (left, right) token-id pairs ranked by training order; merged id for
+// rank r is 256 + r. Returns number of output tokens (<= text_len).
+// ---------------------------------------------------------------------------
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+uint64_t bpe_encode(const uint8_t* text, uint64_t text_len,
+                    const int32_t* merge_left, const int32_t* merge_right,
+                    uint64_t n_merges, int32_t* out, uint64_t out_cap) {
+  if (text_len == 0) return 0;
+  std::unordered_map<uint64_t, int32_t> rank;
+  rank.reserve(n_merges * 2);
+  for (uint64_t r = 0; r < n_merges; ++r) {
+    uint64_t key = ((uint64_t)(uint32_t)merge_left[r] << 32) |
+                   (uint32_t)merge_right[r];
+    rank.emplace(key, (int32_t)r);
+  }
+  std::vector<int32_t> toks(text, text + text_len);
+  auto pair_key = [](int32_t a, int32_t b) {
+    return ((uint64_t)(uint32_t)a << 32) | (uint32_t)b;
+  };
+  for (;;) {
+    int32_t best_rank = INT32_MAX;
+    for (size_t i = 0; i + 1 < toks.size(); ++i) {
+      auto it = rank.find(pair_key(toks[i], toks[i + 1]));
+      if (it != rank.end() && it->second < best_rank) best_rank = it->second;
+    }
+    if (best_rank == INT32_MAX) break;
+    int32_t la = merge_left[best_rank], rb = merge_right[best_rank];
+    int32_t merged = 256 + best_rank;
+    size_t w = 0;
+    for (size_t i = 0; i < toks.size(); ++i) {
+      if (i + 1 < toks.size() && toks[i] == la && toks[i + 1] == rb) {
+        toks[w++] = merged;
+        ++i;
+      } else {
+        toks[w++] = toks[i];
+      }
+    }
+    toks.resize(w);
+  }
+  uint64_t n = toks.size() < out_cap ? toks.size() : out_cap;
+  for (uint64_t i = 0; i < n; ++i) out[i] = toks[i];
+  return toks.size();
+}
+
+}  // extern "C"

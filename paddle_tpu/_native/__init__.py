@@ -87,9 +87,31 @@ def _load():
                                      ctypes.c_int * 1, ctypes.c_int]
         lib.codec_crc32.restype = ctypes.c_uint32
         lib.codec_crc32.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.bpe_encode.restype = ctypes.c_uint64
+        lib.bpe_encode.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                   ctypes.c_void_p, ctypes.c_void_p,
+                                   ctypes.c_uint64, ctypes.c_void_p,
+                                   ctypes.c_uint64]
         _lib = lib
         AVAILABLE = True
         return lib
+
+
+def bpe_encode_native(text: bytes, merge_left: np.ndarray,
+                      merge_right: np.ndarray):
+    """C++ BPE encode fast path; returns np.int32 token ids or None when
+    the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty(max(len(text), 1), np.int32)
+    n = lib.bpe_encode(
+        text, len(text),
+        merge_left.ctypes.data_as(ctypes.c_void_p),
+        merge_right.ctypes.data_as(ctypes.c_void_p),
+        len(merge_left),
+        out.ctypes.data_as(ctypes.c_void_p), len(out))
+    return out[:n].copy()
 
 
 class ShmRing:

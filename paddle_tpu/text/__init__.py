@@ -214,3 +214,4 @@ class MLMBlockDataset(Dataset):
         block[rand] = rng.integers(0, self.vocab_size,
                                    rand.sum(), dtype=np.int32)
         return block, labels
+from .bpe import BPETokenizer  # noqa: F401

@@ -1,0 +1,126 @@
+"""Byte-level BPE tokenizer — train + encode/decode.
+
+≙ the reference ecosystem's BPE tier (PaddleNLP tokenizers over a native
+faster-tokenizer core, outside-repo zoo per SURVEY.md §1). Design:
+
+* pure byte-level: base vocabulary = 256 bytes, merge rank r creates
+  token id 256 + r — no unk token, any bytes round-trip exactly.
+* training: iterative highest-frequency adjacent-pair merging (Sennrich
+  2016) over the raw byte corpus.
+* encode hot path: C++ (`csrc/native.cc bpe_encode`, ctypes-bound) with
+  a pure-Python fallback of identical semantics (parity-tested).
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+
+import numpy as np
+
+__all__ = ["BPETokenizer"]
+
+
+class BPETokenizer:
+    def __init__(self, merges=None):
+        # merges: list of (left_id, right_id) in rank order
+        self.merges = [tuple(m) for m in (merges or [])]
+        self._refresh()
+
+    def _refresh(self):
+        self._rank = {m: r for r, m in enumerate(self.merges)}
+        self._ml = np.asarray([m[0] for m in self.merges], np.int32)
+        self._mr = np.asarray([m[1] for m in self.merges], np.int32)
+        # id -> byte sequence, for decode
+        self._bytes = {i: bytes([i]) for i in range(256)}
+        for r, (a, b) in enumerate(self.merges):
+            self._bytes[256 + r] = self._bytes[a] + self._bytes[b]
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + len(self.merges)
+
+    # -- training ------------------------------------------------------------
+    @classmethod
+    def train(cls, corpus, vocab_size: int = 512) -> "BPETokenizer":
+        """corpus: str | bytes | iterable of either. Learns
+        vocab_size - 256 merges."""
+        if isinstance(corpus, (str, bytes)):
+            corpus = [corpus]
+        seqs = [list(c.encode("utf-8") if isinstance(c, str) else c)
+                for c in corpus]
+        merges = []
+        for r in range(max(0, vocab_size - 256)):
+            counts: Counter = Counter()
+            for s in seqs:
+                counts.update(zip(s[:-1], s[1:]))
+            if not counts:
+                break
+            (a, b), freq = counts.most_common(1)[0]
+            if freq < 2:
+                break
+            new_id = 256 + r
+            merges.append((int(a), int(b)))
+            for si, s in enumerate(seqs):
+                res = []
+                i = 0
+                while i < len(s):
+                    if i + 1 < len(s) and s[i] == a and s[i + 1] == b:
+                        res.append(new_id)
+                        i += 2
+                    else:
+                        res.append(s[i])
+                        i += 1
+                seqs[si] = res
+        return cls(merges)
+
+    # -- encode/decode -------------------------------------------------------
+    def _encode_py(self, data: bytes) -> np.ndarray:
+        toks = list(data)
+        rank = self._rank
+        while True:
+            best = None
+            best_r = len(self.merges)
+            for pair in zip(toks[:-1], toks[1:]):
+                r = rank.get(pair, best_r)
+                if r < best_r:
+                    best_r, best = r, pair
+            if best is None:
+                break
+            a, b = best
+            merged = 256 + best_r
+            res = []
+            i = 0
+            while i < len(toks):
+                if i + 1 < len(toks) and toks[i] == a and toks[i + 1] == b:
+                    res.append(merged)
+                    i += 2
+                else:
+                    res.append(toks[i])
+                    i += 1
+            toks = res
+        return np.asarray(toks, np.int32)
+
+    def encode(self, text) -> np.ndarray:
+        data = text.encode("utf-8") if isinstance(text, str) else bytes(text)
+        if not data:
+            return np.zeros((0,), np.int32)
+        from .._native import bpe_encode_native
+        out = bpe_encode_native(data, self._ml, self._mr)
+        if out is None:                       # no compiler: python fallback
+            out = self._encode_py(data)
+        return out
+
+    def decode(self, ids) -> str:
+        data = b"".join(self._bytes[int(i)] for i in np.asarray(ids)
+                        .reshape(-1))
+        return data.decode("utf-8", errors="replace")
+
+    # -- persistence ---------------------------------------------------------
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"merges": self.merges}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            return cls(json.load(f)["merges"])
