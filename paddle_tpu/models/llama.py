@@ -45,6 +45,9 @@ class LlamaConfig:
     # RingFlashAttention / sep degree, SURVEY.md §2.3 CP row):
     # None | 'ring' | 'ulysses'
     sep_strategy: str | None = None
+    # Mistral-style sliding-window local attention (training/prefill path;
+    # decode with a cache keeps full attention over the cached window)
+    sliding_window: int | None = None
 
     @staticmethod
     def llama3_8b():
@@ -129,6 +132,7 @@ class LlamaAttention(nn.Layer):
         self.num_kv_heads = cfg.num_key_value_heads
         self.head_dim = hd
         self.sep_strategy = getattr(cfg, "sep_strategy", None)
+        self.sliding_window = getattr(cfg, "sliding_window", None)
         self.q_proj = nn.Linear(h, self.num_heads * hd, bias_attr=False)
         self.k_proj = nn.Linear(h, self.num_kv_heads * hd, bias_attr=False)
         self.v_proj = nn.Linear(h, self.num_kv_heads * hd, bias_attr=False)
@@ -192,6 +196,11 @@ class LlamaAttention(nn.Layer):
                            else ra.ring_flash_attention)
                 out = attn_fn(q, k, v, causal=True)
                 return self.o_proj(out.reshape([b, s, -1]))
+        if self.sliding_window is not None and attention_mask is None:
+            from paddle_tpu.ops.flash_attention import flash_attention
+            out = flash_attention(q, k, v, causal=True,
+                                  window_size=self.sliding_window)
+            return self.o_proj(out.reshape([b, s, -1]))
         out = F.scaled_dot_product_attention(q, k, v,
                                              attn_mask=attention_mask,
                                              is_causal=True)
