@@ -97,3 +97,36 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
         out = F.layer_norm(out, out.shape[-1:], weight=ln_scale,
                            bias=ln_bias, epsilon=ln_epsilon)
     return out
+
+
+def swiglu(x, y=None, name=None):
+    """≙ paddle.incubate.nn.functional.swiglu [U]: silu(x) * y, or with
+    y=None split x in half along the last dim (fused-gate convention).
+    XLA fuses this into the surrounding matmuls on TPU."""
+    from ....nn import functional as F
+    if y is None:
+        half = x.shape[-1] // 2
+        x, y = x[..., :half], x[..., half:]
+    return F.silu(x) * y
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    """≙ paddle.incubate.nn.functional.fused_linear (cuBLASLt epilogue in
+    the reference; one fused XLA dot+add here)."""
+    import paddle_tpu as paddle
+    out = paddle.matmul(x, weight, transpose_y=transpose_weight)
+    return out + bias if bias is not None else out
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu", name=None):
+    """≙ paddle.incubate.nn.functional.fused_linear_activation [U]."""
+    import paddle_tpu as paddle
+    from ....nn import functional as F
+    out = paddle.matmul(x, y, transpose_x=trans_x, transpose_y=trans_y)
+    out = out + bias
+    if activation in ("gelu", "relu"):
+        return getattr(F, activation)(out)
+    if activation in (None, "none", ""):
+        return out
+    raise ValueError(f"unsupported activation {activation}")

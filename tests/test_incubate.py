@@ -229,3 +229,33 @@ class TestFusedLayers:
         x = paddle.to_tensor(np.ones((2, 3), np.float32))
         y = paddle.to_tensor(np.full((2, 3), 2.0, np.float32))
         np.testing.assert_allclose(np.asarray(l(x, y)._value), 3.0)
+
+
+class TestFusedFunctionals:
+    def test_swiglu_both_forms(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(rng.normal(size=(2, 8)).astype(np.float32))
+        y = paddle.to_tensor(rng.normal(size=(2, 8)).astype(np.float32))
+        both = IF.swiglu(x, y)
+        ref = F.silu(x) * y
+        np.testing.assert_allclose(np.asarray(both._value),
+                                   np.asarray(ref._value), rtol=1e-6)
+        split = IF.swiglu(paddle.concat([x, y], axis=-1))
+        np.testing.assert_allclose(np.asarray(split._value),
+                                   np.asarray(ref._value), rtol=1e-6)
+
+    def test_fused_linear_activation(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        x = paddle.to_tensor(rng.normal(size=(2, 4)).astype(np.float32))
+        w = paddle.to_tensor(rng.normal(size=(4, 3)).astype(np.float32))
+        b = paddle.to_tensor(rng.normal(size=(3,)).astype(np.float32))
+        out = IF.fused_linear_activation(x, w, b, activation="relu")
+        ref = np.maximum(np.asarray(x._value) @ np.asarray(w._value)
+                         + np.asarray(b._value), 0)
+        np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-5)
+        lin = IF.fused_linear(x, w, b)
+        np.testing.assert_allclose(
+            np.asarray(lin._value),
+            np.asarray(x._value) @ np.asarray(w._value)
+            + np.asarray(b._value), rtol=1e-5)
