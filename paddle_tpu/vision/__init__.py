@@ -8,4 +8,7 @@ from .models import (ResNet, resnet18, resnet34, resnet50,  # noqa: F401
                      resnet101, resnet152, LeNet, AlexNet, alexnet, VGG,
                      vgg11, vgg13, vgg16, vgg19, MobileNetV1, MobileNetV2,
                      mobilenet_v1, mobilenet_v2, SqueezeNet, squeezenet1_0,
-                     squeezenet1_1, DenseNet, densenet121, densenet201)
+                     squeezenet1_1, DenseNet, densenet121, densenet201,
+                     ShuffleNetV2, shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+                     shufflenet_v2_x1_5, shufflenet_v2_x2_0, GoogLeNet,
+                     googlenet)

@@ -467,3 +467,159 @@ def densenet201(pretrained=False, **kw):
 
 def resnet152(pretrained=False, **kwargs):
     return ResNet(BottleneckBlock, 152, **kwargs)
+
+
+class ShuffleNetV2(Layer):
+    """≙ paddle.vision.models.ShuffleNetV2 [U]."""
+
+    class _Unit(Layer):
+        def __init__(self, cin, cout, stride):
+            super().__init__()
+            self.stride = stride
+            branch = cout // 2
+            if stride == 1:
+                inb = cin // 2
+            else:
+                inb = cin
+                self.branch1 = Sequential(
+                    Conv2D(inb, inb, 3, stride, 1, groups=inb,
+                           bias_attr=False),
+                    BatchNorm2D(inb),
+                    Conv2D(inb, branch, 1, bias_attr=False),
+                    BatchNorm2D(branch), ReLU())
+            self.branch2 = Sequential(
+                Conv2D(inb, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), ReLU(),
+                Conv2D(branch, branch, 3, stride, 1, groups=branch,
+                       bias_attr=False),
+                BatchNorm2D(branch),
+                Conv2D(branch, branch, 1, bias_attr=False),
+                BatchNorm2D(branch), ReLU())
+
+        def forward(self, x):
+            import paddle_tpu as paddle
+            if self.stride == 1:
+                half = x.shape[1] // 2
+                x1, x2 = x[:, :half], x[:, half:]
+                out = paddle.concat([x1, self.branch2(x2)], axis=1)
+            else:
+                out = paddle.concat([self.branch1(x), self.branch2(x)],
+                                    axis=1)
+            # channel shuffle (groups=2)
+            b, c, h, w = out.shape
+            out = out.reshape([b, 2, c // 2, h, w]) \
+                .transpose([0, 2, 1, 3, 4]).reshape([b, c, h, w])
+            return out
+
+    CFGS = {0.5: (48, 96, 192, 1024), 1.0: (116, 232, 464, 1024),
+            1.5: (176, 352, 704, 1024), 2.0: (244, 488, 976, 2048)}
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        c1, c2, c3, cout = ShuffleNetV2.CFGS[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = Sequential(Conv2D(3, 24, 3, 2, 1, bias_attr=False),
+                                BatchNorm2D(24), ReLU())
+        self.maxpool = MaxPool2D(3, 2, 1)
+        feats = []
+        cin = 24
+        for cstage, n in zip((c1, c2, c3), (4, 8, 4)):
+            feats.append(ShuffleNetV2._Unit(cin, cstage, 2))
+            for _ in range(n - 1):
+                feats.append(ShuffleNetV2._Unit(cstage, cstage, 1))
+            cin = cstage
+        self.features = Sequential(*feats)
+        self.conv_last = Sequential(
+            Conv2D(cin, cout, 1, bias_attr=False), BatchNorm2D(cout),
+            ReLU())
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(cout, num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.features(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+class GoogLeNet(Layer):
+    """≙ paddle.vision.models.GoogLeNet (Inception v1; aux heads omitted
+    at inference, returned in training like the reference)."""
+
+    class _Inception(Layer):
+        def __init__(self, cin, c1, c3r, c3, c5r, c5, pp):
+            super().__init__()
+            self.b1 = Sequential(Conv2D(cin, c1, 1), ReLU())
+            self.b2 = Sequential(Conv2D(cin, c3r, 1), ReLU(),
+                                 Conv2D(c3r, c3, 3, padding=1), ReLU())
+            self.b3 = Sequential(Conv2D(cin, c5r, 1), ReLU(),
+                                 Conv2D(c5r, c5, 5, padding=2), ReLU())
+            self.b4 = Sequential(MaxPool2D(3, 1, 1),
+                                 Conv2D(cin, pp, 1), ReLU())
+
+        def forward(self, x):
+            import paddle_tpu as paddle
+            return paddle.concat(
+                [self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        I = GoogLeNet._Inception
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            Conv2D(3, 64, 7, 2, 3), ReLU(), MaxPool2D(3, 2, 1),
+            Conv2D(64, 64, 1), ReLU(),
+            Conv2D(64, 192, 3, padding=1), ReLU(), MaxPool2D(3, 2, 1))
+        self.blocks = Sequential(
+            I(192, 64, 96, 128, 16, 32, 32),
+            I(256, 128, 128, 192, 32, 96, 64), MaxPool2D(3, 2, 1),
+            I(480, 192, 96, 208, 16, 48, 64),
+            I(512, 160, 112, 224, 24, 64, 64),
+            I(512, 128, 128, 256, 24, 64, 64),
+            I(512, 112, 144, 288, 32, 64, 64),
+            I(528, 256, 160, 320, 32, 128, 128), MaxPool2D(3, 2, 1),
+            I(832, 256, 160, 320, 32, 128, 128),
+            I(832, 384, 192, 384, 48, 128, 128))
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.head = Sequential(Dropout(0.2), Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.head(x.flatten(1))
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return ShuffleNetV2(scale=2.0, **kw)
+
+
+def googlenet(pretrained=False, **kw):
+    return GoogLeNet(**kw)

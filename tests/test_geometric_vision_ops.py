@@ -282,3 +282,19 @@ class TestGraphSampling:
                                       np.asarray(n._value))
         np.testing.assert_array_equal(np.asarray(dst._value),
                                       [0, 0, 0, 1, 1])
+
+
+class TestVisionZooRound3b:
+    @pytest.mark.parametrize("build,shape,nclass", [
+        (lambda: paddle.vision.shufflenet_v2_x0_5(num_classes=5),
+         (1, 3, 64, 64), 5),
+        (lambda: paddle.vision.googlenet(num_classes=4), (1, 3, 64, 64), 4),
+    ])
+    def test_forward_shapes(self, build, shape, nclass):
+        paddle.seed(0)
+        m = build()
+        m.eval()
+        x = paddle.to_tensor(rng.normal(size=shape).astype(np.float32))
+        out = m(x)
+        assert tuple(out.shape) == (shape[0], nclass)
+        assert np.isfinite(np.asarray(out._value)).all()
