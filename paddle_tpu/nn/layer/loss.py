@@ -2,7 +2,8 @@
 from __future__ import annotations
 
 from .. import functional as F
-from .layers import Layer
+from .layers import Layer, LayerList, Sequential
+from .common import Linear
 
 
 class CrossEntropyLoss(Layer):
@@ -220,3 +221,85 @@ class GaussianNLLLoss(Layer):
 
     def forward(self, input, label, variance):
         return F.gaussian_nll_loss(input, label, variance, *self.args)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """≙ paddle.nn.AdaptiveLogSoftmaxWithLoss [U] (Grave et al. 2017
+    efficient softmax): head over [shortlist + one id per tail cluster],
+    tail clusters projected down by div_value^i. Returns (output,
+    loss) like the reference — output is the per-sample target
+    log-probability.
+
+    TPU note: the reference's CUDA kernel gathers per-cluster subsets
+    (dynamic shapes); here every cluster computes densely over the batch
+    and a mask selects — static shapes, XLA-friendly, same math."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        cutoffs = list(cutoffs)
+        if (cutoffs != sorted(cutoffs) or min(cutoffs) <= 0
+                or max(cutoffs) > n_classes - 1
+                or len(set(cutoffs)) != len(cutoffs)):
+            raise ValueError("cutoffs must be unique, positive, "
+                             "increasing and < n_classes")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        self.shortlist_size = self.cutoffs[0]
+        self.n_clusters = len(self.cutoffs) - 1
+        self.head_size = self.shortlist_size + self.n_clusters
+        self.head = Linear(in_features, self.head_size,
+                           bias_attr=None if head_bias else False)
+        self.tail = LayerList()
+        for i in range(self.n_clusters):
+            hsz = max(1, int(in_features / (div_value ** (i + 1))))
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            self.tail.append(Sequential(
+                Linear(in_features, hsz, bias_attr=False),
+                Linear(hsz, osz, bias_attr=False)))
+
+    def _head_logprob(self, x):
+        return F.log_softmax(self.head(x), axis=-1)
+
+    def forward(self, input, label):
+        import paddle_tpu as paddle
+        x = input
+        y = label.reshape([-1])
+        head_lp = self._head_logprob(x)               # (N, head)
+        # shortlist contribution
+        out = paddle.take_along_axis(
+            head_lp,
+            paddle.clip(y, 0, self.shortlist_size - 1).unsqueeze(1)
+            .astype("int64"), axis=1).squeeze(1)
+        in_short = (y < self.shortlist_size).astype("float32")
+        result = out * in_short
+        for i in range(self.n_clusters):
+            lo, hi = self.cutoffs[i], self.cutoffs[i + 1]
+            mask = ((y >= lo) & (y < hi)).astype("float32")
+            cluster_lp = head_lp[:, self.shortlist_size + i]
+            tail_lp = F.log_softmax(self.tail[i](x), axis=-1)
+            rel = paddle.clip(y - lo, 0, hi - lo - 1)
+            t = paddle.take_along_axis(
+                tail_lp, rel.unsqueeze(1).astype("int64"),
+                axis=1).squeeze(1)
+            result = result + (cluster_lp + t) * mask
+        loss = -result.mean()
+        return result, loss
+
+    def log_prob(self, input):
+        """Full (N, n_classes) log-probabilities."""
+        import paddle_tpu as paddle
+        head_lp = self._head_logprob(input)
+        pieces = [head_lp[:, :self.shortlist_size]]
+        for i in range(self.n_clusters):
+            tail_lp = F.log_softmax(self.tail[i](input), axis=-1)
+            pieces.append(tail_lp
+                          + head_lp[:, self.shortlist_size + i]
+                          .unsqueeze(1))
+        return paddle.concat(pieces, axis=1)
+
+    def predict(self, input):
+        import paddle_tpu as paddle
+        return paddle.argmax(self.log_prob(input), axis=-1)

@@ -421,3 +421,61 @@ class TestAmpDebugging:
         u = nn.Unflatten(1, [2, 3])
         x = paddle.to_tensor(np.zeros((4, 6), np.float32))
         assert tuple(u(x).shape) == (4, 2, 3)
+
+
+class TestAdaptiveLogSoftmax:
+    def test_forward_parity_with_full_logprob(self):
+        paddle.seed(0)
+        m = nn.AdaptiveLogSoftmaxWithLoss(16, 100, cutoffs=[10, 40])
+        x = paddle.to_tensor(np.random.default_rng(0)
+                             .normal(size=(8, 16)).astype(np.float32))
+        y = paddle.to_tensor(np.array([1, 5, 12, 45, 99, 0, 33, 77],
+                                      np.int64))
+        out, loss = m(x, y)
+        lp = m.log_prob(x)
+        ref = np.take_along_axis(np.asarray(lp._value),
+                                 np.asarray(y._value)[:, None], 1)[:, 0]
+        np.testing.assert_allclose(np.asarray(out._value), ref,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.exp(np.asarray(lp._value)).sum(-1),
+                                   1.0, rtol=1e-4)
+        assert float(loss) == pytest.approx(-float(out.mean()), rel=1e-6)
+
+    def test_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        paddle.seed(0)
+        m = nn.AdaptiveLogSoftmaxWithLoss(8, 20, cutoffs=[4, 10],
+                                          div_value=2.0)
+        tm = torch.nn.AdaptiveLogSoftmaxWithLoss(8, 20, cutoffs=[4, 10],
+                                                 div_value=2.0,
+                                                 head_bias=False)
+        # copy our params into torch (head + tails)
+        with torch.no_grad():
+            tm.head.weight.copy_(torch.tensor(
+                np.asarray(m.head.weight._value).T))
+            for i in range(2):
+                tm.tail[i][0].weight.copy_(torch.tensor(
+                    np.asarray(m.tail[i]._sub_layers['0'].weight._value).T))
+                tm.tail[i][1].weight.copy_(torch.tensor(
+                    np.asarray(m.tail[i]._sub_layers['1'].weight._value).T))
+        x = np.random.default_rng(1).normal(size=(6, 8)).astype(np.float32)
+        y = np.array([0, 3, 5, 9, 12, 19], np.int64)
+        out, loss = m(paddle.to_tensor(x), paddle.to_tensor(y))
+        t_out, t_loss = tm(torch.tensor(x), torch.tensor(y))
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   t_out.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        assert float(loss) == pytest.approx(float(t_loss), rel=1e-4)
+
+    def test_grad_and_predict(self):
+        paddle.seed(0)
+        m = nn.AdaptiveLogSoftmaxWithLoss(8, 20, cutoffs=[5])
+        x = paddle.to_tensor(np.random.default_rng(2)
+                             .normal(size=(4, 8)).astype(np.float32),
+                             stop_gradient=False)
+        y = paddle.to_tensor(np.array([0, 6, 19, 2], np.int64))
+        _, loss = m(x, y)
+        loss.backward()
+        assert x.grad is not None
+        pred = m.predict(x)
+        assert tuple(pred.shape) == (4,)
