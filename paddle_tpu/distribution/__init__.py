@@ -1035,3 +1035,86 @@ def _kl_mvn(p, q):
     d = jax.scipy.linalg.solve_triangular(
         q._tril, (p.loc - q.loc)[..., None], lower=True)[..., 0]
     return Tensor(logdet + 0.5 * (tr + jnp.sum(d * d, -1) - n))
+
+
+class AbsTransform(Transform):
+    """≙ paddle.distribution.AbsTransform [U] (not bijective; inverse
+    returns the positive branch like the reference)."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class PowerTransform(Transform):
+    """≙ paddle.distribution.PowerTransform [U]: y = x^p (x > 0)."""
+
+    def __init__(self, power):
+        self.power = _v(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class ChainTransform(Transform):
+    """≙ paddle.distribution.ChainTransform [U]: composition t_n ∘ … ∘
+    t_1 (applied left to right on forward)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = jnp.zeros_like(x)
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class StackTransform(Transform):
+    """≙ paddle.distribution.StackTransform [U]: apply the i-th transform
+    to the i-th slice along `axis`."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, fn_name, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(p)
+                for t, p in zip(self.transforms, parts)]
+        return jnp.concatenate(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _fldj(self, x):
+        return self._map("_fldj", x)
+
+
+__all__ += ["AbsTransform", "PowerTransform", "ChainTransform",
+            "StackTransform"]

@@ -544,3 +544,50 @@ class TestRound3Distributions:
             np.testing.assert_allclose(
                 t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy(),
                 num, atol=1e-3)
+
+
+class TestMoreTransforms:
+    def test_chain_equals_lognormal_affine(self):
+        import scipy.stats as st
+        from paddle_tpu.distribution import (Normal, ChainTransform,
+                                             ExpTransform, AffineTransform,
+                                             TransformedDistribution)
+        # y = 2 * exp(x) : chain [exp, affine(0, 2)] over standard normal
+        td = TransformedDistribution(
+            Normal(0.0, 1.0),
+            [ChainTransform([ExpTransform(), AffineTransform(0.0, 2.0)])])
+        y = np.array([0.5, 1.0, 3.0], np.float32)
+        ref = st.lognorm.logpdf(y, 1.0, scale=2.0)
+        np.testing.assert_allclose(
+            td.log_prob(paddle.to_tensor(y)).numpy(), ref, rtol=1e-4)
+
+    def test_power_and_abs(self):
+        from paddle_tpu.distribution import PowerTransform, AbsTransform
+        p = PowerTransform(2.0)
+        x = np.array([1.5, 2.0], np.float32)
+        np.testing.assert_allclose(
+            p.forward(paddle.to_tensor(x)).numpy(), x ** 2, rtol=1e-6)
+        np.testing.assert_allclose(
+            p.inverse(p.forward(paddle.to_tensor(x))).numpy(), x,
+            rtol=1e-5)
+        eps = 1e-3
+        num = np.log((((x + eps) ** 2) - ((x - eps) ** 2)) / (2 * eps))
+        np.testing.assert_allclose(
+            p.forward_log_det_jacobian(paddle.to_tensor(x)).numpy(), num,
+            atol=1e-3)
+        a = AbsTransform()
+        np.testing.assert_allclose(
+            a.forward(paddle.to_tensor(np.array([-2.0, 3.0]))).numpy(),
+            [2.0, 3.0])
+
+    def test_stack_transform(self):
+        from paddle_tpu.distribution import (StackTransform, ExpTransform,
+                                             AffineTransform)
+        st_ = StackTransform([ExpTransform(), AffineTransform(1.0, 3.0)],
+                             axis=0)
+        x = np.array([[0.0, 1.0], [2.0, 3.0]], np.float32)
+        out = st_.forward(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(out[0], np.exp(x[0]), rtol=1e-6)
+        np.testing.assert_allclose(out[1], 1.0 + 3.0 * x[1], rtol=1e-6)
+        back = st_.inverse(paddle.to_tensor(out)).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-5, atol=1e-6)
