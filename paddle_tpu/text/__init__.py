@@ -215,3 +215,94 @@ class MLMBlockDataset(Dataset):
                                    rand.sum(), dtype=np.int32)
         return block, labels
 from .bpe import BPETokenizer  # noqa: F401
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True, name=None):
+    """≙ paddle.text.viterbi_decode / ViterbiDecoder [U]: CRF max-score
+    path. potentials (B, T, N) emission scores, transition_params (N, N)
+    (or (N+2, N+2) with BOS/EOS when include_bos_eos_tag). TPU-first: the
+    forward max-pass and the backtrace are both `lax.scan`s inside one
+    jittable program (static shapes; `lengths` masks shorter sequences).
+
+    Returns (scores (B,), paths (B, T) int32)."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor, apply, to_tensor
+
+    pot = potentials if isinstance(potentials, Tensor) \
+        else to_tensor(potentials)
+    trans = transition_params if isinstance(transition_params, Tensor) \
+        else to_tensor(transition_params)
+    lens = (lengths if isinstance(lengths, Tensor)
+            else to_tensor(lengths)) if lengths is not None else None
+
+    def fn(p, tr, *rest):
+        ln = rest[0] if rest else None
+        b, t, n = p.shape
+        if include_bos_eos_tag:
+            # last two tags of the (N+2, N+2) table are BOS, EOS
+            core = tr[:n, :n]
+            start = tr[n, :n]        # BOS -> tag
+            stop = tr[:n, n + 1]     # tag -> EOS
+        else:
+            core = tr
+            start = jnp.zeros((n,), p.dtype)
+            stop = jnp.zeros((n,), p.dtype)
+        alpha0 = p[:, 0] + start[None, :]
+        if ln is None:
+            ln_arr = jnp.full((b,), t, jnp.int32)
+        else:
+            ln_arr = ln.astype(jnp.int32)
+
+        def step(carry, xs):
+            alpha, idx = carry
+            emit, pos = xs                     # (B, N), scalar
+            # scores[b, i, j] = alpha[b, i] + core[i, j]
+            s = alpha[:, :, None] + core[None, :, :]
+            best_prev = jnp.argmax(s, axis=1)              # (B, N)
+            best_score = jnp.max(s, axis=1) + emit         # (B, N)
+            live = (pos < ln_arr)[:, None]
+            alpha_new = jnp.where(live, best_score, alpha)
+            return (alpha_new, idx), jnp.where(
+                live, best_prev, jnp.arange(n)[None, :])
+
+        (alpha_f, _), backptrs = jax.lax.scan(
+            step, (alpha0, 0),
+            (jnp.swapaxes(p[:, 1:], 0, 1), jnp.arange(1, t)))
+        final = alpha_f + stop[None, :]
+        scores = jnp.max(final, axis=-1)
+        last_tag = jnp.argmax(final, axis=-1)              # (B,)
+
+        # backtrace: walk backpointers from each sequence's end
+        def back(carry, ptrs_pos):
+            tag = carry
+            ptrs, pos = ptrs_pos                          # (B, N), scalar
+            prev = jnp.take_along_axis(ptrs, tag[:, None],
+                                       1)[:, 0]
+            live = pos < ln_arr
+            tag_new = jnp.where(live, prev, tag)
+            # emit the stepped-back tag: outputs are tag(T-2)..tag(0)
+            return tag_new, tag_new
+
+        _, path_rev = jax.lax.scan(
+            back, last_tag,
+            (backptrs[::-1], jnp.arange(t - 1, 0, -1)))
+        paths = jnp.concatenate(
+            [path_rev[::-1], last_tag[None]], axis=0)      # (T, B)
+        return scores, jnp.swapaxes(paths, 0, 1).astype(jnp.int32)
+
+    args = (pot, trans) + ((lens,) if lens is not None else ())
+    return apply("viterbi_decode", fn, args, multi_output=True)
+
+
+class ViterbiDecoder:
+    """≙ paddle.text.ViterbiDecoder."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -591,3 +591,43 @@ class TestMoreTransforms:
         np.testing.assert_allclose(out[1], 1.0 + 3.0 * x[1], rtol=1e-6)
         back = st_.inverse(paddle.to_tensor(out)).numpy()
         np.testing.assert_allclose(back, x, rtol=1e-5, atol=1e-6)
+
+
+class TestViterbi:
+    def test_brute_force_parity(self):
+        import itertools
+        from paddle_tpu.text import viterbi_decode
+        r = np.random.default_rng(4)
+        B, T, N = 2, 5, 3
+        pot = r.normal(size=(B, T, N)).astype(np.float32)
+        trans = r.normal(size=(N, N)).astype(np.float32)
+        scores, paths = viterbi_decode(paddle.to_tensor(pot),
+                                       paddle.to_tensor(trans),
+                                       include_bos_eos_tag=False)
+        for b in range(B):
+            best, bestp = -1e9, None
+            for p in itertools.product(range(N), repeat=T):
+                s = pot[b, 0, p[0]] + sum(
+                    trans[p[i - 1], p[i]] + pot[b, i, p[i]]
+                    for i in range(1, T))
+                if s > best:
+                    best, bestp = s, p
+            np.testing.assert_allclose(float(scores.numpy()[b]), best,
+                                       rtol=1e-5)
+            np.testing.assert_array_equal(np.asarray(paths._value)[b],
+                                          bestp)
+
+    def test_lengths_and_bos_eos(self):
+        from paddle_tpu.text import ViterbiDecoder
+        r = np.random.default_rng(5)
+        B, T, N = 2, 6, 4
+        pot = r.normal(size=(B, T, N)).astype(np.float32)
+        trans = r.normal(size=(N + 2, N + 2)).astype(np.float32)
+        dec = ViterbiDecoder(paddle.to_tensor(trans))
+        scores, paths = dec(paddle.to_tensor(pot),
+                            paddle.to_tensor(np.array([6, 3], np.int32)))
+        assert tuple(paths.shape) == (B, T)
+        assert np.isfinite(scores.numpy()).all()
+        # shorter sequence: positions beyond length repeat the end tag
+        p1 = np.asarray(paths._value)[1]
+        assert (p1[2:] == p1[2]).all()
