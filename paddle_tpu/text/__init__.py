@@ -20,7 +20,8 @@ from ..io import Dataset
 
 __all__ = ["ByteTokenizer", "WordTokenizer", "Vocab", "LMBlockDataset",
            "MLMBlockDataset", "SyntheticTokens", "FileTokens",
-           "encode_file"]
+           "encode_file", "BPETokenizer", "viterbi_decode",
+           "ViterbiDecoder"]
 
 
 class ByteTokenizer:
