@@ -153,6 +153,19 @@ class Tensor:
     def clone(self) -> "Tensor":
         return apply("clone", lambda v: v + jnp.zeros((), v.dtype), (self,))
 
+    # torch-migration aliases (paddle.Tensor exposes these too [U])
+    def dim(self) -> int:
+        return self._value.ndim
+
+    ndimension = dim
+
+    def nelement(self) -> int:
+        import numpy as _np
+        return int(_np.prod(self._value.shape)) if self._value.shape else 1
+
+    def element_size(self) -> int:
+        return self._value.dtype.itemsize
+
     # -- conversion / movement ---------------------------------------------
     def astype(self, dt) -> "Tensor":
         dt = dtypes.convert_dtype(dt)
