@@ -22,7 +22,8 @@ from ..random_ import get_rng_state_tracker, model_parallel_random_seed  # noqa:
 from . import meta_parallel  # noqa: F401
 from .meta_parallel import (ColumnParallelLinear, RowParallelLinear,  # noqa: F401
                             VocabParallelEmbedding, ParallelCrossEntropy,
-                            PipelineLayer, LayerDesc, SharedLayerDesc)
+                            PipelineLayer, LayerDesc, SharedLayerDesc,
+                            PipelineParallel)
 
 
 class DistributedStrategy:

@@ -3,7 +3,8 @@ from ..tensor.linalg import *  # noqa: F401,F403
 from ..tensor.linalg import (norm, det, slogdet, inv, pinv, solve,  # noqa: F401
                              cholesky, qr, svd, eig, eigh, eigvals,
                              eigvalsh, matrix_power, matrix_rank, multi_dot,
-                             lstsq, cond, cov, corrcoef, lu, lu_unpack,
+                             lstsq, cond, corrcoef, lu, lu_unpack,
                              triangular_solve, cholesky_solve,
                              householder_product, matrix_exp, pca_lowrank,
                              svd_lowrank, vector_norm, matrix_norm)
+from ..tensor.stat import cov  # noqa: F401

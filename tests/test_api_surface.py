@@ -1,0 +1,113 @@
+"""API-surface regression guard: the documented namespaces must keep
+exporting their key names (a rename or dropped import fails here, not in
+a user's script). ≙ the reference's API-signature CI check
+(«tools/check_api_compatible.py» [U])."""
+import importlib
+
+import pytest
+
+import paddle_tpu as paddle
+
+# (module, required names) — spot anchors per namespace, not exhaustive
+SURFACE = {
+    "paddle_tpu": [
+        "to_tensor", "arange", "matmul", "einsum", "where", "concat",
+        "grad", "no_grad", "save", "load", "seed", "jit", "flops",
+        "summary", "block_diag", "vecdot", "gammainc", "isposinf",
+        "diagonal_scatter", "select_scatter", "slice_scatter",
+        "cartesian_prod", "float_power", "cumulative_trapezoid",
+    ],
+    "paddle_tpu.nn": [
+        "Layer", "Linear", "Conv2D", "LSTM", "MultiHeadAttention",
+        "Transformer", "RMSNorm", "MaxUnPool2D", "FractionalMaxPool2D",
+        "AdaptiveLogSoftmaxWithLoss", "Unflatten",
+    ],
+    "paddle_tpu.nn.functional": [
+        "cross_entropy", "scaled_dot_product_attention", "flash_attention",
+        "flash_attn_unpadded", "flash_attn_qkvpacked", "max_unpool2d",
+        "fractional_max_pool2d", "rms_norm", "masked_multihead_attention",
+    ],
+    "paddle_tpu.optimizer": [
+        "SGD", "AdamW", "Lamb", "NAdam", "RAdam", "Rprop", "ASGD",
+        "LBFGS",
+    ],
+    "paddle_tpu.optimizer.lr": [
+        "LRScheduler", "CosineAnnealingDecay", "OneCycleLR", "CyclicLR",
+        "ReduceOnPlateau",
+    ],
+    "paddle_tpu.distribution": [
+        "Normal", "Categorical", "MultivariateNormal", "StudentT",
+        "Cauchy", "Binomial", "Independent", "TransformedDistribution",
+        "ChainTransform", "StackTransform", "kl_divergence",
+    ],
+    "paddle_tpu.distributed": [
+        "all_reduce", "all_gather", "reduce_scatter", "alltoall",
+        "shard_tensor", "reshard", "create_mesh", "spawn",
+        "init_parallel_env", "DataParallel",
+    ],
+    "paddle_tpu.distributed.fleet": [
+        "init", "distributed_model", "distributed_optimizer",
+        "HybridCommunicateGroup", "DataParallel", "PipelineParallel",
+    ],
+    "paddle_tpu.geometric": [
+        "segment_sum", "segment_mean", "send_u_recv", "send_ue_recv",
+        "sample_neighbors", "reindex_graph",
+    ],
+    "paddle_tpu.vision": [
+        "resnet50", "vgg16", "mobilenet_v2", "densenet121", "googlenet",
+        "shufflenet_v2_x1_0", "LeNet",
+    ],
+    "paddle_tpu.vision.ops": [
+        "nms", "roi_align", "roi_pool", "deform_conv2d", "box_iou",
+        "DeformConv2D",
+    ],
+    "paddle_tpu.vision.transforms": [
+        "Compose", "Resize", "ColorJitter", "RandomResizedCrop",
+        "RandomErasing", "adjust_brightness",
+    ],
+    "paddle_tpu.text": [
+        "BPETokenizer", "ByteTokenizer", "viterbi_decode",
+        "ViterbiDecoder", "LMBlockDataset",
+    ],
+    "paddle_tpu.incubate.nn": [
+        "FusedLinear", "FusedMultiHeadAttention",
+        "FusedTransformerEncoderLayer", "FusedRMSNorm",
+    ],
+    "paddle_tpu.incubate.nn.functional": [
+        "swiglu", "fused_linear", "fused_rms_norm", "paged_attention",
+        "flash_attention_varlen", "fused_rotary_position_embedding",
+    ],
+    "paddle_tpu.incubate.autograd": [
+        "vjp", "jvp", "jacobian", "hessian", "grad",
+    ],
+    "paddle_tpu.amp": ["auto_cast", "GradScaler", "decorate"],
+    "paddle_tpu.amp.debugging": [
+        "check_numerics", "collect_operator_stats", "TensorCheckerConfig",
+    ],
+    "paddle_tpu.utils": ["dlpack", "unique_name", "require_version",
+                         "get_flags", "set_flags"],
+    "paddle_tpu.sparse": ["sparse_coo_tensor", "sparse_csr_tensor",
+                          "matmul", "masked_matmul"],
+    "paddle_tpu.linalg": ["svd", "qr", "lu", "lu_solve", "ormqr",
+                          "cholesky_inverse", "matrix_transpose"],
+    "paddle_tpu.metric": ["Accuracy", "Precision", "Recall", "Auc"],
+    "paddle_tpu.profiler": ["Profiler", "RecordEvent", "make_scheduler"],
+    "paddle_tpu.callbacks": ["EarlyStopping", "ModelCheckpoint",
+                             "VisualDL"],
+}
+
+
+@pytest.mark.parametrize("module,names", SURFACE.items(),
+                         ids=list(SURFACE))
+def test_surface(module, names):
+    mod = importlib.import_module(module)
+    missing = [n for n in names if not hasattr(mod, n)]
+    assert not missing, f"{module} missing: {missing}"
+
+
+def test_tensor_method_surface():
+    t = paddle.to_tensor([1.0, 2.0])
+    for m in ("reshape", "matmul", "sum", "backward", "numpy", "item",
+              "astype", "detach", "clone", "dim", "nelement",
+              "element_size", "register_hook", "isposinf", "vecdot"):
+        assert hasattr(t, m), m
