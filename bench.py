@@ -28,6 +28,8 @@ sys.path.insert(0, REPO)
 
 TARGET_MFU = 0.40
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "240"))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "5"))
+PROBE_BUDGET_S = int(os.environ.get("BENCH_TPU_PROBE_BUDGET", "2400"))
 
 
 def probe_tpu() -> bool:
@@ -35,15 +37,25 @@ def probe_tpu() -> bool:
 
     A hung backend init (observed in round 1: `jax.devices()` blocked
     >120 s inside axon setup) kills only the child; the parent moves on.
-    Two attempts, since a stale process holding the chip can clear up.
+    The axon tunnel is known to come and go (round 3: it died mid-session
+    and revived hours later), so we retry PROBE_ATTEMPTS times with
+    exponential backoff between attempts, bounded by a total wall-clock
+    budget PROBE_BUDGET_S.  All three knobs are env-tunable so the driver
+    can raise them (BENCH_TPU_PROBE_ATTEMPTS / _TIMEOUT / _BUDGET).
     """
     code = ("import jax; d = jax.devices(); "
             "assert d and d[0].platform != 'cpu', d; print('ok')")
-    for attempt in range(2):
+    deadline = time.monotonic() + PROBE_BUDGET_S
+    backoff = 5.0
+    for attempt in range(PROBE_ATTEMPTS):
+        remaining = deadline - time.monotonic()
+        if remaining <= 5:
+            sys.stderr.write("bench: TPU probe budget exhausted\n")
+            break
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True,
-                timeout=PROBE_TIMEOUT_S, text=True)
+                timeout=min(PROBE_TIMEOUT_S, remaining), text=True)
             if r.returncode == 0 and "ok" in r.stdout:
                 return True
             sys.stderr.write(
@@ -51,10 +63,10 @@ def probe_tpu() -> bool:
                 f"(rc={r.returncode}): {r.stderr.strip()[-500:]}\n")
         except subprocess.TimeoutExpired:
             sys.stderr.write(
-                f"bench: TPU probe attempt {attempt + 1} timed out "
-                f"after {PROBE_TIMEOUT_S}s\n")
-        if attempt == 0:
-            time.sleep(5)
+                f"bench: TPU probe attempt {attempt + 1} timed out\n")
+        if attempt + 1 < PROBE_ATTEMPTS:
+            time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
+            backoff = min(backoff * 2, 120.0)
     return False
 
 
