@@ -24,17 +24,30 @@ import numpy as np
 import paddle_tpu as paddle
 
 
+def _rope_perm(head_dim: int) -> np.ndarray:
+    half = head_dim // 2
+    idx = np.empty(head_dim, np.int64)
+    idx[0::2] = np.arange(half)
+    idx[1::2] = np.arange(half) + half
+    return idx
+
+
 def _interleave_rows(w: np.ndarray, num_heads: int) -> np.ndarray:
     """Permute rows (out_features, in) from HF half-split rope layout to
     interleaved: per head, row order [0, d/2, 1, d/2+1, ...]."""
     out, hidden = w.shape
     hd = out // num_heads
-    half = hd // 2
-    idx = np.empty(hd, np.int64)
-    idx[0::2] = np.arange(half)
-    idx[1::2] = np.arange(half) + half
     w = w.reshape(num_heads, hd, hidden)
-    return w[:, idx, :].reshape(out, hidden)
+    return w[:, _rope_perm(hd), :].reshape(out, hidden)
+
+
+def _interleave_vec(b: np.ndarray, num_heads: int) -> np.ndarray:
+    """1-D variant of _interleave_rows for q/k projection biases
+    (Qwen-style attention biases): the bias rows must receive the same
+    rope permutation as their matching weight rows."""
+    (out,) = b.shape
+    hd = out // num_heads
+    return b.reshape(num_heads, hd)[:, _rope_perm(hd)].reshape(out)
 
 
 def convert_llama_from_hf(state_dict, config) -> dict:
@@ -72,6 +85,10 @@ def convert_llama_from_hf(state_dict, config) -> dict:
                 "mlp.gate_proj.weight", "mlp.up_proj.weight",
                 "mlp.down_proj.weight")):
             out[name] = v.T
+        elif name.endswith("self_attn.q_proj.bias"):
+            out[name] = _interleave_vec(v, H)
+        elif name.endswith("self_attn.k_proj.bias"):
+            out[name] = _interleave_vec(v, HK)
         elif name.endswith("rotary_emb.inv_freq"):
             continue  # recomputed from config
         else:

@@ -328,10 +328,14 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
                       output_size, data_format, "max_unpool3d")
 
 
-def _fractional_pool_nd(x, n, output_size, kernel_size, random_u, op_name):
+def _fractional_pool_nd(x, n, output_size, kernel_size, random_u, op_name,
+                        return_mask=False):
     """Fractional max pooling (Graham 2014): pseudo-random bin boundaries
     alpha = in/out, boundary_i = ceil(alpha * (i + u)). ≙ paddle
-    fractional_max_pool2d/3d [U]."""
+    fractional_max_pool2d/3d [U]. With return_mask, also returns the flat
+    spatial argmax index per output cell (same convention as
+    max_pool2d(return_mask=True), usable by max_unpool*)."""
+    import itertools
     xt = _t(x)
     in_sp = tuple(xt.shape[2:])
     out_sp = ((output_size,) * n if isinstance(output_size, int)
@@ -352,34 +356,60 @@ def _fractional_pool_nd(x, n, output_size, kernel_size, random_u, op_name):
 
     bs = [bounds(in_sp[d], out_sp[d]) for d in range(n)]
 
-    def fn(v):
+    if not return_mask:
+        def fn(v):
+            b, c = v.shape[0], v.shape[1]
+            out = v
+            # pool one spatial dim at a time: segment-max over the boundary
+            # partition (static boundaries -> static shapes)
+            for d in range(n):
+                bb = bs[d]
+                pieces = [
+                    out[(slice(None),) * (2 + d)
+                        + (slice(int(bb[i]), int(bb[i + 1])),)].max(
+                        axis=2 + d, keepdims=True)
+                    for i in range(out_sp[d])]
+                out = jnp.concatenate(pieces, axis=2 + d)
+            return out
+        return apply(op_name, fn, (xt,))
+
+    def fn_mask(v):
         b, c = v.shape[0], v.shape[1]
-        out = v
-        # pool one spatial dim at a time: segment-max over the boundary
-        # partition (static boundaries -> static shapes)
-        for d in range(n):
-            bb = bs[d]
-            pieces = [
-                out[(slice(None),) * (2 + d)
-                    + (slice(int(bb[i]), int(bb[i + 1])),)].max(
-                    axis=2 + d, keepdims=True)
-                for i in range(out_sp[d])]
-            out = jnp.concatenate(pieces, axis=2 + d)
-        return out
-    return apply(op_name, fn, (xt,))
+        outs, idxs = [], []
+        # per-bin flat argmax: the bins are static axis-aligned boxes, so
+        # loop the (small, static) output grid and reduce each box
+        for cell in itertools.product(*[range(o) for o in out_sp]):
+            starts = [int(bs[d][cell[d]]) for d in range(n)]
+            stops = [int(bs[d][cell[d] + 1]) for d in range(n)]
+            box = v[(slice(None), slice(None))
+                    + tuple(slice(st, sp) for st, sp in zip(starts, stops))]
+            flat = box.reshape(b, c, -1)
+            am = jnp.argmax(flat, axis=-1)                    # (B, C)
+            coords = jnp.unravel_index(
+                am, tuple(sp - st for st, sp in zip(starts, stops)))
+            g = jnp.zeros_like(am)
+            for d in range(n):
+                g = g * in_sp[d] + coords[d] + starts[d]
+            outs.append(jnp.max(flat, axis=-1))
+            idxs.append(g)
+        out = jnp.stack(outs, -1).reshape((b, c) + out_sp)
+        mask = jnp.stack(idxs, -1).reshape((b, c) + out_sp) \
+            .astype(jnp.int32)
+        return out, mask
+    return apply(op_name, fn_mask, (xt,), multi_output=True)
 
 
 def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
     """≙ paddle.nn.functional.fractional_max_pool2d [U]."""
-    out = _fractional_pool_nd(x, 2, output_size, kernel_size, random_u,
-                              "fractional_max_pool2d")
-    return (out, None) if return_mask else out
+    return _fractional_pool_nd(x, 2, output_size, kernel_size, random_u,
+                               "fractional_max_pool2d",
+                               return_mask=return_mask)
 
 
 def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
     """≙ paddle.nn.functional.fractional_max_pool3d [U]."""
-    out = _fractional_pool_nd(x, 3, output_size, kernel_size, random_u,
-                              "fractional_max_pool3d")
-    return (out, None) if return_mask else out
+    return _fractional_pool_nd(x, 3, output_size, kernel_size, random_u,
+                               "fractional_max_pool3d",
+                               return_mask=return_mask)

@@ -729,6 +729,7 @@ class LBFGS(Optimizer):
         self._s_hist: list = []
         self._y_hist: list = []
         self._prev_flat_grad = None
+        self._n_inner = 0  # lifetime inner-iteration count (ref parity)
 
     def _flat_params(self):
         return jnp.concatenate(
@@ -793,25 +794,34 @@ class LBFGS(Optimizer):
             if gtd > -1e-15:
                 break
             t = float(self.get_lr())
-            # backtracking (armijo) line search; strong_wolfe adds the
-            # curvature check
-            ok = False
-            for _ls in range(25):
+            self._n_inner += 1
+            if self._line_search is None:
+                # reference default: one fixed t=lr step per inner
+                # iteration, no search (search only for 'strong_wolfe');
+                # the very first step ever is damped by min(1, 1/sum|g|)
+                if self._n_inner == 1:
+                    t = min(1.0, 1.0 / float(jnp.sum(jnp.abs(g)))) * t
                 self._set_flat_params(x0 + t * d)
                 loss, g = self._eval(closure)
                 evals += 1
-                if loss <= f0 + 1e-4 * t * gtd:
-                    if self._line_search != "strong_wolfe" or abs(float(
-                            jnp.dot(g, d))) <= 0.9 * abs(gtd):
-                        ok = True
+            else:
+                # backtracking (armijo) line search + curvature check
+                ok = False
+                for _ls in range(25):
+                    self._set_flat_params(x0 + t * d)
+                    loss, g = self._eval(closure)
+                    evals += 1
+                    if loss <= f0 + 1e-4 * t * gtd:
+                        if abs(float(jnp.dot(g, d))) <= 0.9 * abs(gtd):
+                            ok = True
+                            break
+                    t *= 0.5
+                    if evals >= self._max_eval:
                         break
-                t *= 0.5
-                if evals >= self._max_eval:
+                if not ok:
+                    self._set_flat_params(x0)
+                    loss, g = self._eval(closure)
                     break
-            if not ok:
-                self._set_flat_params(x0)
-                loss, g = self._eval(closure)
-                break
             s = self._flat_params() - x0
             y = g - g0
             if float(jnp.dot(s, y)) > 1e-10:

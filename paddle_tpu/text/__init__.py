@@ -222,9 +222,13 @@ def viterbi_decode(potentials, transition_params, lengths=None,
                    include_bos_eos_tag=True, name=None):
     """≙ paddle.text.viterbi_decode / ViterbiDecoder [U]: CRF max-score
     path. potentials (B, T, N) emission scores, transition_params (N, N)
-    (or (N+2, N+2) with BOS/EOS when include_bos_eos_tag). TPU-first: the
-    forward max-pass and the backtrace are both `lax.scan`s inside one
-    jittable program (static shapes; `lengths` masks shorter sequences).
+    — the tag dim of both MUST match. With include_bos_eos_tag (the
+    reference contract), N *includes* the BOS/EOS tags: the start scores
+    are `transitions[-1]` (BOS row), the stop scores `transitions[:, -2]`
+    (EOS column), and the decode runs over the first N-2 real labels.
+    TPU-first: the forward max-pass and the backtrace are both
+    `lax.scan`s inside one jittable program (static shapes; `lengths`
+    masks shorter sequences).
 
     Returns (scores (B,), paths (B, T) int32)."""
     import jax
@@ -238,15 +242,32 @@ def viterbi_decode(potentials, transition_params, lengths=None,
     lens = (lengths if isinstance(lengths, Tensor)
             else to_tensor(lengths)) if lengths is not None else None
 
+    n_tags = pot.shape[-1]
+    if tuple(trans.shape) != (n_tags, n_tags):
+        raise ValueError(
+            "viterbi_decode: transition_params must be square with the "
+            "same tag dim as potentials (got transitions "
+            f"{tuple(trans.shape)} vs potentials tag dim {n_tags}). With "
+            "include_bos_eos_tag=True the tag dim includes BOS/EOS: "
+            "start=transitions[-1], stop=transitions[:, -2].")
+    if include_bos_eos_tag and n_tags < 3:
+        raise ValueError(
+            "viterbi_decode: include_bos_eos_tag=True needs at least one "
+            f"real label besides BOS/EOS (got num_tags={n_tags})")
+
     def fn(p, tr, *rest):
         ln = rest[0] if rest else None
-        b, t, n = p.shape
+        b, t, _ = p.shape
         if include_bos_eos_tag:
-            # last two tags of the (N+2, N+2) table are BOS, EOS
+            # reference contract: BOS = last tag, EOS = second-to-last;
+            # real labels are the first n-2 tags
+            n = n_tags - 2
             core = tr[:n, :n]
-            start = tr[n, :n]        # BOS -> tag
-            stop = tr[:n, n + 1]     # tag -> EOS
+            start = tr[-1, :n]       # BOS -> tag
+            stop = tr[:n, -2]        # tag -> EOS
+            p = p[..., :n]
         else:
+            n = n_tags
             core = tr
             start = jnp.zeros((n,), p.dtype)
             stop = jnp.zeros((n,), p.dtype)

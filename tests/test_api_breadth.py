@@ -617,17 +617,52 @@ class TestViterbi:
             np.testing.assert_array_equal(np.asarray(paths._value)[b],
                                           bestp)
 
+    def test_bos_eos_brute_force_parity(self):
+        # reference contract: potentials' tag dim == transitions dim N
+        # (incl. BOS/EOS); start = trans[-1], stop = trans[:, -2]; decode
+        # over the first N-2 real labels.
+        import itertools
+        from paddle_tpu.text import ViterbiDecoder
+        r = np.random.default_rng(5)
+        B, T, N = 2, 4, 5          # 3 real labels + EOS + BOS
+        L = N - 2
+        pot = r.normal(size=(B, T, N)).astype(np.float32)
+        trans = r.normal(size=(N, N)).astype(np.float32)
+        dec = ViterbiDecoder(paddle.to_tensor(trans))
+        scores, paths = dec(paddle.to_tensor(pot))
+        assert tuple(paths.shape) == (B, T)
+        for b in range(B):
+            best, bestp = -1e9, None
+            for p in itertools.product(range(L), repeat=T):
+                s = trans[-1, p[0]] + pot[b, 0, p[0]] + sum(
+                    trans[p[i - 1], p[i]] + pot[b, i, p[i]]
+                    for i in range(1, T)) + trans[p[-1], -2]
+                if s > best:
+                    best, bestp = s, p
+            np.testing.assert_allclose(float(scores.numpy()[b]), best,
+                                       rtol=1e-5)
+            np.testing.assert_array_equal(np.asarray(paths._value)[b],
+                                          bestp)
+
     def test_lengths_and_bos_eos(self):
         from paddle_tpu.text import ViterbiDecoder
         r = np.random.default_rng(5)
-        B, T, N = 2, 6, 4
+        B, T, N = 2, 6, 6          # 4 real labels + EOS + BOS
         pot = r.normal(size=(B, T, N)).astype(np.float32)
-        trans = r.normal(size=(N + 2, N + 2)).astype(np.float32)
+        trans = r.normal(size=(N, N)).astype(np.float32)
         dec = ViterbiDecoder(paddle.to_tensor(trans))
         scores, paths = dec(paddle.to_tensor(pot),
                             paddle.to_tensor(np.array([6, 3], np.int32)))
         assert tuple(paths.shape) == (B, T)
         assert np.isfinite(scores.numpy()).all()
+        assert int(np.asarray(paths._value).max()) < N - 2
         # shorter sequence: positions beyond length repeat the end tag
         p1 = np.asarray(paths._value)[1]
         assert (p1[2:] == p1[2]).all()
+
+    def test_shape_mismatch_raises(self):
+        from paddle_tpu.text import viterbi_decode
+        pot = paddle.to_tensor(np.zeros((1, 3, 4), np.float32))
+        bad = paddle.to_tensor(np.zeros((6, 6), np.float32))
+        with pytest.raises(ValueError, match="tag dim"):
+            viterbi_decode(pot, bad)

@@ -59,3 +59,31 @@ class TestLlamaFromHF:
                           else out._value)
         np.testing.assert_array_equal(
             ours.reshape(-1)[:6], hf_out.numpy().reshape(-1)[3:9])
+
+
+class TestQKBiasInterleave:
+    def test_bias_gets_same_rope_permutation_as_weight_rows(self):
+        # ADVICE r3: Qwen-style q/k biases must be permuted with their
+        # matching weight rows. Marker trick: weight row r is the constant
+        # r and bias[r] = r, so after conversion the transposed weight's
+        # rows and the bias must carry identical permuted markers.
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models.hf_convert import convert_llama_from_hf
+        cfg = LlamaConfig(vocab_size=32, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=1,
+                          num_attention_heads=4, num_key_value_heads=2)
+        out_q = cfg.num_attention_heads * cfg.head_dim
+        out_k = cfg.num_key_value_heads * cfg.head_dim
+        sd = {}
+        for pfx, o in (("q", out_q), ("k", out_k)):
+            w = np.tile(np.arange(o, dtype=np.float32)[:, None],
+                        (1, cfg.hidden_size))
+            sd[f"model.layers.0.self_attn.{pfx}_proj.weight"] = w
+            sd[f"model.layers.0.self_attn.{pfx}_proj.bias"] = \
+                np.arange(o, dtype=np.float32)
+        conv = convert_llama_from_hf(sd, cfg)
+        for pfx in ("q", "k"):
+            w = conv[f"model.layers.0.self_attn.{pfx}_proj.weight"]
+            b = conv[f"model.layers.0.self_attn.{pfx}_proj.bias"]
+            np.testing.assert_array_equal(w.T[:, 0], b)
+            assert not np.array_equal(b, np.sort(b))  # perm is non-trivial

@@ -397,6 +397,45 @@ class TestFractionalPool:
         g = np.asarray(x.grad)
         assert g.sum() == 16.0  # one max per bin
 
+    def test_return_mask_indices(self):
+        # ADVICE r3: return_mask must return real flat argmax indices
+        # (max_pool convention), not None
+        x = np.random.default_rng(11).normal(size=(2, 3, 9, 9)) \
+            .astype(np.float32)
+        out, mask = F.fractional_max_pool2d(paddle.to_tensor(x),
+                                            output_size=3, random_u=0.4,
+                                            return_mask=True)
+        ov, mv = np.asarray(out._value), np.asarray(mask._value)
+        assert mv.dtype == np.int32 and mv.shape == ov.shape
+        # gathering the input at the mask indices recovers the outputs
+        flat = x.reshape(2, 3, -1)
+        for b in range(2):
+            for c in range(3):
+                np.testing.assert_array_equal(
+                    flat[b, c][mv[b, c].ravel()], ov[b, c].ravel())
+        # and the mask round-trips through max_unpool2d: unpooled map has
+        # exactly the pooled values at the mask positions
+        up = F.max_unpool2d(out, mask, kernel_size=3, output_size=[9, 9])
+        uv = np.asarray(up._value)
+        assert uv.shape == x.shape
+        for b in range(2):
+            for c in range(3):
+                np.testing.assert_array_equal(
+                    uv[b, c].ravel()[mv[b, c].ravel()], ov[b, c].ravel())
+        assert (uv != 0).sum() <= 2 * 3 * 9  # sparse elsewhere
+
+    def test_return_mask_3d(self):
+        x = np.random.default_rng(12).normal(size=(1, 2, 6, 6, 6)) \
+            .astype(np.float32)
+        out, mask = F.fractional_max_pool3d(paddle.to_tensor(x),
+                                            output_size=2, random_u=0.7,
+                                            return_mask=True)
+        ov, mv = np.asarray(out._value), np.asarray(mask._value)
+        flat = x.reshape(1, 2, -1)
+        for c in range(2):
+            np.testing.assert_array_equal(
+                flat[0, c][mv[0, c].ravel()], ov[0, c].ravel())
+
 
 class TestAmpDebugging:
     def test_check_numerics_and_stats(self):

@@ -318,3 +318,37 @@ class TestRound3Optimizers:
             loss = opt.step(closure)
         np.testing.assert_allclose(np.asarray(w._value), [1.0, 1.0],
                                    atol=1e-2)
+
+    def test_lbfgs_no_line_search_matches_torch(self):
+        # ADVICE r3: line_search_fn=None must take a single t=lr step per
+        # inner iteration (reference default), not run backtracking
+        import torch
+        from paddle_tpu.optimizer import LBFGS
+        x0 = np.array([-0.7, 1.3], np.float32)
+
+        w = paddle_tpu.Parameter(paddle.to_tensor(x0)._value)
+        opt = LBFGS(learning_rate=0.05, max_iter=4, parameters=[w])
+
+        def closure():
+            loss = ((w - paddle.to_tensor(
+                np.array([1.0, 2.0], np.float32))) ** 2).sum() \
+                + 0.5 * (w[0] * w[1])
+            loss.backward()
+            return loss
+
+        tw = torch.tensor(x0.copy(), requires_grad=True)
+        topt = torch.optim.LBFGS([tw], lr=0.05, max_iter=4)
+
+        def tclosure():
+            topt.zero_grad()
+            tl = ((tw - torch.tensor([1.0, 2.0])) ** 2).sum() \
+                + 0.5 * (tw[0] * tw[1])
+            tl.backward()
+            return tl
+
+        for _ in range(3):
+            opt.step(closure)
+            topt.step(tclosure)
+        np.testing.assert_allclose(np.asarray(w._value),
+                                   tw.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
