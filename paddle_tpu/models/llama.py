@@ -45,8 +45,10 @@ class LlamaConfig:
     # RingFlashAttention / sep degree, SURVEY.md §2.3 CP row):
     # None | 'ring' | 'ulysses'
     sep_strategy: str | None = None
-    # Mistral-style sliding-window local attention (training/prefill path;
-    # decode with a cache keeps full attention over the cached window)
+    # Mistral-style sliding-window local attention, honored on every
+    # path: flash-kernel training, masked no-cache, chunked prefill with
+    # cache, and single-token decode (cache positions outside the window
+    # are masked out)
     sliding_window: int | None = None
 
     @staticmethod
@@ -110,6 +112,20 @@ def apply_rope(x: Tensor, cos: Tensor, sin: Tensor, position_offset=0):
     return _apply("rope", fn, (x, cos, sin))
 
 
+def _window_band(s: int, n_keys: int, offset: int,
+                 window: int | None) -> np.ndarray:
+    """(s, n_keys) bool: q row i (global position i + offset) may attend
+    key j iff j <= i + offset (causal) and, with a sliding window,
+    j > i + offset - window. The single source of truth for the band —
+    every attention path derives its mask from here."""
+    rows = np.arange(s)[:, None] + offset
+    cols = np.arange(n_keys)[None, :]
+    band = cols <= rows
+    if window is not None:
+        band &= cols > rows - window
+    return band
+
+
 def _update_kv_cache(cache: Tensor, new: Tensor, offset) -> Tensor:
     """Write `new` (B, S, HK, D) into the static cache (B, S_max, HK, D)
     at sequence position `offset` (python int or traced scalar)."""
@@ -157,30 +173,37 @@ class LlamaAttention(nn.Layer):
             k_cache = _update_kv_cache(k_cache, k, position_offset)
             v_cache = _update_kv_cache(v_cache, v, position_offset)
             cur_len = position_offset + s
+            win = self.sliding_window
             if s == 1:
-                # decode: one new token attends every cached position < len;
-                # attention_mask ((B, S_cache) bool) excludes e.g. padding
+                # decode: one new token attends every cached position < len
+                # inside the sliding window; attention_mask ((B, S_cache)
+                # bool) excludes e.g. padding
                 out = F.masked_multihead_attention(
                     q, k_cache, v_cache, seq_len=cur_len,
-                    attn_mask=attention_mask)
+                    attn_mask=attention_mask, window_size=win)
             else:
                 # (chunked) prefill: end-aligned causal over the filled
                 # prefix — q row i attends keys <= i + offset (the flash
-                # kernel's native decode convention)
+                # kernel's native decode convention), window-banded when
+                # sliding_window is set
                 if not isinstance(position_offset, int):
                     raise ValueError(
                         "prefill (seq>1) needs a static position_offset")
                 mask = None
-                if attention_mask is not None:
-                    # (B, cur_len) key-validity mask -> (B,1,S,cur_len)
-                    am = attention_mask
-                    if not isinstance(am, Tensor):
-                        am = paddle.to_tensor(am)
-                    mask = am[:, :cur_len].astype("bool") \
-                        .unsqueeze(1).unsqueeze(1)
+                if attention_mask is not None or win is not None:
+                    band = _window_band(s, cur_len, position_offset, win)
+                    mask = paddle.to_tensor(band[None, None])  # (1,1,S,L)
+                    if attention_mask is not None:
+                        # (B, cur_len) key-validity mask -> (B,1,1,cur_len)
+                        am = attention_mask
+                        if not isinstance(am, Tensor):
+                            am = paddle.to_tensor(am)
+                        am = am[:, :cur_len].astype("bool") \
+                            .unsqueeze(1).unsqueeze(1)
+                        mask = paddle.logical_and(mask, am)
                 out = F.scaled_dot_product_attention(
                     q, k_cache[:, :cur_len], v_cache[:, :cur_len],
-                    attn_mask=mask, is_causal=True)
+                    attn_mask=mask, is_causal=mask is None)
             out = self.o_proj(out.reshape([b, s, -1]))
             if use_cache:
                 return out, (k_cache, v_cache)
@@ -196,10 +219,30 @@ class LlamaAttention(nn.Layer):
                            else ra.ring_flash_attention)
                 out = attn_fn(q, k, v, causal=True)
                 return self.o_proj(out.reshape([b, s, -1]))
-        if self.sliding_window is not None and attention_mask is None:
-            from paddle_tpu.ops.flash_attention import flash_attention
-            out = flash_attention(q, k, v, causal=True,
-                                  window_size=self.sliding_window)
+        if self.sliding_window is not None:
+            if attention_mask is None:
+                from paddle_tpu.ops.flash_attention import flash_attention
+                out = flash_attention(q, k, v, causal=True,
+                                      window_size=self.sliding_window)
+                return self.o_proj(out.reshape([b, s, -1]))
+            # combine the window band with the user mask (bool masks AND,
+            # additive masks get -inf outside the band); is_causal still
+            # applies the upper-triangular bound
+            am = attention_mask
+            if not isinstance(am, Tensor):
+                am = paddle.to_tensor(am)
+            band = _window_band(s, s, 0, self.sliding_window)
+            if am.dtype == paddle.bool:
+                if am.ndim == 2:          # (B, S) key-validity mask
+                    am = am.unsqueeze(1).unsqueeze(1)
+                am = paddle.logical_and(
+                    am, paddle.to_tensor(band[None, None]))
+            else:
+                am = am + paddle.to_tensor(
+                    np.where(band, 0.0, -1e30)[None, None]
+                    .astype(np.float32)).astype(am.dtype)
+            out = F.scaled_dot_product_attention(q, k, v, attn_mask=am,
+                                                 is_causal=True)
             return self.o_proj(out.reshape([b, s, -1]))
         out = F.scaled_dot_product_attention(q, k, v,
                                              attn_mask=attention_mask,

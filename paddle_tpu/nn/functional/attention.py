@@ -160,7 +160,8 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
 
 
 def masked_multihead_attention(query, k_cache, v_cache, seq_len,
-                               scale=None, attn_mask=None, name=None):
+                               scale=None, attn_mask=None,
+                               window_size=None, name=None):
     """Decode-time attention over a static KV cache.
 
     ≙ reference `masked_multihead_attention` decode kernel
@@ -171,7 +172,9 @@ def masked_multihead_attention(query, k_cache, v_cache, seq_len,
     be a multiple of the cache's HK). `seq_len` may be traced (decode
     position inside a scan). Softmax in fp32. `attn_mask`: optional
     (B, T_cache) bool — False positions (e.g. left padding written into
-    the cache) are excluded.
+    the cache) are excluded. `window_size`: Mistral-style sliding window —
+    q at position p attends only cache positions t with p - window < t
+    (combined with the causal bound and `attn_mask`).
     """
     q, kc, vc = _t(query), _t(k_cache), _t(v_cache)
     sl = seq_len._value if isinstance(seq_len, Tensor) else seq_len
@@ -192,6 +195,9 @@ def masked_multihead_attention(query, k_cache, v_cache, seq_len,
         kpos = jnp.arange(t)
         qpos = sl - s + jnp.arange(s)
         mask = (kpos[None, :] <= qpos[:, None])[None, None, None]
+        if window_size is not None:
+            mask = mask & (kpos[None, :]
+                           > qpos[:, None] - window_size)[None, None, None]
         if am is not None:
             pad = am.astype(bool)[:, None, None, None, :]  # (B,1,1,1,T)
             mask = mask & pad

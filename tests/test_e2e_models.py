@@ -202,3 +202,36 @@ class TestSlidingWindowLlama:
         np.testing.assert_allclose(win[:, :8], full[:, :8], rtol=1e-4,
                                    atol=1e-4)
         assert np.abs(win[:, -1] - full[:, -1]).max() > 1e-4
+
+    def test_window_cache_paths_match_nocache(self):
+        # ADVICE r3: the KV-cache branches (chunked prefill s>1 and
+        # single-token decode s==1) must honor sliding_window exactly like
+        # the no-cache forward.
+        from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                             synthetic_lm_batch)
+        paddle.seed(1)
+        cfg = LlamaConfig.tiny()
+        cfg.sliding_window = 8
+        m = LlamaForCausalLM(cfg)
+        T = 32
+        ids, _ = synthetic_lm_batch(2, T, cfg.vocab_size, seed=3)
+        ref = np.asarray(m(ids)._value)          # no-cache windowed logits
+
+        hk, hd = cfg.num_key_value_heads, cfg.head_dim
+        caches = [
+            (paddle.zeros([2, T, hk, hd]), paddle.zeros([2, T, hk, hd]))
+            for _ in range(cfg.num_hidden_layers)]
+        # chunked prefill: first 16, then next 15 (s>1, offset=16)
+        logits1, caches = m(ids[:, :16], past_key_values=caches,
+                            position_offset=0, use_cache=True)
+        logits2, caches = m(ids[:, 16:31], past_key_values=caches,
+                            position_offset=16, use_cache=True)
+        np.testing.assert_allclose(np.asarray(logits1._value),
+                                   ref[:, :16], rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(logits2._value),
+                                   ref[:, 16:31], rtol=2e-4, atol=2e-4)
+        # single-token decode at position 31
+        logits3, _ = m(ids[:, 31:32], past_key_values=caches,
+                       position_offset=31, use_cache=True)
+        np.testing.assert_allclose(np.asarray(logits3._value)[:, 0],
+                                   ref[:, 31], rtol=2e-4, atol=2e-4)
