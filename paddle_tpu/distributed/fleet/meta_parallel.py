@@ -269,9 +269,11 @@ class PipelineLayer(Layer):
 
 class PipelineParallel(Layer):
     """≙ «.../fleet/meta_parallel/pipeline_parallel.py» PipelineParallel.
-    train_batch splits into micro-batches and runs the schedule; the 1F1B
-    shard_map schedule lands with stage 7 — until then micro-batches run
-    sequentially inside one compiled program (GPipe-equivalent memory)."""
+    train_batch keeps the reference's eager micro-batch-loop API. The
+    TRUE 1F1B SPMD schedule (S-bounded activation residency) lives in
+    `distributed.fleet.pipeline.pipeline_1f1b` and is what
+    `models.llama_pipe.LlamaForCausalLMPipe` runs for fused training —
+    use that path for real pipelined workloads."""
 
     def __init__(self, layers, hcg=None, strategy=None):
         super().__init__()

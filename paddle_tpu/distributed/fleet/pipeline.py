@@ -11,14 +11,25 @@ hop stage→stage+1 through a single `ppermute` per tick (collective_permute
 over ICI). The reference's send/recv meta-negotiation, batched isend/irecv
 and per-stage Python scheduling all collapse into this one compiled loop.
 
-Backward is `jax.grad` through the scan: XLA replays the schedule in
-reverse (the ppermute transposes to the opposite rotation), which yields
-GPipe-equivalent ordering; per-tick rematerialization (`jax.checkpoint`
-around the stage body) bounds residuals to one activation per tick —
-O(B·hidden) total, a GPipe-with-remat profile (NOT true 1F1B's
-S·microbatch bound, and no interleaved virtual stages yet — both remain
-future work; a functional 1F1B needs fwd/bwd tick interleaving that XLA's
-grad-of-scan does not express directly).
+TWO schedules are provided:
+
+* `pipeline_forward` — forward pipelining with backward = `jax.grad`
+  through the scan: XLA replays the schedule in reverse (the ppermute
+  transposes to the opposite rotation), a GPipe-with-remat profile
+  (per-tick `jax.checkpoint` bounds residuals to one activation per
+  tick, so the stash grows with the microbatch count M). Supports
+  interleaved virtual stages (`virtual_chunks`), including M > S via
+  sequential rounds.
+* `pipeline_1f1b` — TRUE 1F1B (≙ the reference's
+  `PipelineParallel.train_batch` steady-state schedule): ONE fused
+  forward+backward scan under `jax.custom_vjp`. Each device alternates
+  F and B slots on opposite parities — F(i, s) at slot s + 2i,
+  B(i, s) at slot 2S-1-s + 2i, total 2(M+S-1) slots, the canonical
+  1F1B timing — and keeps a circular stash of at most S stage-input
+  activations (the in-flight count at stage s is S-s). Because the
+  scan is the *manually written* backward, XLA saves nothing per tick:
+  activation residency is ∝ S and independent of M, which is exactly
+  the 1F1B memory profile the GPipe path lacks.
 
 Output handling: by default every device returns the (M, mb, ...) buffer
 and the last stage's copy is broadcast with a one-hop `ppermute` fan-out
@@ -43,7 +54,7 @@ from ..collective import _SM_KW, shard_map as _shard_map
 
 from ..mesh import ProcessMesh
 
-__all__ = ["pipeline_forward", "stack_stage_params"]
+__all__ = ["pipeline_forward", "pipeline_1f1b", "stack_stage_params"]
 
 
 def stack_stage_params(per_stage_params):
@@ -97,8 +108,12 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
     hands to chunk v+1's stage 0 via the one ppermute). Per-tick work
     drops to 1/V of a fat stage, shrinking the fill/drain bubble from
     (S-1) fat-stage units to ~(S-1)/V-ish: ticks go (M + S - 1) ->
-    (M + V*S - 1) at 1/V the cost each. Constraint: M <= S (the
-    conflict-free schedule; run multiple rounds for larger batches).
+    (M + V*S - 1) at 1/V the cost each. The conflict-free schedule
+    handles S microbatches per lap; for M > S the pipeline runs
+    ceil(M/S) sequential ROUNDS inside the same compiled scan (M must
+    divide into rounds of S, i.e. M % S == 0), lifting the old M <= S
+    constraint — gradient accumulation composes across rounds because
+    the rounds are an outer `lax.scan` the autodiff sums over.
     Returns y: (B, ...) final-stage output, or (M, *reduce_shape) with
     reduce_fn. Differentiable.
     """
@@ -107,13 +122,19 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
     v_chunks = int(virtual_chunks)
     b = x.shape[0]
     assert b % m == 0, f"batch {b} not divisible by microbatches {m}"
+    rounds = 1
+    m_round = m
     if v_chunks > 1 and m > s_count:
-        raise ValueError(
-            f"interleaved pipeline needs num_microbatches ({m}) <= pp "
-            f"degree ({s_count}); run multiple rounds for larger batches")
+        if m % s_count != 0:
+            raise ValueError(
+                f"interleaved pipeline with num_microbatches ({m}) > pp "
+                f"degree ({s_count}) needs microbatches divisible into "
+                f"rounds of {s_count} (got {m} % {s_count} != 0)")
+        rounds = m // s_count
+        m_round = s_count
     mb = b // m
     xs = x.reshape(m, mb, *x.shape[1:])
-    ticks = m + v_chunks * s_count - 1
+    ticks = m_round + v_chunks * s_count - 1
 
     body = stage_fn
     if remat:
@@ -130,61 +151,80 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
         s = jax.lax.axis_index(axis)
         perm = [(j, (j + 1) % s_count) for j in range(s_count)]
 
-        def tick(carry, t):
-            state, buf = carry
-            if v_chunks > 1:
-                # interleave schedule: at tick t this device runs chunk
-                # v for microbatch t - v*S - s (at most one valid (m, v)
-                # since M <= S); garbage flows on inactive ticks and is
-                # never recorded
-                rel = t - s
-                v = jnp.clip(rel // s_count, 0, v_chunks - 1)
-                m_i = rel - v * s_count
-                x_t = jax.lax.dynamic_index_in_dim(
-                    xs_local, jnp.clip(m_i, 0, m - 1), 0, keepdims=False)
-                inp = jnp.where((s == 0) & (v == 0),
-                                x_t.astype(state.dtype), state)
-                params_t = jax.tree_util.tree_map(
-                    lambda l: jax.lax.dynamic_index_in_dim(
-                        l, v, 0, keepdims=False), params1)
-            else:
-                # stage 0 ingests microbatch t (clamped; inactive ticks
-                # are overwritten later), others take the ppermuted
-                # activation
-                x_t = jax.lax.dynamic_index_in_dim(
-                    xs_local, jnp.clip(t, 0, m - 1), 0, keepdims=False)
-                inp = jnp.where(s == 0, x_t.astype(state.dtype), state)
-                params_t = params1
-            y = body(params_t, inp, *extra)
-            # the final (stage, chunk)'s tick-t output is microbatch
-            # t - (V-1)*S - (S-1)
-            idx = t - (v_chunks - 1) * s_count - (s_count - 1)
-            idx_c = jnp.clip(idx, 0, m - 1)
-            valid = (idx >= 0) & (idx < m)
-            if reduce_fn is not None:
-                # only the final stage's reduction matters; lax.cond lets
-                # every other device skip the (lm-head-sized) compute —
-                # the predicate is per-device so each takes its own branch
-                r = jax.lax.cond(
-                    (s == s_count - 1) & valid,
-                    lambda: reduce_fn(y, idx_c, *r_args)
-                    .astype(buf.dtype).reshape(buf.shape[1:]),
-                    lambda: buf[idx_c])
-                buf = buf.at[idx_c].set(r)
-            else:
-                cur = jax.lax.dynamic_index_in_dim(buf, idx_c, 0,
-                                                   keepdims=False)
-                upd = jnp.where(valid, y, cur)
-                buf = jax.lax.dynamic_update_index_in_dim(buf, upd,
-                                                          idx_c, 0)
-            state = jax.lax.ppermute(y, axis, perm)
-            return (state, buf), None
+        def run_round(xs_round, r_off):
+            def tick(carry, t):
+                state, buf = carry
+                if v_chunks > 1:
+                    # interleave schedule: at tick t this device runs
+                    # chunk v for microbatch t - v*S - s (at most one
+                    # valid (m, v) since the round has <= S microbatches);
+                    # garbage flows on inactive ticks, never recorded
+                    rel = t - s
+                    v = jnp.clip(rel // s_count, 0, v_chunks - 1)
+                    m_i = rel - v * s_count
+                    x_t = jax.lax.dynamic_index_in_dim(
+                        xs_round, jnp.clip(m_i, 0, m_round - 1), 0,
+                        keepdims=False)
+                    inp = jnp.where((s == 0) & (v == 0),
+                                    x_t.astype(state.dtype), state)
+                    params_t = jax.tree_util.tree_map(
+                        lambda l: jax.lax.dynamic_index_in_dim(
+                            l, v, 0, keepdims=False), params1)
+                else:
+                    # stage 0 ingests microbatch t (clamped; inactive
+                    # ticks are overwritten later), others take the
+                    # ppermuted activation
+                    x_t = jax.lax.dynamic_index_in_dim(
+                        xs_round, jnp.clip(t, 0, m_round - 1), 0,
+                        keepdims=False)
+                    inp = jnp.where(s == 0, x_t.astype(state.dtype), state)
+                    params_t = params1
+                y = body(params_t, inp, *extra)
+                # the final (stage, chunk)'s tick-t output is microbatch
+                # t - (V-1)*S - (S-1)
+                idx = t - (v_chunks - 1) * s_count - (s_count - 1)
+                idx_c = jnp.clip(idx, 0, m_round - 1)
+                valid = (idx >= 0) & (idx < m_round)
+                if reduce_fn is not None:
+                    # only the final stage's reduction matters; lax.cond
+                    # lets every other device skip the (lm-head-sized)
+                    # compute — the predicate is per-device so each takes
+                    # its own branch
+                    r = jax.lax.cond(
+                        (s == s_count - 1) & valid,
+                        lambda: reduce_fn(y, idx_c + r_off, *r_args)
+                        .astype(buf.dtype).reshape(buf.shape[1:]),
+                        lambda: buf[idx_c])
+                    buf = buf.at[idx_c].set(r)
+                else:
+                    cur = jax.lax.dynamic_index_in_dim(buf, idx_c, 0,
+                                                       keepdims=False)
+                    upd = jnp.where(valid, y, cur)
+                    buf = jax.lax.dynamic_update_index_in_dim(buf, upd,
+                                                              idx_c, 0)
+                state = jax.lax.ppermute(y, axis, perm)
+                return (state, buf), None
 
-        state0 = jnp.zeros_like(xs_local[0])
-        buf0 = (jnp.zeros((m,) + tuple(reduce_shape), jnp.float32)
-                if reduce_fn is not None else jnp.zeros_like(xs_local))
-        (_, buf), _ = jax.lax.scan(tick, (state0, buf0),
-                                   jnp.arange(ticks))
+            state0 = jnp.zeros_like(xs_round[0])
+            buf0 = (jnp.zeros((m_round,) + tuple(reduce_shape),
+                              jnp.float32)
+                    if reduce_fn is not None else jnp.zeros_like(xs_round))
+            (_, buf), _ = jax.lax.scan(tick, (state0, buf0),
+                                       jnp.arange(ticks))
+            return buf
+
+        if rounds == 1:
+            buf = run_round(xs_local, 0)
+        else:
+            xs_r = xs_local.reshape(rounds, m_round, *xs_local.shape[1:])
+
+            def rbody(_, rx):
+                r_idx, xs_round = rx
+                return None, run_round(xs_round, r_idx * m_round)
+
+            _, bufs = jax.lax.scan(
+                rbody, None, (jnp.arange(rounds), xs_r))
+            buf = bufs.reshape((m,) + bufs.shape[2:])
         # only the last stage holds the real output: recursive-doubling
         # broadcast from stage S-1 — ceil(log2 S) ppermute hops, each
         # device receives the buffer exactly once ((S-1)·|buf| total
@@ -228,3 +268,320 @@ def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
     if reduce_fn is not None:
         return out                      # (M,) per-microbatch scalars
     return out.reshape(b, *out.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# True 1F1B (one-forward-one-backward) schedule
+# ---------------------------------------------------------------------------
+def _spec_axes(spec):
+    """Set of mesh axis names appearing in a PartitionSpec."""
+    out = set()
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            out.update(a for a in entry if a is not None)
+        else:
+            out.add(entry)
+    return out
+
+
+def _tree_spec_axes(specs):
+    out = set()
+    for s in jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda l: isinstance(l, P)):
+        out.update(_spec_axes(s))
+    return out
+
+
+def _psum_tree(tree, axes):
+    if not axes:
+        return tree
+    return jax.tree_util.tree_map(
+        lambda l: jax.lax.psum(l, tuple(axes)), tree)
+
+
+def pipeline_1f1b(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
+                  num_microbatches: int, axis: str = "pp",
+                  extra_args: tuple = (), param_specs=None, x_spec=None,
+                  reduce_fn: Optional[Callable] = None,
+                  reduce_args: tuple = (), reduce_arg_specs=None,
+                  reduce_mean_axes: tuple = (),
+                  reduce_shape: tuple = (),
+                  grad_component: int = 0,
+                  need_input_grad: bool = True):
+    """TRUE 1F1B pipelined training step (≙ the reference
+    `PipelineParallel.train_batch` 1F1B schedule,
+    «.../fleet/meta_parallel/pipeline_parallel.py», SURVEY.md §7 hard
+    part #1) — same signature family as `pipeline_forward` with
+    `reduce_fn`, same return value (the (M, *reduce_shape) per-microbatch
+    reductions), but the backward pass is a MANUALLY interleaved 1F1B
+    schedule instead of grad-of-scan GPipe:
+
+    * One `lax.scan` over 2(M+S-1) slots. Device s runs F(i) at slot
+      s + 2i and B(i) at slot 2S-1-s + 2i — F slots have parity s, B
+      slots parity s+1, so the two never collide and the wall-clock
+      matches the canonical 1F1B timeline.
+    * A circular stash holds at most S stage-INPUT activations (the
+      in-flight bound at stage s is S - s). The stage body is
+      rematerialized inside each B slot via `jax.vjp`, so activation
+      residency is ∝ S·microbatch and INDEPENDENT of M — the 1F1B
+      memory profile that grad-of-scan cannot express.
+    * Activations ppermute s→s+1 every slot; grad-activations ppermute
+      s→s-1 every slot; garbage flows on inactive lanes and is gated
+      off by each receiver's own schedule predicate.
+
+    Differentiation contract: the function is wrapped in
+    `jax.custom_vjp`, so `jax.grad` / `loss.backward()` through the
+    returned reductions Just Works — with one documented assumption:
+    the cotangent of the `grad_component`-th reduction component must
+    be UNIFORM across microbatches (true for every mean/sum-style loss
+    combiner, including the global-token-mean sum/count pattern, where
+    d loss/d sum_i = 1/total_count for all i). Components other than
+    `grad_component` must be gradient-free w.r.t. the network (e.g.
+    valid-token counts). This is exactly the reference's gradient
+    -accumulation semantics (each microbatch backward seeded with the
+    same scale).
+
+    need_input_grad=False drops the (M, mb, ...) input-cotangent buffer
+    (use when x is not a function of trained parameters).
+    """
+    s_count = mesh.get_dim_size(axis)
+    m = num_microbatches
+    b = x.shape[0]
+    assert b % m == 0, f"batch {b} not divisible by microbatches {m}"
+    if reduce_fn is None:
+        raise ValueError("pipeline_1f1b is a training-step schedule: it "
+                         "needs reduce_fn (the per-microbatch loss head); "
+                         "use pipeline_forward for inference")
+    mb = b // m
+    xs = x.reshape(m, mb, *x.shape[1:])
+    slots = 2 * (m + s_count - 1)
+    r_shape = tuple(reduce_shape)
+    if r_shape == ():
+        seed = jnp.float32(1.0)
+    else:
+        import numpy as _np0
+        _gc_idx = _np0.unravel_index(grad_component, r_shape)
+        seed = jnp.zeros(r_shape, jnp.float32).at[_gc_idx].set(1.0)
+
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(
+            lambda l: P(axis, *([None] * (l.ndim - 1))), stacked_params)
+    if x_spec is None:
+        xs_spec = P(*([None] * xs.ndim))
+    else:
+        xs_spec = P(None, *tuple(x_spec))
+    extra_specs = tuple(P(*([None] * jnp.asarray(e).ndim))
+                        for e in extra_args)
+    if reduce_arg_specs is None:
+        reduce_arg_specs = tuple(P(*([None] * jnp.asarray(a).ndim))
+                                 for a in reduce_args)
+    reduce_arg_specs = tuple(reduce_arg_specs)
+
+    # differentiable reduce_args = inexact-dtype leaves (labels etc. are
+    # integer arrays: no cotangent)
+    r_diff = tuple(i for i, a in enumerate(reduce_args)
+                   if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact))
+
+    # mesh axes that carry any input sharding: gradients must be
+    # psum-reduced over every such axis that is absent from their own
+    # output spec (axes with no input sharding are replicated-compute —
+    # summing over them would overcount)
+    used_axes = (_tree_spec_axes(param_specs) | _spec_axes(xs_spec)
+                 | _tree_spec_axes(list(extra_specs))
+                 | _tree_spec_axes(list(reduce_arg_specs)) | {axis})
+    used_axes &= set(mesh.dim_names)
+
+    def _grad_axes(spec):
+        return tuple(sorted(used_axes - _spec_axes(spec)))
+
+    losses_spec = P(*([None] * (1 + len(r_shape))))
+
+    def combined(sp, xv, extra, rargs):
+        """shard_map body builder: returns (losses, gparams, gx, gextra,
+        grargs) — all grads already cross-axis psum-reduced."""
+
+        def local_fn(params_local, xs_local, *rest):
+            n_extra = len(extra)
+            extra_l = rest[:n_extra]
+            rargs_l = rest[n_extra:]
+            params1 = jax.tree_util.tree_map(lambda l: l[0], params_local)
+            s = jax.lax.axis_index(axis)
+            perm_f = [(j, (j + 1) % s_count) for j in range(s_count)]
+            perm_b = [(j, (j - 1) % s_count) for j in range(s_count)]
+            act0 = jnp.zeros_like(xs_local[0])
+            rargs_d = tuple(rargs_l[i] for i in r_diff)
+
+            def slot(carry, t):
+                (state_f, state_b, stash, gp_acc, gx_buf, gex_acc,
+                 gra_acc, loss_buf) = carry
+                # ---- forward slot -----------------------------------
+                rel_f = t - s
+                i_f = jnp.clip(rel_f // 2, 0, m - 1)
+                do_f = (rel_f >= 0) & (rel_f % 2 == 0) & (rel_f // 2 < m)
+                x_t = jax.lax.dynamic_index_in_dim(xs_local, i_f, 0,
+                                                   keepdims=False)
+                x_in = jnp.where(s == 0, x_t.astype(act0.dtype), state_f)
+                y = jax.lax.cond(
+                    do_f,
+                    lambda: stage_fn(params1, x_in, *extra_l)
+                    .astype(act0.dtype),
+                    lambda: act0)
+                old = jax.lax.dynamic_index_in_dim(stash, i_f % s_count,
+                                                   0, keepdims=False)
+                stash = jax.lax.dynamic_update_index_in_dim(
+                    stash, jnp.where(do_f, x_in, old), i_f % s_count, 0)
+                # ---- backward slot ----------------------------------
+                rel_b = t - (2 * s_count - 1 - s)
+                i_b = jnp.clip(rel_b // 2, 0, m - 1)
+                do_b = (rel_b >= 0) & (rel_b % 2 == 0) & (rel_b // 2 < m)
+                inp = jax.lax.dynamic_index_in_dim(stash, i_b % s_count,
+                                                   0, keepdims=False)
+
+                def bwd_last():
+                    def f(p, a, ex, rd):
+                        ra = list(rargs_l)
+                        for k, i in enumerate(r_diff):
+                            ra[i] = rd[k]
+                        out = reduce_fn(stage_fn(p, a, *ex), i_b, *ra)
+                        return out.astype(jnp.float32).reshape(r_shape)
+                    r_val, vjp = jax.vjp(f, params1, inp, extra_l,
+                                         rargs_d)
+                    gp, ga, gex, grd = vjp(seed)
+                    return gp, ga, gex, grd, r_val
+
+                def bwd_mid():
+                    def f(p, a, ex):
+                        return stage_fn(p, a, *ex).astype(act0.dtype)
+                    _, vjp = jax.vjp(f, params1, inp, extra_l)
+                    gp, ga, gex = vjp(state_b)
+                    return (gp, ga, gex,
+                            jax.tree_util.tree_map(jnp.zeros_like,
+                                                   rargs_d),
+                            jnp.zeros(r_shape, jnp.float32))
+
+                zeros_b = (
+                    jax.tree_util.tree_map(jnp.zeros_like, params1),
+                    jnp.zeros_like(act0),
+                    jax.tree_util.tree_map(jnp.zeros_like, extra_l),
+                    jax.tree_util.tree_map(jnp.zeros_like, rargs_d),
+                    jnp.zeros(r_shape, jnp.float32))
+                gp, ga, gex, grd, r_val = jax.lax.cond(
+                    do_b,
+                    lambda: jax.lax.cond(s == s_count - 1, bwd_last,
+                                         bwd_mid),
+                    lambda: zeros_b)
+                gp_acc = jax.tree_util.tree_map(jnp.add, gp_acc, gp)
+                gex_acc = jax.tree_util.tree_map(jnp.add, gex_acc, gex)
+                gra_acc = jax.tree_util.tree_map(jnp.add, gra_acc, grd)
+                if gx_buf is not None:
+                    cur = jax.lax.dynamic_index_in_dim(gx_buf, i_b, 0,
+                                                       keepdims=False)
+                    gx_buf = jax.lax.dynamic_update_index_in_dim(
+                        gx_buf, jnp.where(do_b & (s == 0), ga, cur),
+                        i_b, 0)
+                cur_l = jax.lax.dynamic_index_in_dim(loss_buf, i_b, 0,
+                                                     keepdims=False)
+                loss_buf = jax.lax.dynamic_update_index_in_dim(
+                    loss_buf,
+                    jnp.where(do_b & (s == s_count - 1), r_val, cur_l),
+                    i_b, 0)
+                # ---- ring hops --------------------------------------
+                state_f = jax.lax.ppermute(y, axis, perm_f)
+                state_b = jax.lax.ppermute(ga, axis, perm_b)
+                return (state_f, state_b, stash, gp_acc, gx_buf, gex_acc,
+                        gra_acc, loss_buf), None
+
+            carry0 = (
+                act0, jnp.zeros_like(act0),
+                jnp.zeros((s_count,) + act0.shape, act0.dtype),
+                jax.tree_util.tree_map(jnp.zeros_like, params1),
+                (jnp.zeros((m,) + act0.shape, act0.dtype)
+                 if need_input_grad else None),
+                jax.tree_util.tree_map(jnp.zeros_like, extra_l),
+                jax.tree_util.tree_map(jnp.zeros_like, rargs_d),
+                jnp.zeros((m,) + r_shape, jnp.float32))
+            (_, _, _, gp_acc, gx_buf, gex_acc, gra_acc,
+             loss_buf), _ = jax.lax.scan(slot, carry0,
+                                         jnp.arange(slots))
+            # cross-axis reductions: each grad psums over every
+            # input-sharded axis absent from its own placement
+            loss_buf = jax.lax.psum(loss_buf, axis)
+            for ax in reduce_mean_axes:
+                loss_buf = jax.lax.pmean(loss_buf, ax)
+            gp_out = jax.tree_util.tree_map(
+                lambda g, sp_: _psum_tree(g, _grad_axes(sp_))[None],
+                gp_acc, param_specs,
+                is_leaf=lambda l: isinstance(l, P))
+            if gx_buf is not None:
+                gx_buf = _psum_tree(gx_buf, _grad_axes(xs_spec))
+            gex_out = tuple(
+                _psum_tree(g, _grad_axes(sp_))
+                for g, sp_ in zip(gex_acc, extra_specs))
+            gra_out = tuple(
+                _psum_tree(g, _grad_axes(reduce_arg_specs[i]))
+                for g, i in zip(gra_acc, r_diff))
+            return (loss_buf, gp_out, gx_buf, gex_out, gra_out)
+
+        gx_spec = xs_spec if need_input_grad else None
+        out_specs = (losses_spec, param_specs, gx_spec,
+                     tuple(extra_specs),
+                     tuple(reduce_arg_specs[i] for i in r_diff))
+        return _shard_map(
+            local_fn, mesh=mesh.jax_mesh,
+            in_specs=(param_specs, xs_spec) + tuple(extra_specs)
+            + tuple(reduce_arg_specs),
+            out_specs=out_specs, **_SM_KW)(sp, xv, *extra, *rargs)
+
+    from jax import dtypes as _jdt
+    import numpy as _np
+
+    def _int_ct(a):
+        return _np.zeros(jnp.shape(a), _jdt.float0)
+
+    @jax.custom_vjp
+    def run(sp, xv, extra, rargs):
+        return combined(sp, xv, extra, rargs)[0]
+
+    def run_fwd(sp, xv, extra, rargs):
+        losses, gp, gx, gex, gra = combined(sp, xv, extra, rargs)
+        return losses, (gp, gx, gex, gra, rargs)
+
+    def run_bwd(res, ct):
+        gp, gx, gex, gra, rargs = res
+        # uniform-cotangent assumption (gradient-accumulation semantics):
+        # scale the accumulated grads by the per-microbatch cotangent of
+        # the grad component (same flat index the forward seed used)
+        if r_shape == ():
+            c = ct
+        else:
+            import numpy as _np1
+            c = ct[(slice(None),)
+                   + tuple(_np1.unravel_index(grad_component, r_shape))]
+        scale = jnp.mean(c).astype(jnp.float32)
+        # the returned losses were pmean'd over reduce_mean_axes, so the
+        # caller's cotangent is w.r.t. the MEAN — but the grads were
+        # psum-accumulated raw over those (input-sharded) axes; undo the
+        # double counting
+        for ax in reduce_mean_axes:
+            if ax in used_axes:
+                scale = scale / mesh.get_dim_size(ax)
+
+        def mul(g):
+            return (g * scale).astype(g.dtype)
+
+        g_sp = jax.tree_util.tree_map(mul, gp)
+        # cotangent for the primal's second arg, which is xs (M, mb, ...)
+        # — the caller-side reshape transposes it back to (B, ...)
+        g_x = (mul(gx) if gx is not None
+               else jnp.zeros((m, mb) + x.shape[1:], x.dtype))
+        g_extra = jax.tree_util.tree_map(mul, gex)
+        gra_it = iter(gra)
+        g_rargs = tuple(
+            mul(next(gra_it)) if i in r_diff else _int_ct(a)
+            for i, a in enumerate(rargs))
+        return g_sp, g_x, g_extra, g_rargs
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stacked_params, xs, tuple(extra_args), tuple(reduce_args))

@@ -88,12 +88,21 @@ class LlamaForCausalLMPipe(nn.Layer):
 
     def __init__(self, cfg: LlamaConfig | None = None,
                  num_microbatches: int = 1,
-                 virtual_pipeline_degree: int = 1):
+                 virtual_pipeline_degree: int = 1,
+                 pipeline_schedule: str = "1f1b"):
         super().__init__()
         cfg = cfg or LlamaConfig.llama3_8b()
         self.config = cfg
         self.num_microbatches = num_microbatches
         self.virtual_pipeline_degree = virtual_pipeline_degree
+        # '1f1b' (default; ≙ reference PipelineParallel.train_batch,
+        # S-bounded activation residency) or 'gpipe' (grad-of-scan).
+        # The interleaved virtual pipeline (V > 1) currently runs the
+        # GPipe schedule; 1F1B applies to the plain-stage layout.
+        if pipeline_schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"unknown pipeline_schedule "
+                             f"{pipeline_schedule!r}")
+        self.pipeline_schedule = pipeline_schedule
         h = cfg.hidden_size
         hd = cfg.head_dim
         nh, nkv = cfg.num_attention_heads, cfg.num_key_value_heads
@@ -155,8 +164,8 @@ class LlamaForCausalLMPipe(nn.Layer):
             sn = sin[:ids.shape[1]]
             params = dict(zip(_STACK_NAMES, dec))
             if use_pp:
-                from paddle_tpu.distributed.fleet.pipeline import \
-                    pipeline_forward
+                from paddle_tpu.distributed.fleet.pipeline import (
+                    pipeline_1f1b, pipeline_forward)
                 s_count = mesh.get_dim_size("pp")
                 L = cfg.num_hidden_layers
                 vp = self.virtual_pipeline_degree
@@ -251,17 +260,22 @@ class LlamaForCausalLMPipe(nn.Layer):
                         return jnp.stack([jnp.sum(per_tok),
                                           valid.sum().astype(jnp.float32)])
 
-                    stats = pipeline_forward(
+                    use_1f1b = (self.pipeline_schedule == "1f1b"
+                                and vp == 1)
+                    pipe_call = (pipeline_1f1b if use_1f1b
+                                 else pipeline_forward)
+                    kw = ({} if use_1f1b
+                          else {"virtual_chunks": vp})
+                    stats = pipe_call(
                         stage_fn, staged, x, mesh, m, axis="pp",
                         extra_args=(cs, sn), param_specs=specs,
                         x_spec=P(dp, None, None),
-                        virtual_chunks=vp,
                         reduce_fn=reduce_fn,
                         reduce_args=(norm_w, head_w, lab_r),
                         reduce_arg_specs=(P(None), P(None, mp),
                                           P(None, dp, None)),
                         reduce_mean_axes=("dp",) if dp else (),
-                        reduce_shape=(2,))
+                        reduce_shape=(2,), **kw)
                     # (M, 2) per-microbatch (sum, count) — dp-pmean'd,
                     # which preserves the sum/count ratio
                     return jnp.sum(stats[:, 0]) / jnp.maximum(

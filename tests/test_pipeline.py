@@ -134,7 +134,8 @@ class TestLlamaPipe:
         assert g is not None, "embedding got no gradient"
         assert float(np.abs(g.numpy()).max()) > 0, "embedding grad all-zero"
 
-    def test_pp_training_loss_decreases(self):
+    @pytest.mark.parametrize("schedule", ["1f1b", "gpipe"])
+    def test_pp_training_loss_decreases(self, schedule):
         """3D mesh (dp x pp x mp): full train step through TrainStep."""
         from paddle_tpu.models.llama import LlamaConfig
         from paddle_tpu.models.llama_pipe import (LlamaForCausalLMPipe,
@@ -144,7 +145,8 @@ class TestLlamaPipe:
         mesh = dist.create_mesh(dp=2, pp=2, mp=2)
         paddle.seed(0)
         cfg = LlamaConfig.tiny()
-        model = LlamaForCausalLMPipe(cfg, num_microbatches=2)
+        model = LlamaForCausalLMPipe(cfg, num_microbatches=2,
+                                     pipeline_schedule=schedule)
         with dist.use_mesh(mesh):
             shard_llama_pipe(model, mesh)
             opt = AdamW(learning_rate=1e-3, parameters=model.parameters())
@@ -157,6 +159,38 @@ class TestLlamaPipe:
             losses = [float(step(ids, labels)) for _ in range(4)]
         assert losses[-1] < losses[0], losses
         assert np.isfinite(losses).all()
+
+    def test_1f1b_matches_gpipe_loss_and_grads(self, pp_mesh):
+        """Same weights, same batch: the two schedules are the same math
+        (loss + every parameter gradient, incl. embedding through the
+        input cotangent and norm/head through reduce_args)."""
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.models.llama_pipe import (LlamaForCausalLMPipe,
+                                                  synthetic_lm_batch)
+        paddle.seed(0)
+        cfg = LlamaConfig.tiny()
+        cfg.num_hidden_layers = 4
+        ids, labels = synthetic_lm_batch(4, 32, cfg.vocab_size)
+        results = {}
+        for schedule in ("1f1b", "gpipe"):
+            paddle.seed(0)
+            model = LlamaForCausalLMPipe(cfg, num_microbatches=4,
+                                         pipeline_schedule=schedule)
+            with dist.use_mesh(pp_mesh):
+                loss, _ = model(ids, labels=labels)
+                loss.backward()
+            results[schedule] = (
+                float(loss),
+                {n: np.asarray(p.grad._value)
+                 for n, p in model.named_parameters()
+                 if p.grad is not None})
+        l1, g1 = results["1f1b"]
+        l2, g2 = results["gpipe"]
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+        assert set(g1) == set(g2) and len(g1) > 5
+        for n in g1:
+            np.testing.assert_allclose(g1[n], g2[n], rtol=2e-4,
+                                       atol=2e-5, err_msg=n)
 
 
 class TestFusedLossPipeline:
@@ -264,12 +298,21 @@ class TestInterleavedPipeline:
         np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
 
-    def test_too_many_microbatches_raises(self, pp_mesh):
+    def test_more_microbatches_than_stages_multi_round(self, pp_mesh):
+        """M > S runs as sequential rounds now (round-4: the old M <= S
+        constraint is lifted); only non-round-divisible M raises."""
         chunks = self._chunks(8)
         stacked = self._stack_interleaved(chunks, 4, 2)
         x = jnp.asarray(rng.normal(size=(8, 5, 16)).astype(np.float32))
-        with pytest.raises(ValueError):
-            pipeline_forward(_mlp_stage, stacked, x, pp_mesh, 8,
+        y = pipeline_forward(_mlp_stage, stacked, x, pp_mesh, 8,
+                             virtual_chunks=2)
+        ref = x
+        for c in chunks:
+            ref = _mlp_stage(c, ref)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline_forward(_mlp_stage, stacked, x[:6], pp_mesh, 6,
                              virtual_chunks=2)
 
     def test_grads_match_sequential(self, pp_mesh):

@@ -1,0 +1,329 @@
+"""True-1F1B pipeline schedule tests (8-virtual-device CPU mesh).
+
+≙ reference `PipelineParallel.train_batch` 1F1B
+(«.../fleet/meta_parallel/pipeline_parallel.py», SURVEY.md §7 hard part
+#1). Oracles: sequential execution + jax.grad, and the GPipe
+(grad-of-scan) path. The memory test inspects compiled-HLO temp
+allocation to verify the S-bounded (M-independent) activation residency
+claim — the defining property of 1F1B.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet.pipeline import (pipeline_1f1b,
+                                                   pipeline_forward,
+                                                   stack_stage_params)
+
+rng = np.random.default_rng(11)
+
+
+def _mlp_stage(params, x, *extra):
+    w1, w2 = params
+    return x + jnp.tanh(x @ w1) @ w2
+
+
+def _stages(s, h=16, hid=32):
+    return [(jnp.asarray(rng.normal(size=(h, hid)).astype(np.float32)
+                         * 0.3),
+             jnp.asarray(rng.normal(size=(hid, h)).astype(np.float32)
+                         * 0.3)) for _ in range(s)]
+
+
+@pytest.fixture(scope="module")
+def pp_mesh():
+    return dist.create_mesh(pp=4)
+
+
+def _seq_losses(per_stage, x, m):
+    """Oracle: per-microbatch sum-of-squares through the stage chain."""
+    mb = x.shape[0] // m
+    out = []
+    for i in range(m):
+        y = x[i * mb:(i + 1) * mb]
+        for p in per_stage:
+            y = _mlp_stage(p, y)
+        out.append(jnp.sum(y.astype(jnp.float32) ** 2))
+    return jnp.stack(out)
+
+
+class TestOneFOneB:
+    @pytest.mark.parametrize("micro", [2, 4, 8])
+    def test_losses_match_sequential(self, pp_mesh, micro):
+        per_stage = _stages(4)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.asarray(rng.normal(size=(8, 5, 16)).astype(np.float32))
+
+        def reduce_fn(y, idx):
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        got = pipeline_1f1b(_mlp_stage, stacked, x, pp_mesh, micro,
+                            reduce_fn=reduce_fn)
+        want = _seq_losses(per_stage, x, micro)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_grads_match_sequential(self, pp_mesh):
+        per_stage = _stages(4)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.asarray(rng.normal(size=(8, 3, 16)).astype(np.float32))
+
+        def reduce_fn(y, idx):
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        def loss_1f1b(sp, xx):
+            return jnp.mean(pipeline_1f1b(
+                _mlp_stage, sp, xx, pp_mesh, 4, reduce_fn=reduce_fn))
+
+        def loss_seq(sp, xx):
+            return jnp.mean(_seq_losses(
+                [jax.tree_util.tree_map(lambda l: l[i], sp)
+                 for i in range(4)], xx, 4))
+
+        g1 = jax.grad(loss_1f1b, (0, 1))(stacked, x)
+        g2 = jax.grad(loss_seq, (0, 1))(stacked, x)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_grads_match_gpipe_path(self, pp_mesh):
+        """1F1B and grad-of-scan GPipe are the same math."""
+        per_stage = _stages(4)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.asarray(rng.normal(size=(4, 3, 16)).astype(np.float32))
+
+        def reduce_fn(y, idx):
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        def l_new(sp):
+            return jnp.mean(pipeline_1f1b(
+                _mlp_stage, sp, x, pp_mesh, 4, reduce_fn=reduce_fn,
+                need_input_grad=False))
+
+        def l_old(sp):
+            return jnp.mean(pipeline_forward(
+                _mlp_stage, sp, x, pp_mesh, 4, reduce_fn=reduce_fn))
+
+        g1 = jax.grad(l_new)(stacked)
+        g2 = jax.grad(l_old)(stacked)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_reduce_vector_and_args_grads(self, pp_mesh):
+        """(sum, count) reductions: component 0 carries gradient, the
+        reduce_args (a trained head weight) receive cotangents, and an
+        integer reduce_arg (labels) rides through without one."""
+        per_stage = _stages(4)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.asarray(rng.normal(size=(4, 3, 16)).astype(np.float32))
+        w = jnp.asarray(rng.normal(size=(16,)).astype(np.float32))
+        labels = jnp.asarray(
+            rng.integers(0, 2, size=(4, 3)).astype(np.int32))
+
+        def reduce_fn(y, idx, wv, lab):
+            li = jax.lax.dynamic_index_in_dim(lab, idx, 0,
+                                              keepdims=False)
+            per = (y @ wv) * li.astype(jnp.float32)[..., None][..., 0]
+            return jnp.stack([jnp.sum(per),
+                              jnp.sum(li).astype(jnp.float32)])
+
+        lab_r = labels.reshape(4, 1, 3)
+
+        def loss_new(sp, wv):
+            st = pipeline_1f1b(
+                _mlp_stage, sp, x, pp_mesh, 4, reduce_fn=reduce_fn,
+                reduce_args=(wv, lab_r), reduce_shape=(2,),
+                need_input_grad=False)
+            return jnp.sum(st[:, 0]) / jnp.maximum(jnp.sum(st[:, 1]), 1.0)
+
+        def loss_old(sp, wv):
+            st = pipeline_forward(
+                _mlp_stage, sp, x, pp_mesh, 4, reduce_fn=reduce_fn,
+                reduce_args=(wv, lab_r), reduce_shape=(2,))
+            return jnp.sum(st[:, 0]) / jnp.maximum(jnp.sum(st[:, 1]), 1.0)
+
+        v1, g1 = jax.value_and_grad(loss_new, (0, 1))(stacked, w)
+        v2, g2 = jax.value_and_grad(loss_old, (0, 1))(stacked, w)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_dp_mesh_grads_match_gpipe(self):
+        """dp x pp mesh with reduce_mean_axes=('dp',): the 1F1B manual
+        backward must NOT overcount grads by the dp degree (round-4
+        code-review finding: psum'd grads + pmean'd losses double-counted
+        the mean factor)."""
+        mesh = dist.create_mesh(dp=2, pp=4)
+        per_stage = _stages(4)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.asarray(rng.normal(size=(8, 3, 16)).astype(np.float32))
+
+        def reduce_fn(y, idx):
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        kw = dict(x_spec=P("dp", None, None),
+                  reduce_mean_axes=("dp",))
+
+        def l_new(sp, xx):
+            return jnp.mean(pipeline_1f1b(
+                _mlp_stage, sp, xx, mesh, 4, reduce_fn=reduce_fn, **kw))
+
+        def l_old(sp, xx):
+            return jnp.mean(pipeline_forward(
+                _mlp_stage, sp, xx, mesh, 4, reduce_fn=reduce_fn, **kw))
+
+        v1, g1 = jax.value_and_grad(l_new, (0, 1))(stacked, x)
+        v2, g2 = jax.value_and_grad(l_old, (0, 1))(stacked, x)
+        np.testing.assert_allclose(float(v1), float(v2), rtol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_input_grad_flows(self, pp_mesh):
+        per_stage = _stages(4)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.asarray(rng.normal(size=(4, 3, 16)).astype(np.float32))
+
+        def reduce_fn(y, idx):
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        def loss_new(xx):
+            return jnp.mean(pipeline_1f1b(
+                _mlp_stage, stacked, xx, pp_mesh, 4,
+                reduce_fn=reduce_fn))
+
+        def loss_seq(xx):
+            return jnp.mean(_seq_losses(per_stage, xx, 4))
+
+        g1 = jax.grad(loss_new)(x)
+        g2 = jax.grad(loss_seq)(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestMemoryProfile:
+    """The defining 1F1B property: activation residency ∝ S, not M
+    (VERDICT r3 missing #1 'done' criterion)."""
+
+    def _temp_bytes(self, schedule, mesh, m, mb=4, h=64, hid=128):
+        per_stage = [(jnp.asarray(
+            rng.normal(size=(h, hid)).astype(np.float32) * 0.2),
+            jnp.asarray(rng.normal(size=(hid, h)).astype(np.float32)
+                        * 0.2)) for _ in range(4)]
+        stacked = stack_stage_params(per_stage)
+        x = jnp.zeros((m * mb, 8, h), jnp.float32)
+
+        def reduce_fn(y, idx):
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        if schedule == "1f1b":
+            def loss(sp, xx):
+                return jnp.mean(pipeline_1f1b(
+                    _mlp_stage, sp, xx, mesh, m, reduce_fn=reduce_fn,
+                    need_input_grad=False))
+        else:
+            def loss(sp, xx):
+                return jnp.mean(pipeline_forward(
+                    _mlp_stage, sp, xx, mesh, m, reduce_fn=reduce_fn))
+
+        c = jax.jit(jax.grad(loss)).lower(stacked, x).compile()
+        ma = c.memory_analysis()
+        return getattr(ma, "temp_size_in_bytes", None)
+
+    def test_residency_independent_of_microbatches(self, pp_mesh):
+        vals = {}
+        for sched in ("1f1b", "gpipe"):
+            lo = self._temp_bytes(sched, pp_mesh, m=4)
+            hi = self._temp_bytes(sched, pp_mesh, m=16)
+            vals[sched] = (lo, hi)
+        if any(v is None for pair in vals.values() for v in pair):
+            pytest.skip("memory_analysis unavailable on this backend")
+        lo1, hi1 = vals["1f1b"]
+        lo2, hi2 = vals["gpipe"]
+        print(f"\ncompiled temp bytes (fixed microbatch size, M=4 -> 16):"
+              f" 1f1b {lo1} -> {hi1}; gpipe {lo2} -> {hi2}")
+        # GPipe residuals grow ~linearly in M; 1F1B's stash must not.
+        # 4x the microbatches: allow modest growth (per-microbatch loss
+        # buffers etc.) but nothing near the GPipe slope.
+        assert hi2 > 2.0 * lo2, (lo2, hi2)          # sanity: oracle grows
+        assert hi1 < 1.6 * lo1, (lo1, hi1)          # 1f1b must not
+        assert hi1 < hi2 / 2, (hi1, hi2)
+
+
+class TestInterleavedMultiRound:
+    """M > S interleave via sequential rounds (VERDICT r3 missing #1:
+    'lift the M <= S interleave constraint')."""
+
+    def _chunks(self, n, h=16, hid=32):
+        return [(jnp.asarray(rng.normal(size=(h, hid)).astype(np.float32)
+                             * 0.3),
+                 jnp.asarray(rng.normal(size=(hid, h)).astype(np.float32)
+                             * 0.3)) for _ in range(n)]
+
+    def _stack_interleaved(self, chunks, s, v):
+        def leaf(i):
+            return jnp.stack(
+                [jnp.stack([chunks[vv * s + ss][i] for vv in range(v)])
+                 for ss in range(s)])
+        return (leaf(0), leaf(1))
+
+    @pytest.mark.parametrize("micro", [8, 12])
+    def test_matches_sequential(self, pp_mesh, micro):
+        s, v = 4, 2
+        chunks = self._chunks(s * v)
+        stacked = self._stack_interleaved(chunks, s, v)
+        x = jnp.asarray(rng.normal(size=(micro, 5, 16))
+                        .astype(np.float32))
+        y = pipeline_forward(_mlp_stage, stacked, x, pp_mesh, micro,
+                             virtual_chunks=v)
+        ref = x
+        for c in chunks:
+            ref = _mlp_stage(c, ref)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_non_divisible_raises(self, pp_mesh):
+        chunks = self._chunks(8)
+        stacked = self._stack_interleaved(chunks, 4, 2)
+        x = jnp.asarray(rng.normal(size=(6, 5, 16)).astype(np.float32))
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline_forward(_mlp_stage, stacked, x, pp_mesh, 6,
+                             virtual_chunks=2)
+
+    def test_multi_round_grads(self, pp_mesh):
+        s, v = 4, 2
+        chunks = self._chunks(s * v)
+        stacked = self._stack_interleaved(chunks, s, v)
+        x = jnp.asarray(rng.normal(size=(8, 5, 16)).astype(np.float32))
+
+        def loss_pipe(st):
+            return jnp.sum(pipeline_forward(
+                _mlp_stage, st, x, pp_mesh, 8,
+                virtual_chunks=v).astype(jnp.float32) ** 2)
+
+        def loss_seq(cs):
+            ref = x
+            for c in cs:
+                ref = _mlp_stage(c, ref)
+            return jnp.sum(ref.astype(jnp.float32) ** 2)
+
+        g_pipe = jax.grad(loss_pipe)(stacked)
+        g_seq = jax.grad(loss_seq)(chunks)
+        for i in range(2):
+            got = np.asarray(g_pipe[i])
+            for ss in range(s):
+                for vv in range(v):
+                    np.testing.assert_allclose(
+                        got[ss, vv], np.asarray(g_seq[vv * s + ss][i]),
+                        rtol=3e-4, atol=3e-4)
