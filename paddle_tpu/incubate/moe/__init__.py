@@ -18,11 +18,19 @@ TPU-native design — two dispatch strategies behind one MoELayer API:
   as grouped matmuls — the Pallas kernel in ops/grouped_matmul.py on
   TPU (block-padded groups), ragged_dot elsewhere. This is the
   DeepSeekMoE-scale path (E=64+), where the dense (T, E, C) tensors
-  are catastrophic. Composes with expert parallelism via a shard_map
-  all_to_all dispatch with static per-pair buffers
-  (moe_ffn_dropless_ep_values) — truly dropless on one shard; under EP
-  a generous per-pair budget bounds the exchange (see
-  ep_pair_capacity_factor).
+  are catastrophic. Under expert parallelism, TWO dispatch modes:
+
+  - ep_dispatch='exact' (default): a TWO-PHASE exchange — per-pair
+    counts are all-gathered, then `lax.ragged_all_to_all` moves only
+    the real rows (the TPU-native equivalent of the reference's
+    `global_scatter`/`global_gather` exactness). ZERO drops under any
+    routing skew; the receive buffer is sized to the static worst case
+    (ep·T_local·k rows), which is the price of exactness under XLA's
+    static shapes — only the ragged payload actually rides the ICI.
+  - ep_dispatch='capacity': static per-pair budget buffers (cheapest
+    memory, bounded bandwidth); tokens beyond a pair's budget are
+    DROPPED, and the layer surfaces a hard per-step drop counter
+    (`MoELayer.last_drop_count`) so silent degradation is impossible.
 
 Both use the standard load-balancing auxiliary loss.
 """
@@ -40,7 +48,8 @@ from ...nn import initializer as I
 from ...nn.layer.layers import Layer
 
 __all__ = ["moe_gating_values", "moe_ffn_values",
-           "moe_ffn_dropless_values", "MoELayer", "shard_moe"]
+           "moe_ffn_dropless_values", "moe_ffn_dropless_ep_exact_values",
+           "MoELayer", "shard_moe"]
 
 
 def moe_gating_values(logits, top_k: int, capacity: int):
@@ -77,12 +86,18 @@ def moe_ffn_values(x2, gate_w, w_gate, w_up, w_down, top_k: int,
                    capacity_factor: float, ep_axis: Optional[str] = None,
                    mesh=None):
     """Dense-dispatch MoE SwiGLU FFN. x2: (T, H); gate_w: (H, E);
-    stacked experts w_gate/w_up: (E, H, I), w_down: (E, I, H)."""
+    stacked experts w_gate/w_up: (E, H, I), w_down: (E, I, H).
+    Returns (out, aux, drops) — drops = routed slots beyond expert
+    capacity (int32 scalar)."""
     t, h = x2.shape
     e = gate_w.shape[1]
     capacity = max(int(math.ceil(top_k * t / e * capacity_factor)), 1)
     logits = x2.astype(jnp.float32) @ gate_w.astype(jnp.float32)
     dispatch, combine, aux = moe_gating_values(logits, top_k, capacity)
+
+    # capacity drops: routed slots that found no queue position
+    drops = (jnp.float32(t * top_k)
+             - jnp.sum(dispatch)).astype(jnp.int32)
 
     xe = jnp.einsum("tec,th->ech", dispatch.astype(x2.dtype), x2)  # (E,C,H)
     if ep_axis is not None and mesh is not None and \
@@ -98,7 +113,7 @@ def moe_ffn_values(x2, gate_w, w_gate, w_up, w_down, top_k: int,
         from ...distributed.mesh import shard_constraint
         oe = shard_constraint(oe, ep_axis, None, None, mesh=mesh)
     out = jnp.einsum("tec,ech->th", combine.astype(oe.dtype), oe)
-    return out.astype(x2.dtype), aux
+    return out.astype(x2.dtype), aux, drops
 
 
 def _aux_loss(probs, gate_idx):
@@ -191,14 +206,20 @@ def moe_ffn_dropless_ep_values(x2, gate_w, w_gate_l, w_up_l, w_down_l,
     shard_map: x2 is this program's (T_local, H) token shard; w_*_l are
     the E/ep experts this shard owns.
 
-    ≙ the reference's `global_scatter`/`global_gather` ragged alltoall
-    dispatch (SURVEY.md §2.3 EP row), made static-shape: each (src, dst)
-    shard pair exchanges a fixed `pair_capacity`-row buffer via
-    `lax.all_to_all` over the `ep` ICI axis; tokens beyond a pair's
-    budget are dropped (generous default ≈ 2x the uniform-routing load —
-    tune with MoELayer.ep_pair_capacity_factor; the single-shard dropless
-    path drops nothing). Expert compute is the same grouped-matmul FFN;
-    a reverse all_to_all routes rows home.
+    ≙ the reference's `global_scatter`/`global_gather` alltoall dispatch
+    (SURVEY.md §2.3 EP row), made static-shape: each (src, dst) shard
+    pair exchanges a fixed `pair_capacity`-row buffer via
+    `lax.all_to_all` over the `ep` ICI axis. With pair_capacity =
+    T_local·k (the static worst case — MoELayer's 'exact' mode, the
+    default) NO routing skew can overflow a pair's buffer, so the
+    exchange is EXACT like the reference's; with a smaller budget
+    ('capacity' mode) overflow tokens are dropped and the returned drop
+    counter (globally psum-reduced) surfaces exactly how many. Expert
+    compute is the same grouped-matmul FFN; a reverse all_to_all routes
+    rows home.
+
+    Returns (out (T_local, H), aux scalar, drops scalar int32 —
+    replicated global count of dropped token-choices this step).
     """
     t_l, h = x2.shape
     e = gate_w.shape[1]
@@ -238,6 +259,11 @@ def moe_ffn_dropless_ep_values(x2, gate_w, w_gate_l, w_up_l, w_down_l,
     wv = gate_vals.reshape(-1).astype(jnp.float32)
     out = jnp.zeros((t_l, h), jnp.float32).at[tok].add(
         slot_rows.astype(jnp.float32) * wv[:, None])
+    # hard drop counter: every shard counts its overflowed slots; psum
+    # over every token-sharding axis gives the replicated global count
+    drops = jnp.sum(~keep).astype(jnp.int32)
+    for ax in token_axes:
+        drops = jax.lax.psum(drops, ax)
     # aux loss: pmean the FACTORS (routed fraction f, mean prob p) across
     # token shards before multiplying, so the scalar equals the
     # single-shard global aux exactly (mean of per-shard products would
@@ -249,7 +275,7 @@ def moe_ffn_dropless_ep_values(x2, gate_w, w_gate_l, w_up_l, w_down_l,
         f = jax.lax.pmean(f, ax)
         p = jax.lax.pmean(p, ax)
     aux = e * jnp.sum(f * p)
-    return out.astype(x2.dtype), aux
+    return out.astype(x2.dtype), aux, drops
 
 
 class MoELayer(Layer):
@@ -264,7 +290,15 @@ class MoELayer(Layer):
                  capacity_factor: float = 1.25,
                  shared_intermediate_size: int = 0,
                  ep_axis: str = "ep", dropless: bool = False,
-                 ep_pair_capacity_factor: float = 2.0, name=None):
+                 ep_pair_capacity_factor: Optional[float] = None,
+                 name=None):
+        """ep_pair_capacity_factor: None (default) = EXACT dropless-EP
+        dispatch — per-pair buffers sized to the T_local·k worst case so
+        no routing skew can drop a token (≙ reference global_scatter
+        exactness; costs ep× the bandwidth of the uniform load). A float
+        f bounds each pair's buffer at ≈ f·uniform-load instead; skewed
+        routing beyond it drops tokens, and the global count lands in
+        `self.last_drop_count` after every eager forward."""
         super().__init__()
         self.hidden_size = hidden_size
         self.intermediate_size = intermediate_size
@@ -274,6 +308,7 @@ class MoELayer(Layer):
         self.ep_axis = ep_axis
         self.dropless = dropless
         self.ep_pair_capacity_factor = ep_pair_capacity_factor
+        self.last_drop_count: Optional[int] = None
         e, h, i = num_experts, hidden_size, intermediate_size
         self.gate_weight = self.create_parameter(
             (h, e), default_initializer=I.Normal(0.0, 0.02))
@@ -328,9 +363,16 @@ class MoELayer(Layer):
                             shard_map as _shard_map
                     from jax.sharding import PartitionSpec as P
                     t_l = t // n_shards
-                    cap = max(1, min(
-                        int(math.ceil(top_k * t_l / ep_size * pcf)),
-                        t_l * top_k))
+                    if pcf is None:
+                        # exact mode: the static worst case — one shard
+                        # can never send more than its own T_local*k
+                        # slots to one destination, so zero drops under
+                        # ANY routing (≙ global_scatter exactness)
+                        cap = t_l * top_k
+                    else:
+                        cap = max(1, min(
+                            int(math.ceil(top_k * t_l / ep_size * pcf)),
+                            t_l * top_k))
 
                     def body(x_l, gw_, wg_l, wu_l, wd_l):
                         return moe_ffn_dropless_ep_values(
@@ -341,21 +383,29 @@ class MoELayer(Layer):
                         in_specs=(P(tok_axes, None), P(None, None),
                                   P(ep, None, None), P(ep, None, None),
                                   P(ep, None, None)),
-                        out_specs=(P(tok_axes, None), P()))
-                    out, aux = mapped(x2, gw, wg, wu, wd)
-                    return out.reshape(xv.shape), aux
+                        out_specs=(P(tok_axes, None), P(), P()))
+                    out, aux, drops = mapped(x2, gw, wg, wu, wd)
+                    return out.reshape(xv.shape), aux, drops
                 # fall through to capacity path on indivisible shapes
             elif self.dropless:
                 out, aux = moe_ffn_dropless_values(x2, gw, wg, wu, wd,
                                                    top_k)
-                return out.reshape(xv.shape), aux
-            out, aux = moe_ffn_values(x2, gw, wg, wu, wd, top_k, cf,
-                                      ep, mesh)
-            return out.reshape(xv.shape), aux
+                return (out.reshape(xv.shape), aux,
+                        jnp.zeros((), jnp.int32))
+            out, aux, drops = moe_ffn_values(x2, gw, wg, wu, wd, top_k,
+                                             cf, ep, mesh)
+            return out.reshape(xv.shape), aux, drops
 
-        out, aux = apply("moe_ffn", fn,
-                         (x, self.gate_weight, self.w_gate, self.w_up,
-                          self.w_down), multi_output=True)
+        out, aux, drops = apply("moe_ffn", fn,
+                                (x, self.gate_weight, self.w_gate,
+                                 self.w_up, self.w_down),
+                                multi_output=True)
+        # surface the hard drop counter when running eagerly (a traced
+        # value would leak a tracer — skip inside jit)
+        try:
+            self.last_drop_count = int(drops._value)
+        except Exception:
+            self.last_drop_count = None
         if self.shared_gate is not None:
             from ...nn import functional as F
             out = out + self.shared_down(
