@@ -43,6 +43,7 @@ from . import utils  # noqa: F401
 from . import audio  # noqa: F401
 from . import geometric  # noqa: F401
 from . import vision  # noqa: F401
+from . import static  # noqa: F401
 
 from .device import (get_device, set_device, is_compiled_with_cuda,  # noqa: F401
                      is_compiled_with_rocm, is_compiled_with_xpu,
@@ -57,10 +58,32 @@ def cast(x, dtype):
     return x.astype(dtype)
 
 
+class CPUPlace:
+    """≙ paddle.CPUPlace (device placement is XLA's job on TPU; Places
+    are accepted for API compatibility and ignored)."""
+
+    def __repr__(self):
+        return "CPUPlace"
+
+
+class CUDAPlace:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"CUDAPlace({self.device_id})"
+
+
+class XPUPlace(CUDAPlace):
+    pass
+
+
 def in_dynamic_mode() -> bool:
-    """The framework is always 'dynamic' from the user's view; compilation
-    happens per-function under paddle_tpu.jit (no global static mode)."""
-    return True
+    """True unless paddle.enable_static()/static.program_guard is active
+    (the static surface is an op-replay record over the same eager ops —
+    see paddle_tpu.static)."""
+    from . import static as _static
+    return not _static.in_static_mode()
 
 
 def in_dynamic_or_pir_mode() -> bool:
@@ -68,14 +91,15 @@ def in_dynamic_or_pir_mode() -> bool:
 
 
 def enable_static():
-    raise NotImplementedError(
-        "Global static-graph mode is intentionally not supported: the "
-        "TPU-native compile path is per-function `paddle_tpu.jit.to_static` "
-        "(whole-train-step XLA compilation). See SURVEY.md §7 stage 3.")
+    """≙ paddle.enable_static: ops record into
+    static.default_main_program() until disable_static()."""
+    from . import static as _static
+    _static.enable_static()
 
 
 def disable_static():
-    pass
+    from . import static as _static
+    _static.disable_static()
 
 
 def disable_signal_handler():

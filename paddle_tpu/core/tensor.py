@@ -80,7 +80,39 @@ class Tensor:
     def numpy(self) -> np.ndarray:
         return np.asarray(self._value)
 
+    def _graph_break(self, coercion: str):
+        raise GraphBreakError(
+            f"{coercion} on a traced Tensor: data-dependent Python "
+            "control flow cannot be compiled into one XLA program "
+            "(≙ a SOT graph break in the reference). Inside "
+            "to_static/TrainStep/static.Executor, express the branch "
+            "with tensor ops (paddle.where, logical masks) or move it "
+            "outside the compiled step; paddle.jit.not_to_static marks "
+            "helpers that must stay eager.")
+
+    def __bool__(self):
+        if isinstance(self._value, jax.core.Tracer):
+            self._graph_break("bool()/if-condition")
+        return bool(self._value)
+
+    def __float__(self):
+        if isinstance(self._value, jax.core.Tracer):
+            self._graph_break("float()")
+        return float(self._value)
+
+    def __int__(self):
+        if isinstance(self._value, jax.core.Tracer):
+            self._graph_break("int()")
+        return int(self._value)
+
+    def __index__(self):
+        if isinstance(self._value, jax.core.Tracer):
+            self._graph_break("integer indexing coercion")
+        return self._value.__index__()
+
     def item(self, *idx):
+        if isinstance(self._value, jax.core.Tracer):
+            self._graph_break(".item()")
         if idx:
             return self.numpy().item(*idx)
         return self.numpy().item()
@@ -102,18 +134,6 @@ class Tensor:
         if self.ndim == 0:
             return format(self.item(), spec)
         return repr(self)
-
-    def __bool__(self):
-        return bool(self.numpy())
-
-    def __int__(self):
-        return int(self.numpy())
-
-    def __float__(self):
-        return float(self.numpy())
-
-    def __index__(self):
-        return int(self.numpy())
 
     def __hash__(self):
         return id(self)
@@ -304,6 +324,20 @@ def _check_nan_inf(name: str, out_vals, multi_output: bool) -> None:
 # a module-level hook because every op module binds `apply` by reference
 _op_observer = None
 
+# optional post-op recorder (paddle.static Program capture): called with
+# (name, fn, in_tensors, out, multi_output) after the op executed
+_op_recorder = None
+
+
+class GraphBreakError(TypeError):
+    """Data-dependent Python control flow reached a traced Tensor.
+
+    ≙ the reference SOT front end's graph-break detection
+    («python/paddle/jit/sot/», SURVEY.md §2.2): instead of silently
+    unrolling or failing deep inside XLA, the framework raises this
+    pointed error at the exact Python coercion (`if t:`, `float(t)`,
+    `int(t)`, `t.numpy()`) that cannot be compiled."""
+
 
 def apply(name: str,
           fn: Callable,
@@ -321,17 +355,23 @@ def apply(name: str,
     # SURVEY.md §3.1)
     from . import amp_state as _amp
     decision = _amp.resolve(name)
+    fn_effective = fn
     if decision is not None:
-        import numpy as _np
         from . import dtype as _dt
         low = _dt.convert_dtype(_amp.amp_state.dtype)
         if decision == "low":
-            vals = [v.astype(low) if v.dtype == jnp.float32 else v
-                    for v in vals]
+            def _cast(v):
+                return v.astype(low) if v.dtype == jnp.float32 else v
         else:
-            vals = [v.astype(jnp.float32)
-                    if v.dtype in (jnp.float16, jnp.bfloat16) else v
-                    for v in vals]
+            def _cast(v):
+                return (v.astype(jnp.float32)
+                        if v.dtype in (jnp.float16, jnp.bfloat16) else v)
+        vals = [_cast(v) for v in vals]
+
+        # the static recorder replays fn on RAW env values, so the AMP
+        # cast must be part of the recorded function — bake it in
+        def fn_effective(*vs, _fn=fn, _c=_cast):
+            return _fn(*[_c(v) for v in vs])
 
     needs_grad = is_grad_enabled() and any(
         (not t.stop_gradient) for t in tensors)
@@ -362,8 +402,12 @@ def apply(name: str,
         return t
 
     if multi_output:
-        return type(out_vals)(make(i, v) for i, v in enumerate(out_vals))
-    return make(0, out_vals)
+        out = type(out_vals)(make(i, v) for i, v in enumerate(out_vals))
+    else:
+        out = make(0, out_vals)
+    if _op_recorder is not None:
+        _op_recorder(name, fn_effective, tensors, out, multi_output)
+    return out
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:

@@ -48,7 +48,7 @@ from ...nn import initializer as I
 from ...nn.layer.layers import Layer
 
 __all__ = ["moe_gating_values", "moe_ffn_values",
-           "moe_ffn_dropless_values", "moe_ffn_dropless_ep_exact_values",
+           "moe_ffn_dropless_values", "moe_ffn_dropless_ep_values",
            "MoELayer", "shard_moe"]
 
 

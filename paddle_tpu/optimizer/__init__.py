@@ -26,8 +26,15 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
         if parameters is None:
-            raise ValueError(
-                "parameters must be provided (dygraph-style construction)")
+            # the reference's static-graph style: parameters bound later
+            # by minimize() from the recording Program's captured params
+            from ..static import _recording_program
+            if _recording_program() is None:
+                raise ValueError(
+                    "parameters must be provided (dygraph-style "
+                    "construction), or construct the optimizer inside a "
+                    "static.program_guard and call minimize(loss)")
+            parameters = []
         self._parameter_list = list(parameters)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
@@ -163,6 +170,16 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        # static mode: record the train-step intent on the active Program
+        # (Executor.run then does fwd+bwd+update in one compiled program)
+        from ..static import _recording_program
+        prog = _recording_program()
+        if prog is not None and prog._slot(loss) is not None:
+            if not self._parameter_list:
+                self._parameter_list = prog.all_parameters()
+                self._param_groups = [{"params": self._parameter_list}]
+            prog._minimize = (self, prog._slot(loss))
+            return None, None
         loss.backward()
         self.step()
         return None, None

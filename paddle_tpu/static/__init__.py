@@ -1,0 +1,564 @@
+"""paddle_tpu.static — the static-graph compatibility surface.
+
+≙ «python/paddle/static/» (Program/Executor/data/program_guard — the
+reference's largest migration surface, SURVEY.md §2.2 Static API row).
+
+TPU-native design: a `Program` is NOT a ProgramDesc/PIR graph — it is an
+op-replay record. While a `program_guard` is active, every framework op
+(all of them funnel through `core.tensor.apply`) appends (op name, the
+op's value-level function, input/output slots) to the active Program;
+`static.data` registers feed slots, and parameters are captured the
+first time an op consumes them. `Executor.run(program, feed,
+fetch_list)` then replays the op list as ONE pure function under
+`jax.jit` — the InterpreterCore + pass stack of the reference collapses
+into a single XLA compilation, and `optimizer.minimize(loss)` recorded
+in the program turns the replay into a full fwd+bwd+update train step
+(`jax.value_and_grad` over the captured parameters, optimizer update
+traced exactly like `paddle.jit.TrainStep`).
+
+Semantics notes vs the reference:
+* shapes: `static.data(shape=[None, ...])` placeholders record with the
+  unknown dims as 1; the replay re-executes the op functions on the REAL
+  feed shapes, so any batch size works (one compile per feed signature).
+* randomness: ops that drew RNG keys at construction time replay with
+  the captured keys (deterministic across `run` calls).
+* AMP lists are resolved at record time, not replay time.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import tensor as core_tensor
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["Program", "program_guard", "data", "Executor",
+           "default_main_program", "default_startup_program",
+           "name_scope", "InputSpec", "nn", "global_scope",
+           "save_inference_model", "load_inference_model", "save", "load",
+           "cpu_places", "cuda_places", "device_guard", "py_func",
+           "in_static_mode"]
+
+
+class _OpRec:
+    __slots__ = ("name", "fn", "in_refs", "out_slots", "multi")
+
+    def __init__(self, name, fn, in_refs, out_slots, multi):
+        self.name = name
+        self.fn = fn
+        self.in_refs = in_refs        # ("var", slot) | ("const", value)
+        self.out_slots = out_slots
+        self.multi = multi
+
+
+class Program:
+    """≙ paddle.static.Program — an op-replay record (see module doc)."""
+
+    def __init__(self):
+        self.ops: List[_OpRec] = []
+        self._slot_of: Dict[int, int] = {}
+        self._keep: List[Tensor] = []    # strong refs: stable ids
+        self.n_slots = 0
+        self.feeds: Dict[str, Tuple[int, tuple, str]] = {}
+        self.params: Dict[int, Parameter] = {}
+        self._init_snapshot: Dict[int, Any] = {}
+        self._minimize = None            # (optimizer, loss_slot)
+        self._paired_startup: Optional["Program"] = None
+        self._exec_cache: Dict[Any, Any] = {}
+
+    # -- slot management -----------------------------------------------
+    def _slot(self, t) -> Optional[int]:
+        return self._slot_of.get(id(t))
+
+    def _new_slot(self, t) -> int:
+        s = self.n_slots
+        self.n_slots += 1
+        self._slot_of[id(t)] = s
+        self._keep.append(t)
+        return s
+
+    def _ref_of(self, t):
+        s = self._slot(t)
+        if s is not None:
+            return ("var", s)
+        if isinstance(t, Parameter):
+            s = self._new_slot(t)
+            self.params[s] = t
+            # independent copy: the live buffer gets DONATED by the
+            # jitted train step, which would delete an aliased snapshot
+            self._init_snapshot[s] = jnp.array(t._value, copy=True)
+            return ("var", s)
+        return ("const", t._value)
+
+    # -- recording -----------------------------------------------------
+    def _record(self, name, fn, in_tensors, out, multi):
+        in_refs = [self._ref_of(t) for t in in_tensors]
+        outs = tuple(out) if multi else (out,)
+        out_slots = [self._new_slot(t) for t in outs]
+        self.ops.append(_OpRec(name, fn, in_refs, out_slots, multi))
+        self._exec_cache.clear()
+
+    # -- introspection (migration helpers) -----------------------------
+    def list_vars(self):
+        return list(self._keep)
+
+    def all_parameters(self):
+        return list(self.params.values())
+
+    def global_block(self):
+        return self
+
+    @property
+    def num_ops(self):
+        return len(self.ops)
+
+    def __repr__(self):
+        return (f"Program(ops={len(self.ops)}, feeds="
+                f"{list(self.feeds)}, params={len(self.params)})")
+
+
+# the recording stack + lazily-created defaults (enable_static installs
+# the default main program as the ambient recorder)
+_guard_stack: List[Tuple[Program, Optional[Program]]] = []
+_default_main = Program()
+_default_startup = Program()
+_static_mode = False
+
+
+def default_main_program() -> Program:
+    return _guard_stack[-1][0] if _guard_stack else _default_main
+
+
+def default_startup_program() -> Program:
+    if _guard_stack and _guard_stack[-1][1] is not None:
+        return _guard_stack[-1][1]
+    return _default_startup
+
+
+def _recording_program() -> Optional[Program]:
+    if _guard_stack:
+        return _guard_stack[-1][0]
+    if _static_mode:
+        return _default_main
+    return None
+
+
+_suspended = 0
+
+
+class _suspend_recording:
+    """Executor.run executes ops (replay + optimizer update) that must
+    NOT be re-recorded into the program."""
+
+    def __enter__(self):
+        global _suspended
+        _suspended += 1
+        return self
+
+    def __exit__(self, *a):
+        global _suspended
+        _suspended -= 1
+        return False
+
+
+def _hook(name, fn, in_tensors, out, multi):
+    if _suspended:
+        return
+    prog = _recording_program()
+    if prog is not None:
+        prog._record(name, fn, in_tensors, out, multi)
+
+
+def _sync_hook():
+    core_tensor._op_recorder = (_hook if (_guard_stack or _static_mode)
+                                else None)
+
+
+def enable_static():
+    """≙ paddle.enable_static: ops now record into
+    default_main_program() (or the innermost program_guard)."""
+    global _static_mode
+    _static_mode = True
+    _sync_hook()
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+    _sync_hook()
+
+
+def in_static_mode() -> bool:
+    return _static_mode or bool(_guard_stack)
+
+
+class program_guard:
+    """≙ paddle.static.program_guard(main, startup=None)."""
+
+    def __init__(self, main_program: Program,
+                 startup_program: Optional[Program] = None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        if self.startup is not None:
+            self.main._paired_startup = self.startup
+            self.startup._paired_main = self.main
+        _guard_stack.append((self.main, self.startup))
+        _sync_hook()
+        return self
+
+    def __exit__(self, *exc):
+        _guard_stack.pop()
+        _sync_hook()
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """≙ paddle.static.data: a feed placeholder. Unknown dims (None/-1)
+    record as 1; Executor.run replays with the real feed shapes."""
+    prog = _recording_program()
+    if prog is None:
+        raise RuntimeError(
+            "paddle.static.data() outside a static context — call "
+            "paddle.enable_static() or use static.program_guard")
+    conc = tuple(1 if (s is None or int(s) < 0) else int(s)
+                 for s in shape)
+    t = Tensor(jnp.zeros(conc, dtypes.convert_dtype(dtype)),
+               stop_gradient=True)
+    t.name = name
+    slot = prog._new_slot(t)
+    prog.feeds[name] = (slot, tuple(shape), str(dtype))
+    return t
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def global_scope():
+    return default_main_program()
+
+
+def cpu_places(device_count=None):
+    return ["cpu"]
+
+
+def cuda_places(device_ids=None):
+    return ["tpu"]
+
+
+class device_guard:
+    def __init__(self, device=None):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    raise NotImplementedError(
+        "static.py_func embeds arbitrary Python in the graph, which "
+        "cannot be compiled to XLA; wrap the computation in framework "
+        "ops or run it outside the Executor")
+
+
+class Executor:
+    """≙ paddle.static.Executor: replays a Program as one jitted XLA
+    program. run(startup) re-applies the captured parameter initial
+    values; run(main, feed, fetch_list) executes (and trains, when the
+    program recorded optimizer.minimize)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    # -- startup -------------------------------------------------------
+    def _run_startup(self, program: Program):
+        main = getattr(program, "_paired_main", None)
+        target = main if main is not None else program
+        for slot, p in target.params.items():
+            snap = target._init_snapshot.get(slot)
+            if snap is not None:
+                # a copy: the installed value will be donated by the
+                # next train step, and the snapshot must survive it
+                p._value = jnp.array(snap, copy=True)
+        return []
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy=True, **kwargs):
+        program = program if program is not None else default_main_program()
+        if not isinstance(program, Program):
+            raise TypeError(f"Executor.run expects a static.Program, got "
+                            f"{type(program)}")
+        if not program.ops:
+            return self._run_startup(program)
+        if feed is None and fetch_list is None:
+            # NEVER silently reset a trained program — the reference
+            # executes it; we need feeds to replay, so be explicit
+            raise ValueError(
+                "Executor.run on a program with ops needs feed= and "
+                "fetch_list= (run(startup_program) initializes "
+                "parameters; it is identified by having no ops)")
+
+        feed = dict(feed or {})
+        fetch_list = list(fetch_list or [])
+        fetch_slots = []
+        for f in fetch_list:
+            if isinstance(f, str):
+                if f not in program.feeds:
+                    raise KeyError(f"fetch name {f!r} is not a feed; pass "
+                                   "the Tensor variable itself")
+                fetch_slots.append(program.feeds[f][0])
+            else:
+                s = program._slot(f)
+                if s is None:
+                    raise ValueError(
+                        "fetch target was not created inside this "
+                        "Program (unknown variable)")
+                fetch_slots.append(s)
+
+        feed_names = sorted(program.feeds)
+        missing = [n for n in feed_names if n not in feed]
+        if missing:
+            raise KeyError(f"missing feeds: {missing}")
+        feed_vals = [jnp.asarray(feed[n]) for n in feed_names]
+
+        key = (len(program.ops), tuple(fetch_slots),
+               tuple((n, tuple(v.shape), str(v.dtype))
+                     for n, v in zip(feed_names, feed_vals)),
+               program._minimize is not None)
+        runner = program._exec_cache.get(key)
+        if runner is None:
+            runner = self._build(program, feed_names, fetch_slots)
+            program._exec_cache[key] = runner
+        with _suspend_recording():
+            outs = runner(feed_vals)
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+    # -- replay build --------------------------------------------------
+    def _build(self, program: Program, feed_names, fetch_slots):
+        param_slots = sorted(program.params)
+        params = [program.params[s] for s in param_slots]
+
+        def replay(env):
+            for rec in program.ops:
+                ins = [env[r[1]] if r[0] == "var" else r[1]
+                       for r in rec.in_refs]
+                out = rec.fn(*ins)
+                if rec.multi:
+                    for s, v in zip(rec.out_slots, out):
+                        env[s] = v
+                else:
+                    env[rec.out_slots[0]] = out
+            return env
+
+        def base_env(feed_vals, param_vals):
+            env: Dict[int, Any] = {}
+            for n, v in zip(feed_names, feed_vals):
+                env[program.feeds[n][0]] = v
+            for s, v in zip(param_slots, param_vals):
+                env[s] = v
+            return env
+
+        if program._minimize is None:
+            def pure(feed_vals, param_vals):
+                env = replay(base_env(feed_vals, param_vals))
+                return [env[s] for s in fetch_slots]
+            jitted = jax.jit(pure)
+
+            def runner(feed_vals):
+                pv = [p._value for p in params]
+                return jitted(feed_vals, pv)
+            return runner
+
+        opt, loss_slot = program._minimize
+        opt.ensure_state()
+
+        def acc_trees():
+            acc = {name: {i: store[id(p)]
+                          for i, p in enumerate(params) if id(p) in store}
+                   for name, store in opt._accumulators.items()}
+            master = {i: opt._master_weights[id(p)]
+                      for i, p in enumerate(params)
+                      if id(p) in opt._master_weights}
+            return acc, master
+
+        def pure(feed_vals, param_vals, acc, master, lr, step_count):
+            def loss_of(pv):
+                env = replay(base_env(feed_vals, pv))
+                return env[loss_slot].astype(jnp.float32), env
+
+            (loss, env), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(param_vals)
+            old_state = [(p._value, p.grad) for p in params]
+            # restore ALL python-side optimizer state in finally: an
+            # aborted trace must not leak tracers into the optimizer
+            # (same failure mode jit.TrainStep guards against)
+            old_acc = opt._accumulators
+            old_master = opt._master_weights
+            old_step = opt._step_count
+            old_get_lr = opt.get_lr
+            try:
+                for p, v, g in zip(params, param_vals, grads):
+                    p._value = v
+                    p.grad = Tensor(g)
+                opt._accumulators = {
+                    name: {id(params[i]): arr for i, arr in store.items()}
+                    for name, store in acc.items()}
+                opt._master_weights = {
+                    id(params[i]): arr for i, arr in master.items()}
+                opt._step_count = step_count
+                opt.get_lr = lambda: lr
+                opt.step()
+                new_params = [p._value for p in params]
+                new_acc = {
+                    name: {i: store[id(params[i])]
+                           for i in range(len(params))
+                           if id(params[i]) in store}
+                    for name, store in opt._accumulators.items()}
+                new_master = {i: opt._master_weights[id(params[i])]
+                              for i in range(len(params))
+                              if id(params[i]) in opt._master_weights}
+            finally:
+                for p, (v, g) in zip(params, old_state):
+                    p._value = v
+                    p.grad = g
+                opt._accumulators = old_acc
+                opt._master_weights = old_master
+                opt._step_count = old_step
+                opt.get_lr = old_get_lr
+            return ([env[s] for s in fetch_slots], new_params, new_acc,
+                    new_master)
+
+        jitted = jax.jit(pure, donate_argnums=(1, 2, 3))
+
+        def runner(feed_vals):
+            acc, master = acc_trees()
+            lr = np.float32(opt.get_lr())
+            outs, new_p, new_acc, new_master = jitted(
+                feed_vals, [p._value for p in params], acc, master, lr,
+                np.int32(opt._step_count))
+            for p, v in zip(params, new_p):
+                p._value = v
+                p.grad = None
+            for name, store in new_acc.items():
+                opt._accumulators[name] = {
+                    id(params[i]): arr for i, arr in store.items()}
+            opt._master_weights = {
+                id(params[i]): arr for i, arr in new_master.items()}
+            opt._step_count += 1
+            return outs
+        return runner
+
+
+# -- static.nn ---------------------------------------------------------
+def _keep_layer(layer):
+    """Pin a construction-time layer on the active Program so its
+    parameters outlive the guard (and return it)."""
+    prog = _recording_program()
+    if prog is not None:
+        if not hasattr(prog, "_layers"):
+            prog._layers = []
+        prog._layers.append(layer)
+    return layer
+
+
+class _StaticNN:
+    """≙ paddle.static.nn — the construction-time layer helpers. Each
+    call creates real parameters (kept alive on the active Program) and
+    records the ops like any eager layer call."""
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims=1, activation=None, name=None,
+           weight_attr=None, bias_attr=None):
+        from .. import nn as _nn
+        in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+        layer = _keep_layer(_nn.Linear(in_dim, size))
+        xin = x
+        if len(x.shape) > num_flatten_dims + 1:
+            xin = x.reshape(list(x.shape[:num_flatten_dims]) + [in_dim])
+        out = layer(xin)
+        if activation:
+            from ..nn import functional as F
+            out = getattr(F, activation)(out)
+        return out
+
+    @staticmethod
+    def batch_norm(x, *a, **k):
+        from .. import nn as _nn
+        return _keep_layer(_nn.BatchNorm(int(x.shape[1])))(x)
+
+    @staticmethod
+    def embedding(x, size, name=None, **k):
+        from .. import nn as _nn
+        return _keep_layer(_nn.Embedding(size[0], size[1]))(x)
+
+
+nn = _StaticNN()
+
+
+# -- save/load (param-level; ≙ static.save/static.load) ----------------
+def save(program: Program, model_path: str, protocol=4):
+    from ..framework import io as fio
+    state = {f"param_{s}": p for s, p in sorted(program.params.items())}
+    fio.save(state, model_path + ".pdparams")
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    from ..framework import io as fio
+    state = fio.load(model_path + ".pdparams")
+    for s, p in sorted(program.params.items()):
+        t = state.get(f"param_{s}")
+        if t is not None:
+            p._value = (t._value if isinstance(t, Tensor)
+                        else jnp.asarray(t)).astype(p._value.dtype)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program: Optional[Program] = None, **kwargs):
+    """≙ paddle.static.save_inference_model: parameters + the replay
+    metadata needed for load_inference_model in this process family
+    (cross-language serving goes through paddle.jit.save/StableHLO)."""
+    import pickle
+    program = program if program is not None else default_main_program()
+    from ..framework import io as fio
+    state = {f"param_{s}": p for s, p in sorted(program.params.items())}
+    fio.save(state, path_prefix + ".pdiparams")
+    meta = {
+        "feeds": [getattr(v, "name", None) for v in feed_vars],
+        "fetch_slots": [program._slot(v) for v in fetch_vars],
+    }
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    import pickle
+    from ..framework import io as fio
+    state = fio.load(path_prefix + ".pdiparams")
+    with open(path_prefix + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    return state, meta["feeds"], meta["fetch_slots"]

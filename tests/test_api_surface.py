@@ -65,6 +65,11 @@ SURFACE = {
         "Compose", "Resize", "ColorJitter", "RandomResizedCrop",
         "RandomErasing", "adjust_brightness",
     ],
+    "paddle_tpu.static": [
+        "Program", "program_guard", "data", "Executor",
+        "default_main_program", "default_startup_program", "nn",
+        "save_inference_model",
+    ],
     "paddle_tpu.text": [
         "BPETokenizer", "ByteTokenizer", "viterbi_decode",
         "ViterbiDecoder", "LMBlockDataset",

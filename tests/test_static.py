@@ -1,0 +1,217 @@
+"""paddle.static compatibility surface (VERDICT r3 missing #2 / next #7).
+
+The migration oracle: reference-style static training scripts run
+verbatim against the op-replay Program + jitted Executor, and converge
+like their eager equivalents. Graph-break detection gets its own tier:
+data-dependent Python control flow inside a compiled region must raise
+the pointed GraphBreakError, not a cryptic tracer leak.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import GraphBreakError
+
+
+@pytest.fixture(autouse=True)
+def _leave_dynamic():
+    yield
+    paddle.disable_static()
+
+
+class TestStaticMigrationScript:
+    def test_reference_style_regression_script(self):
+        """The canonical paddle 2.x static linear-regression script."""
+        paddle.enable_static()
+        assert not paddle.in_dynamic_mode()
+
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data(name="x", shape=[None, 13],
+                                   dtype="float32")
+            y = paddle.static.data(name="y", shape=[None, 1],
+                                   dtype="float32")
+            hidden = paddle.static.nn.fc(x, size=32, activation="relu")
+            pred = paddle.static.nn.fc(hidden, size=1)
+            loss = paddle.mean(
+                paddle.nn.functional.square_error_cost(pred, y))
+            opt = paddle.optimizer.SGD(learning_rate=0.05)
+            opt.minimize(loss)
+
+        exe = paddle.static.Executor(paddle.CPUPlace())
+        exe.run(startup)
+
+        rng = np.random.default_rng(0)
+        w_true = rng.normal(size=(13, 1)).astype(np.float32)
+        losses = []
+        for i in range(30):
+            xb = rng.normal(size=(16, 13)).astype(np.float32)
+            yb = xb @ w_true
+            (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                            fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < 0.25 * losses[0], (losses[0], losses[-1])
+        paddle.disable_static()
+        assert paddle.in_dynamic_mode()
+
+    def test_variable_batch_size_replays(self):
+        """shape=[None, d] placeholders: the same program serves any
+        batch size (one compile per signature)."""
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data(name="x", shape=[None, 4],
+                                   dtype="float32")
+            out = (x * 2.0 + 1.0).sum(axis=1)
+        exe = paddle.static.Executor()
+        for b in (1, 3, 8):
+            xb = np.ones((b, 4), np.float32)
+            (ov,) = exe.run(main, feed={"x": xb}, fetch_list=[out])
+            np.testing.assert_allclose(ov, np.full((b,), 12.0), rtol=1e-6)
+
+    def test_startup_rerun_resets_parameters(self):
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data(name="x", shape=[None, 8],
+                                   dtype="float32")
+            y = paddle.static.data(name="y", shape=[None, 1],
+                                   dtype="float32")
+            pred = paddle.static.nn.fc(x, size=1)
+            loss = paddle.mean((pred - y) ** 2)
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        w0 = [np.asarray(p._value).copy()
+              for p in main.all_parameters()]
+        rng = np.random.default_rng(1)
+        for _ in range(5):
+            xb = rng.normal(size=(8, 8)).astype(np.float32)
+            exe.run(main, feed={"x": xb, "y": xb[:, :1]},
+                    fetch_list=[loss])
+        changed = any(
+            not np.array_equal(np.asarray(p._value), w)
+            for p, w in zip(main.all_parameters(), w0))
+        assert changed
+        exe.run(startup)                       # reset to init snapshot
+        for p, w in zip(main.all_parameters(), w0):
+            np.testing.assert_array_equal(np.asarray(p._value), w)
+
+    def test_eager_layer_inside_program(self):
+        """paddle.nn layers built inside program_guard record like
+        static.nn helpers (the real migration path)."""
+        from paddle_tpu import nn
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data(name="x", shape=[None, 6],
+                                   dtype="float32")
+            net = nn.Sequential(nn.Linear(6, 16), nn.ReLU(),
+                                nn.Linear(16, 2))
+            out = net(x)
+        exe = paddle.static.Executor()
+        xb = np.random.default_rng(2).normal(size=(4, 6)) \
+            .astype(np.float32)
+        (ov,) = exe.run(main, feed={"x": xb}, fetch_list=[out])
+        net_eager = net(paddle.to_tensor(xb))
+        np.testing.assert_allclose(ov, np.asarray(net_eager._value),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data(name="x", shape=[None, 4],
+                                   dtype="float32")
+            out = paddle.static.nn.fc(x, size=3)
+        p = str(tmp_path / "model")
+        paddle.static.save(main, p)
+        w_before = np.asarray(main.all_parameters()[0]._value).copy()
+        main.all_parameters()[0]._value = \
+            main.all_parameters()[0]._value * 0
+        paddle.static.load(main, p)
+        np.testing.assert_array_equal(
+            np.asarray(main.all_parameters()[0]._value), w_before)
+
+
+class TestExecutorGuards:
+    def test_run_trained_program_without_feed_raises(self):
+        """Never silently reset a trained program (round-4 review)."""
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data(name="x", shape=[None, 4],
+                                   dtype="float32")
+            _ = paddle.static.nn.fc(x, size=2)
+        exe = paddle.static.Executor()
+        with pytest.raises(ValueError, match="feed"):
+            exe.run(main)
+
+    def test_amp_casts_survive_replay(self):
+        """Ops recorded under auto_cast must replay with the same casts
+        (the recorded fn bakes the AMP decision in)."""
+        from paddle_tpu import amp
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data(name="x", shape=[None, 8],
+                                   dtype="float32")
+            with amp.auto_cast(level="O1", dtype="bfloat16"):
+                y = paddle.matmul(x, paddle.to_tensor(
+                    np.eye(8, dtype=np.float32)))
+        exe = paddle.static.Executor()
+        xb = (np.arange(16, dtype=np.float32).reshape(2, 8)
+              + 0.00390625 / 3)     # sub-bf16-precision offset
+        (ov,) = exe.run(main, feed={"x": xb}, fetch_list=[y])
+        # bf16 rounding must be visible in the replayed output
+        import jax.numpy as jnp
+        want = np.asarray(
+            jnp.asarray(xb).astype(jnp.bfloat16).astype(jnp.float32))
+        np.testing.assert_allclose(ov, want, rtol=1e-6)
+
+
+class TestGraphBreakContract:
+    def test_bool_on_traced_tensor_raises_pointed_error(self):
+        import paddle_tpu.jit as jit
+
+        def f(x):
+            if x.sum() > 0:          # data-dependent Python branch
+                return x * 2
+            return x
+
+        sf = jit.to_static(f)
+        with pytest.raises(GraphBreakError, match="graph break"):
+            sf(paddle.to_tensor(np.ones(4, np.float32)))
+
+    def test_float_int_item_on_traced_tensor(self):
+        import paddle_tpu.jit as jit
+        for coerce in (float, int, lambda t: t.item()):
+            def f(x, c=coerce):
+                _ = c(x.sum())
+                return x
+
+            with pytest.raises(GraphBreakError):
+                jit.to_static(f)(paddle.to_tensor(
+                    np.ones(3, np.float32)))
+
+    def test_eager_coercions_still_work(self):
+        t = paddle.to_tensor(np.float32(2.5))
+        assert float(t) == 2.5
+        assert int(t) == 2
+        assert bool(paddle.to_tensor(True))
+        assert t.item() == 2.5
+
+    def test_trainstep_graph_break_is_pointed(self):
+        from paddle_tpu import nn
+        from paddle_tpu.optimizer import SGD
+        model = nn.Linear(4, 2)
+        opt = SGD(learning_rate=0.1, parameters=model.parameters())
+
+        def loss_fn(m, x, y):
+            out = m(x)
+            if out.mean() > 0:       # trace-burning branch
+                return (out ** 2).mean()
+            return out.mean()
+
+        step = paddle.jit.TrainStep(model, opt, loss_fn=loss_fn)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        with pytest.raises(Exception) as ei:
+            step(x, x)
+        assert "graph break" in str(ei.value).lower() or \
+            isinstance(ei.value, GraphBreakError)
