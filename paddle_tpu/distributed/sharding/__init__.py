@@ -29,7 +29,15 @@ def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
     level: 'os' (stage 1), 'os_g' (stage 2), 'p_g_os' (stage 3) — all map
     to sharding the parameters over the `axis` mesh axis; optimizer state
     and grads inherit the placement inside the compiled step.
+
+    Placement report: parameters that could not be sharded (no dim
+    divisible by the axis size, or every divisible dim already taken by
+    another axis) are NOT silent — they are collected on
+    `model._group_sharded_skipped` (list of (name, shape, reason)) and a
+    summary warning fires when any parameter stayed replicated.
     """
+    import warnings
+
     from ..mesh import Replicate, Shard, get_mesh, shard_tensor
 
     mesh = get_mesh()
@@ -38,8 +46,15 @@ def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
         return model, optimizer, scaler
 
     n = mesh.get_dim_size(axis)
-    for p in model.parameters():
+    skipped = []
+    named = getattr(model, "named_parameters", None)
+    params = (list(named()) if callable(named)
+              else [(f"param_{i}", p)
+                    for i, p in enumerate(model.parameters())])
+    for name, p in params:
+        shape = tuple(p._value.shape)
         if p._value.ndim == 0:
+            skipped.append((name, shape, "0-d parameter"))
             continue
         # shard the largest divisible dim over the sharding axis
         dims = sorted(range(p._value.ndim),
@@ -47,23 +62,36 @@ def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
         target = next((d for d in dims if p._value.shape[d] % n == 0),
                       None)
         if target is None:
+            skipped.append((name, shape,
+                            f"no dim divisible by {axis}={n}"))
             continue
         existing = getattr(p, "dist_attr", None)
         placements = (list(existing[1]) if existing
                       else [Replicate() for _ in mesh.dim_names])
         ax_i = mesh.dim_names.index(axis)
         if not isinstance(placements[ax_i], Replicate):
-            continue  # already placed on this axis
+            continue  # already placed on this axis (not a skip)
         taken = {pl.dim for pl in placements if isinstance(pl, Shard)}
         if target in taken:
             target = next((d for d in dims if p._value.shape[d] % n == 0
                            and d not in taken), None)
             if target is None:
+                skipped.append((name, shape,
+                                "all divisible dims taken by other "
+                                "mesh axes"))
                 continue
         placements[ax_i] = Shard(target)
         s = shard_tensor(p, mesh, placements)
         p._value = s._value
         p.dist_attr = s.dist_attr
+    model._group_sharded_skipped = skipped
+    if skipped:
+        warnings.warn(
+            f"group_sharded_parallel: {len(skipped)} parameter(s) stayed "
+            f"replicated on '{axis}' (see model._group_sharded_skipped): "
+            + "; ".join(f"{nm} {sh}: {why}"
+                        for nm, sh, why in skipped[:3])
+            + ("..." if len(skipped) > 3 else ""))
     return model, optimizer, scaler
 
 

@@ -187,6 +187,9 @@ class TrainStep:
             old_p = [p._value for p in params]
             old_g = [p.grad for p in params]
             old_b = [b._value for b in buffers]
+            old_acc = opt._accumulators if opt is not None else None
+            old_master = opt._master_weights if opt is not None else None
+            old_step = opt._step_count if opt is not None else None
             try:
                 for p, v in zip(params, param_vals):
                     p._value = v
@@ -279,6 +282,13 @@ class TrainStep:
                     p.grad = g
                 for b, v in zip(buffers, old_b):
                     b._value = v
+                if opt is not None:
+                    # restore python-side optimizer state: tracing (e.g.
+                    # memory_analysis, or an aborted trace) must not leak
+                    # tracers into _accumulators/_step_count
+                    opt._accumulators = old_acc
+                    opt._master_weights = old_master
+                    opt._step_count = old_step
 
         donate = (0, 2, 3) if self.donate else ()
         return jax.jit(pure, donate_argnums=donate)
@@ -346,6 +356,27 @@ class TrainStep:
         if self.optimizer is not None:
             self.optimizer.ensure_state()
         self._jitted = self._make_pure()
+
+    def memory_analysis(self, *args):
+        """XLA buffer-assignment sizes for THIS train step at the given
+        example inputs (utils.memory.compiled_memory_stats over the same
+        pure function __call__ runs): the per-step HBM accounting that
+        defends remat/ZeRO/pipeline memory claims. ≙ the reference's
+        `max_memory_allocated` + StatAllocator observability (SURVEY.md
+        §5), but ahead-of-time and exact."""
+        if self._jitted is None:
+            self._warmup(*args)
+        opt = self.optimizer
+        acc, master = self._materialize_state()
+        lr = np.float32(opt.get_lr()) if opt else np.float32(0.0)
+        arg_vals = _tensors_to_values(list(args))
+        lowered = self._jitted.lower(
+            [p._value for p in self._params],
+            [b._value for b in self._buffers],
+            acc, master, default_generator._key, lr,
+            np.int32(opt._step_count if opt else 0), arg_vals)
+        from ..utils.memory import analysis_dict
+        return analysis_dict(lowered.compile().memory_analysis())
 
 
 def save(layer, path, input_spec=None, **configs):

@@ -290,6 +290,13 @@ class LlamaForCausalLMPipe(nn.Layer):
                     return _layer_values(
                         lp, act, cs, sn, cfg, cfg.num_attention_heads,
                         cfg.num_key_value_heads, None), None
+                if cfg.recompute and self.training:
+                    # scan-form remat: residuals shrink from every wide
+                    # per-layer intermediate to just the (L, B, S, H)
+                    # layer inputs — structural in the jaxpr, so it
+                    # holds on every backend (unlike loop-form remat,
+                    # which XLA:CPU CSE can undo)
+                    body = jax.checkpoint(body)
                 x, _ = jax.lax.scan(body, x, params)
             return x
 

@@ -133,6 +133,24 @@ class Profiler:
         self._last_export_dir = None
         self._step_times: list[float] = []
         self._t_last = None
+        # per-step HBM accounting (≙ StatAllocator / max_memory_allocated,
+        # SURVEY.md §5): sample the live allocator counters at every
+        # step() boundary; empty on backends without memory_stats (CPU)
+        self._profile_memory = profile_memory
+        self._mem_samples: list[dict] = []
+
+    def _sample_memory(self):
+        if not self._profile_memory:
+            return
+        try:
+            st = jax.devices()[0].memory_stats() or {}
+        except Exception:
+            st = {}
+        self._mem_samples.append({
+            "step": self.step_num,
+            "bytes_in_use": st.get("bytes_in_use", 0),
+            "peak_bytes_in_use": st.get("peak_bytes_in_use", 0),
+        })
 
     def start(self):
         self._t_last = time.perf_counter()
@@ -150,6 +168,7 @@ class Profiler:
         if self._t_last is not None:
             self._step_times.append(now - self._t_last)
         self._t_last = now
+        self._sample_memory()
         self.step_num += 1
         self._transition(self._scheduler(self.step_num))
 
@@ -201,6 +220,18 @@ class Profiler:
             lines.append(
                 f"steps: {len(st)}  avg step: {1e3 * sum(st) / len(st):.3f} "
                 f"ms  min: {1e3 * min(st):.3f}  max: {1e3 * max(st):.3f}")
+        if self._mem_samples and any(
+                s["peak_bytes_in_use"] for s in self._mem_samples):
+            peak = max(s["peak_bytes_in_use"] for s in self._mem_samples)
+            last = self._mem_samples[-1]["bytes_in_use"]
+            lines.append(
+                f"device memory: peak {peak / 2**20:.1f} MiB, "
+                f"in-use (last step) {last / 2**20:.1f} MiB "
+                f"({len(self._mem_samples)} samples)")
+        elif self._profile_memory:
+            lines.append("device memory: allocator stats unavailable on "
+                         "this backend (use utils.memory."
+                         "compiled_memory_stats for AOT numbers)")
         if self._trace_dir:
             lines.append(f"device trace (XPlane): {self._trace_dir} — view "
                          f"with TensorBoard or Perfetto")
