@@ -190,6 +190,7 @@ class TrainStep:
             old_acc = opt._accumulators if opt is not None else None
             old_master = opt._master_weights if opt is not None else None
             old_step = opt._step_count if opt is not None else None
+            old_get_lr = opt.get_lr if opt is not None else None
             try:
                 for p, v in zip(params, param_vals):
                     p._value = v
@@ -205,7 +206,6 @@ class TrainStep:
                     opt._master_weights = {
                         id(params[i]): arr for i, arr in master_list.items()}
                     opt._step_count = step_count
-                    opt_get_lr = opt.get_lr
                     opt.get_lr = lambda: lr
                 args = jax.tree_util.tree_map(Tensor, arg_vals)
                 k = self.accumulate_steps
@@ -258,7 +258,6 @@ class TrainStep:
                     loss, aux = run_micro(args)
                 if opt is not None:
                     opt.step()
-                    opt.get_lr = opt_get_lr
                 new_params = [p._value for p in params]
                 new_bufs = [b._value for b in buffers]
                 new_acc = {
@@ -285,10 +284,11 @@ class TrainStep:
                 if opt is not None:
                     # restore python-side optimizer state: tracing (e.g.
                     # memory_analysis, or an aborted trace) must not leak
-                    # tracers into _accumulators/_step_count
+                    # tracers into _accumulators/_step_count/get_lr
                     opt._accumulators = old_acc
                     opt._master_weights = old_master
                     opt._step_count = old_step
+                    opt.get_lr = old_get_lr
 
         donate = (0, 2, 3) if self.donate else ()
         return jax.jit(pure, donate_argnums=donate)

@@ -86,8 +86,15 @@ class GenerationMixin:
         buffers = list(self.buffers())
         key = default_generator.next_key()
 
+        # the cached closure binds the param/buffer LISTS positionally,
+        # so any structural change (e.g. weight-only quantization swaps
+        # Linear params for int8 buffers) must invalidate it
+        struct = (tuple((tuple(p.shape), str(p.dtype)) for p in params),
+                  tuple((tuple(bu.shape), str(bu.dtype))
+                        for bu in buffers))
         sig = (b, prompt_len, n_new, cache_len, decode_strategy,
-               float(temperature), int(top_k), float(top_p), eos_token_id)
+               float(temperature), int(top_k), float(top_p), eos_token_id,
+               struct)
         cache = getattr(self, "_generate_cache", None)
         if cache is None or cache[0] != sig:
             jitted = self._build_generate(sig)
@@ -102,7 +109,7 @@ class GenerationMixin:
 
     def _build_generate(self, sig):
         (b, prompt_len, n_new, cache_len, strategy, temperature, top_k,
-         top_p, eos_token_id) = sig
+         top_p, eos_token_id, _struct) = sig
         cfg = self.config
         params = list(self.parameters())
         buffers = list(self.buffers())

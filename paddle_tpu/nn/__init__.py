@@ -1,6 +1,7 @@
 """paddle_tpu.nn — layers + functional. ≙ reference «python/paddle/nn/» [U]."""
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import quant  # noqa: F401
 from .layer import *  # noqa: F401,F403
 from .layer.layers import (Layer, Sequential, LayerList, LayerDict,  # noqa: F401
                            ParameterList)

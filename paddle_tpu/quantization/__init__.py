@@ -20,7 +20,8 @@ from ..nn.layer.layers import Layer
 
 __all__ = ["fake_quant", "quantize_linear", "dequantize_linear",
            "AbsmaxObserver", "EMAObserver", "FakeQuanterWithAbsMax",
-           "QuantConfig", "QAT", "PTQ", "QuantedLinear"]
+           "QuantConfig", "QAT", "PTQ", "QuantedLinear",
+           "WeightOnlyLinear", "quantize_model_weight_only"]
 
 
 def _ste_round(x):
@@ -157,6 +158,22 @@ class QuantedLinear(Layer):
 
     def forward(self, x):
         from ..nn import functional as F
+        if self._converted == "w8a8":
+            # MXU-native int8 execution: dynamic per-tensor activation
+            # scale, per-channel weight scale, int8xint8->int32 dot
+            from ..nn.quant import (int8_dot_values,
+                                    quantize_activation_dynamic_values)
+            iw, ws = self._int_weight, self._w_scale
+            bias = self.linear.bias
+
+            def fn(xv, wv, sv, *b):
+                xq, xs = quantize_activation_dynamic_values(xv)
+                out = int8_dot_values(xq, wv, xs, sv)
+                if b:
+                    out = out + b[0].astype(out.dtype)
+                return out.astype(xv.dtype)
+            args = (x, iw, ws) + ((bias,) if bias is not None else ())
+            return apply("quanted_linear_w8a8", fn, args)
         if self._converted:
             wq = dequantize_linear(self._int_weight, self._w_scale,
                                    axis=1)
@@ -165,14 +182,93 @@ class QuantedLinear(Layer):
         wq = self.weight_quanter(self.linear.weight)
         return F.linear(xq, wq, self.linear.bias)
 
-    def convert(self):
-        """Freeze: int8 weights + per-channel scales."""
+    def convert(self, mode: str = "dequant"):
+        """Freeze: int8 weights + per-channel scales.
+
+        mode='dequant' — weights stored int8, dequantized into the fp
+        matmul (weight-only memory win). mode='w8a8' — activations
+        dynamically quantized per call and the matmul runs on the MXU's
+        int8 path (2x-peak on TPU; ≙ the cuBLASLt int8 fused linear)."""
+        if mode not in ("dequant", "w8a8"):
+            raise ValueError(f"unknown convert mode {mode!r}")
         w = self.linear.weight
         scale = jnp.max(jnp.abs(w._value), axis=0)
-        self._w_scale = Tensor(scale)
+        self._w_scale = Tensor(scale.astype(jnp.float32))
         self._int_weight = quantize_linear(w, self._w_scale, axis=1)
-        self._converted = True
+        self._converted = mode
         return self
+
+
+class WeightOnlyLinear(Layer):
+    """Serving-path Linear with int8/int4 weights in HBM, dequantized on
+    the fly into the bf16 matmul (≙ paddle.nn.quant weight-only path for
+    LLM decode — HBM-bandwidth-bound, so 1/2 or 1/4 the weight bytes is
+    a direct decode speedup)."""
+
+    def __init__(self, linear, weight_dtype: str = "int8",
+                 group_size: int = -1):
+        super().__init__()
+        from ..nn.quant import weight_quantize_values
+        self.weight_dtype = weight_dtype
+        self.group_size = group_size
+        self._algo = f"weight_only_{weight_dtype}"
+        qw, sc = weight_quantize_values(
+            linear.weight._value, self._algo, group_size)
+        self.register_buffer("quant_weight", Tensor(qw))
+        self.register_buffer("weight_scale", Tensor(sc))
+        self.bias = linear.bias
+        self.in_features = linear.weight.shape[0]
+        self.out_features = linear.weight.shape[1]
+
+    def forward(self, x):
+        from ..nn.quant import weight_only_linear
+        return weight_only_linear(
+            x, self.quant_weight, bias=self.bias,
+            weight_scale=self.weight_scale,
+            weight_dtype=self.weight_dtype, group_size=self.group_size)
+
+
+def quantize_model_weight_only(model, weight_dtype: str = "int8",
+                               group_size: int = -1, exclude=()):
+    """Swap every nn.Linear in `model` for a WeightOnlyLinear (the LLM
+    serving conversion; pass e.g. exclude=('lm_head',) to keep the
+    output head in full precision). Returns the model, modified in
+    place. Layers that cannot be quantized (odd in-features for int4,
+    in-features not divisible by group_size) are left in fp, collected
+    on `model._weight_only_skipped`, and warned about — never a
+    mid-walk crash with a half-converted model."""
+    import warnings
+
+    from ..nn import Linear
+    skipped = []
+    for parent in model.sublayers(include_self=True):
+        for name, sub in list(parent._sub_layers.items()):
+            if not isinstance(sub, Linear) or name in exclude:
+                continue
+            k = sub.weight.shape[0]
+            if weight_dtype == "int4" and k % 2:
+                skipped.append((name, tuple(sub.weight.shape),
+                                "odd in-features for int4 packing"))
+                continue
+            if group_size not in (-1, None) and k % int(group_size):
+                skipped.append((name, tuple(sub.weight.shape),
+                                f"in-features not divisible by "
+                                f"group_size={group_size}"))
+                continue
+            # setattr, not _sub_layers[name]=...: sublayers also live in
+            # the instance __dict__, and attribute-access forwards
+            # (self.q_proj(x)) would otherwise keep the stale fp layer
+            setattr(parent, name, WeightOnlyLinear(sub, weight_dtype,
+                                                   group_size))
+    model._weight_only_skipped = skipped
+    if skipped:
+        warnings.warn(
+            f"quantize_model_weight_only: {len(skipped)} layer(s) left "
+            "in fp (see model._weight_only_skipped): "
+            + "; ".join(f"{nm} {sh}: {why}"
+                        for nm, sh, why in skipped[:3])
+            + ("..." if len(skipped) > 3 else ""))
+    return model
 
 
 class QuantConfig:
@@ -195,7 +291,9 @@ def _swap_linears(model, fn):
     for parent in model.sublayers(include_self=True):
         for name, sub in list(parent._sub_layers.items()):
             if isinstance(sub, Linear):
-                parent._sub_layers[name] = fn(sub)
+                # setattr keeps _sub_layers and the instance __dict__ in
+                # sync (attribute-access forwards see the new layer)
+                setattr(parent, name, fn(sub))
     return model
 
 
@@ -251,5 +349,5 @@ class PTQ:
         for parent in model.sublayers(include_self=True):
             for name, sub in list(parent._sub_layers.items()):
                 if sub.__class__.__name__ == "_ObservedLinear":
-                    parent._sub_layers[name] = conv(sub)
+                    setattr(parent, name, conv(sub))
         return model

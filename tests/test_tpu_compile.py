@@ -176,3 +176,62 @@ class TestGroupedMatmulCompile:
         rhs = jnp.zeros((8, BENCH_HIDDEN, 2816), jnp.bfloat16)
         sizes = jnp.full((8,), 512, jnp.int32)
         _compile(gmm_pallas, lhs, rhs, sizes)
+
+
+class TestInt8MXUCompile:
+    """Round-4: the W8A8 path must hit the MXU's native int8 mode on
+    the real chip (VERDICT r3 #4), and be FASTER than bf16 at a
+    serving-ish shape."""
+
+    def test_int8_dot_compiles_and_runs(self):
+        from paddle_tpu.nn.quant import (int8_dot_values,
+                                         quantize_activation_dynamic_values)
+
+        x = jnp.zeros((BENCH_ROWS // 4, BENCH_HIDDEN), jnp.bfloat16)
+        w8 = jnp.zeros((BENCH_HIDDEN, 4 * BENCH_HIDDEN), jnp.int8)
+        ws = jnp.ones((4 * BENCH_HIDDEN,), jnp.float32)
+
+        def f(xv):
+            xq, xs = quantize_activation_dynamic_values(xv)
+            return int8_dot_values(xq, w8, xs, ws)
+        _compile(f, x)
+
+    def test_weight_only_int8_decode_shape(self):
+        from paddle_tpu.nn.quant import (weight_only_linear_values,
+                                         weight_quantize_values)
+
+        w = jnp.ones((BENCH_HIDDEN, 4 * BENCH_HIDDEN), jnp.float32)
+        qw, sc = weight_quantize_values(w)
+        x = jnp.zeros((BENCH_B, 1, BENCH_HIDDEN), jnp.bfloat16)  # decode
+        _compile(lambda xv: weight_only_linear_values(
+            xv.reshape(-1, BENCH_HIDDEN), qw, sc), x)
+
+    def test_int8_faster_than_bf16_at_large_shape(self):
+        """Measured on-chip speedup check (soft: asserts not slower than
+        0.9x; records the ratio in the output for the round notes)."""
+        import time
+
+        m, k, n = 4096, 4096, 4096
+        xb = jnp.ones((m, k), jnp.bfloat16)
+        wb = jnp.ones((k, n), jnp.bfloat16)
+        x8 = jnp.ones((m, k), jnp.int8)
+        w8 = jnp.ones((k, n), jnp.int8)
+
+        f_bf = jax.jit(lambda a, b: a @ b)
+        f_i8 = jax.jit(lambda a, b: jax.lax.dot_general(
+            a, b, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32))
+
+        def timeit(f, a, b):
+            jax.device_get(f(a, b))          # compile + warm
+            t0 = time.perf_counter()
+            for _ in range(10):
+                r = f(a, b)
+            jax.device_get(r)
+            return (time.perf_counter() - t0) / 10
+
+        t_bf = timeit(f_bf, xb, wb)
+        t_i8 = timeit(f_i8, x8, w8)
+        print(f"\nint8 vs bf16 matmul {m}x{k}x{n}: bf16 {t_bf*1e3:.3f} "
+              f"ms, int8 {t_i8*1e3:.3f} ms ({t_bf/t_i8:.2f}x)")
+        assert t_i8 < t_bf / 0.9, (t_i8, t_bf)
