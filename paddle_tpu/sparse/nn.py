@@ -1,0 +1,345 @@
+"""paddle_tpu.sparse.nn — sparse layers + functional.
+
+≙ reference «python/paddle/sparse/nn/» (ReLU/Softmax layers, sparse
+attention, Conv3D/SubmConv3D, BatchNorm, MaxPool3D). See the package
+docstring for the dense-backed-conv design note.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, apply
+from ..nn.layer.layers import Layer
+
+__all__ = ["functional", "ReLU", "ReLU6", "LeakyReLU", "Softmax",
+           "Conv3D", "SubmConv3D", "BatchNorm", "MaxPool3D"]
+
+
+class functional:
+    """paddle.sparse.nn.functional."""
+
+    @staticmethod
+    def relu(x, name=None):
+        from . import relu as _relu
+        return _relu(x)
+
+    @staticmethod
+    def relu6(x, name=None):
+        from . import _unary
+        return _unary("relu6", lambda v: jnp.clip(v, 0, 6))(x)
+
+    @staticmethod
+    def leaky_relu(x, negative_slope=0.01, name=None):
+        from . import _unary
+        return _unary("leaky_relu",
+                      lambda v: jnp.where(v > 0, v,
+                                          negative_slope * v))(x)
+
+    @staticmethod
+    def softmax(x, axis=-1, name=None):
+        """Row-wise softmax over the LAST sparse dim's stored entries
+        (≙ paddle.sparse.nn.functional.softmax on 2-D CSR/COO: absent
+        entries are -inf, i.e. excluded). Differentiable in values."""
+        from . import SparseCooTensor, SparseCsrTensor, _coo
+        if axis not in (-1, len(x.shape) - 1):
+            raise ValueError("sparse softmax supports the last axis")
+        c = _coo(x)
+        nd = len(c._shape)
+        # segment = all leading dims flattened (a 'row')
+        if nd == 1:
+            seg = jnp.zeros((c.nnz(),), jnp.int32)
+            n_seg = 1
+        else:
+            lead = np.asarray(c._indices[:, :nd - 1])
+            sizes = c._shape[:nd - 1]
+            seg = jnp.asarray(np.ravel_multi_index(
+                tuple(lead[:, d] for d in range(nd - 1)), sizes),
+                jnp.int32)
+            n_seg = int(np.prod(sizes))
+
+        def fn(v):
+            m = jax.ops.segment_max(v, seg, num_segments=n_seg)
+            e = jnp.exp(v - m[seg])
+            z = jax.ops.segment_sum(e, seg, num_segments=n_seg)
+            return e / z[seg]
+        vals = apply("sparse_softmax", fn, (c._values,))
+        out = SparseCooTensor(c._indices, vals, c._shape,
+                              coalesced=c._coalesced)
+        return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) \
+            else out
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask, key_padding_mask=None,
+                  attn_mask=None, name=None):
+        """Mask-driven sparse attention (≙ paddle.sparse sparse_attention
+        / nn.functional.attention): scores are computed ONLY at the
+        mask's (S, S) sparsity pattern (SDDMM), row-softmaxed over the
+        stored entries, then combined with V (SpMM) — the (S, S) dense
+        score matrix never exists. query/key/value: (B, H, S, D); the
+        pattern is shared across batch and heads. Differentiable in
+        q/k/v."""
+        from . import _coo
+        m = _coo(sparse_mask)
+        rows = m._indices[:, 0]
+        cols = m._indices[:, 1]
+        s_len = m._shape[0]
+        qt, kt, vt = query, key, value
+
+        def fn(q, k, v):
+            d = q.shape[-1]
+            qr = q[..., rows, :]                        # (B, H, nnz, D)
+            kc = k[..., cols, :]
+            scores = jnp.einsum("...nd,...nd->...n", qr, kc) \
+                / jnp.sqrt(jnp.float32(d)).astype(q.dtype)
+            sm = jax.ops.segment_max(
+                jnp.moveaxis(scores, -1, 0), rows, num_segments=s_len)
+            e = jnp.exp(jnp.moveaxis(scores, -1, 0) - sm[rows])
+            z = jax.ops.segment_sum(e, rows, num_segments=s_len)
+            p = e / z[rows]                             # (nnz, B, H)
+            contrib = p[..., None] * jnp.moveaxis(
+                v, -2, 0)[cols]                         # (nnz, B, H, D)
+            out = jax.ops.segment_sum(contrib, rows,
+                                      num_segments=s_len)
+            return jnp.moveaxis(out, 0, -2)             # (B, H, S, D)
+        return apply("sparse_attention", fn, (qt, kt, vt))
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self.axis)
+
+
+def _dense_conv3d(xd, w, b, stride, padding, subm_mask=None):
+    """x (N, D, H, W, C) dense, w (kd, kh, kw, Cin, Cout)."""
+    out = jax.lax.conv_general_dilated(
+        xd, w, window_strides=(stride,) * 3,
+        padding=[(padding, padding)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    if b is not None:
+        out = out + b
+    if subm_mask is not None:
+        out = out * subm_mask
+    return out
+
+
+class _ConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, subm=False, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride = stride if isinstance(stride, int) else stride[0]
+        self.padding = padding if isinstance(padding, int) else padding[0]
+        self.subm = subm
+        fan_in = in_channels * int(np.prod(k))
+        self.weight = self.create_parameter(
+            k + (in_channels, out_channels),
+            default_initializer=I.XavierNormal(fan_in=fan_in,
+                                               fan_out=out_channels))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter(
+                         (out_channels,),
+                         default_initializer=I.Constant(0.0)))
+
+    def forward(self, x):
+        """x: SparseCooTensor (N, D, H, W, C). Dense-backed compute; the
+        output pattern is the active output sites (SubmConv: exactly the
+        input sites; Conv3D: nonzero outputs)."""
+        from . import SparseCooTensor
+        xd = x.to_dense()
+        idx = x._indices
+
+        if self.subm:
+            if self.stride != 1:
+                raise ValueError("SubmConv3D requires stride 1")
+            mask_np = np.zeros(tuple(x._shape[:4]) + (1,), np.float32)
+            sites = np.asarray(idx)[:, :4]
+            mask_np[tuple(sites[:, d] for d in range(4))] = 1.0
+            mask = jnp.asarray(mask_np)
+        else:
+            mask = None
+
+        args = (xd, self.weight) + (() if self.bias is None
+                                    else (self.bias,))
+
+        def fn(xv, wv, *bv):
+            return _dense_conv3d(xv, wv.astype(xv.dtype),
+                                 bv[0].astype(xv.dtype) if bv else None,
+                                 self.stride, self.padding, mask)
+        out_dense = apply("sparse_conv3d", fn, args)
+
+        if self.subm:
+            # output sites == input SPATIAL sites (the submanifold
+            # property) x every output channel
+            sites = np.unique(np.asarray(idx)[:, :4], axis=0)
+            cout = int(self.weight.shape[-1])
+            ch = np.arange(cout)
+            out_idx = jnp.asarray(np.concatenate(
+                [np.repeat(sites, cout, 0),
+                 np.tile(ch[:, None], (len(sites), 1))], axis=1),
+                jnp.int32)
+        else:
+            dn = np.asarray(out_dense._value)
+            nz = np.argwhere(np.any(dn != 0, axis=-1))
+            ch = np.arange(dn.shape[-1])
+            out_idx = jnp.asarray(np.concatenate(
+                [np.repeat(nz, len(ch), 0),
+                 np.tile(ch[:, None], (len(nz), 1))], axis=1), jnp.int32)
+        rows = tuple(out_idx[:, d] for d in range(out_idx.shape[1]))
+
+        def gather(dv):
+            return dv[rows]
+        vals = apply("sparse_conv3d_gather", gather, (out_dense,))
+        return SparseCooTensor(out_idx, vals,
+                               tuple(out_dense._value.shape)
+                               if not self.subm else
+                               tuple(x._shape[:4])
+                               + (self.weight.shape[-1],))
+
+
+class Conv3D(_ConvBase):
+    """≙ paddle.sparse.nn.Conv3D (dense-backed; see package doc)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, subm=False, bias_attr=bias_attr)
+
+
+class SubmConv3D(_ConvBase):
+    """≙ paddle.sparse.nn.SubmConv3D: submanifold convolution — outputs
+    exist ONLY at input active sites, so sparsity never dilates (the
+    point-cloud property). Dense-backed compute with an active-site
+    mask; semantics exact."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, bias_attr=None,
+                 key=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, subm=True, bias_attr=bias_attr)
+
+
+class BatchNorm(Layer):
+    """≙ paddle.sparse.nn.BatchNorm: normalizes the VALUES per channel
+    (last dim) over the stored entries only."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        from ..nn import initializer as I
+        self.epsilon = epsilon
+        self.momentum = momentum
+        self.weight = self.create_parameter(
+            (num_features,), default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), default_initializer=I.Constant(0.0))
+        self.register_buffer("_mean", Tensor(jnp.zeros(num_features)))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones(num_features)))
+
+    def forward(self, x):
+        """x: SparseCooTensor whose LAST index dim is the channel
+        (values are flat per-entry scalars): per-channel stats over the
+        stored entries via channel-segmented reductions."""
+        from . import SparseCooTensor
+        c = x
+        training = self.training
+        mom, eps = self.momentum, self.epsilon
+        rm, rv = self._mean, self._variance
+        ch = c._indices[:, -1]
+        nf = int(self.weight.shape[0])
+
+        def fn(v, w, b, m, va):
+            if training:
+                cnt = jnp.maximum(jax.ops.segment_sum(
+                    jnp.ones_like(v), ch, num_segments=nf), 1.0)
+                mean = jax.ops.segment_sum(v, ch,
+                                           num_segments=nf) / cnt
+                var = jax.ops.segment_sum(
+                    jnp.square(v), ch, num_segments=nf) / cnt \
+                    - jnp.square(mean)
+            else:
+                mean, var = m, va
+            out = (v - mean[ch]) * jax.lax.rsqrt(var[ch] + eps) \
+                * w[ch] + b[ch]
+            return out, mean, var
+        vals, mean, var = apply("sparse_batch_norm", fn,
+                                (c._values, self.weight, self.bias,
+                                 rm, rv), multi_output=True)
+        if training:
+            self._mean._value = (mom * rm._value
+                                 + (1 - mom) * mean._value)
+            self._variance._value = (mom * rv._value
+                                     + (1 - mom) * var._value)
+        return SparseCooTensor(c._indices, vals, c._shape,
+                               coalesced=c._coalesced)
+
+
+class MaxPool3D(Layer):
+    """≙ paddle.sparse.nn.MaxPool3D (dense-backed)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC", name=None):
+        super().__init__()
+        self.k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        s = stride if stride is not None else kernel_size
+        self.s = (s,) * 3 if isinstance(s, int) else tuple(s)
+        self.p = padding
+
+    def forward(self, x):
+        from . import SparseCooTensor
+        xd = x.to_dense()
+        k, s, p = self.k, self.s, self.p
+        win = ((1,) + k + (1,), (1,) + s + (1,),
+               [(0, 0)] + [(p, p)] * 3 + [(0, 0)])
+
+        # occupancy mask: empty cells pool as -inf (stored-entries-only
+        # semantics), and the output pattern is windows containing ANY
+        # active site — value sign must not decide liveness
+        occ = np.zeros(tuple(x._shape), np.float32)
+        ii = np.asarray(x._indices)
+        occ[tuple(ii[:, d] for d in range(ii.shape[1]))] = 1.0
+        occ_j = jnp.asarray(occ) > 0
+
+        def fn(v):
+            filled = jnp.where(occ_j, v, -jnp.inf)
+            return jax.lax.reduce_window(filled, -jnp.inf, jax.lax.max,
+                                         *win)
+        dense = apply("sparse_max_pool3d", fn, (xd,))
+        occ_pooled = np.asarray(jax.lax.reduce_window(
+            jnp.asarray(occ), -jnp.inf, jax.lax.max, *win))
+        nz = np.argwhere(occ_pooled > 0)
+        idx = jnp.asarray(nz, jnp.int32)
+        rows = tuple(idx[:, d] for d in range(idx.shape[1]))
+        vals = apply("sparse_pool_gather", lambda dv: dv[rows], (dense,))
+        return SparseCooTensor(idx, vals, occ_pooled.shape)

@@ -92,7 +92,10 @@ SURFACE = {
     "paddle_tpu.utils": ["dlpack", "unique_name", "require_version",
                          "get_flags", "set_flags"],
     "paddle_tpu.sparse": ["sparse_coo_tensor", "sparse_csr_tensor",
-                          "matmul", "masked_matmul"],
+                          "matmul", "masked_matmul", "mv", "addmm",
+                          "coalesce", "sin", "tanh", "cast", "nn"],
+    "paddle_tpu.sparse.nn": ["ReLU", "Softmax", "Conv3D", "SubmConv3D",
+                             "BatchNorm", "MaxPool3D", "functional"],
     "paddle_tpu.linalg": ["svd", "qr", "lu", "lu_solve", "ormqr",
                           "cholesky_inverse", "matrix_transpose"],
     "paddle_tpu.metric": ["Accuracy", "Precision", "Recall", "Auc"],

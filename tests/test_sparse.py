@@ -1,0 +1,354 @@
+"""paddle.sparse parity tier (VERDICT r3 missing #3 / next #5).
+
+Oracles: scipy.sparse for structure/matmul/binary ops, dense numpy for
+softmax/attention/conv, and jax.grad-free eager backward for the
+gradients-throughout requirement (sp.values().grad must populate).
+"""
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+rng = np.random.default_rng(23)
+
+
+def _rand_coo(m=6, n=5, density=0.4, seed=0):
+    r = np.random.default_rng(seed)
+    mat = sp.random(m, n, density=density, random_state=r.integers(1e6),
+                    dtype=np.float32)
+    coo = mat.tocoo()
+    idx = np.stack([coo.row, coo.col])
+    t = sparse.sparse_coo_tensor(idx, coo.data.astype(np.float32),
+                                 [m, n], stop_gradient=False)
+    return t, mat.toarray().astype(np.float32)
+
+
+class TestStructure:
+    def test_coalesce_sums_duplicates(self):
+        idx = np.array([[0, 0, 1], [1, 1, 2]])
+        vals = np.array([1.0, 2.0, 3.0], np.float32)
+        s = sparse.sparse_coo_tensor(idx, vals, [3, 3])
+        c = s.coalesce()
+        assert c.nnz() == 2 and c.is_coalesced()
+        d = np.asarray(c.to_dense()._value)
+        assert d[0, 1] == 3.0 and d[1, 2] == 3.0
+
+    def test_coo_csr_roundtrip_vs_scipy(self):
+        t, dense = _rand_coo(seed=1)
+        csr = t.to_sparse_csr()
+        ref = sp.csr_matrix(dense)
+        np.testing.assert_array_equal(np.asarray(csr.crows()._value),
+                                      ref.indptr)
+        np.testing.assert_array_equal(np.asarray(csr.cols()._value),
+                                      ref.indices)
+        np.testing.assert_allclose(np.asarray(csr.values()._value),
+                                   ref.data, rtol=1e-6)
+        back = np.asarray(csr.to_sparse_coo().to_dense()._value)
+        np.testing.assert_allclose(back, dense, rtol=1e-6)
+
+    def test_transpose(self):
+        t, dense = _rand_coo(seed=2)
+        tt = sparse.transpose(t, [1, 0])
+        np.testing.assert_allclose(np.asarray(tt.to_dense()._value),
+                                   dense.T, rtol=1e-6)
+
+
+class TestBinaryOps:
+    @pytest.mark.parametrize("op,npop", [
+        ("add", np.add), ("subtract", np.subtract),
+        ("multiply", np.multiply)])
+    def test_vs_scipy_dense(self, op, npop):
+        a, da = _rand_coo(seed=3)
+        b, db = _rand_coo(seed=4)
+        out = getattr(sparse, op)(a, b)
+        np.testing.assert_allclose(np.asarray(out.to_dense()._value),
+                                   npop(da, db), rtol=1e-5, atol=1e-6)
+
+    def test_multiply_pattern_is_intersection(self):
+        a, da = _rand_coo(seed=5)
+        b, db = _rand_coo(seed=6)
+        out = sparse.multiply(a, b)
+        n_both = int(((da != 0) & (db != 0)).sum())
+        assert out.nnz() == n_both
+
+    def test_binary_grads_flow(self):
+        a, da = _rand_coo(seed=7)
+        b, db = _rand_coo(seed=8)
+        out = sparse.add(a, b)
+        out.values().sum().backward()
+        ga = a.values().grad
+        gb = b.values().grad
+        assert ga is not None and gb is not None
+        np.testing.assert_allclose(np.asarray(ga._value), 1.0)
+        np.testing.assert_allclose(np.asarray(gb._value), 1.0)
+
+
+class TestMatmulFamily:
+    def test_spmm_vs_scipy(self):
+        t, dense = _rand_coo(seed=9)
+        y = rng.normal(size=(5, 4)).astype(np.float32)
+        out = sparse.matmul(t, paddle.to_tensor(y))
+        np.testing.assert_allclose(np.asarray(out._value), dense @ y,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_spmm_grads_to_values_and_dense(self):
+        t, dense = _rand_coo(seed=10)
+        y = paddle.to_tensor(rng.normal(size=(5, 4)).astype(np.float32),
+                             stop_gradient=False)
+        out = sparse.matmul(t, y)
+        (out ** 2).sum().backward()
+        gv = t.values().grad
+        gy = y.grad
+        assert gv is not None and gy is not None
+        # dense oracle: d/dA sum((A@Y)^2) = 2 (A@Y) Y^T at the pattern
+        gd = 2 * (dense @ np.asarray(y._value)) @ np.asarray(y._value).T
+        ii = np.asarray(t.indices()._value)
+        np.testing.assert_allclose(np.asarray(gv._value),
+                                   gd[ii[0], ii[1]], rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_mv_and_addmm(self):
+        t, dense = _rand_coo(seed=11)
+        v = rng.normal(size=(5,)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sparse.mv(t, paddle.to_tensor(v))._value),
+            dense @ v, rtol=1e-5, atol=1e-6)
+        inp = rng.normal(size=(6, 4)).astype(np.float32)
+        y = rng.normal(size=(5, 4)).astype(np.float32)
+        got = sparse.addmm(paddle.to_tensor(inp), t,
+                           paddle.to_tensor(y), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(np.asarray(got._value),
+                                   0.5 * inp + 2.0 * (dense @ y),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_sddmm_vs_dense(self):
+        mask, dmask = _rand_coo(m=6, n=6, seed=12)
+        x = rng.normal(size=(6, 8)).astype(np.float32)
+        y = rng.normal(size=(8, 6)).astype(np.float32)
+        out = sparse.masked_matmul(paddle.to_tensor(x),
+                                   paddle.to_tensor(y), mask)
+        ref = (x @ y) * (dmask != 0)
+        np.testing.assert_allclose(np.asarray(out.to_dense()._value),
+                                   ref, rtol=1e-4, atol=1e-5)
+
+    def test_sddmm_grads(self):
+        mask, dmask = _rand_coo(m=4, n=4, seed=13)
+        x = paddle.to_tensor(rng.normal(size=(4, 3)).astype(np.float32),
+                             stop_gradient=False)
+        y = paddle.to_tensor(rng.normal(size=(3, 4)).astype(np.float32),
+                             stop_gradient=False)
+        out = sparse.masked_matmul(x, y, mask)
+        out.values().sum().backward()
+        assert x.grad is not None and y.grad is not None
+        # oracle: d sum(M*(XY)) / dX = M Y^T (M the 0/1 pattern)
+        pat = (dmask != 0).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(x.grad._value),
+                                   pat @ np.asarray(y._value).T,
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestEdgeCases:
+    """Round-4 review regressions."""
+
+    def test_empty_operand_binary(self):
+        a, da = _rand_coo(seed=30)
+        # disjoint multiply -> empty result; adding it back must work
+        empty = sparse.sparse_coo_tensor(
+            np.zeros((2, 0), np.int64), np.zeros((0,), np.float32),
+            [6, 5])
+        out = sparse.add(a, empty)
+        np.testing.assert_allclose(np.asarray(out.to_dense()._value),
+                                   da, rtol=1e-6)
+        out2 = sparse.multiply(a, empty)
+        assert out2.nnz() == 0
+
+    def test_shape_mismatch_raises_valueerror(self):
+        a, _ = _rand_coo(m=4, n=4, seed=31)
+        b, _ = _rand_coo(m=2, n=8, seed=32)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            sparse.add(a, b)
+
+    def test_matmul_with_vector_routes_to_mv(self):
+        t, dense = _rand_coo(seed=33)
+        v = rng.normal(size=(5,)).astype(np.float32)
+        out = sparse.matmul(t, paddle.to_tensor(v))
+        assert tuple(out.shape) == (6,)
+        np.testing.assert_allclose(np.asarray(out._value), dense @ v,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_unary_accepts_name_kwarg(self):
+        t, _ = _rand_coo(seed=34)
+        out = sparse.tanh(t, name="t")
+        assert out.nnz() == t.nnz()
+
+    def test_maxpool_keeps_negative_sites(self):
+        from paddle_tpu.sparse.nn import MaxPool3D
+        # two sites (0,0,0,0,0) and (0,4,4,4,0) in (ndim, nnz) layout
+        idx = np.array([[0, 0], [0, 4], [0, 4], [0, 4], [0, 0]])
+        vals = np.array([-2.0, -3.0], np.float32)   # all-negative
+        t = sparse.sparse_coo_tensor(idx, vals, [1, 8, 8, 8, 1])
+        out = MaxPool3D(kernel_size=2, stride=2)(t)
+        assert out.nnz() >= 2, "negative-valued sites were dropped"
+        v = np.asarray(out.values()._value)
+        assert (v < 0).all()
+
+
+class TestUnaryZoo:
+    @pytest.mark.parametrize("name,npf", [
+        ("sin", np.sin), ("tanh", np.tanh), ("sqrt", np.sqrt),
+        ("square", np.square), ("abs", np.abs), ("log1p", np.log1p),
+        ("expm1", np.expm1), ("neg", np.negative)])
+    def test_value_ops(self, name, npf):
+        idx = np.array([[0, 1, 2], [1, 2, 0]])
+        vals = np.array([0.5, 0.25, 0.75], np.float32)
+        s = sparse.sparse_coo_tensor(idx, vals, [3, 3])
+        out = getattr(sparse, name)(s)
+        np.testing.assert_allclose(np.asarray(out.values()._value),
+                                   npf(vals), rtol=1e-5)
+        # pattern unchanged
+        np.testing.assert_array_equal(
+            np.asarray(out.indices()._value), idx)
+
+    def test_cast(self):
+        t, _ = _rand_coo(seed=14)
+        out = sparse.cast(t, value_dtype="bfloat16")
+        assert "bfloat16" in str(out.values()._value.dtype)
+
+
+class TestSoftmaxAttention:
+    def test_csr_softmax_vs_dense(self):
+        t, dense = _rand_coo(m=5, n=7, density=0.5, seed=15)
+        out = sparse.nn.functional.softmax(t)
+        # dense oracle: -inf outside the pattern
+        masked = np.where(dense != 0, dense, -np.inf)
+        ref = np.exp(masked - masked.max(-1, keepdims=True))
+        ref = np.nan_to_num(ref / ref.sum(-1, keepdims=True))
+        np.testing.assert_allclose(np.asarray(out.to_dense()._value),
+                                   ref, rtol=1e-5, atol=1e-6)
+
+    def test_softmax_grads(self):
+        t, _ = _rand_coo(seed=16)
+        out = sparse.nn.functional.softmax(t)
+        (out.values() ** 2).sum().backward()
+        assert t.values().grad is not None
+
+    def test_sparse_attention_vs_dense(self):
+        B, H, S, D = 2, 3, 8, 4
+        q = rng.normal(size=(B, H, S, D)).astype(np.float32)
+        k = rng.normal(size=(B, H, S, D)).astype(np.float32)
+        v = rng.normal(size=(B, H, S, D)).astype(np.float32)
+        # causal band pattern as the sparse mask
+        pat = np.tril(np.ones((S, S), np.float32))
+        idx = np.stack(np.nonzero(pat))
+        mask = sparse.sparse_coo_tensor(idx, pat[pat != 0], [S, S])
+        out = sparse.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k),
+            paddle.to_tensor(v), mask)
+        # dense oracle
+        scores = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D)
+        scores = np.where(pat[None, None] != 0, scores, -np.inf)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = p @ v
+        np.testing.assert_allclose(np.asarray(out._value), ref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_sparse_attention_grads(self):
+        B, H, S, D = 1, 2, 6, 4
+        q = paddle.to_tensor(rng.normal(size=(B, H, S, D))
+                             .astype(np.float32), stop_gradient=False)
+        k = paddle.to_tensor(rng.normal(size=(B, H, S, D))
+                             .astype(np.float32), stop_gradient=False)
+        v = paddle.to_tensor(rng.normal(size=(B, H, S, D))
+                             .astype(np.float32), stop_gradient=False)
+        pat = np.tril(np.ones((S, S), np.float32))
+        idx = np.stack(np.nonzero(pat))
+        mask = sparse.sparse_coo_tensor(idx, pat[pat != 0], [S, S])
+        out = sparse.nn.functional.attention(q, k, v, mask)
+        (out ** 2).sum().backward()
+        for t in (q, k, v):
+            assert t.grad is not None
+            assert np.isfinite(np.asarray(t.grad._value)).all()
+            assert np.abs(np.asarray(t.grad._value)).max() > 0
+
+
+class TestSparseConv:
+    def _point_cloud(self, n_pts=20, size=8, cin=3, seed=20):
+        r = np.random.default_rng(seed)
+        sites = np.unique(r.integers(0, size, (n_pts, 3)), axis=0)
+        n = len(sites)
+        feats = r.normal(size=(n, cin)).astype(np.float32)
+        # COO over (N=1, D, H, W, C): one entry per (site, channel)
+        idx_rows = []
+        vals = []
+        for i, s_ in enumerate(sites):
+            for c in range(cin):
+                idx_rows.append([0, s_[0], s_[1], s_[2], c])
+                vals.append(feats[i, c])
+        idx = np.asarray(idx_rows).T
+        t = sparse.sparse_coo_tensor(
+            idx, np.asarray(vals, np.float32), [1, size, size, size, cin],
+            stop_gradient=False)
+        dense = np.asarray(t.to_dense()._value)
+        return t, dense, sites
+
+    def test_subm_conv_output_sites_match_input(self):
+        from paddle_tpu.sparse.nn import SubmConv3D
+        paddle.seed(0)
+        t, dense, sites = self._point_cloud()
+        conv = SubmConv3D(3, 5, kernel_size=3, padding=1)
+        out = conv(t)
+        assert out.shape == [1, 8, 8, 8, 5]
+        od = np.asarray(out.to_dense()._value)
+        # the submanifold property: non-active sites stay EXACTLY zero
+        site_mask = np.zeros((8, 8, 8), bool)
+        site_mask[sites[:, 0], sites[:, 1], sites[:, 2]] = True
+        assert np.all(od[0][~site_mask] == 0)
+        # active sites match a dense conv at those positions
+        import jax
+        ref = np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(dense), conv.weight._value.astype(jnp.float32),
+            (1, 1, 1), [(1, 1)] * 3,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC")))
+        ref = ref + np.asarray(conv.bias._value)
+        np.testing.assert_allclose(od[0][site_mask],
+                                   ref[0][site_mask], rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_conv3d_matches_dense_conv(self):
+        from paddle_tpu.sparse.nn import Conv3D
+        paddle.seed(1)
+        t, dense, _ = self._point_cloud(seed=21)
+        conv = Conv3D(3, 4, kernel_size=3, padding=1, bias_attr=False)
+        out = conv(t)
+        import jax
+        ref = np.asarray(jax.lax.conv_general_dilated(
+            jnp.asarray(dense), conv.weight._value.astype(jnp.float32),
+            (1, 1, 1), [(1, 1)] * 3,
+            dimension_numbers=("NDHWC", "DHWIO", "NDHWC")))
+        np.testing.assert_allclose(np.asarray(out.to_dense()._value),
+                                   ref * (np.abs(ref) > 0), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_subm_conv_grads_flow(self):
+        from paddle_tpu.sparse.nn import SubmConv3D
+        paddle.seed(2)
+        t, _, _ = self._point_cloud(seed=22)
+        conv = SubmConv3D(3, 4, kernel_size=3, padding=1)
+        out = conv(t)
+        (out.values() ** 2).sum().backward()
+        assert conv.weight.grad is not None
+        assert t.values().grad is not None
+        assert np.abs(np.asarray(conv.weight.grad._value)).max() > 0
+
+    def test_relu_batchnorm_pipeline(self):
+        from paddle_tpu.sparse.nn import BatchNorm, ReLU, SubmConv3D
+        paddle.seed(3)
+        t, _, _ = self._point_cloud(seed=23)
+        net_out = ReLU()(BatchNorm(4)(SubmConv3D(3, 4, 3, padding=1)(t)))
+        v = np.asarray(net_out.values()._value)
+        assert (v >= 0).all() and np.isfinite(v).all()
