@@ -55,6 +55,33 @@ def _sample_token(logits, key, strategy, temperature, top_k, top_p):
     return tok, jnp.take_along_axis(logp, tok[:, None], -1)[:, 0]
 
 
+class bind_state:
+    """Context manager: temporarily install traced param/buffer values
+    on a model's live Parameter/Tensor objects (the jit-harness pattern
+    every compiled model program uses — generate, continuous-batching
+    prefill/decode). Restores the originals on exit, exception-safe."""
+
+    def __init__(self, params, buffers, pv, bv):
+        self.params, self.buffers = params, buffers
+        self.pv, self.bv = pv, bv
+
+    def __enter__(self):
+        self._old_p = [p._value for p in self.params]
+        self._old_b = [b._value for b in self.buffers]
+        for p, v in zip(self.params, self.pv):
+            p._value = v
+        for b, v in zip(self.buffers, self.bv):
+            b._value = v
+        return self
+
+    def __exit__(self, *exc):
+        for p, v in zip(self.params, self._old_p):
+            p._value = v
+        for b, v in zip(self.buffers, self._old_b):
+            b._value = v
+        return False
+
+
 class GenerationMixin:
     """Mixin over cache-capable causal LMs; adds `generate()`.
 
@@ -118,13 +145,7 @@ class GenerationMixin:
         hd = cfg.head_dim
 
         def run(pv, bv, ids_v, key):
-            old_p = [p._value for p in params]
-            old_b = [bu._value for bu in buffers]
-            try:
-                for p, v in zip(params, pv):
-                    p._value = v
-                for bu, v in zip(buffers, bv):
-                    bu._value = v
+            with bind_state(params, buffers, pv, bv):
                 kv_dtype = pv[0].dtype
                 with no_grad():
                     caches = [
@@ -181,10 +202,5 @@ class GenerationMixin:
                     else:
                         toks, lps = tok0[:, None], lp0[:, None]
                     return toks, lps
-            finally:
-                for p, v in zip(params, old_p):
-                    p._value = v
-                for bu, v in zip(buffers, old_b):
-                    bu._value = v
 
         return jax.jit(run)

@@ -15,6 +15,7 @@ import math
 from dataclasses import dataclass, field
 
 import numpy as np
+import jax.numpy as jnp
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
@@ -95,13 +96,27 @@ def apply_rope(x: Tensor, cos: Tensor, sin: Tensor, position_offset=0):
     """x: (B, S, H, D) — Pallas fused rope kernel (custom VJP = inverse
     rotation). ≙ fused_rotary_position_embedding
     «paddle/phi/kernels/fusion/» [U]. `position_offset` may be a traced
-    scalar (decode-time position): that routes to an XLA dynamic-slice
-    path, since a Pallas grid cannot depend on a traced offset."""
+    scalar (decode-time position) — routed to an XLA dynamic-slice path
+    — or a (B,) VECTOR of per-sequence positions with S == 1
+    (continuous-batching decode: each slot rotates at its own angle)."""
     from paddle_tpu.core.tensor import apply as _apply
     from paddle_tpu.ops.rope import rope_values
 
     off = (position_offset._value
            if isinstance(position_offset, Tensor) else position_offset)
+
+    if not isinstance(off, int) and jnp.ndim(off) == 1:
+        if x.shape[1] != 1:
+            raise ValueError("vector position_offset needs S == 1")
+
+        def fn_vec(v, c, s):
+            cv = c[off].astype(jnp.float32)[:, None, None, :]  # (B,1,1,half)
+            sv = s[off].astype(jnp.float32)[:, None, None, :]
+            x1 = v[..., 0::2].astype(jnp.float32)
+            x2 = v[..., 1::2].astype(jnp.float32)
+            return jnp.stack([x1 * cv - x2 * sv, x2 * cv + x1 * sv],
+                             axis=-1).reshape(v.shape).astype(v.dtype)
+        return _apply("rope_vec", fn_vec, (x, cos, sin))
 
     # use_pallas=False: measured on the v5e (round 3), the XLA rotation
     # fuses into the surrounding projections and beats the standalone
@@ -128,10 +143,21 @@ def _window_band(s: int, n_keys: int, offset: int,
 
 def _update_kv_cache(cache: Tensor, new: Tensor, offset) -> Tensor:
     """Write `new` (B, S, HK, D) into the static cache (B, S_max, HK, D)
-    at sequence position `offset` (python int or traced scalar)."""
+    at sequence position `offset` (python int, traced scalar, or a (B,)
+    vector of per-sequence positions with S == 1)."""
     from paddle_tpu.core.tensor import apply as _apply
     import jax
     off = offset._value if isinstance(offset, Tensor) else offset
+
+    if not isinstance(off, int) and jnp.ndim(off) == 1:
+        if new.shape[1] != 1:
+            raise ValueError("vector cache offset needs S == 1")
+
+        def fn_vec(c, n):
+            b = c.shape[0]
+            return c.at[jnp.arange(b), off].set(
+                n[:, 0].astype(c.dtype))
+        return _apply("kv_cache_update_vec", fn_vec, (cache, new))
 
     def fn(c, n):
         return jax.lax.dynamic_update_slice_in_dim(

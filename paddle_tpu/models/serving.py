@@ -1,0 +1,230 @@
+"""Continuous-batching serving loop.
+
+≙ the reference inference engine's in-flight batching
+(«paddle/fluid/inference/» serving stack + fused_multi_transformer
+decode kernels, SURVEY.md §1 L10 / §2.1 fused rows) — TPU-native:
+
+* ONE compiled decode-step program serves the whole slot batch forever:
+  (caches, last tokens, per-slot positions) -> (next tokens, caches),
+  with per-slot positions flowing as a VECTOR through rope, the KV
+  scatter, and the end-aligned attention mask. Slots at different
+  sequence positions decode together — no recompilation, ever.
+* Admission happens BETWEEN steps on the host: a finished slot's cache
+  rows are overwritten by the next request's prefill (prompt lengths
+  bucketed to a padding grid so prefill programs are reused), and the
+  decode program never notices. This is vLLM-style continuous batching
+  with XLA-static shapes.
+* Greedy decoding (the serving default); sampling hooks onto the same
+  step function later.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ContinuousBatchingEngine", "Request"]
+
+
+@dataclass
+class Request:
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    output: List[int] = field(default_factory=list)
+    done: bool = False
+
+
+class ContinuousBatchingEngine:
+    """In-flight batched greedy serving for cache-capable causal LMs
+    (LlamaForCausalLM-family: forward(ids, past_key_values,
+    position_offset, use_cache))."""
+
+    def __init__(self, model, max_batch_size: int = 8,
+                 max_seq_len: Optional[int] = None,
+                 eos_token_id: Optional[int] = None,
+                 prompt_pad: int = 16):
+        cfg = model.config
+        self.model = model
+        self.B = int(max_batch_size)
+        self.S = int(max_seq_len or cfg.max_position_embeddings)
+        self.eos = eos_token_id
+        self.pad = int(prompt_pad)
+        self._params = list(model.parameters())
+        self._buffers = list(model.buffers())
+        hk, hd = cfg.num_key_value_heads, cfg.head_dim
+        L = cfg.num_hidden_layers
+        dt = self._params[0]._value.dtype
+        self._caches = [
+            (jnp.zeros((self.B, self.S, hk, hd), dt),
+             jnp.zeros((self.B, self.S, hk, hd), dt))
+            for _ in range(L)]
+        # host-side slot state
+        self._pos = np.zeros(self.B, np.int32)        # next write position
+        self._tok = np.zeros(self.B, np.int32)        # last emitted token
+        self._slot_req: List[Optional[Request]] = [None] * self.B
+        self._queue: List[Request] = []
+        self._next_rid = 0
+        self._decode_jit = None
+        self._insert_jit = None
+        self._prefill_jits: Dict[int, object] = {}
+
+    # -- public API ----------------------------------------------------
+    def add_request(self, prompt, max_new_tokens: int = 32) -> int:
+        toks = [int(t) for t in np.asarray(prompt).ravel()]
+        if len(toks) >= self.S:
+            raise ValueError(
+                f"prompt length {len(toks)} does not fit max_seq_len "
+                f"{self.S} (need at least one decode position)")
+        r = Request(self._next_rid, toks, int(max_new_tokens))
+        self._next_rid += 1
+        self._queue.append(r)
+        return r.rid
+
+    def run(self) -> Dict[int, List[int]]:
+        """Drive until every queued request completes; returns
+        {request id: generated tokens}."""
+        results: Dict[int, List[int]] = {}
+        while self._queue or any(r is not None for r in self._slot_req):
+            for r in self.step():
+                results[r.rid] = r.output
+        return results
+
+    def step(self) -> List[Request]:
+        """Admit waiting requests into free slots, decode ONE token for
+        every active slot, release finished slots. Returns the requests
+        that finished this step."""
+        finished = self._admit()
+        active = [i for i, r in enumerate(self._slot_req)
+                  if r is not None]
+        if not active:
+            return finished
+        self._decode()
+        for i in active:
+            r = self._slot_req[i]
+            tok = int(self._tok[i])
+            r.output.append(tok)
+            hit_eos = self.eos is not None and tok == self.eos
+            if hit_eos or len(r.output) >= r.max_new_tokens \
+                    or int(self._pos[i]) >= self.S - 1:
+                r.done = True
+                finished.append(r)
+                self._slot_req[i] = None     # slot freed for admission
+        return finished
+
+    # -- internals -----------------------------------------------------
+    def _bucket(self, n: int) -> int:
+        # clamped to the cache: a prompt near max_seq_len must not
+        # round its prefill window past the cache end
+        return min(int(-(-n // self.pad) * self.pad), self.S)
+
+    def _build_prefill(self, p_len: int):
+        model, B, S = self.model, self.B, self.S
+        params, buffers = self._params, self._buffers
+        cfg = model.config
+        hk, hd = cfg.num_key_value_heads, cfg.head_dim
+        L = cfg.num_hidden_layers
+
+        def run(pv, bv, ids, true_len):
+            from .generation import bind_state
+            with bind_state(params, buffers, pv, bv):
+                dt = pv[0].dtype
+                caches = [(Tensor(jnp.zeros((1, S, hk, hd), dt)),
+                           Tensor(jnp.zeros((1, S, hk, hd), dt)))
+                          for _ in range(L)]
+                # key-validity mask: padded tail positions excluded
+                am = (jnp.arange(S) < true_len)[None, :]
+                logits, new_caches = model.forward(
+                    Tensor(ids), attention_mask=Tensor(am),
+                    past_key_values=caches, position_offset=0,
+                    use_cache=True)
+                # first generated token comes from the LAST REAL row
+                last = logits._value[0, true_len - 1]
+                tok = jnp.argmax(last).astype(jnp.int32)
+                return tok, [(k._value, v._value)
+                             for k, v in new_caches]
+
+        return jax.jit(run)
+
+    def _admit(self):
+        finished = []
+        free = [i for i, r in enumerate(self._slot_req) if r is None]
+        while free and self._queue:
+            slot = free.pop(0)
+            req = self._queue.pop(0)
+            p_len = len(req.prompt)
+            bucket = self._bucket(max(p_len, 1))
+            jit = self._prefill_jits.get(bucket)
+            if jit is None:
+                jit = self._build_prefill(bucket)
+                self._prefill_jits[bucket] = jit
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :p_len] = req.prompt
+            tok, cache_rows = jit(
+                [p._value for p in self._params],
+                [b._value for b in self._buffers],
+                jnp.asarray(ids), jnp.int32(p_len))
+            # one donated-in-place program writes every layer's slot
+            # rows (2L separate .at[].set dispatches would each copy
+            # the full batch cache)
+            if self._insert_jit is None:
+                def _insert(caches, rows, s_):
+                    return [(ck.at[s_].set(rk[0]),
+                             cv.at[s_].set(rv[0]))
+                            for (ck, cv), (rk, rv)
+                            in zip(caches, rows)]
+                self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
+            self._caches = self._insert_jit(self._caches, cache_rows,
+                                            jnp.int32(slot))
+            self._slot_req[slot] = req
+            self._pos[slot] = p_len
+            self._tok[slot] = int(tok)
+            req.output.append(int(tok))
+            if (self.eos is not None and int(tok) == self.eos) \
+                    or req.max_new_tokens <= 1:
+                req.done = True
+                finished.append(req)
+                self._slot_req[slot] = None
+                free.insert(0, slot)
+        return finished
+
+    def _build_decode(self):
+        model = self.model
+        params, buffers = self._params, self._buffers
+
+        def run(pv, bv, caches, tok, pos):
+            from .generation import bind_state
+            with bind_state(params, buffers, pv, bv):
+                pkv = [(Tensor(k), Tensor(v)) for k, v in caches]
+                logits, new_caches = model.forward(
+                    Tensor(tok[:, None]), past_key_values=pkv,
+                    position_offset=Tensor(pos), use_cache=True)
+                nxt = jnp.argmax(logits._value[:, 0], -1) \
+                    .astype(jnp.int32)
+                return nxt, [(k._value, v._value)
+                             for k, v in new_caches]
+
+        return jax.jit(run, donate_argnums=(2,))
+
+    def _decode(self):
+        if self._decode_jit is None:
+            self._decode_jit = self._build_decode()
+        # inactive slots decode garbage at a clamped position; their
+        # outputs are never read and their cache rows are overwritten at
+        # admission
+        pos = np.clip(self._pos, 0, self.S - 1)
+        nxt, new_caches = self._decode_jit(
+            [p._value for p in self._params],
+            [b._value for b in self._buffers],
+            self._caches, jnp.asarray(self._tok), jnp.asarray(pos))
+        self._caches = new_caches
+        nxt = np.asarray(nxt)
+        for i, r in enumerate(self._slot_req):
+            if r is not None:
+                self._tok[i] = nxt[i]
+                self._pos[i] += 1

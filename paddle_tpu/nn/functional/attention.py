@@ -170,7 +170,9 @@ def masked_multihead_attention(query, k_cache, v_cache, seq_len,
     with END-aligned causality: q row i sees cache[t] iff
     t <= seq_len - S + i (for S=1: every t < seq_len). GQA native (H may
     be a multiple of the cache's HK). `seq_len` may be traced (decode
-    position inside a scan). Softmax in fp32. `attn_mask`: optional
+    position inside a scan) and may be a (B,) VECTOR of per-sequence
+    lengths (continuous batching: each slot at its own position).
+    Softmax in fp32. `attn_mask`: optional
     (B, T_cache) bool — False positions (e.g. left padding written into
     the cache) are excluded. `window_size`: Mistral-style sliding window —
     q at position p attends only cache positions t with p - window < t
@@ -193,11 +195,18 @@ def masked_multihead_attention(query, k_cache, v_cache, seq_len,
             "bskgd,btkd->bkgst", qh, kk,
             preferred_element_type=jnp.float32) * sc
         kpos = jnp.arange(t)
-        qpos = sl - s + jnp.arange(s)
-        mask = (kpos[None, :] <= qpos[:, None])[None, None, None]
+        sl_arr = jnp.asarray(sl)
+        if sl_arr.ndim == 0:
+            qpos = (sl_arr - s + jnp.arange(s))[None, :]     # (1, S)
+        else:
+            # per-sequence lengths (continuous batching): (B, S)
+            qpos = sl_arr[:, None] - s + jnp.arange(s)[None, :]
+        mask = (kpos[None, None, :]
+                <= qpos[:, :, None])[:, None, None]          # (B,1,1,S,T)
         if window_size is not None:
-            mask = mask & (kpos[None, :]
-                           > qpos[:, None] - window_size)[None, None, None]
+            mask = mask & (kpos[None, None, :]
+                           > qpos[:, :, None]
+                           - window_size)[:, None, None]
         if am is not None:
             pad = am.astype(bool)[:, None, None, None, :]  # (B,1,1,1,T)
             mask = mask & pad

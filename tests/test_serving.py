@@ -279,3 +279,104 @@ class TestGPTGenerate:
                              np.int32)
             cur = np.concatenate([cur, nxt[:, None]], 1)
         np.testing.assert_array_equal(np.asarray(toks._value), cur[:, 10:])
+
+
+class TestContinuousBatching:
+    """In-flight batching (VERDICT r3 next #3): slots at different
+    positions decode in ONE compiled step; admission reuses freed slots.
+    Oracle: per-request generate() greedy outputs."""
+
+    def _model(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        paddle.seed(7)
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m, cfg
+
+    def _ref_greedy(self, m, prompt, n):
+        out = m.generate(paddle.to_tensor(
+            np.asarray(prompt, np.int32)[None]), max_new_tokens=n,
+            decode_strategy="greedy_search")
+        t = out[0] if isinstance(out, (tuple, list)) else out
+        return [int(x) for x in np.asarray(t._value).ravel()[:n]]
+
+    def test_matches_per_request_greedy(self):
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        m, cfg = self._model()
+        rng_ = np.random.default_rng(3)
+        prompts = [list(rng_.integers(1, cfg.vocab_size,
+                                      rng_.integers(3, 12)))
+                   for _ in range(5)]
+        lens = [6, 9, 4, 7, 5]
+        # max_batch_size 2 < 5 requests: slots MUST be reused in flight
+        eng = ContinuousBatchingEngine(m, max_batch_size=2,
+                                       max_seq_len=64)
+        rids = [eng.add_request(p, n) for p, n in zip(prompts, lens)]
+        results = eng.run()
+        assert set(results) == set(rids)
+        for rid, p, n in zip(rids, prompts, lens):
+            ref = self._ref_greedy(m, p, n)
+            assert results[rid] == ref, (rid, results[rid], ref)
+
+    def test_mid_flight_admission(self):
+        """A request added while others are mid-decode joins without
+        disturbing them."""
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        m, cfg = self._model()
+        eng = ContinuousBatchingEngine(m, max_batch_size=4,
+                                       max_seq_len=64)
+        a = eng.add_request([5, 42, 7], 8)
+        b = eng.add_request([9, 1, 2, 3, 4], 8)
+        done = {}
+        for _ in range(3):
+            for r in eng.step():
+                done[r.rid] = r.output
+        c = eng.add_request([11, 13], 6)     # mid-flight
+        while len(done) < 3:
+            for r in eng.step():
+                done[r.rid] = r.output
+        assert done[a] == self._ref_greedy(m, [5, 42, 7], 8)
+        assert done[b] == self._ref_greedy(m, [9, 1, 2, 3, 4], 8)
+        assert done[c] == self._ref_greedy(m, [11, 13], 6)
+
+    def test_eos_frees_slot(self):
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        m, cfg = self._model()
+        # discover the greedy continuation, then declare its 2nd token
+        # as EOS: the engine must stop that request early
+        ref = self._ref_greedy(m, [5, 42, 7], 6)
+        eos = ref[1]
+        eng = ContinuousBatchingEngine(m, max_batch_size=2,
+                                       max_seq_len=64, eos_token_id=eos)
+        rid = eng.add_request([5, 42, 7], 6)
+        out = eng.run()[rid]
+        assert out == ref[:2], (out, ref)
+
+    def test_single_compiled_decode_program(self):
+        """The decode step compiles once regardless of slot positions."""
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        m, cfg = self._model()
+        eng = ContinuousBatchingEngine(m, max_batch_size=3,
+                                       max_seq_len=64)
+        for p, n in [([5, 4], 4), ([1, 2, 3, 4, 5, 6, 7], 6),
+                     ([9], 5)]:
+            eng.add_request(p, n)
+        eng.run()
+        assert eng._decode_jit is not None
+        # jax caches by signature; the step signature never changed
+        sizes = eng._decode_jit._cache_size() \
+            if hasattr(eng._decode_jit, "_cache_size") else 1
+        assert sizes == 1, sizes
+
+    def test_prompt_length_validation(self):
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        m, cfg = self._model()
+        eng = ContinuousBatchingEngine(m, max_batch_size=2,
+                                       max_seq_len=32)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            eng.add_request(list(range(40)), 4)
+        # near-limit prompt: bucket must clamp to the cache, not crash
+        rid = eng.add_request(list(np.arange(1, 30) % cfg.vocab_size), 2)
+        out = eng.run()[rid]
+        assert len(out) == 2
