@@ -90,25 +90,38 @@ class Tensor:
             "outside the compiled step; paddle.jit.not_to_static marks "
             "helpers that must stay eager.")
 
-    def __bool__(self):
+    def _scalar(self, coercion: str) -> np.ndarray:
+        """Concrete 0-d view for python-scalar coercion: paddle allows
+        float()/int()/bool() on any 1-element tensor (numpy deprecated
+        the implicit squeeze, so do it explicitly)."""
         if isinstance(self._value, jax.core.Tracer):
-            self._graph_break("bool()/if-condition")
-        return bool(self._value)
+            self._graph_break(coercion)
+        arr = self.numpy()
+        if arr.ndim:
+            if arr.size != 1:
+                raise TypeError(
+                    f"only 1-element tensors convert to python scalars "
+                    f"(got shape {tuple(arr.shape)})")
+            arr = arr.reshape(())
+        return arr
+
+    def __bool__(self):
+        return bool(self._scalar("bool()/if-condition"))
 
     def __float__(self):
-        if isinstance(self._value, jax.core.Tracer):
-            self._graph_break("float()")
-        return float(self._value)
+        return float(self._scalar("float()"))
 
     def __int__(self):
-        if isinstance(self._value, jax.core.Tracer):
-            self._graph_break("int()")
-        return int(self._value)
+        return int(self._scalar("int()"))
 
     def __index__(self):
-        if isinstance(self._value, jax.core.Tracer):
-            self._graph_break("integer indexing coercion")
-        return self._value.__index__()
+        arr = self._scalar("integer indexing coercion")
+        if not np.issubdtype(arr.dtype, np.integer) and \
+                arr.dtype != np.bool_:
+            raise TypeError(
+                f"only integer tensors are valid indices (got "
+                f"{arr.dtype})")
+        return int(arr)
 
     def item(self, *idx):
         if isinstance(self._value, jax.core.Tracer):

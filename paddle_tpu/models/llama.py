@@ -109,13 +109,12 @@ def apply_rope(x: Tensor, cos: Tensor, sin: Tensor, position_offset=0):
         if x.shape[1] != 1:
             raise ValueError("vector position_offset needs S == 1")
 
+        from paddle_tpu.ops.rope import rope_rotate_values
+
         def fn_vec(v, c, s):
             cv = c[off].astype(jnp.float32)[:, None, None, :]  # (B,1,1,half)
             sv = s[off].astype(jnp.float32)[:, None, None, :]
-            x1 = v[..., 0::2].astype(jnp.float32)
-            x2 = v[..., 1::2].astype(jnp.float32)
-            return jnp.stack([x1 * cv - x2 * sv, x2 * cv + x1 * sv],
-                             axis=-1).reshape(v.shape).astype(v.dtype)
+            return rope_rotate_values(v, cv, sv)
         return _apply("rope_vec", fn_vec, (x, cos, sin))
 
     # use_pallas=False: measured on the v5e (round 3), the XLA rotation

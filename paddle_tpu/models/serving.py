@@ -53,6 +53,13 @@ class ContinuousBatchingEngine:
         self.model = model
         self.B = int(max_batch_size)
         self.S = int(max_seq_len or cfg.max_position_embeddings)
+        if self.S > cfg.max_position_embeddings:
+            # past the precomputed rope table the traced gather would
+            # silently clamp to the last row — wrong angles forever
+            raise ValueError(
+                f"max_seq_len {self.S} exceeds the model's rope table "
+                f"(max_position_embeddings="
+                f"{cfg.max_position_embeddings})")
         self.eos = eos_token_id
         self.pad = int(prompt_pad)
         self._params = list(model.parameters())
@@ -77,6 +84,11 @@ class ContinuousBatchingEngine:
     # -- public API ----------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32) -> int:
         toks = [int(t) for t in np.asarray(prompt).ravel()]
+        if not toks:
+            raise ValueError("empty prompt")
+        if int(max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
         if len(toks) >= self.S:
             raise ValueError(
                 f"prompt length {len(toks)} does not fit max_seq_len "

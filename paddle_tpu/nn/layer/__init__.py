@@ -11,7 +11,7 @@ from .norm import (LayerNorm, RMSNorm, BatchNorm, BatchNorm1D,  # noqa: F401
                    LocalResponseNorm, SpectralNorm)
 from .pooling import *  # noqa: F401,F403
 from .rnn import (SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,  # noqa: F401
-                  SimpleRNN, LSTM, GRU)
+                  SimpleRNN, LSTM, GRU, RNNCellBase)
 from .transformer import (MultiHeadAttention, Transformer,  # noqa: F401
                           TransformerEncoder, TransformerEncoderLayer,
                           TransformerDecoder, TransformerDecoderLayer)

@@ -268,3 +268,8 @@ class BiRNN(Layer):
         of, stf = self.rnn_fw(inputs, sf)
         ob, stb = self.rnn_bw(inputs, sb)
         return concat([of, ob], -1), (stf, stb)
+
+
+# public base-class name (≙ paddle.nn.RNNCellBase): subclass with a
+# forward(inputs, states) to build custom cells usable inside RNN/BiRNN
+RNNCellBase = _RNNCellBase

@@ -36,6 +36,17 @@ def _rope_kernel(x1_ref, x2_ref, cos_ref, sin_ref, r1_ref, r2_ref, *, sign):
     r2_ref[:] = (x2 * c + x1 * s).astype(r2_ref.dtype)
 
 
+def rope_rotate_values(x, c, s):
+    """Interleaved-pair rotation with trig already broadcast-shaped
+    against x's de-interleaved halves — the ONE definition of the pair
+    convention (used by the XLA fallback here and the per-batch
+    vector-position decode path in models/llama.py)."""
+    x1 = x[..., 0::2].astype(jnp.float32)
+    x2 = x[..., 1::2].astype(jnp.float32)
+    return jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s],
+                     axis=-1).reshape(x.shape).astype(x.dtype)
+
+
 def _rope_apply(x, cos, sin, sign, block_s):
     b, seq, h, d = x.shape
     bs = min(block_s, seq) if block_s else 0
@@ -43,10 +54,7 @@ def _rope_apply(x, cos, sin, sign, block_s):
         # XLA fallback for ragged sequence lengths
         c = cos[None, :, None, :].astype(jnp.float32)
         s = (sin * sign)[None, :, None, :].astype(jnp.float32)
-        x1 = x[..., 0::2].astype(jnp.float32)
-        x2 = x[..., 1::2].astype(jnp.float32)
-        return jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s],
-                         axis=-1).reshape(x.shape).astype(x.dtype)
+        return rope_rotate_values(x, c, s)
     half_spec = pl.BlockSpec((1, bs, h, d // 2), lambda i, j: (i, j, 0, 0))
     trig_spec = pl.BlockSpec((bs, d // 2), lambda i, j: (j, 0))
     r1, r2 = pl.pallas_call(

@@ -23,7 +23,8 @@ from .math import abs, all, any, max, min, pow, round, sum  # noqa: F401
 from .manipulation import slice  # noqa: F401
 
 # linalg ops that paddle also exposes at top level
-from .linalg import (norm, dist, cholesky, matrix_power, pinv)  # noqa: F401
+from .linalg import (norm, dist, cholesky, matrix_power, pinv,  # noqa: F401
+                     tensordot)
 from .manipulation import t  # noqa: F401
 
 _METHOD_SOURCES = [math, manipulation, logic, search, stat, linalg, attribute,

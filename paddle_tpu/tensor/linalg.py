@@ -310,3 +310,25 @@ def ormqr(x, tau, y, left=True, transpose=False, name=None):
         qm = jnp.swapaxes(q, -1, -2) if transpose else q
         return qm @ b if left else b @ qm
     return apply("ormqr", fn, (_t(x), _t(tau), _t(y)))
+
+
+def tensordot(x, y, axes=2, name=None):
+    """≙ paddle.tensordot [U]: contract over `axes` — an int (last k of
+    x vs first k of y), a single list (same axes both sides), or a pair
+    of lists."""
+    from ..core.tensor import Tensor
+
+    def _norm_axes(a):
+        if isinstance(a, Tensor):
+            a = np.asarray(a._value).tolist()
+        if isinstance(a, int):
+            return a
+        a = list(a)
+        if len(a) == 2 and isinstance(a[0], (list, tuple, np.ndarray)):
+            return ([int(i) for i in a[0]], [int(i) for i in a[1]])
+        return ([int(i) for i in a], [int(i) for i in a])
+
+    ax = _norm_axes(axes)
+    return apply("tensordot",
+                 lambda a, b: jnp.tensordot(a, b, axes=ax),
+                 (_t(x), _t(y)))

@@ -3,6 +3,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # heavy tier (VERDICT r3 #9)
+
 import jax
 
 import paddle_tpu as paddle

@@ -8,6 +8,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # heavy tier (VERDICT r3 #9)
+
 import paddle_tpu as paddle
 import paddle_tpu.distributed as dist
 from paddle_tpu import nn

@@ -190,6 +190,7 @@ class TestFleet:
         assert single[-1] < single[0]
 
 
+@pytest.mark.slow
 class TestGraftEntry:
     def test_dryrun_multichip(self):
         _need8()

@@ -46,6 +46,7 @@ class TestBertE2E:
         l2 = float(model2(ids, labels=labels)[0])
         assert l1 == pytest.approx(l2, rel=1e-5)
 
+    @pytest.mark.slow
     def test_bert_amp_bf16(self):
         paddle.seed(0)
         cfg = BertConfig.tiny()
@@ -117,6 +118,7 @@ class TestOptimizerStateCheckpoint:
         model.set_state_dict(state["model"])
 
 
+@pytest.mark.slow
 class TestDiffusion:
     def test_dit_diffusion_train_and_ddim_sample(self):
         """DiT trains on the noise-prediction loss and DDIM-samples in one
@@ -169,6 +171,7 @@ class TestDiffusion:
         assert np.isfinite(c).all()
 
 
+@pytest.mark.slow
 class TestSlidingWindowLlama:
     def test_mistral_style_window_trains(self):
         from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,

@@ -158,6 +158,7 @@ class TestCuSeqlens:
                                    atol=2e-4)
 
 
+@pytest.mark.slow
 class TestPackedTraining:
     def test_packed_batch_train_step(self):
         """Packed two-documents-per-row batch trains through the varlen

@@ -209,6 +209,7 @@ class TestDeformConv:
         assert off.grad is not None
 
 
+@pytest.mark.slow
 class TestVisionZoo:
     """Forward-shape + grad smoke for the round-3 model-zoo additions.
     ≙ reference «test/legacy_test/test_vision_models.py» [U]."""
@@ -284,6 +285,7 @@ class TestGraphSampling:
                                       [0, 0, 0, 1, 1])
 
 
+@pytest.mark.slow
 class TestVisionZooRound3b:
     @pytest.mark.parametrize("build,shape,nclass", [
         (lambda: paddle.vision.shufflenet_v2_x0_5(num_classes=5),

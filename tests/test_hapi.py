@@ -154,6 +154,7 @@ class TestAccumulateGradBatches:
 
 
 class TestFlops:
+    @pytest.mark.slow
     def test_lenet_flops_counts_conv_and_linear(self):
         import paddle_tpu as paddle
         m = paddle.vision.LeNet()

@@ -5,6 +5,8 @@ oracle available offline). ≙ PaddleNLP convert-from-hf utilities
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # transformers integration tier
+
 import paddle_tpu as paddle
 
 torch = pytest.importorskip("torch")

@@ -64,6 +64,7 @@ class TestCompiledMemoryStats:
         assert remat["temp_bytes"] < 0.7 * plain["temp_bytes"], (
             remat["temp_bytes"], plain["temp_bytes"])
 
+    @pytest.mark.slow
     def test_llama_recompute_flag_reduces_memory(self):
         """The model-level recompute toggle (≙ PaddleNLP recipe
         `recompute`) measurably shrinks the train-step temp memory —

@@ -82,6 +82,7 @@ class TestMoELayer:
 
 
 class TestExpertParallel:
+    @pytest.mark.slow
     def test_ep_sharded_training_step(self):
         """MoE model trains on a dp×ep mesh; loss decreases."""
         from paddle_tpu.models.moe import (MoEConfig, MoEForCausalLM,
@@ -278,6 +279,7 @@ class TestDroplessMoE:
                                    atol=1e-4)
 
 
+@pytest.mark.slow
 class TestDroplessEP:
     """Dropless × expert parallelism: shard_map all_to_all dispatch
     (VERDICT r2 item 6; SURVEY.md §2.3 EP row, §7 hard part 3)."""

@@ -144,6 +144,7 @@ class TestFlashAttention:
         assert fa._auto_block(100, 64) == 100     # unaligned -> XLA gate
         assert fa._auto_block(200, 64) == 128
 
+    @pytest.mark.slow
     def test_auto_block_parity_bench_shape(self):
         # fwd+bwd at a 2048-seq GQA shape where _auto_block picks 1024 —
         # guards the production default path (CI runs interpret mode;

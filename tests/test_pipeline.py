@@ -44,6 +44,7 @@ class TestPipelineForward:
         np.testing.assert_allclose(np.asarray(y), np.asarray(want),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_grads_match_sequential(self, pp_mesh):
         per_stage = self._stages(4)
         stacked = stack_stage_params(per_stage)
@@ -85,6 +86,7 @@ class TestPipelineForward:
                                    rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 class TestLlamaPipe:
     def test_parity_with_unstacked_llama(self):
         """No-pp path (scan over layers) == per-layer eager Llama."""
@@ -193,6 +195,7 @@ class TestLlamaPipe:
                                        atol=2e-5, err_msg=n)
 
 
+@pytest.mark.slow
 class TestFusedLossPipeline:
     """reduce_fn loss fusion: the (M, mb, S, H) output buffer collapses to
     (M,) scalars (VERDICT r2 item 7 — memory numbers + loss parity)."""
@@ -315,6 +318,7 @@ class TestInterleavedPipeline:
             pipeline_forward(_mlp_stage, stacked, x[:6], pp_mesh, 6,
                              virtual_chunks=2)
 
+    @pytest.mark.slow
     def test_grads_match_sequential(self, pp_mesh):
         s, v = 4, 2
         chunks = self._chunks(s * v)
@@ -363,6 +367,7 @@ class TestInterleavedPipeline:
         np.testing.assert_allclose(np.asarray(out), ref_r, rtol=2e-4)
 
 
+@pytest.mark.slow
 class TestLlamaPipeInterleaved:
     def test_interleaved_matches_scan(self, pp_mesh):
         """V=2 interleaved llama pipe == no-pp scan decoder."""

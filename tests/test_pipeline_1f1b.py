@@ -92,6 +92,7 @@ class TestOneFOneB:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_grads_match_gpipe_path(self, pp_mesh):
         """1F1B and grad-of-scan GPipe are the same math."""
         per_stage = _stages(4)
@@ -117,6 +118,7 @@ class TestOneFOneB:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_reduce_vector_and_args_grads(self, pp_mesh):
         """(sum, count) reductions: component 0 carries gradient, the
         reduce_args (a trained head weight) receive cotangents, and an
@@ -158,6 +160,7 @@ class TestOneFOneB:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_dp_mesh_grads_match_gpipe(self):
         """dp x pp mesh with reduce_mean_axes=('dp',): the 1F1B manual
         backward must NOT overcount grads by the dp degree (round-4
@@ -301,6 +304,7 @@ class TestInterleavedMultiRound:
             pipeline_forward(_mlp_stage, stacked, x, pp_mesh, 6,
                              virtual_chunks=2)
 
+    @pytest.mark.slow
     def test_multi_round_grads(self, pp_mesh):
         s, v = 4, 2
         chunks = self._chunks(s * v)

@@ -139,6 +139,7 @@ class TestQuantedLinearW8A8:
 
 
 class TestLlamaWeightOnlyServing:
+    @pytest.mark.slow
     def test_quantized_llama_logits_parity_and_decode(self):
         from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
                                              synthetic_lm_batch)

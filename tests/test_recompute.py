@@ -105,6 +105,7 @@ class TestRecompute:
             l1 = float(step(ids, labels))
         assert l1 < l0
 
+    @pytest.mark.slow
     def test_recompute_matches_plain_llama_loss(self):
         """Same seed => identical loss with and without recompute (no
         dropout in llama, so the RNG snapshot does not perturb parity)."""
@@ -124,6 +125,7 @@ class TestRecompute:
 
 
 class TestGradAccumulation:
+    @pytest.mark.slow
     def test_k4_matches_k1(self):
         """accumulate_steps=4 over one batch == one big-batch step."""
         from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,

@@ -133,6 +133,7 @@ class TestGenerate:
         m.eval()
         return cfg, m
 
+    @pytest.mark.slow
     def test_greedy_matches_eager_refeed(self):
         """Greedy KV-cache decode == argmax over full re-forward each
         step (the VERDICT 'greedy-decode parity test vs eager forward')."""
@@ -258,6 +259,7 @@ class TestAttentionMaskWithCache:
 
 
 class TestGPTGenerate:
+    @pytest.mark.slow
     def test_greedy_matches_eager_refeed(self):
         """GPT decode with learned position embeddings + KV cache matches
         argmax over full re-forward each step."""
@@ -281,6 +283,7 @@ class TestGPTGenerate:
         np.testing.assert_array_equal(np.asarray(toks._value), cur[:, 10:])
 
 
+@pytest.mark.slow
 class TestContinuousBatching:
     """In-flight batching (VERDICT r3 next #3): slots at different
     positions decode in ONE compiled step; admission reuses freed slots.
@@ -365,9 +368,10 @@ class TestContinuousBatching:
         eng.run()
         assert eng._decode_jit is not None
         # jax caches by signature; the step signature never changed
-        sizes = eng._decode_jit._cache_size() \
-            if hasattr(eng._decode_jit, "_cache_size") else 1
-        assert sizes == 1, sizes
+        if not hasattr(eng._decode_jit, "_cache_size"):
+            pytest.skip("jax private _cache_size API unavailable — "
+                        "single-compilation guarantee unverifiable here")
+        assert eng._decode_jit._cache_size() == 1
 
     def test_prompt_length_validation(self):
         from paddle_tpu.models.serving import ContinuousBatchingEngine

@@ -86,6 +86,7 @@ class TestFakeData:
                         transform=lambda im: im * 2)
         np.testing.assert_allclose(ds_t[2][0], x1 * 2)
 
+    @pytest.mark.slow
     def test_trains_resnet_smoke(self):
         from paddle_tpu.io import DataLoader
         from paddle_tpu.vision.models import resnet18
