@@ -44,6 +44,8 @@ from . import audio  # noqa: F401
 from . import geometric  # noqa: F401
 from . import vision  # noqa: F401
 from . import static  # noqa: F401
+from . import inference  # noqa: F401
+from . import base  # noqa: F401
 
 from .device import (get_device, set_device, is_compiled_with_cuda,  # noqa: F401
                      is_compiled_with_rocm, is_compiled_with_xpu,

@@ -1,0 +1,104 @@
+"""paddle_tpu.base — migration shim for `paddle.base` (ex-`fluid`).
+
+≙ «python/paddle/base/» (SURVEY.md §2.2 base/framework-glue row). The
+reference's Program/Block/Variable machinery is replaced by the
+op-replay `paddle.static` surface and the trace-to-XLA `paddle.jit`
+path; this module re-exports the handful of `paddle.base.*` touchpoints
+real migration scripts reach for (core feature probes, dygraph guard,
+executor, ParamAttr, unique_name), each backed by the TPU-native
+equivalent. Anything deeper (LayerHelper, custom C++ op registration)
+has no analogue by design — see docs/migration.md.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..framework import ParamAttr  # noqa: F401
+from ..utils import unique_name  # noqa: F401
+from ..static import (Executor, Program, default_main_program,  # noqa: F401
+                      default_startup_program, global_scope,
+                      program_guard)
+
+
+class core:
+    """≙ paddle.base.core feature probes (the libpaddle module)."""
+
+    @staticmethod
+    def is_compiled_with_cuda() -> bool:
+        return False
+
+    @staticmethod
+    def is_compiled_with_rocm() -> bool:
+        return False
+
+    @staticmethod
+    def is_compiled_with_xpu() -> bool:
+        return False
+
+    @staticmethod
+    def is_compiled_with_ipu() -> bool:
+        return False
+
+    class CPUPlace:
+        pass
+
+    class CUDAPlace:
+        def __init__(self, device_id=0):
+            self.device_id = device_id
+
+    @staticmethod
+    def get_cuda_device_count() -> int:
+        return 0
+
+
+class framework:
+    """≙ paddle.base.framework essentials."""
+
+    @staticmethod
+    def in_dygraph_mode() -> bool:
+        import paddle_tpu as paddle
+        return paddle.in_dynamic_mode()
+
+    in_dynamic_mode = in_dygraph_mode
+
+    @staticmethod
+    def default_main_program():
+        return default_main_program()
+
+    @staticmethod
+    def default_startup_program():
+        return default_startup_program()
+
+
+class dygraph:
+    """≙ paddle.base.dygraph: guard() is the ambient mode here."""
+
+    @staticmethod
+    @contextlib.contextmanager
+    def guard(place=None):
+        import paddle_tpu as paddle
+        was_static = not paddle.in_dynamic_mode()
+        if was_static:
+            paddle.disable_static()
+        try:
+            yield
+        finally:
+            if was_static:
+                paddle.enable_static()
+
+    @staticmethod
+    def to_variable(value, name=None, zero_copy=None, dtype=None):
+        import paddle_tpu as paddle
+        return paddle.to_tensor(value, dtype=dtype)
+
+
+class executor:
+    Executor = Executor
+
+    @staticmethod
+    def global_scope():
+        return global_scope()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False

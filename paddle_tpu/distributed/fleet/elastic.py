@@ -19,7 +19,7 @@ import re
 import time
 from typing import Optional
 
-__all__ = ["ElasticManager", "latest_checkpoint"]
+__all__ = ["ElasticManager", "latest_checkpoint", "HeartbeatMembership"]
 
 
 def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
@@ -99,3 +99,123 @@ class ElasticManager:
             import shutil
             shutil.rmtree(os.path.join(self.ckpt_dir, f"step_{s}"),
                           ignore_errors=True)
+
+
+class HeartbeatMembership:
+    """File-backed membership + heartbeat watch — the launcher-local
+    form of the reference ElasticManager's ETCD register/watch
+    («.../fleet/elastic/manager.py»: register np, watch peers, classify
+    scale-up/down). Workers on one host (or a shared filesystem)
+    register by writing `<dir>/worker_<rank>.hb` timestamps from a
+    daemon thread; the watcher classifies peers dead after
+    `timeout` seconds of silence, and `poll()` reports joins/deaths so
+    a controller can relaunch (checkpoint-restart does the resume).
+    """
+
+    def __init__(self, dir: str, rank: Optional[int] = None,
+                 interval: float = 1.0, timeout: float = 5.0):
+        self.dir = dir
+        self.rank = rank
+        self.interval = interval
+        self.timeout = timeout
+        self._stop = False
+        self._thread = None
+        self._last_alive: set = set()
+        os.makedirs(dir, exist_ok=True)
+
+    # -- worker side ---------------------------------------------------
+    def _beat_path(self, rank: int) -> str:
+        return os.path.join(self.dir, f"worker_{rank}.hb")
+
+    def start(self):
+        """Register this worker and heartbeat from a daemon thread
+        (restartable: a stopped membership can start() again)."""
+        assert self.rank is not None, "worker needs a rank"
+        self._stop = False
+        import threading
+
+        def beat():
+            while not self._stop:
+                self.heartbeat()
+                time.sleep(self.interval)
+
+        self.heartbeat()                  # register immediately
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+        return self
+
+    def heartbeat(self):
+        """One manual beat (for loops that prefer explicit control).
+        Atomic write (tmp + rename): a reader must never observe a
+        truncated/empty file and misclassify the worker as dead."""
+        assert self.rank is not None
+        tmp = self._beat_path(self.rank) + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(time.time()))
+        os.replace(tmp, self._beat_path(self.rank))
+
+    def stop(self):
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+        if self.rank is not None:
+            try:
+                os.remove(self._beat_path(self.rank))
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *a):
+        self.stop()
+        return False
+
+    # -- watcher side --------------------------------------------------
+    def alive(self) -> set:
+        """Ranks with a fresh heartbeat. Freshness uses the heartbeat
+        file's mtime (stamped by the filesystem, which on a shared FS is
+        the server clock) rather than the writer's embedded timestamp —
+        cross-host clock skew must not misclassify live workers."""
+        now = time.time()
+        out = set()
+        for name in os.listdir(self.dir):
+            m = re.fullmatch(r"worker_(\d+)\.hb", name)
+            if not m:
+                continue
+            try:
+                ts = os.stat(os.path.join(self.dir, name)).st_mtime
+            except OSError:
+                continue
+            if now - ts <= self.timeout:
+                out.add(int(m.group(1)))
+        return out
+
+    def wait_for_peers(self, np_: int, timeout: float = 60.0) -> set:
+        """Block until np_ workers are registered (rendezvous barrier)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            a = self.alive()
+            if len(a) >= np_:
+                self._last_alive = a
+                return a
+            time.sleep(self.interval / 2)
+        raise TimeoutError(
+            f"only {len(self.alive())}/{np_} workers registered within "
+            f"{timeout}s")
+
+    def poll(self) -> dict:
+        """Membership delta since the last poll: {'alive', 'joined',
+        'dead', 'event'} with event in (None, 'scale_up', 'scale_down')
+        — the reference's scale classification."""
+        a = self.alive()
+        joined = a - self._last_alive
+        dead = self._last_alive - a
+        event = None
+        if dead:
+            event = "scale_down"
+        elif joined and self._last_alive:
+            event = "scale_up"
+        self._last_alive = a
+        return {"alive": a, "joined": joined, "dead": dead,
+                "event": event}

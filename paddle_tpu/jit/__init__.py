@@ -390,16 +390,28 @@ def save(layer, path, input_spec=None, **configs):
         if input_spec is not None:
             try:
                 from jax import export as jexport
-                params = list(layer.parameters())
-                buffers = list(layer.buffers())
+                # derive BOTH lists from state_dict: that is exactly
+                # what .pdiparams serializes and what TranslatedLayer
+                # rebinds positionally at load — same membership
+                # (non-persistable buffers excluded; they bake as
+                # constants) and same ORDER, or the arity/binding drifts
+                sd = layer.state_dict()
+                params = [t for t in sd.values()
+                          if isinstance(t, Parameter)]
+                buffers = [t for t in sd.values()
+                           if isinstance(t, Tensor)
+                           and not isinstance(t, Parameter)]
 
                 def pure(param_vals, buf_vals, *arg_vals):
-                    for p, v in zip(params, param_vals):
-                        p._value = v
-                    for b, v in zip(buffers, buf_vals):
-                        b._value = v
-                    out = layer(*[Tensor(a) for a in arg_vals])
-                    return _tensors_to_values(out)
+                    # bind_state restores the live values afterwards —
+                    # without it the export trace left TRACERS on the
+                    # model's parameters (caught by the predictor-API
+                    # tests: the model was unusable after jit.save)
+                    from ..models.generation import bind_state
+                    with bind_state(params, buffers, param_vals,
+                                    buf_vals):
+                        out = layer(*[Tensor(a) for a in arg_vals])
+                        return _tensors_to_values(out)
                 specs = [jax.ShapeDtypeStruct(tuple(s.shape), s.dtype)
                          for s in input_spec]
                 exp = jexport.export(jax.jit(pure))(
@@ -414,10 +426,11 @@ def save(layer, path, input_spec=None, **configs):
         raise TypeError("jit.save expects an nn.Layer")
 
 
-def load(path, **configs):
-    """≙ paddle.jit.load — returns a TranslatedLayer-like callable."""
+def load(path, params_file=None, **configs):
+    """≙ paddle.jit.load — returns a TranslatedLayer-like callable.
+    `params_file` overrides the default `<path>.pdiparams`."""
     from ..framework import io as fio
-    state = fio.load(path + ".pdiparams")
+    state = fio.load(params_file or path + ".pdiparams")
 
     class TranslatedLayer:
         def __init__(self):
