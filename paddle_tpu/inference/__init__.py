@@ -144,7 +144,11 @@ class Predictor:
                       if isinstance(t, Tensor))
         n_in = max(len(self._layer._exported.in_avals) - n_state, 1)
         self._inputs = [_IOHandle(f"input_{i}") for i in range(n_in)]
-        self._outputs: List[_IOHandle] = []
+        # output handles exist UP FRONT (the reference script fetches
+        # them before the run loop) and are STABLE across runs — run()
+        # refreshes their values, never replaces the objects
+        n_out = max(len(self._layer._exported.out_avals), 1)
+        self._outputs = [_IOHandle(f"output_{i}") for i in range(n_out)]
 
     # -- handle API ----------------------------------------------------
     def get_input_names(self) -> List[str]:
@@ -157,7 +161,7 @@ class Predictor:
         raise KeyError(name)
 
     def get_output_names(self) -> List[str]:
-        return [h.name for h in self._outputs] or ["output_0"]
+        return [h.name for h in self._outputs]
 
     def get_output_handle(self, name: str) -> _IOHandle:
         for h in self._outputs:
@@ -179,8 +183,9 @@ class Predictor:
         out = self._layer(*[Tensor(v) for v in vals])
         leaves = jax.tree_util.tree_leaves(
             out, is_leaf=lambda x: isinstance(x, Tensor))
-        self._outputs = [_IOHandle(f"output_{i}")
-                         for i in range(len(leaves))]
+        if len(leaves) != len(self._outputs):
+            self._outputs = [_IOHandle(f"output_{i}")
+                             for i in range(len(leaves))]
         for h, t in zip(self._outputs, leaves):
             h._value = t._value if isinstance(t, Tensor) else jnp.asarray(t)
         return [np.asarray(h._value) for h in self._outputs]

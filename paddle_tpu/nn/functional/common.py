@@ -399,3 +399,78 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                + fetch(x1, y1) * (wx * wy)[:, None])
         return out.astype(v.dtype)
     return apply("grid_sample", fn, (_t(x), _t(grid)))
+
+
+def embedding_bag(input, weight, offsets=None, mode="mean",
+                  per_sample_weights=None, padding_idx=None, name=None):
+    """≙ paddle.nn.functional.embedding_bag [U]: pooled embedding lookup
+    — gathers rows of `weight` and reduces per bag ('sum'|'mean'|'max').
+    2-D `input` (B, S): each row is a bag; 1-D `input` with `offsets`
+    (B,): ragged bags (torch convention)."""
+    ids = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    wt = _t(weight)
+    psw = (per_sample_weights._value
+           if isinstance(per_sample_weights, Tensor)
+           else (jnp.asarray(per_sample_weights)
+                 if per_sample_weights is not None else None))
+    if mode not in ("sum", "mean", "max"):
+        raise ValueError(f"unknown embedding_bag mode {mode!r}")
+    if psw is not None and mode != "sum":
+        raise ValueError("per_sample_weights needs mode='sum'")
+
+    if ids.ndim == 1:
+        if offsets is None:
+            raise ValueError("1-D input needs offsets")
+        off = (offsets._value if isinstance(offsets, Tensor)
+               else jnp.asarray(offsets)).astype(jnp.int32)
+        n = ids.shape[0]
+        bag_of = jnp.cumsum(
+            jnp.zeros(n, jnp.int32).at[off[1:]].add(1)) \
+            if off.shape[0] > 1 else jnp.zeros(n, jnp.int32)
+        b = off.shape[0]
+
+        def fn(w):
+            rows = w[ids]
+            if psw is not None:
+                rows = rows * psw[:, None]
+            if padding_idx is not None:
+                rows = jnp.where((ids == padding_idx)[:, None], 0, rows)
+            if mode == "max":
+                neg = jnp.full_like(rows, -jnp.inf)
+                rows_m = jnp.where(
+                    (ids == padding_idx)[:, None], neg, rows) \
+                    if padding_idx is not None else rows
+                out = jax.ops.segment_max(rows_m, bag_of, num_segments=b)
+                return jnp.where(jnp.isfinite(out), out, 0)
+            s = jax.ops.segment_sum(rows, bag_of, num_segments=b)
+            if mode == "sum":
+                return s
+            # mean denominator excludes padded entries (torch parity,
+            # same as the 2-D path)
+            ones = jnp.ones(n)
+            if padding_idx is not None:
+                ones = jnp.where(ids == padding_idx, 0.0, ones)
+            cnt = jax.ops.segment_sum(ones, bag_of, num_segments=b)
+            return s / jnp.maximum(cnt, 1)[:, None]
+        return apply("embedding_bag", fn, (wt,))
+
+    def fn2(w):
+        rows = w[ids]                                   # (B, S, D)
+        mask = None
+        if padding_idx is not None:
+            mask = (ids != padding_idx)[..., None]
+            rows = jnp.where(mask, rows, 0)
+        if psw is not None:
+            rows = rows * psw[..., None]
+        if mode == "sum":
+            return jnp.sum(rows, axis=1)
+        if mode == "mean":
+            if mask is not None:
+                cnt = jnp.maximum(jnp.sum(mask, axis=1), 1)
+                return jnp.sum(rows, axis=1) / cnt
+            return jnp.mean(rows, axis=1)
+        neg = jnp.where(mask, rows, -jnp.inf) if mask is not None \
+            else rows
+        out = jnp.max(neg, axis=1)
+        return jnp.where(jnp.isfinite(out), out, 0)
+    return apply("embedding_bag", fn2, (wt,))

@@ -429,3 +429,45 @@ def rnnt_loss(*args, **kwargs):
     raise NotImplementedError(
         "rnnt_loss: transducer loss is deferred (not in north-star configs); "
         "the CTC path covers speech CTC training.")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean",
+                         name=None):
+    """≙ paddle.nn.functional.margin_cross_entropy [U]: ArcFace-family
+    combined-margin softmax. `logits` are COSINES (L2-normalized
+    features x weights); the target class logit cos(t) becomes
+    cos(m1*t + m2) - m3, everything is scaled, then softmax CE.
+    Single-shard TPU form (the reference's model-parallel variant maps
+    to an mp-sharded vocab + the same math; use fleet
+    ParallelCrossEntropy for that)."""
+    if reduction not in ("mean", "sum", "none"):
+        raise ValueError(
+            f"margin_cross_entropy: unknown reduction {reduction!r} "
+            "(expected 'mean', 'sum', or 'none')")
+    lb = (label._value if isinstance(label, Tensor)
+          else jnp.asarray(label)).astype(jnp.int32).reshape(-1)
+    lt = _t(logits)
+
+    def fn(v):
+        vf = v.astype(jnp.float32)
+        n, c = vf.shape
+        tgt = jnp.take_along_axis(vf, lb[:, None], axis=1)[:, 0]
+        theta = jnp.arccos(jnp.clip(tgt, -1.0 + 1e-7, 1.0 - 1e-7))
+        tgt_m = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(lb, c, dtype=vf.dtype)
+        adj = vf + onehot * (tgt_m - tgt)[:, None]
+        z = adj * scale
+        logp = jax.nn.log_softmax(z, axis=-1)
+        loss = -jnp.take_along_axis(logp, lb[:, None], axis=1)[:, 0]
+        if reduction == "mean":
+            loss_out = jnp.mean(loss)
+        elif reduction == "sum":
+            loss_out = jnp.sum(loss)
+        else:
+            loss_out = loss[:, None]
+        return loss_out, jnp.exp(logp)
+    loss, sm = apply("margin_cross_entropy", fn, (lt,),
+                     multi_output=True)
+    return (loss, sm) if return_softmax else loss

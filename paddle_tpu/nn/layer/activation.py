@@ -85,3 +85,14 @@ class Maxout(Layer):
 
     def forward(self, x):
         return F.maxout(x, self.groups, self.axis)
+
+
+class Softmax2D(Layer):
+    """≙ paddle.nn.Softmax2D [U]: softmax over the channel dim of
+    (N, C, H, W) / (C, H, W) inputs."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError(
+                f"Softmax2D expects 3-D or 4-D input, got {x.ndim}-D")
+        return F.softmax(x, axis=-3)

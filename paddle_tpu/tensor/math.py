@@ -589,3 +589,26 @@ def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
             l, h = jnp.min(v), jnp.max(v)
         return jnp.linspace(l, h, bins + 1, dtype=jnp.float32)
     return apply("histogram_bin_edges", fn, (_t(input),))
+
+
+def i0e(x, name=None):
+    """≙ paddle.i0e [U]: exponentially scaled modified Bessel I0
+    (fp32 internally, input dtype preserved)."""
+    return apply("i0e", lambda v: jax.scipy.special.i0e(
+        v.astype(jnp.float32)).astype(v.dtype), (_t(x),))
+
+
+def i1e(x, name=None):
+    """≙ paddle.i1e [U]: exponentially scaled modified Bessel I1
+    (fp32 internally, input dtype preserved)."""
+    return apply("i1e", lambda v: jax.scipy.special.i1e(
+        v.astype(jnp.float32)).astype(v.dtype), (_t(x),))
+
+
+def multigammaln(x, p, name=None):
+    """≙ paddle.multigammaln [U]: log multivariate gamma (fp32
+    internally, input dtype preserved)."""
+    return apply("multigammaln",
+                 lambda v: jax.scipy.special.multigammaln(
+                     v.astype(jnp.float32), int(p)).astype(v.dtype),
+                 (_t(x),))

@@ -245,3 +245,11 @@ def rand_like(x, dtype=None, name=None):
 def randn_like(x, dtype=None, name=None):
     dt = dtypes.convert_dtype(dtype) if dtype is not None else x._value.dtype
     return Tensor(jax.random.normal(_key(), tuple(x.shape), dt))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    """≙ paddle.log_normal [U]: exp(N(mean, std^2)) samples."""
+    shp = _shape_arg(shape) if shape is not None else ()
+    out = jnp.exp(mean + std * jax.random.normal(_key(), shp)) \
+        .astype(_dt(dtype))
+    return Tensor(out)

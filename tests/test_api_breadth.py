@@ -666,3 +666,130 @@ class TestViterbi:
         bad = paddle.to_tensor(np.zeros((6, 6), np.float32))
         with pytest.raises(ValueError, match="tag dim"):
             viterbi_decode(pot, bad)
+
+
+class TestRound4Breadth:
+    """i0e/i1e/multigammaln/log_normal/Softmax2D/embedding_bag/
+    margin_cross_entropy (round-4 breadth audit closers)."""
+
+    def test_bessel_scaled_vs_scipy(self):
+        import scipy.special as sp
+        x = np.linspace(0.1, 5, 13).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(paddle.i0e(paddle.to_tensor(x))._value),
+            sp.i0e(x), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(paddle.i1e(paddle.to_tensor(x))._value),
+            sp.i1e(x), rtol=1e-5)
+
+    def test_multigammaln_vs_scipy(self):
+        import scipy.special as sp
+        x = np.linspace(3, 8, 7).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(paddle.multigammaln(
+                paddle.to_tensor(x), 3)._value),
+            sp.multigammaln(x, 3), rtol=1e-5)
+
+    def test_log_normal_moments(self):
+        paddle.seed(3)
+        s = np.asarray(paddle.log_normal(
+            mean=0.0, std=0.25, shape=[20000])._value)
+        assert (s > 0).all()
+        np.testing.assert_allclose(np.log(s).mean(), 0.0, atol=0.02)
+        np.testing.assert_allclose(np.log(s).std(), 0.25, atol=0.02)
+
+    def test_softmax2d(self):
+        from paddle_tpu import nn
+        x = np.random.default_rng(0).normal(size=(2, 3, 4, 5)) \
+            .astype(np.float32)
+        out = np.asarray(nn.Softmax2D()(paddle.to_tensor(x))._value)
+        np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+        with pytest.raises(ValueError):
+            nn.Softmax2D()(paddle.to_tensor(np.zeros((2, 3), np.float32)))
+
+    def test_embedding_bag_vs_torch(self):
+        import torch
+        import paddle_tpu.nn.functional as F
+        r = np.random.default_rng(5)
+        w = r.normal(size=(10, 4)).astype(np.float32)
+        ids2d = r.integers(0, 10, (3, 5))
+        for mode in ("sum", "mean", "max"):
+            got = np.asarray(F.embedding_bag(
+                paddle.to_tensor(ids2d.astype(np.int32)),
+                paddle.to_tensor(w), mode=mode)._value)
+            ref = torch.nn.functional.embedding_bag(
+                torch.tensor(ids2d), torch.tensor(w),
+                mode=mode).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        # ragged 1-D + offsets
+        ids1 = r.integers(0, 10, (7,))
+        offs = np.array([0, 3, 5])
+        got = np.asarray(F.embedding_bag(
+            paddle.to_tensor(ids1.astype(np.int32)),
+            paddle.to_tensor(w),
+            offsets=paddle.to_tensor(offs.astype(np.int32)),
+            mode="mean")._value)
+        ref = torch.nn.functional.embedding_bag(
+            torch.tensor(ids1), torch.tensor(w),
+            offsets=torch.tensor(offs), mode="mean").numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_margin_cross_entropy_reduces_to_softmax_ce(self):
+        import paddle_tpu.nn.functional as F
+        r = np.random.default_rng(6)
+        cos = np.clip(r.normal(scale=0.4, size=(8, 12)), -0.95,
+                      0.95).astype(np.float32)
+        lab = r.integers(0, 12, (8,)).astype(np.int64)
+        # m1=1, m2=0, m3=0: identical to scaled softmax CE
+        plain = F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(lab), margin1=1.0,
+            margin2=0.0, margin3=0.0, scale=10.0)
+        z = cos * 10.0
+        lse = np.log(np.exp(z - z.max(-1, keepdims=True)).sum(-1)) \
+            + z.max(-1)
+        ref = (lse - z[np.arange(8), lab]).mean()
+        np.testing.assert_allclose(float(plain), ref, rtol=1e-5)
+        # arcface margin must INCREASE the loss (harder target)
+        hard = F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(lab), margin2=0.5,
+            scale=10.0)
+        assert float(hard) > float(plain)
+        # grads flow
+        t = paddle.to_tensor(cos, stop_gradient=False)
+        F.margin_cross_entropy(t, paddle.to_tensor(lab)).backward()
+        assert t.grad is not None
+
+    def test_embedding_bag_offsets_padding_mean_matches_torch(self):
+        import torch
+        import paddle_tpu.nn.functional as F
+        w = np.arange(40, dtype=np.float32).reshape(10, 4)
+        ids = np.array([0, 1, 2], np.int64)
+        offs = np.array([0, 3], np.int64)
+        got = np.asarray(F.embedding_bag(
+            paddle.to_tensor(ids.astype(np.int32)), paddle.to_tensor(w),
+            offsets=paddle.to_tensor(offs.astype(np.int32)),
+            mode="mean", padding_idx=0)._value)
+        ref = torch.nn.functional.embedding_bag(
+            torch.tensor(ids), torch.tensor(w),
+            offsets=torch.tensor(offs), mode="mean",
+            padding_idx=0).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_margin_ce_bad_reduction_raises(self):
+        import paddle_tpu.nn.functional as F
+        with pytest.raises(ValueError, match="reduction"):
+            F.margin_cross_entropy(
+                paddle.to_tensor(np.zeros((2, 3), np.float32)),
+                paddle.to_tensor(np.zeros(2, np.int64)),
+                reduction="avg")
+
+    def test_log_normal_int_and_tensor_shapes(self):
+        paddle.seed(0)
+        assert tuple(paddle.log_normal(shape=5).shape) == (5,)
+        assert tuple(paddle.log_normal(
+            shape=paddle.to_tensor(np.array([3], np.int32))).shape) == (3,)
+
+    def test_i0e_preserves_dtype(self):
+        import jax.numpy as jnp
+        t = paddle.to_tensor(np.ones(4, np.float32)).astype("bfloat16")
+        assert paddle.i0e(t)._value.dtype == jnp.bfloat16

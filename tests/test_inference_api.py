@@ -219,3 +219,25 @@ class TestReviewRegressions:
         time.sleep(0.7)                   # past one timeout window
         assert watcher.alive() == {0}, "restarted worker went stale"
         w.stop()
+
+    def test_output_handles_stable_and_prefetchable(self, tmp_path):
+        """Reference scripts fetch output handles BEFORE the run loop
+        and reuse them across runs (round-4 review finding)."""
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        m.eval()
+        prefix = str(tmp_path / "h")
+        paddle.jit.save(m, prefix,
+                        input_spec=[paddle.static.InputSpec([1, 4],
+                                                            "float32")])
+        from paddle_tpu.inference import Config, create_predictor
+        p = create_predictor(Config(prefix))
+        out_h = p.get_output_handle(p.get_output_names()[0])  # pre-run
+        in_h = p.get_input_handle(p.get_input_names()[0])
+        for scale in (1.0, 2.0):
+            in_h.copy_from_cpu(np.full((1, 4), scale, np.float32))
+            p.run()
+            fresh = out_h.copy_to_cpu()          # same handle object
+            want = np.asarray(m(paddle.to_tensor(
+                np.full((1, 4), scale, np.float32)))._value)
+            np.testing.assert_allclose(fresh, want, rtol=1e-5)
