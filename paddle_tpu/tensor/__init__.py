@@ -28,7 +28,7 @@ from .linalg import (norm, dist, cholesky, matrix_power, pinv,  # noqa: F401
 from .manipulation import t  # noqa: F401
 
 _METHOD_SOURCES = [math, manipulation, logic, search, stat, linalg, attribute,
-                   creation]
+                   creation, random]
 
 # ops attached as Tensor methods (tensor-first signature)
 _METHOD_NAMES = [
@@ -83,6 +83,10 @@ _METHOD_NAMES = [
     "histogram_bin_edges", "bitwise_invert", "diagonal_scatter",
     "select_scatter", "slice_scatter", "sgn", "sinc", "pdist", "renorm",
     "vander", "combinations", "polygamma", "gammaln",
+    # round-4 breadth (Tensor-method audit closers)
+    "arccos", "arcsin", "arctan", "arccosh", "arcsinh", "arctanh",
+    "reverse", "logit", "multinomial", "slice", "stack", "tensordot",
+    "inverse", "is_tensor", "shard_index",
 ]
 
 
@@ -133,6 +137,53 @@ def _attach_methods():
         self._node = None
         return self
 
+    def fill_diagonal_(self, value, offset=0, wrap=False):
+        import builtins
+        import jax.numpy as jnp
+        v = self._value
+        if v.ndim < 2:
+            raise ValueError("fill_diagonal_ needs ndim >= 2")
+        if v.ndim > 2:
+            # paddle semantics for >2-D: all dims equal, fill x[i,...,i]
+            if len(set(v.shape)) != 1:
+                raise ValueError(
+                    "fill_diagonal_ on ndim > 2 requires all dims equal")
+            if offset:
+                raise ValueError("offset is 2-D only")
+            i = jnp.arange(v.shape[0])
+            self._value = v.at[(i,) * v.ndim].set(value)
+            self._node = None
+            return self
+        r, c = v.shape
+        # builtins: the module-level min/max are paddle's reductions
+        ln = builtins.max(builtins.min(r - builtins.max(-offset, 0),
+                                       c - builtins.max(offset, 0)), 0)
+        i = jnp.arange(ln)
+        v = v.at[i + builtins.max(-offset, 0),
+                 i + builtins.max(offset, 0)].set(value)
+        if wrap and r > c and offset == 0:
+            # numpy wrap semantics: every (C+1)th flat element
+            flat = v.reshape(-1).at[jnp.arange(0, r * c, c + 1)]                 .set(value)
+            v = flat.reshape(r, c)
+        self._value = v
+        self._node = None
+        return self
+
+    def pin_memory(self):
+        return self          # host/device staging is XLA's job on TPU
+
+    def softmax(self, axis=-1):
+        from ..nn import functional as F
+        return F.softmax(self, axis)
+
+    def lu(self, pivot=True, get_infos=False, name=None):
+        from .. import linalg as _linalg
+        return _linalg.lu(self, pivot=pivot, get_infos=get_infos)
+
+    Tensor.lu = lu
+    Tensor.fill_diagonal_ = fill_diagonal_
+    Tensor.pin_memory = pin_memory
+    Tensor.softmax = softmax
     Tensor.zero_ = zero_
     Tensor.fill_ = fill_
     Tensor.uniform_ = random.uniform_

@@ -714,3 +714,7 @@ def slice_scatter(x, value, axes, starts, ends, strides, name=None):
             idx[ax % v.ndim] = builtins_slice(st, en, sd)
         return v.at[tuple(idx)].set(s.astype(v.dtype))
     return apply("slice_scatter", fn, (_t(x), _t(value)))
+
+
+# paddle alias: reverse == flip
+reverse = flip

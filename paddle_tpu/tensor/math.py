@@ -77,7 +77,14 @@ frac = _unary("frac", lambda v: v - jnp.trunc(v))
 erf = _unary("erf", jax.scipy.special.erf)
 erfinv = _unary("erfinv", jax.scipy.special.erfinv)
 sigmoid = _unary("sigmoid", jax.nn.sigmoid)
-logit = _unary("logit", jax.scipy.special.logit)
+def logit(x, eps=None, name=None):
+    """≙ paddle.logit: log(x/(1-x)); with eps, x is clamped to
+    [eps, 1-eps] first (reference contract)."""
+    def fn(v):
+        if eps is not None:
+            v = jnp.clip(v, eps, 1 - eps)
+        return jax.scipy.special.logit(v)
+    return apply("logit", fn, (_t(x),))
 digamma = _unary("digamma", jax.scipy.special.digamma)
 lgamma = _unary("lgamma", jax.scipy.special.gammaln)
 angle = _unary("angle", jnp.angle)
@@ -612,3 +619,12 @@ def multigammaln(x, p, name=None):
                  lambda v: jax.scipy.special.multigammaln(
                      v.astype(jnp.float32), int(p)).astype(v.dtype),
                  (_t(x),))
+
+
+# numpy-style aliases (paddle ships both spellings)
+arccos = acos
+arcsin = asin
+arctan = atan
+arccosh = acosh
+arcsinh = asinh
+arctanh = atanh
