@@ -20,17 +20,23 @@ TPU-native design — two dispatch strategies behind one MoELayer API:
   DeepSeekMoE-scale path (E=64+), where the dense (T, E, C) tensors
   are catastrophic. Under expert parallelism, TWO dispatch modes:
 
-  - ep_dispatch='exact' (default): a TWO-PHASE exchange — per-pair
-    counts are all-gathered, then `lax.ragged_all_to_all` moves only
-    the real rows (the TPU-native equivalent of the reference's
-    `global_scatter`/`global_gather` exactness). ZERO drops under any
-    routing skew; the receive buffer is sized to the static worst case
-    (ep·T_local·k rows), which is the price of exactness under XLA's
-    static shapes — only the ragged payload actually rides the ICI.
-  - ep_dispatch='capacity': static per-pair budget buffers (cheapest
-    memory, bounded bandwidth); tokens beyond a pair's budget are
-    DROPPED, and the layer surfaces a hard per-step drop counter
-    (`MoELayer.last_drop_count`) so silent degradation is impossible.
+  - exact mode (default, `ep_pair_capacity_factor=None`): ZERO drops
+    under any routing skew. On TPU this is a TWO-PHASE exchange —
+    per-pair counts are all-gathered, then `lax.ragged_all_to_all`
+    moves ONLY the real rows, so just the ragged payload rides the ICI
+    (the TPU-native equivalent of the reference's
+    `global_scatter`/`global_gather` exactness); the receive buffer is
+    still sized to the static ep·T_local·k worst case, the price of
+    exactness under XLA's static shapes. On backends where XLA has no
+    `ragged-all-to-all` (CPU — the 8-virtual-device test mesh), the
+    same exactness is kept by a dense `lax.all_to_all` of worst-case
+    per-pair buffers (ep× the bandwidth of the actual load); the two
+    paths are numerically identical.
+  - capacity mode (`ep_pair_capacity_factor=f`): static per-pair
+    budget buffers (cheapest memory, bounded bandwidth); tokens beyond
+    a pair's budget are DROPPED, and the layer surfaces a hard
+    per-step drop counter (`MoELayer.last_drop_count`) so silent
+    degradation is impossible.
 
 Both use the standard load-balancing auxiliary loss.
 """
@@ -199,27 +205,58 @@ def moe_ffn_dropless_values(x2, gate_w, w_gate, w_up, w_down, top_k: int):
     return out.astype(x2.dtype), _aux_loss(probs, gate_idx)
 
 
+def _ragged_ep_offsets(counts, me):
+    """Offset bookkeeping for the two-phase ragged exchange.
+
+    counts: (ep, ep) int32, counts[s, j] = rows shard s sends to shard
+    j (the all-gathered per-pair counts). Receivers lay incoming rows
+    out in sender order. For shard `me` returns, all (ep,) int32:
+      out_off[j]      where my rows land in receiver j's buffer
+      recv_sizes[s]   rows I receive from sender s
+      recv_off[s]     where sender s's rows sit in my receive buffer
+      back_out_off[s] where my returned rows land in sender s's
+                      dst-sorted send layout (= s's own send offsets
+                      toward me, recomputed here from the shared counts)
+    """
+    out_off = (jnp.cumsum(counts, axis=0) - counts)[me]
+    recv_sizes = counts[:, me]
+    recv_off = jnp.cumsum(recv_sizes) - recv_sizes
+    back_out_off = (jnp.cumsum(counts, axis=1) - counts)[:, me]
+    return out_off, recv_sizes, recv_off, back_out_off
+
+
 def moe_ffn_dropless_ep_values(x2, gate_w, w_gate_l, w_up_l, w_down_l,
                                top_k: int, ep_size: int, axis_name: str,
-                               token_axes, pair_capacity: int):
+                               token_axes, pair_capacity: int,
+                               ragged: bool = False):
     """Per-shard body of the dropless × expert-parallel path. Runs INSIDE
     shard_map: x2 is this program's (T_local, H) token shard; w_*_l are
     the E/ep experts this shard owns.
 
     ≙ the reference's `global_scatter`/`global_gather` alltoall dispatch
-    (SURVEY.md §2.3 EP row), made static-shape: each (src, dst) shard
-    pair exchanges a fixed `pair_capacity`-row buffer via
-    `lax.all_to_all` over the `ep` ICI axis. With pair_capacity =
-    T_local·k (the static worst case — MoELayer's 'exact' mode, the
-    default) NO routing skew can overflow a pair's buffer, so the
-    exchange is EXACT like the reference's; with a smaller budget
-    ('capacity' mode) overflow tokens are dropped and the returned drop
-    counter (globally psum-reduced) surfaces exactly how many. Expert
-    compute is the same grouped-matmul FFN; a reverse all_to_all routes
-    rows home.
+    (SURVEY.md §2.3 EP row). Two exchange strategies:
+
+    * ragged=False (every backend): each (src, dst) shard pair exchanges
+      a fixed `pair_capacity`-row buffer via `lax.all_to_all` over the
+      `ep` ICI axis. With pair_capacity = T_local·k (the static worst
+      case — MoELayer's 'exact' mode, the default) NO routing skew can
+      overflow a pair's buffer, so the exchange is EXACT like the
+      reference's; with a smaller budget ('capacity' mode) overflow
+      tokens are dropped and the returned drop counter (globally
+      psum-reduced) surfaces exactly how many.
+    * ragged=True (TPU only — XLA:CPU has no ragged-all-to-all thunk;
+      always exact, `pair_capacity` is ignored): per-pair counts are
+      all-gathered, then THREE `lax.ragged_all_to_all`s move only the
+      real rows (tokens out, expert ids out, FFN rows home), so just
+      the ragged payload rides the ICI. The receive buffer stays at the
+      static ep·T_local·k worst case — static shapes — but bandwidth is
+      proportional to the actual routed load, like `global_scatter`.
+
+    Expert compute is the same grouped-matmul FFN either way.
 
     Returns (out (T_local, H), aux scalar, drops scalar int32 —
-    replicated global count of dropped token-choices this step).
+    replicated global count of dropped token-choices this step; always
+    0 when ragged).
     """
     t_l, h = x2.shape
     e = gate_w.shape[1]
@@ -234,6 +271,12 @@ def moe_ffn_dropless_ep_values(x2, gate_w, w_gate_l, w_up_l, w_down_l,
     flat = gate_idx.reshape(-1)                   # (N,) global expert id
     tok = jnp.arange(n) // top_k
     dst = flat // e_l                             # target ep shard
+
+    if ragged:
+        out, aux = _moe_ep_ragged(x2, tok, flat, dst, gate_vals, probs,
+                                  gate_idx, w_gate_l, w_up_l, w_down_l,
+                                  e, e_l, ep_size, axis_name, token_axes)
+        return out, aux, jnp.zeros((), jnp.int32)
     # rank of each slot within its destination's buffer (priority = slot
     # order, i.e. token-major / choice-minor)
     oh = jax.nn.one_hot(dst, ep_size, dtype=jnp.int32)
@@ -276,6 +319,73 @@ def moe_ffn_dropless_ep_values(x2, gate_w, w_gate_l, w_up_l, w_down_l,
         p = jax.lax.pmean(p, ax)
     aux = e * jnp.sum(f * p)
     return out.astype(x2.dtype), aux, drops
+
+
+def _moe_ep_ragged(x2, tok, flat, dst, gate_vals, probs, gate_idx,
+                   w_gate_l, w_up_l, w_down_l, e, e_l, ep_size,
+                   axis_name, token_axes):
+    """Two-phase exact exchange: count all-gather + ragged_all_to_all.
+    See moe_ffn_dropless_ep_values (ragged=True). TPU-only at runtime."""
+    t_l, h = x2.shape
+    n = t_l * gate_vals.shape[1]
+
+    # dst-sorted send layout: receiver j's rows are contiguous
+    order = jnp.argsort(dst, stable=True)                     # (N,)
+    send_x = x2[tok[order]]
+    send_e = (flat % e_l)[order].astype(jnp.int32)
+    send_sizes = jnp.bincount(dst, length=ep_size).astype(jnp.int32)
+    in_off = (jnp.cumsum(send_sizes) - send_sizes).astype(jnp.int32)
+
+    # phase 1: per-pair counts ride a (tiny) all_gather
+    counts = jax.lax.all_gather(send_sizes, axis_name)        # (ep, ep)
+    me = jax.lax.axis_index(axis_name)
+    out_off, recv_sizes, recv_off, back_out_off = \
+        _ragged_ep_offsets(counts, me)
+
+    # phase 2: only the real rows move; the receive buffer keeps the
+    # static worst-case size (zeros beyond the received region — zero
+    # rows contribute zero through the bias-free SwiGLU)
+    r_buf = ep_size * n
+    recv_x = jax.lax.ragged_all_to_all(
+        send_x, jnp.zeros((r_buf, h), send_x.dtype), in_off,
+        send_sizes, out_off, recv_sizes, axis_name=axis_name)
+    recv_e = jax.lax.ragged_all_to_all(
+        send_e, jnp.zeros((r_buf,), jnp.int32), in_off,
+        send_sizes, out_off, recv_sizes, axis_name=axis_name)
+
+    rows = _expert_ffn_rows(recv_x, recv_e, w_gate_l, w_up_l, w_down_l,
+                            e_l)
+
+    # route rows home into the sender's dst-sorted layout, then unsort
+    back = jax.lax.ragged_all_to_all(
+        rows.astype(x2.dtype), jnp.zeros((n, h), x2.dtype), recv_off,
+        recv_sizes, back_out_off, send_sizes, axis_name=axis_name)
+    slot_rows = jnp.zeros_like(back).at[order].set(back)
+
+    wv = gate_vals.reshape(-1).astype(jnp.float32)
+    out = jnp.zeros((t_l, h), jnp.float32).at[tok].add(
+        slot_rows.astype(jnp.float32) * wv[:, None])
+    # aux loss: pmean the factors (see the dense path's comment)
+    f = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32),
+                 axis=0)
+    p = jnp.mean(probs, axis=0)
+    for ax in token_axes:
+        f = jax.lax.pmean(f, ax)
+        p = jax.lax.pmean(p, ax)
+    aux = e * jnp.sum(f * p)
+    return out.astype(x2.dtype), aux
+
+
+def _ragged_ep_supported() -> bool:
+    """Gate for the ragged exact-EP exchange: XLA has a
+    ragged-all-to-all thunk on TPU but not on CPU (verified UNIMPLEMENTED
+    on jax 0.9.0 XLA:CPU). PDT_MOE_RAGGED=1/0 overrides for tests."""
+    import os
+    ov = os.environ.get("PDT_MOE_RAGGED")
+    if ov is not None:
+        return ov == "1"
+    from ...ops import on_tpu
+    return on_tpu() and hasattr(jax.lax, "ragged_all_to_all")
 
 
 class MoELayer(Layer):
@@ -363,12 +473,17 @@ class MoELayer(Layer):
                             shard_map as _shard_map
                     from jax.sharding import PartitionSpec as P
                     t_l = t // n_shards
+                    use_ragged = False
                     if pcf is None:
-                        # exact mode: the static worst case — one shard
-                        # can never send more than its own T_local*k
-                        # slots to one destination, so zero drops under
-                        # ANY routing (≙ global_scatter exactness)
+                        # exact mode: zero drops under ANY routing
+                        # (≙ global_scatter exactness). On TPU the
+                        # two-phase ragged exchange moves only real
+                        # rows; elsewhere the dense worst-case buffer
+                        # (one shard can never send more than its own
+                        # T_local*k slots to one destination) keeps
+                        # the same exactness at ep× the bandwidth.
                         cap = t_l * top_k
+                        use_ragged = _ragged_ep_supported()
                     else:
                         cap = max(1, min(
                             int(math.ceil(top_k * t_l / ep_size * pcf)),
@@ -377,7 +492,7 @@ class MoELayer(Layer):
                     def body(x_l, gw_, wg_l, wu_l, wd_l):
                         return moe_ffn_dropless_ep_values(
                             x_l, gw_, wg_l, wu_l, wd_l, top_k, ep_size,
-                            ep, list(tok_axes), cap)
+                            ep, list(tok_axes), cap, ragged=use_ragged)
                     mapped = _shard_map(
                         body, mesh=mesh.jax_mesh,
                         in_specs=(P(tok_axes, None), P(None, None),

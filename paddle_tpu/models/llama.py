@@ -164,6 +164,24 @@ def _update_kv_cache(cache: Tensor, new: Tensor, offset) -> Tensor:
     return _apply("kv_cache_update", fn, (cache, new))
 
 
+class PagedKVCacheView:
+    """`past_key_value` for the paged decode path (≙ the reference serving
+    engine's blocked KV cache under «fused_multi_transformer», SURVEY.md
+    §2.1 fused row): per-layer page pools (HK, P, page_size, D) plus the
+    SHARED per-sequence block table (B, pps). The token's write position
+    and the context length both come from `position_offset`, which must be
+    a (B,) vector on this path. Decode-only (seq_len == 1)."""
+
+    def __init__(self, k_pages, v_pages, block_tables):
+        self.k_pages = k_pages if isinstance(k_pages, Tensor) \
+            else Tensor(k_pages)
+        self.v_pages = v_pages if isinstance(v_pages, Tensor) \
+            else Tensor(v_pages)
+        bt = block_tables._value if isinstance(block_tables, Tensor) \
+            else block_tables
+        self.block_tables = jnp.asarray(bt, jnp.int32)
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
@@ -193,6 +211,43 @@ class LlamaAttention(nn.Layer):
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         q = apply_rope(q, cos, sin, position_offset)
         k = apply_rope(k, cos, sin, position_offset)
+        if isinstance(past_key_value, PagedKVCacheView):
+            if s != 1:
+                raise ValueError(
+                    "paged KV cache is decode-only (seq_len == 1); "
+                    "prefill scatters rows via paged_prefill_scatter")
+            if self.sliding_window is not None:
+                raise NotImplementedError(
+                    "sliding_window attention over a paged KV cache is "
+                    "not supported — use the dense cache layout")
+            from paddle_tpu.ops.paged_attention import (
+                paged_append_values, paged_attention_values)
+            from paddle_tpu.core.tensor import apply as _apply
+            pos = (position_offset._value
+                   if isinstance(position_offset, Tensor)
+                   else jnp.asarray(position_offset, jnp.int32))
+            if jnp.ndim(pos) != 1:
+                raise ValueError(
+                    "paged KV cache needs a (B,) position_offset vector")
+            bt = past_key_value.block_tables
+
+            def fn_append(kp, vp, kk, vv):
+                return paged_append_values(kp, vp, kk[:, 0], vv[:, 0],
+                                           bt, pos)
+            kp_new, vp_new = _apply(
+                "paged_kv_append", fn_append,
+                (past_key_value.k_pages, past_key_value.v_pages, k, v),
+                multi_output=True)
+
+            def fn_attn(qq, kp, vp):
+                return paged_attention_values(qq[:, 0], kp, vp, pos + 1,
+                                              bt)
+            out = _apply("paged_attention", fn_attn,
+                         (q, kp_new, vp_new))
+            out = self.o_proj(out.reshape([b, s, -1]))
+            if use_cache:
+                return out, PagedKVCacheView(kp_new, vp_new, bt)
+            return out
         if past_key_value is not None:
             k_cache, v_cache = past_key_value
             k_cache = _update_kv_cache(k_cache, k, position_offset)

@@ -97,8 +97,9 @@ class LlamaForCausalLMPipe(nn.Layer):
         self.virtual_pipeline_degree = virtual_pipeline_degree
         # '1f1b' (default; ≙ reference PipelineParallel.train_batch,
         # S-bounded activation residency) or 'gpipe' (grad-of-scan).
-        # The interleaved virtual pipeline (V > 1) currently runs the
-        # GPipe schedule; 1F1B applies to the plain-stage layout.
+        # Both compose with the interleaved virtual pipeline (V > 1);
+        # 1f1b × V>1 runs the table-driven interleaved 1F1B schedule
+        # (≙ PipelineParallelWithInterleave).
         if pipeline_schedule not in ("1f1b", "gpipe"):
             raise ValueError(f"unknown pipeline_schedule "
                              f"{pipeline_schedule!r}")
@@ -260,12 +261,10 @@ class LlamaForCausalLMPipe(nn.Layer):
                         return jnp.stack([jnp.sum(per_tok),
                                           valid.sum().astype(jnp.float32)])
 
-                    use_1f1b = (self.pipeline_schedule == "1f1b"
-                                and vp == 1)
+                    use_1f1b = self.pipeline_schedule == "1f1b"
                     pipe_call = (pipeline_1f1b if use_1f1b
                                  else pipeline_forward)
-                    kw = ({} if use_1f1b
-                          else {"virtual_chunks": vp})
+                    kw = {"virtual_chunks": vp}
                     stats = pipe_call(
                         stage_fn, staged, x, mesh, m, axis="pp",
                         extra_args=(cs, sn), param_specs=specs,

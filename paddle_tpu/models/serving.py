@@ -1,24 +1,36 @@
-"""Continuous-batching serving loop.
+"""Continuous-batching serving loop over a PAGED KV cache.
 
 ≙ the reference inference engine's in-flight batching
-(«paddle/fluid/inference/» serving stack + fused_multi_transformer
-decode kernels, SURVEY.md §1 L10 / §2.1 fused rows) — TPU-native:
+(«paddle/fluid/inference/» serving stack + fused_multi_transformer /
+masked_multihead_attention decode kernels, SURVEY.md §1 L10 / §2.1 fused
+rows) — TPU-native:
 
 * ONE compiled decode-step program serves the whole slot batch forever:
-  (caches, last tokens, per-slot positions) -> (next tokens, caches),
-  with per-slot positions flowing as a VECTOR through rope, the KV
-  scatter, and the end-aligned attention mask. Slots at different
-  sequence positions decode together — no recompilation, ever.
-* Admission happens BETWEEN steps on the host: a finished slot's cache
-  rows are overwritten by the next request's prefill (prompt lengths
-  bucketed to a padding grid so prefill programs are reused), and the
-  decode program never notices. This is vLLM-style continuous batching
-  with XLA-static shapes.
-* Greedy decoding (the serving default); sampling hooks onto the same
-  step function later.
+  (page pools, last tokens, per-slot positions, block tables) ->
+  (next tokens, page pools), with per-slot positions flowing as a VECTOR
+  through rope, the paged KV append, and the paged-attention context
+  lengths. Slots at different sequence positions decode together — no
+  recompilation, ever.
+* The KV cache is a fixed pool of (page_size x D) pages per layer shared
+  by all slots (vLLM-style). A host-side allocator hands pages out
+  lazily as sequences grow and reclaims them when requests finish, so
+  HBM-in-use is proportional to the tokens actually resident, not to
+  B x S_max. Page 0 is a permanently reserved trash page: writes from
+  inactive slots and padded prefill rows land there and are never read.
+* Admission happens BETWEEN steps on the host: prompt lengths are
+  bucketed to a padding grid so prefill programs are reused (LRU-capped),
+  and a request is admitted only when its WORST-CASE page demand fits the
+  pool net of other slots' outstanding reservations — growth can then
+  never strand a mid-flight request.
+* Greedy decoding by default; temperature / top-k / top-p sampling rides
+  the same compiled step via `_sample_token` (seeded, reproducible).
+* `kv_layout="dense"` keeps the previous per-slot contiguous caches
+  (needed for sliding-window models; also the parity oracle for the
+  paged path).
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -27,6 +39,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from ..autograd import no_grad
 
 __all__ = ["ContinuousBatchingEngine", "Request"]
 
@@ -41,14 +54,23 @@ class Request:
 
 
 class ContinuousBatchingEngine:
-    """In-flight batched greedy serving for cache-capable causal LMs
+    """In-flight batched serving for cache-capable causal LMs
     (LlamaForCausalLM-family: forward(ids, past_key_values,
     position_offset, use_cache))."""
 
     def __init__(self, model, max_batch_size: int = 8,
                  max_seq_len: Optional[int] = None,
                  eos_token_id: Optional[int] = None,
-                 prompt_pad: int = 16):
+                 prompt_pad: int = 16,
+                 kv_layout: str = "paged",
+                 page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 do_sample: bool = False,
+                 temperature: float = 1.0,
+                 top_k: int = 0,
+                 top_p: float = 1.0,
+                 seed: int = 0,
+                 max_prefill_programs: int = 8):
         cfg = model.config
         self.model = model
         self.B = int(max_batch_size)
@@ -60,17 +82,49 @@ class ContinuousBatchingEngine:
                 f"max_seq_len {self.S} exceeds the model's rope table "
                 f"(max_position_embeddings="
                 f"{cfg.max_position_embeddings})")
+        if kv_layout not in ("paged", "dense"):
+            raise ValueError(f"kv_layout {kv_layout!r}: paged|dense")
+        if kv_layout == "paged" \
+                and getattr(cfg, "sliding_window", None) is not None:
+            raise NotImplementedError(
+                "sliding_window models need kv_layout='dense'")
         self.eos = eos_token_id
         self.pad = int(prompt_pad)
+        self.layout = kv_layout
+        self.strategy = "sampling" if do_sample else "greedy_search"
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self._key = jax.random.PRNGKey(int(seed))
+        self._max_prefill = int(max_prefill_programs)
         self._params = list(model.parameters())
         self._buffers = list(model.buffers())
         hk, hd = cfg.num_key_value_heads, cfg.head_dim
         L = cfg.num_hidden_layers
         dt = self._params[0]._value.dtype
-        self._caches = [
-            (jnp.zeros((self.B, self.S, hk, hd), dt),
-             jnp.zeros((self.B, self.S, hk, hd), dt))
-            for _ in range(L)]
+        self._kv_shape = (L, hk, hd, dt)
+        if kv_layout == "dense":
+            self._caches = [
+                (jnp.zeros((self.B, self.S, hk, hd), dt),
+                 jnp.zeros((self.B, self.S, hk, hd), dt))
+                for _ in range(L)]
+        else:
+            self.page_size = int(page_size)
+            self.pps = -(-self.S // self.page_size)
+            # +1: page 0 is the reserved trash page
+            self.num_pages = int(num_pages or self.B * self.pps + 1)
+            if self.num_pages < 2:
+                raise ValueError("num_pages must be >= 2 (page 0 is "
+                                 "reserved)")
+            self._kv = [
+                (jnp.zeros((hk, self.num_pages, self.page_size, hd), dt),
+                 jnp.zeros((hk, self.num_pages, self.page_size, hd), dt))
+                for _ in range(L)]
+            self._bt = np.zeros((self.B, self.pps), np.int32)
+            self._free: List[int] = list(range(1, self.num_pages))
+            self._slot_pages: List[List[int]] = [[] for _ in range(self.B)]
+            self._slot_reserved = np.zeros(self.B, np.int64)
+            self._scatter_jits: Dict[int, object] = {}
         # host-side slot state
         self._pos = np.zeros(self.B, np.int32)        # next write position
         self._tok = np.zeros(self.B, np.int32)        # last emitted token
@@ -79,7 +133,7 @@ class ContinuousBatchingEngine:
         self._next_rid = 0
         self._decode_jit = None
         self._insert_jit = None
-        self._prefill_jits: Dict[int, object] = {}
+        self._prefill_jits: "OrderedDict[int, object]" = OrderedDict()
 
     # -- public API ----------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32) -> int:
@@ -94,6 +148,16 @@ class ContinuousBatchingEngine:
                 f"prompt length {len(toks)} does not fit max_seq_len "
                 f"{self.S} (need at least one decode position)")
         r = Request(self._next_rid, toks, int(max_new_tokens))
+        if self.layout == "paged":
+            usable = self.num_pages - 1
+            need = self._worst_pages(r)
+            if need > usable:
+                raise ValueError(
+                    f"request needs up to {need} KV pages (prompt "
+                    f"{len(toks)} + max_new_tokens {max_new_tokens} at "
+                    f"page_size {self.page_size}) but the pool has only "
+                    f"{usable} usable pages — it could never be "
+                    f"admitted; raise num_pages")
         self._next_rid += 1
         self._queue.append(r)
         return r.rid
@@ -126,40 +190,97 @@ class ContinuousBatchingEngine:
                     or int(self._pos[i]) >= self.S - 1:
                 r.done = True
                 finished.append(r)
-                self._slot_req[i] = None     # slot freed for admission
+                self._release_slot(i)
         return finished
 
+    def cache_memory_info(self) -> Dict[str, float]:
+        """KV-cache HBM accounting. For the paged layout `bytes_in_use`
+        is proportional to pages actually allocated (≙ the inference
+        engine's memory-optim story, SURVEY.md §1 L10)."""
+        L, hk, hd, dt = self._kv_shape
+        itemsize = jnp.dtype(dt).itemsize
+        if self.layout == "dense":
+            total = self.B * self.S * hk * hd * itemsize * 2 * L
+            return {"layout": "dense", "bytes_pool": total,
+                    "bytes_in_use": total, "utilization": 1.0}
+        page_bytes = self.page_size * hk * hd * itemsize * 2 * L
+        usable = self.num_pages - 1
+        in_use = usable - len(self._free)
+        return {"layout": "paged", "page_bytes": page_bytes,
+                "total_pages": usable, "pages_in_use": in_use,
+                "bytes_pool": self.num_pages * page_bytes,
+                "bytes_in_use": in_use * page_bytes,
+                "utilization": in_use / max(usable, 1)}
+
     # -- internals -----------------------------------------------------
+    def _release_slot(self, slot: int):
+        self._slot_req[slot] = None
+        if self.layout == "paged":
+            self._free.extend(self._slot_pages[slot])
+            self._slot_pages[slot] = []
+            self._slot_reserved[slot] = 0
+            # inactive slots keep decoding garbage; their block-table row
+            # must point at the trash page, not at reclaimed pages
+            self._bt[slot] = 0
+
+    def _next_keys(self, n: int = 1):
+        keys = jax.random.split(self._key, n + 1)
+        self._key = keys[0]
+        return keys[1:] if n > 1 else keys[1]
+
     def _bucket(self, n: int) -> int:
         # clamped to the cache: a prompt near max_seq_len must not
         # round its prefill window past the cache end
         return min(int(-(-n // self.pad) * self.pad), self.S)
 
+    def _get_prefill(self, bucket: int):
+        jit = self._prefill_jits.get(bucket)
+        if jit is None:
+            jit = self._build_prefill(bucket)
+            self._prefill_jits[bucket] = jit
+            while len(self._prefill_jits) > self._max_prefill:
+                old, _ = self._prefill_jits.popitem(last=False)  # LRU
+                # the paged scatter program is keyed by the same bucket —
+                # evict it together or compiled programs still accumulate
+                if self.layout == "paged":
+                    self._scatter_jits.pop(old, None)
+        else:
+            self._prefill_jits.move_to_end(bucket)
+        return jit
+
     def _build_prefill(self, p_len: int):
-        model, B, S = self.model, self.B, self.S
+        """One compiled program per prompt bucket: causal pass over the
+        padded prompt -> (first token, per-layer KV rows for the
+        prompt window). Layout-agnostic — rows are inserted into the
+        dense cache or scattered into pages by a separate donated
+        program."""
+        model = self.model
         params, buffers = self._params, self._buffers
         cfg = model.config
         hk, hd = cfg.num_key_value_heads, cfg.head_dim
         L = cfg.num_hidden_layers
+        strat, temp = self.strategy, self.temperature
+        tk, tp = self.top_k, self.top_p
 
-        def run(pv, bv, ids, true_len):
-            from .generation import bind_state
-            with bind_state(params, buffers, pv, bv):
+        def run(pv, bv, ids, true_len, key):
+            from .generation import bind_state, _sample_token
+            with bind_state(params, buffers, pv, bv), no_grad():
                 dt = pv[0].dtype
-                caches = [(Tensor(jnp.zeros((1, S, hk, hd), dt)),
-                           Tensor(jnp.zeros((1, S, hk, hd), dt)))
+                caches = [(Tensor(jnp.zeros((1, p_len, hk, hd), dt)),
+                           Tensor(jnp.zeros((1, p_len, hk, hd), dt)))
                           for _ in range(L)]
                 # key-validity mask: padded tail positions excluded
-                am = (jnp.arange(S) < true_len)[None, :]
+                am = (jnp.arange(p_len) < true_len)[None, :]
                 logits, new_caches = model.forward(
                     Tensor(ids), attention_mask=Tensor(am),
                     past_key_values=caches, position_offset=0,
                     use_cache=True)
                 # first generated token comes from the LAST REAL row
                 last = logits._value[0, true_len - 1]
-                tok = jnp.argmax(last).astype(jnp.int32)
-                return tok, [(k._value, v._value)
-                             for k, v in new_caches]
+                tok, _ = _sample_token(last[None], key, strat, temp,
+                                       tk, tp)
+                return tok[0], [(k._value[0], v._value[0])
+                                for k, v in new_caches]
 
         return jax.jit(run)
 
@@ -167,32 +288,24 @@ class ContinuousBatchingEngine:
         finished = []
         free = [i for i, r in enumerate(self._slot_req) if r is None]
         while free and self._queue:
-            slot = free.pop(0)
-            req = self._queue.pop(0)
+            req = self._queue[0]
             p_len = len(req.prompt)
+            if self.layout == "paged" and not self._reserve_ok(req):
+                break                      # FIFO: wait for pages to free
+            slot = free.pop(0)
+            self._queue.pop(0)
             bucket = self._bucket(max(p_len, 1))
-            jit = self._prefill_jits.get(bucket)
-            if jit is None:
-                jit = self._build_prefill(bucket)
-                self._prefill_jits[bucket] = jit
+            jit = self._get_prefill(bucket)
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :p_len] = req.prompt
-            tok, cache_rows = jit(
+            tok, rows = jit(
                 [p._value for p in self._params],
                 [b._value for b in self._buffers],
-                jnp.asarray(ids), jnp.int32(p_len))
-            # one donated-in-place program writes every layer's slot
-            # rows (2L separate .at[].set dispatches would each copy
-            # the full batch cache)
-            if self._insert_jit is None:
-                def _insert(caches, rows, s_):
-                    return [(ck.at[s_].set(rk[0]),
-                             cv.at[s_].set(rv[0]))
-                            for (ck, cv), (rk, rv)
-                            in zip(caches, rows)]
-                self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
-            self._caches = self._insert_jit(self._caches, cache_rows,
-                                            jnp.int32(slot))
+                jnp.asarray(ids), jnp.int32(p_len), self._next_keys())
+            if self.layout == "paged":
+                self._paged_insert(slot, req, p_len, bucket, rows)
+            else:
+                self._dense_insert(slot, rows)
             self._slot_req[slot] = req
             self._pos[slot] = p_len
             self._tok[slot] = int(tok)
@@ -201,25 +314,91 @@ class ContinuousBatchingEngine:
                     or req.max_new_tokens <= 1:
                 req.done = True
                 finished.append(req)
-                self._slot_req[slot] = None
+                self._release_slot(slot)
                 free.insert(0, slot)
         return finished
 
+    # -- dense layout --------------------------------------------------
+    def _dense_insert(self, slot: int, rows):
+        # one donated-in-place program writes every layer's slot rows
+        # (2L separate .at[].set dispatches would each copy the full
+        # batch cache); rows are (bucket, hk, hd) — bucket <= S, written
+        # from position 0
+        if self._insert_jit is None:
+            def _insert(caches, rows_, s_):
+                return [(ck.at[s_, :rk.shape[0]].set(rk.astype(ck.dtype)),
+                         cv.at[s_, :rv.shape[0]].set(rv.astype(cv.dtype)))
+                        for (ck, cv), (rk, rv) in zip(caches, rows_)]
+            self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
+        self._caches = self._insert_jit(self._caches, rows,
+                                        jnp.int32(slot))
+
+    # -- paged layout --------------------------------------------------
+    def _worst_pages(self, req: Request) -> int:
+        worst_len = min(len(req.prompt) + req.max_new_tokens, self.S)
+        return -(-worst_len // self.page_size)
+
+    def _reserve_ok(self, req: Request) -> bool:
+        """Admit only if the request's worst-case page demand fits the
+        pool net of other slots' outstanding (reserved-but-unallocated)
+        pages — lazy growth can then never fail mid-flight."""
+        outstanding = int(sum(
+            self._slot_reserved[i] - len(self._slot_pages[i])
+            for i, r in enumerate(self._slot_req) if r is not None))
+        return len(self._free) - outstanding >= self._worst_pages(req)
+
+    def _alloc_page(self, slot: int) -> int:
+        page = self._free.pop()
+        self._slot_pages[slot].append(page)
+        self._bt[slot, len(self._slot_pages[slot]) - 1] = page
+        return page
+
+    def _paged_insert(self, slot: int, req: Request, p_len: int,
+                      bucket: int, rows):
+        self._slot_reserved[slot] = self._worst_pages(req)
+        while len(self._slot_pages[slot]) * self.page_size < p_len:
+            self._alloc_page(slot)
+        jit = self._scatter_jits.get(bucket)
+        if jit is None:
+            from paddle_tpu.ops.paged_attention import \
+                paged_prefill_scatter
+
+            def _scatter(kv, rows_, bt_row, true_len):
+                return [
+                    paged_prefill_scatter(kp, vp, rk.astype(kp.dtype),
+                                          rv.astype(vp.dtype), bt_row,
+                                          true_len)
+                    for (kp, vp), (rk, rv) in zip(kv, rows_)]
+            jit = jax.jit(_scatter, donate_argnums=(0,))
+            self._scatter_jits[bucket] = jit
+        self._kv = jit(self._kv, rows, jnp.asarray(self._bt[slot]),
+                       jnp.int32(p_len))
+
+    # -- decode --------------------------------------------------------
     def _build_decode(self):
         model = self.model
         params, buffers = self._params, self._buffers
+        strat, temp = self.strategy, self.temperature
+        tk, tp = self.top_k, self.top_p
+        paged = self.layout == "paged"
 
-        def run(pv, bv, caches, tok, pos):
-            from .generation import bind_state
-            with bind_state(params, buffers, pv, bv):
-                pkv = [(Tensor(k), Tensor(v)) for k, v in caches]
+        def run(pv, bv, kv, tok, pos, bt, key):
+            from .generation import bind_state, _sample_token
+            with bind_state(params, buffers, pv, bv), no_grad():
+                if paged:
+                    from .llama import PagedKVCacheView
+                    pkv = [PagedKVCacheView(k, v, bt) for k, v in kv]
+                else:
+                    pkv = [(Tensor(k), Tensor(v)) for k, v in kv]
                 logits, new_caches = model.forward(
                     Tensor(tok[:, None]), past_key_values=pkv,
                     position_offset=Tensor(pos), use_cache=True)
-                nxt = jnp.argmax(logits._value[:, 0], -1) \
-                    .astype(jnp.int32)
-                return nxt, [(k._value, v._value)
-                             for k, v in new_caches]
+                nxt, _ = _sample_token(logits._value[:, 0], key, strat,
+                                       temp, tk, tp)
+                if paged:
+                    return nxt, [(c.k_pages._value, c.v_pages._value)
+                                 for c in new_caches]
+                return nxt, [(k._value, v._value) for k, v in new_caches]
 
         return jax.jit(run, donate_argnums=(2,))
 
@@ -227,14 +406,34 @@ class ContinuousBatchingEngine:
         if self._decode_jit is None:
             self._decode_jit = self._build_decode()
         # inactive slots decode garbage at a clamped position; their
-        # outputs are never read and their cache rows are overwritten at
-        # admission
+        # outputs are never read. Paged: their block-table rows are all
+        # trash-page, so their KV writes land in page 0 (never read);
+        # dense: their cache rows are overwritten at admission.
         pos = np.clip(self._pos, 0, self.S - 1)
-        nxt, new_caches = self._decode_jit(
+        if self.layout == "paged":
+            for i, r in enumerate(self._slot_req):
+                if r is None:
+                    continue
+                # lazy growth: next token writes at pos[i] — allocate its
+                # page if the sequence just crossed a page boundary
+                # (guaranteed to succeed by the admission reservation)
+                while len(self._slot_pages[i]) * self.page_size \
+                        <= int(self._pos[i]):
+                    self._alloc_page(i)
+            kv = self._kv
+            bt = jnp.asarray(self._bt)
+        else:
+            kv = self._caches
+            bt = jnp.zeros((), jnp.int32)     # unused placeholder
+        nxt, new_kv = self._decode_jit(
             [p._value for p in self._params],
             [b._value for b in self._buffers],
-            self._caches, jnp.asarray(self._tok), jnp.asarray(pos))
-        self._caches = new_caches
+            kv, jnp.asarray(self._tok), jnp.asarray(pos), bt,
+            self._next_keys())
+        if self.layout == "paged":
+            self._kv = new_kv
+        else:
+            self._caches = new_kv
         nxt = np.asarray(nxt)
         for i, r in enumerate(self._slot_req):
             if r is not None:

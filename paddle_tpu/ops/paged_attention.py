@@ -169,6 +169,39 @@ def paged_attention(q: Tensor, k_pages: Tensor, v_pages: Tensor,
     return apply("paged_attention", fn, (q, k_pages, v_pages))
 
 
+def paged_append_values(k_pages, v_pages, k, v, block_tables, positions):
+    """Write one token per sequence into the page pools.
+
+    k/v: (B, HK, D); positions: (B,) global position of the new token;
+    block_tables: (B, pps). Returns the updated (k_pages, v_pages)."""
+    page_size = k_pages.shape[2]
+    page_idx = jnp.take_along_axis(
+        block_tables, (positions // page_size)[:, None], axis=1)[:, 0]
+    slot = positions % page_size
+    kp = k_pages.at[:, page_idx, slot].set(jnp.swapaxes(k, 0, 1))
+    vp = v_pages.at[:, page_idx, slot].set(jnp.swapaxes(v, 0, 1))
+    return kp, vp
+
+
+def paged_prefill_scatter(k_pages, v_pages, k_rows, v_rows, block_table,
+                          true_len, trash_page=0):
+    """Scatter a prefilled prompt's KV rows into the page pools.
+
+    k_rows/v_rows: (T, HK, D) rows for positions 0..T-1 of ONE sequence;
+    block_table: (pps,) page ids for that sequence; rows at positions
+    >= true_len are routed to `trash_page` (a permanently reserved page
+    that is never read) so the scatter stays static-shape."""
+    t = k_rows.shape[0]
+    page_size = k_pages.shape[2]
+    pos = jnp.arange(t)
+    page_idx = jnp.where(pos < true_len,
+                         block_table[pos // page_size], trash_page)
+    slot = pos % page_size
+    kp = k_pages.at[:, page_idx, slot].set(jnp.swapaxes(k_rows, 0, 1))
+    vp = v_pages.at[:, page_idx, slot].set(jnp.swapaxes(v_rows, 0, 1))
+    return kp, vp
+
+
 class PagedKVCache:
     """Page-pool KV cache for serving (one per layer).
 
@@ -188,14 +221,8 @@ class PagedKVCache:
     def append(self, k, v, block_tables, positions):
         """k/v: (B, HK, D) one token per sequence; positions: (B,) global
         position of the new token; block_tables: (B, pps)."""
-        page_idx = jnp.take_along_axis(
-            block_tables, (positions // self.page_size)[:, None],
-            axis=1)[:, 0]                              # (B,)
-        slot = positions % self.page_size              # (B,)
-        kp, vp = self.k_pages, self.v_pages
-        # scatter one row per (sequence, kv head)
-        kp = kp.at[:, page_idx, slot].set(jnp.swapaxes(k, 0, 1))
-        vp = vp.at[:, page_idx, slot].set(jnp.swapaxes(v, 0, 1))
+        kp, vp = paged_append_values(self.k_pages, self.v_pages, k, v,
+                                     block_tables, positions)
         new = PagedKVCache.__new__(PagedKVCache)
         new.page_size = self.page_size
         new.k_pages, new.v_pages = kp, vp

@@ -384,3 +384,133 @@ class TestContinuousBatching:
         rid = eng.add_request(list(np.arange(1, 30) % cfg.vocab_size), 2)
         out = eng.run()[rid]
         assert len(out) == 2
+
+
+@pytest.mark.slow
+class TestPagedEngine:
+    """Paged-KV serving engine (VERDICT r4 item 2): block-table cache
+    wired into the decode step, occupancy-proportional HBM accounting,
+    sampling exposure, page-pool admission control."""
+
+    def _model(self):
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        paddle.seed(7)
+        cfg = LlamaConfig.tiny()
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m, cfg
+
+    def test_paged_matches_dense_engine(self):
+        """The paged engine's outputs equal the dense engine's (same
+        model, same prompts) — the engine-level paged == dense oracle."""
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        m, cfg = self._model()
+        rng_ = np.random.default_rng(5)
+        prompts = [list(rng_.integers(1, cfg.vocab_size,
+                                      rng_.integers(3, 14)))
+                   for _ in range(4)]
+        lens = [6, 8, 5, 7]
+        outs = {}
+        for layout in ("paged", "dense"):
+            eng = ContinuousBatchingEngine(m, max_batch_size=2,
+                                           max_seq_len=64,
+                                           kv_layout=layout)
+            rids = [eng.add_request(p, n) for p, n in zip(prompts, lens)]
+            res = eng.run()
+            outs[layout] = [res[r] for r in rids]
+        assert outs["paged"] == outs["dense"]
+
+    def test_memory_occupancy_proportional(self):
+        """bytes_in_use tracks pages actually allocated, not B*S_max;
+        finished requests return their pages."""
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        m, cfg = self._model()
+        eng = ContinuousBatchingEngine(m, max_batch_size=4,
+                                       max_seq_len=64, kv_layout="paged",
+                                       page_size=16)
+        info0 = eng.cache_memory_info()
+        assert info0["pages_in_use"] == 0 and info0["bytes_in_use"] == 0
+        rid = eng.add_request([3, 5, 7], 4)       # 3 tokens -> 1 page
+        eng.step()
+        info1 = eng.cache_memory_info()
+        assert info1["pages_in_use"] >= 1
+        assert info1["bytes_in_use"] < info1["bytes_pool"] / 2
+        eng.run()
+        info2 = eng.cache_memory_info()
+        assert info2["pages_in_use"] == 0         # pages reclaimed
+
+    def test_pool_exhaustion_defers_admission(self):
+        """A pool too small for two concurrent requests serves them
+        SEQUENTIALLY (FIFO), not incorrectly."""
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        m, cfg = self._model()
+        # each request worst-cases at ceil((3+6)/16)=1 page; pool of 1
+        # usable page forces one-at-a-time admission
+        eng = ContinuousBatchingEngine(m, max_batch_size=2,
+                                       max_seq_len=64, kv_layout="paged",
+                                       page_size=16, num_pages=2)
+        a = eng.add_request([5, 42, 7], 6)
+        b = eng.add_request([9, 1, 2], 6)
+        # after the first step only one request may hold pages
+        eng.step()
+        active = [r for r in eng._slot_req if r is not None]
+        assert len(active) == 1
+        res = eng.run()
+        ref_a = self._ref(m, [5, 42, 7], 6)
+        ref_b = self._ref(m, [9, 1, 2], 6)
+        assert res[a] == ref_a and res[b] == ref_b
+
+    def _ref(self, m, prompt, n):
+        out = m.generate(paddle.to_tensor(
+            np.asarray(prompt, np.int32)[None]), max_new_tokens=n)
+        t = out[0] if isinstance(out, (tuple, list)) else out
+        return [int(x) for x in np.asarray(t._value).ravel()[:n]]
+
+    def test_sampling_seeded_reproducible(self):
+        """do_sample engines with the same seed emit identical streams;
+        top_p -> 0 degenerates to greedy."""
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        m, cfg = self._model()
+        p = [5, 42, 7, 11]
+
+        def run_once(seed, **kw):
+            eng = ContinuousBatchingEngine(m, max_batch_size=2,
+                                           max_seq_len=64, seed=seed,
+                                           **kw)
+            rid = eng.add_request(p, 8)
+            return eng.run()[rid]
+
+        s1 = run_once(3, do_sample=True, temperature=0.8, top_k=20)
+        s2 = run_once(3, do_sample=True, temperature=0.8, top_k=20)
+        s3 = run_once(4, do_sample=True, temperature=0.8, top_k=20)
+        assert s1 == s2
+        greedy = run_once(0)
+        tiny_p = run_once(9, do_sample=True, top_p=1e-9)
+        assert tiny_p == greedy
+        assert len(s3) == 8
+
+    def test_sliding_window_model_requires_dense(self):
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig.tiny()
+        cfg.sliding_window = 16
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        with pytest.raises(NotImplementedError, match="dense"):
+            ContinuousBatchingEngine(m, max_batch_size=2, max_seq_len=64)
+        eng = ContinuousBatchingEngine(m, max_batch_size=2,
+                                       max_seq_len=64, kv_layout="dense")
+        rid = eng.add_request([5, 4, 3], 4)
+        assert len(eng.run()[rid]) == 4
+
+    def test_prefill_program_cache_capped(self):
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        m, cfg = self._model()
+        eng = ContinuousBatchingEngine(m, max_batch_size=1,
+                                       max_seq_len=64, prompt_pad=4,
+                                       max_prefill_programs=2)
+        for n_len in (3, 7, 11, 15):
+            eng.add_request(list(range(1, n_len + 1)), 2)
+        eng.run()
+        assert len(eng._prefill_jits) <= 2
