@@ -862,8 +862,25 @@ def pipeline_1f1b(stage_fn: Callable, stacked_params, x, mesh: ProcessMesh,
     def _int_ct(a):
         return _np.zeros(jnp.shape(a), _jdt.float0)
 
+    # an UNdifferentiated call (eval / loss monitoring) must not pay the
+    # fused fwd+bwd scan's backward compute and gradient-accumulator
+    # memory (advisor r4): the custom_vjp PRIMAL runs the forward-only
+    # schedule; jax.grad routes through run_fwd (the fused scan) instead.
+    # The GPipe interleave needs M % S == 0 — outside that, eval keeps
+    # the fused scan (correct, just not cheaper).
+    _fwd_only_ok = (v_chunks == 1 or m <= s_count or m % s_count == 0)
+
     @jax.custom_vjp
     def run(sp, xv, extra, rargs):
+        if _fwd_only_ok:
+            return pipeline_forward(
+                stage_fn, sp, xv.reshape(b, *x.shape[1:]), mesh, m,
+                axis=axis, remat=False, extra_args=extra,
+                param_specs=param_specs, x_spec=x_spec,
+                reduce_fn=reduce_fn, reduce_args=rargs,
+                reduce_arg_specs=reduce_arg_specs,
+                reduce_mean_axes=reduce_mean_axes, reduce_shape=r_shape,
+                virtual_chunks=v_chunks)
         return combined(sp, xv, extra, rargs)[0]
 
     def run_fwd(sp, xv, extra, rargs):

@@ -138,12 +138,19 @@ class Predictor:
                 f"{config.prefix}.pdmodel missing or unreadable — "
                 "jit.save must be called with input_spec to produce the "
                 "serialized program")
-        # the exported signature is (params..., buffers..., *inputs)
-        # flattened: real input count = total avals - state tensors
-        n_state = sum(1 for t in self._layer.state.values()
-                      if isinstance(t, Tensor))
-        n_in = max(len(self._layer._exported.in_avals) - n_state, 1)
-        self._inputs = [_IOHandle(f"input_{i}") for i in range(n_in)]
+        meta = getattr(self._layer, "meta", None)
+        if meta is not None:
+            # authoritative arity/names from the jit.save sidecar
+            self._inputs = [_IOHandle(n) for n in meta["input_names"]]
+        else:
+            # legacy artifact without .pdmeta: the exported signature is
+            # (params..., buffers..., *inputs) flattened — approximate
+            # input count = total avals - state tensors (wrong if
+            # buffers baked as constants; re-save to get the sidecar)
+            n_state = sum(1 for t in self._layer.state.values()
+                          if isinstance(t, Tensor))
+            n_in = max(len(self._layer._exported.in_avals) - n_state, 1)
+            self._inputs = [_IOHandle(f"input_{i}") for i in range(n_in)]
         # output handles exist UP FRONT (the reference script fetches
         # them before the run loop) and are STABLE across runs — run()
         # refreshes their values, never replaces the objects

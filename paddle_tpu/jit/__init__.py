@@ -419,6 +419,21 @@ def save(layer, path, input_spec=None, **configs):
                     [b._value for b in buffers], *specs)
                 with open(path + ".pdmodel", "wb") as f:
                     f.write(exp.serialize())
+                # sidecar metadata: the REAL input arity/names, so the
+                # Predictor never has to reverse-engineer them from
+                # flat-aval arithmetic (advisor r4: that breaks when
+                # buffers bake as constants or inputs are pytrees)
+                import json
+                meta = {
+                    "input_names": [
+                        getattr(s, "name", None) or f"input_{i}"
+                        for i, s in enumerate(input_spec)],
+                    "n_inputs": len(list(input_spec)),
+                    "n_params": len(params),
+                    "n_buffers": len(buffers),
+                }
+                with open(path + ".pdmeta", "w") as f:
+                    json.dump(meta, f)
             except Exception as e:  # export is best-effort
                 import warnings
                 warnings.warn(f"StableHLO export skipped: {e}")
@@ -436,11 +451,16 @@ def load(path, params_file=None, **configs):
         def __init__(self):
             self.state = state
             self._exported = None
+            self.meta = None
             import os
             if os.path.exists(path + ".pdmodel"):
                 from jax import export as jexport
                 with open(path + ".pdmodel", "rb") as f:
                     self._exported = jexport.deserialize(f.read())
+            if os.path.exists(path + ".pdmeta"):
+                import json
+                with open(path + ".pdmeta") as f:
+                    self.meta = json.load(f)
 
         def state_dict(self):
             return self.state

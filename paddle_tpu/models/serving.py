@@ -86,8 +86,14 @@ class ContinuousBatchingEngine:
             raise ValueError(f"kv_layout {kv_layout!r}: paged|dense")
         if kv_layout == "paged" \
                 and getattr(cfg, "sliding_window", None) is not None:
-            raise NotImplementedError(
-                "sliding_window models need kv_layout='dense'")
+            # the paged decode path has no band-mask support yet; a
+            # sliding-window model constructed with the (paged) DEFAULT
+            # must keep working, so fall back rather than crash
+            import warnings
+            warnings.warn(
+                "sliding_window model: paged KV layout is not yet "
+                "supported, falling back to kv_layout='dense'")
+            kv_layout = "dense"
         self.eos = eos_token_id
         self.pad = int(prompt_pad)
         self.layout = kv_layout

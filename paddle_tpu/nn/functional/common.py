@@ -409,13 +409,13 @@ def embedding_bag(input, weight, offsets=None, mode="mean",
     (B,): ragged bags (torch convention)."""
     ids = input._value if isinstance(input, Tensor) else jnp.asarray(input)
     wt = _t(weight)
-    psw = (per_sample_weights._value
-           if isinstance(per_sample_weights, Tensor)
-           else (jnp.asarray(per_sample_weights)
-                 if per_sample_weights is not None else None))
+    # per_sample_weights rides through apply() as a real input so the tape
+    # records its vjp (torch contract: grad flows to it in mode='sum')
+    psw_t = (_t(per_sample_weights)
+             if per_sample_weights is not None else None)
     if mode not in ("sum", "mean", "max"):
         raise ValueError(f"unknown embedding_bag mode {mode!r}")
-    if psw is not None and mode != "sum":
+    if psw_t is not None and mode != "sum":
         raise ValueError("per_sample_weights needs mode='sum'")
 
     if ids.ndim == 1:
@@ -429,7 +429,7 @@ def embedding_bag(input, weight, offsets=None, mode="mean",
             if off.shape[0] > 1 else jnp.zeros(n, jnp.int32)
         b = off.shape[0]
 
-        def fn(w):
+        def fn(w, psw=None):
             rows = w[ids]
             if psw is not None:
                 rows = rows * psw[:, None]
@@ -452,9 +452,10 @@ def embedding_bag(input, weight, offsets=None, mode="mean",
                 ones = jnp.where(ids == padding_idx, 0.0, ones)
             cnt = jax.ops.segment_sum(ones, bag_of, num_segments=b)
             return s / jnp.maximum(cnt, 1)[:, None]
-        return apply("embedding_bag", fn, (wt,))
+        return apply("embedding_bag", fn,
+                     (wt,) if psw_t is None else (wt, psw_t))
 
-    def fn2(w):
+    def fn2(w, psw=None):
         rows = w[ids]                                   # (B, S, D)
         mask = None
         if padding_idx is not None:
@@ -473,4 +474,5 @@ def embedding_bag(input, weight, offsets=None, mode="mean",
             else rows
         out = jnp.max(neg, axis=1)
         return jnp.where(jnp.isfinite(out), out, 0)
-    return apply("embedding_bag", fn2, (wt,))
+    return apply("embedding_bag", fn2,
+                 (wt,) if psw_t is None else (wt, psw_t))

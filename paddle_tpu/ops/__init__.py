@@ -9,6 +9,21 @@ def on_tpu() -> bool:
     return _jax.devices()[0].platform == "tpu"
 
 
+def mxu_dot(a, b, dims, preferred_element_type=None):
+    """dot_general pinned to DEFAULT precision for use INSIDE kernels.
+
+    The kernels are bf16-MXU by design (bf16 x bf16 -> f32 accumulate is
+    the native systolic-array mode). A global
+    `jax_default_matmul_precision="highest"` — set e.g. by test harnesses
+    for CPU-vs-NumPy parity — would otherwise leak into the traced kernel
+    body as contract_precision<fp32> on bf16 operands, which Mosaic
+    rejects ("Bad lhs type", seen live on v5e) and which would emulate
+    fp32 matmul at 6x cost even where it compiled."""
+    return _jax.lax.dot_general(
+        a, b, dims, precision=_jax.lax.Precision.DEFAULT,
+        preferred_element_type=preferred_element_type)
+
+
 from . import flash_attention  # noqa: F401,E402
 from . import flash_varlen  # noqa: F401,E402
 from . import grouped_matmul  # noqa: F401,E402

@@ -34,7 +34,7 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-from . import on_tpu
+from . import mxu_dot, on_tpu
 from ..core.tensor import Tensor, apply
 
 DEFAULT_BLOCK_Q = 128
@@ -139,7 +139,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def compute():
         q = q_ref[0]                       # (Bq, D)
         k = k_ref[0]                       # (Bk, D)
-        s = jax.lax.dot_general(
+        s = mxu_dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
         if causal:
@@ -152,7 +152,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)    # (Bq, 1)
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        acc_scr[:] = acc_scr[:] * alpha + mxu_dot(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = m_new
@@ -234,7 +234,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        s = jax.lax.dot_general(
+        s = mxu_dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
@@ -247,11 +247,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         # ~NEG_INF and exp(s - lse) would blow up instead of vanishing
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)
         do = do_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(
+        dp = mxu_dot(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # (Bq, Bk)
         ds = p * (dp - delta) * scale                  # (Bq, Bk)
-        dq_scr[:] += jax.lax.dot_general(
+        dq_scr[:] += mxu_dot(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
@@ -284,7 +284,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        s = jax.lax.dot_general(
+        s = mxu_dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
         if causal:
@@ -293,14 +293,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = jnp.max(delta_ref[0], axis=-1, keepdims=True)
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)
         do = do_ref[0].astype(jnp.float32)
-        dv_scr[:] += jax.lax.dot_general(
+        dv_scr[:] += mxu_dot(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (Bk, D)
-        dp = jax.lax.dot_general(
+        dp = mxu_dot(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale                    # (Bq, Bk)
-        dk_scr[:] += jax.lax.dot_general(
+        dk_scr[:] += mxu_dot(
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # (Bk, D)
 

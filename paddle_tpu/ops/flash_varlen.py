@@ -31,7 +31,7 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
-from . import on_tpu
+from . import mxu_dot, on_tpu
 from ..core.tensor import Tensor, apply
 from .flash_attention import (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, LANES,
                               NEG_INF)
@@ -69,7 +69,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref,
     def compute():
         q = q_ref[0]
         k = k_ref[0]
-        s = jax.lax.dot_general(
+        s = mxu_dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         s = _mask(s, sq_ref[0], sk_ref[0], qi, ki, block_q, block_k,
@@ -79,7 +79,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref,
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_scr[:] = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+        acc_scr[:] = acc_scr[:] * alpha + mxu_dot(
             p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = m_new
@@ -114,7 +114,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        s = jax.lax.dot_general(
+        s = mxu_dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         s = _mask(s, sq_ref[0], sk_ref[0], qi, ki, block_q, block_k,
@@ -123,11 +123,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = jnp.max(delta_ref[0], axis=-1, keepdims=True)
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)
         do = do_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(
+        dp = mxu_dot(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        dq_scr[:] += jax.lax.dot_general(
+        dq_scr[:] += mxu_dot(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
@@ -160,7 +160,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q = q_ref[0]
         k = k_ref[0]
         v = v_ref[0]
-        s = jax.lax.dot_general(
+        s = mxu_dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         s = _mask(s, sq_ref[0], sk_ref[0], qi, ki, block_q, block_k,
@@ -169,14 +169,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = jnp.max(delta_ref[0], axis=-1, keepdims=True)
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse), 0.0)
         do = do_ref[0].astype(jnp.float32)
-        dv_scr[:] += jax.lax.dot_general(
+        dv_scr[:] += mxu_dot(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
+        dp = mxu_dot(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
-        dk_scr[:] += jax.lax.dot_general(
+        dk_scr[:] += mxu_dot(
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 

@@ -36,7 +36,7 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-from . import on_tpu
+from . import mxu_dot, on_tpu
 
 DEFAULT_BLOCK = 128
 
@@ -50,7 +50,7 @@ def _gmm_kernel(te_ref, lhs_ref, rhs_ref, out_ref, acc_ref, *, nk):
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    acc_ref[...] += jax.lax.dot_general(
+    acc_ref[...] += mxu_dot(
         lhs_ref[...], rhs_ref[0], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
 

@@ -31,7 +31,7 @@ try:
 except ImportError:  # pragma: no cover
     pltpu = None
 
-from . import on_tpu
+from . import mxu_dot, on_tpu
 from ..core.tensor import Tensor, apply
 
 NEG_INF = -1e30
@@ -64,7 +64,7 @@ def _paged_kernel(ctx_ref, bt_ref,          # scalar-prefetched
         q = q_ref[0, 0].astype(jnp.float32)          # (G, D)
         k = k_ref[0, 0].astype(jnp.float32)          # (page_size, D)
         v = v_ref[0, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(
+        s = mxu_dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (G, page_size)
         pos = i * page_size + jax.lax.broadcasted_iota(
@@ -76,7 +76,7 @@ def _paged_kernel(ctx_ref, bt_ref,          # scalar-prefetched
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)                        # (G, page_size)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, -1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        acc_ref[:] = acc_ref[:] * alpha + mxu_dot(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # (G, D)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)

@@ -102,6 +102,17 @@ class Program:
         self.ops.append(_OpRec(name, fn, in_refs, out_slots, multi))
         self._exec_cache.clear()
 
+    def _slot_by_name(self, name: str) -> Optional[int]:
+        """Resolve a named Tensor recorded in this Program to its slot.
+        Lazy reverse scan (not a dict kept at record time) because users
+        often set `.name` AFTER the op that created the variable ran;
+        last definition wins, matching the reference's name->var scope
+        lookup (≙ Block.var, «python/paddle/base/framework.py» [U])."""
+        for t in reversed(self._keep):
+            if getattr(t, "name", None) == name:
+                return self._slot_of.get(id(t))
+        return None
+
     # -- introspection (migration helpers) -----------------------------
     def list_vars(self):
         return list(self._keep)
@@ -326,10 +337,16 @@ class Executor:
         fetch_slots = []
         for f in fetch_list:
             if isinstance(f, str):
-                if f not in program.feeds:
-                    raise KeyError(f"fetch name {f!r} is not a feed; pass "
-                                   "the Tensor variable itself")
-                fetch_slots.append(program.feeds[f][0])
+                if f in program.feeds:
+                    fetch_slots.append(program.feeds[f][0])
+                    continue
+                slot = program._slot_by_name(f)
+                if slot is None:
+                    raise KeyError(
+                        f"fetch name {f!r} matches no feed and no named "
+                        "variable recorded in this Program; pass the "
+                        "Tensor variable itself or set .name on it")
+                fetch_slots.append(slot)
             else:
                 s = program._slot(f)
                 if s is None:

@@ -259,13 +259,16 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         ln = rest[0] if rest else None
         b, t, _ = p.shape
         if include_bos_eos_tag:
-            # reference contract: BOS = last tag, EOS = second-to-last;
-            # real labels are the first n-2 tags
-            n = n_tags - 2
-            core = tr[:n, :n]
-            start = tr[-1, :n]       # BOS -> tag
-            stop = tr[:n, -2]        # tag -> EOS
-            p = p[..., :n]
+            # reference contract: BOS = last tag, EOS = second-to-last.
+            # Decode runs over the FULL tag space — BOS/EOS are only
+            # discouraged via their transition scores (the reference
+            # seeds alpha at -10000 everywhere but BOS and never slices
+            # the tag dim), so a potentials matrix that favors them
+            # mid-sequence legitimately selects them, matching upstream.
+            n = n_tags
+            core = tr
+            start = tr[-1, :]        # BOS -> tag
+            stop = tr[:, -2]         # tag -> EOS
         else:
             n = n_tags
             core = tr

@@ -620,12 +620,12 @@ class TestViterbi:
     def test_bos_eos_brute_force_parity(self):
         # reference contract: potentials' tag dim == transitions dim N
         # (incl. BOS/EOS); start = trans[-1], stop = trans[:, -2]; decode
-        # over the first N-2 real labels.
+        # runs over the FULL tag space — BOS/EOS are discouraged only by
+        # their transition scores, never hard-excluded (advisor r4).
         import itertools
         from paddle_tpu.text import ViterbiDecoder
         r = np.random.default_rng(5)
         B, T, N = 2, 4, 5          # 3 real labels + EOS + BOS
-        L = N - 2
         pot = r.normal(size=(B, T, N)).astype(np.float32)
         trans = r.normal(size=(N, N)).astype(np.float32)
         dec = ViterbiDecoder(paddle.to_tensor(trans))
@@ -633,7 +633,7 @@ class TestViterbi:
         assert tuple(paths.shape) == (B, T)
         for b in range(B):
             best, bestp = -1e9, None
-            for p in itertools.product(range(L), repeat=T):
+            for p in itertools.product(range(N), repeat=T):
                 s = trans[-1, p[0]] + pot[b, 0, p[0]] + sum(
                     trans[p[i - 1], p[i]] + pot[b, i, p[i]]
                     for i in range(1, T)) + trans[p[-1], -2]
@@ -644,12 +644,29 @@ class TestViterbi:
             np.testing.assert_array_equal(np.asarray(paths._value)[b],
                                           bestp)
 
+    def test_bos_eos_discouraging_transitions_stay_on_real_labels(self):
+        # when BOS/EOS carry strongly negative incoming transitions (the
+        # trained-CRF shape), full-space decode picks only real labels
+        from paddle_tpu.text import ViterbiDecoder
+        r = np.random.default_rng(7)
+        B, T, N = 2, 5, 6
+        pot = r.normal(size=(B, T, N)).astype(np.float32)
+        trans = r.normal(size=(N, N)).astype(np.float32)
+        trans[:, -1] = -1e4        # nothing enters BOS
+        trans[-2, :] = -1e4        # nothing leaves EOS
+        trans[:, -2] -= 20.0       # EOS mid-sequence strongly penalized
+        dec = ViterbiDecoder(paddle.to_tensor(trans))
+        _, paths = dec(paddle.to_tensor(pot))
+        assert int(np.asarray(paths._value).max()) < N - 2
+
     def test_lengths_and_bos_eos(self):
         from paddle_tpu.text import ViterbiDecoder
         r = np.random.default_rng(5)
         B, T, N = 2, 6, 6          # 4 real labels + EOS + BOS
         pot = r.normal(size=(B, T, N)).astype(np.float32)
         trans = r.normal(size=(N, N)).astype(np.float32)
+        trans[:, -1] = -1e4        # trained-CRF shape: BOS/EOS never
+        trans[:, -2] -= 20.0       # entered mid-sequence
         dec = ViterbiDecoder(paddle.to_tensor(trans))
         scores, paths = dec(paddle.to_tensor(pot),
                             paddle.to_tensor(np.array([6, 3], np.int32)))
@@ -733,6 +750,49 @@ class TestRound4Breadth:
             torch.tensor(ids1), torch.tensor(w),
             offsets=torch.tensor(offs), mode="mean").numpy()
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_embedding_bag_per_sample_weights_grad_vs_torch(self):
+        # grad must FLOW to per_sample_weights in mode='sum' (advisor
+        # r4: it was closed over instead of passing through apply())
+        import torch
+        import paddle_tpu.nn.functional as F
+        r = np.random.default_rng(9)
+        w = r.normal(size=(10, 4)).astype(np.float32)
+        ids2d = r.integers(0, 10, (3, 5))
+        psw = r.normal(size=(3, 5)).astype(np.float32)
+
+        pt = paddle.to_tensor(psw, stop_gradient=False)
+        wt = paddle.to_tensor(w, stop_gradient=False)
+        out = F.embedding_bag(paddle.to_tensor(ids2d.astype(np.int32)),
+                              wt, mode="sum", per_sample_weights=pt)
+        out.sum().backward()
+        assert pt.grad is not None and wt.grad is not None
+
+        tw = torch.tensor(w, requires_grad=True)
+        tp = torch.tensor(psw, requires_grad=True)
+        tout = torch.nn.functional.embedding_bag(
+            torch.tensor(ids2d), tw, mode="sum", per_sample_weights=tp)
+        tout.sum().backward()
+        np.testing.assert_allclose(np.asarray(pt.grad._value),
+                                   tp.grad.numpy(), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(wt.grad._value),
+                                   tw.grad.numpy(), rtol=1e-5, atol=1e-6)
+        # 1-D ragged path too
+        ids1 = r.integers(0, 10, (6,))
+        offs = np.array([0, 2, 5], np.int32)
+        psw1 = r.normal(size=(6,)).astype(np.float32)
+        p1 = paddle.to_tensor(psw1, stop_gradient=False)
+        F.embedding_bag(paddle.to_tensor(ids1.astype(np.int32)),
+                        paddle.to_tensor(w),
+                        offsets=paddle.to_tensor(offs), mode="sum",
+                        per_sample_weights=p1).sum().backward()
+        tp1 = torch.tensor(psw1, requires_grad=True)
+        torch.nn.functional.embedding_bag(
+            torch.tensor(ids1), torch.tensor(w),
+            offsets=torch.tensor(offs.astype(np.int64)), mode="sum",
+            per_sample_weights=tp1).sum().backward()
+        np.testing.assert_allclose(np.asarray(p1.grad._value),
+                                   tp1.grad.numpy(), rtol=1e-5, atol=1e-6)
 
     def test_margin_cross_entropy_reduces_to_softmax_ce(self):
         import paddle_tpu.nn.functional as F

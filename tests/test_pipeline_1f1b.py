@@ -62,10 +62,20 @@ class TestOneFOneB:
         def reduce_fn(y, idx):
             return jnp.sum(y.astype(jnp.float32) ** 2)
 
-        got = pipeline_1f1b(_mlp_stage, stacked, x, pp_mesh, micro,
-                            reduce_fn=reduce_fn)
+        def call(sp):
+            return pipeline_1f1b(_mlp_stage, sp, x, pp_mesh, micro,
+                                 reduce_fn=reduce_fn)
+
         want = _seq_losses(per_stage, x, micro)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+        # fused-scan primal (via vjp -> run_fwd) AND forward-only eval
+        # primal (undifferentiated call) must both match
+        got_fused, _ = jax.vjp(call, stacked)
+        np.testing.assert_allclose(np.asarray(got_fused),
+                                   np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+        got_eval = call(stacked)
+        np.testing.assert_allclose(np.asarray(got_eval),
+                                   np.asarray(want),
                                    rtol=1e-4, atol=1e-4)
 
     def test_grads_match_sequential(self, pp_mesh):
@@ -364,10 +374,25 @@ class TestInterleaved1F1B:
         stacked = self._stack(chunks, s, v)
         x = jnp.asarray(rng.normal(size=(micro, 5, 16))
                         .astype(np.float32))
-        got = pipeline_1f1b(_mlp_stage, stacked, x, pp_mesh, micro,
-                            reduce_fn=self._reduce, virtual_chunks=v)
+
+        def call(sp):
+            return pipeline_1f1b(_mlp_stage, sp, x, pp_mesh, micro,
+                                 reduce_fn=self._reduce,
+                                 virtual_chunks=v)
+
         want = _seq_losses(chunks, x, micro)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+        # jax.vjp routes the primal through run_fwd = the fused
+        # interleaved scan's loss_buf (the schedule under test) ...
+        got_fused, _ = jax.vjp(call, stacked)
+        np.testing.assert_allclose(np.asarray(got_fused),
+                                   np.asarray(want),
+                                   rtol=1e-4, atol=1e-4)
+        # ... while the undifferentiated call exercises the
+        # forward-only eval primal (falls back to the fused scan when
+        # M % S != 0 defeats the GPipe interleave)
+        got_eval = call(stacked)
+        np.testing.assert_allclose(np.asarray(got_eval),
+                                   np.asarray(want),
                                    rtol=1e-4, atol=1e-4)
 
     def test_grads_match_sequential(self, pp_mesh):
@@ -416,10 +441,13 @@ class TestInterleaved1F1B:
                 chunks[2 * ss + 1]) for ss in range(s)])
         # interleaved layout runs chunks in virtual order v*S+s = layer
         ilv = self._stack(chunks, s, 2)
-        l1 = pipeline_1f1b(fat_stage, fat, x, pp_mesh, m,
-                           reduce_fn=self._reduce)
-        l2 = pipeline_1f1b(_mlp_stage, ilv, x, pp_mesh, m,
-                           reduce_fn=self._reduce, virtual_chunks=2)
+        # jax.vjp so the primal is the fused scan (the schedule under
+        # test), not the forward-only eval fast path
+        l1, _ = jax.vjp(lambda sp: pipeline_1f1b(
+            fat_stage, sp, x, pp_mesh, m, reduce_fn=self._reduce), fat)
+        l2, _ = jax.vjp(lambda sp: pipeline_1f1b(
+            _mlp_stage, sp, x, pp_mesh, m, reduce_fn=self._reduce,
+            virtual_chunks=2), ilv)
         np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
                                    rtol=1e-4, atol=1e-4)
 

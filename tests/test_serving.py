@@ -489,7 +489,10 @@ class TestPagedEngine:
         assert tiny_p == greedy
         assert len(s3) == 8
 
-    def test_sliding_window_model_requires_dense(self):
+    def test_sliding_window_model_falls_back_to_dense(self):
+        """A sliding-window model constructed with the (paged) DEFAULT
+        keeps working: the engine warns and serves dense (code review
+        r5 — crashing on the default broke existing callers)."""
         from paddle_tpu.models.serving import ContinuousBatchingEngine
         from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
         cfg = LlamaConfig.tiny()
@@ -497,10 +500,10 @@ class TestPagedEngine:
         paddle.seed(0)
         m = LlamaForCausalLM(cfg)
         m.eval()
-        with pytest.raises(NotImplementedError, match="dense"):
-            ContinuousBatchingEngine(m, max_batch_size=2, max_seq_len=64)
-        eng = ContinuousBatchingEngine(m, max_batch_size=2,
-                                       max_seq_len=64, kv_layout="dense")
+        with pytest.warns(UserWarning, match="dense"):
+            eng = ContinuousBatchingEngine(m, max_batch_size=2,
+                                           max_seq_len=64)
+        assert eng.layout == "dense"
         rid = eng.add_request([5, 4, 3], 4)
         assert len(eng.run()[rid]) == 4
 

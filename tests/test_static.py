@@ -55,6 +55,26 @@ class TestStaticMigrationScript:
         paddle.disable_static()
         assert paddle.in_dynamic_mode()
 
+    def test_fetch_named_variable_by_string(self):
+        """String fetch targets resolve against any NAMED variable
+        recorded in the Program, not only feeds (advisor r4; ≙ the
+        reference Executor's scope name lookup)."""
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data(name="x", shape=[None, 4],
+                                   dtype="float32")
+            mid = x * 3.0
+            mid.name = "mid"
+            out = mid + 1.0
+        exe = paddle.static.Executor()
+        xb = np.ones((2, 4), np.float32)
+        mv, ov = exe.run(main, feed={"x": xb},
+                         fetch_list=["mid", out])
+        np.testing.assert_allclose(mv, np.full((2, 4), 3.0), rtol=1e-6)
+        np.testing.assert_allclose(ov, np.full((2, 4), 4.0), rtol=1e-6)
+        with pytest.raises(KeyError):
+            exe.run(main, feed={"x": xb}, fetch_list=["nonexistent"])
+
     def test_variable_batch_size_replays(self):
         """shape=[None, d] placeholders: the same program serves any
         batch size (one compile per signature)."""
