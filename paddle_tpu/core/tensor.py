@@ -78,6 +78,8 @@ class Tensor:
         return self._node is None
 
     def numpy(self) -> np.ndarray:
+        if isinstance(self._value, jax.core.Tracer):
+            self._graph_break("numpy()")
         return np.asarray(self._value)
 
     def _graph_break(self, coercion: str):

@@ -4,8 +4,9 @@ from .parallel import (init_parallel_env, get_rank, get_world_size,  # noqa: F40
                        is_initialized, is_available, ParallelEnv)
 from .mesh import (ProcessMesh, Placement, Shard, Replicate, Partial,  # noqa: F401
                    ReduceType, shard_tensor, reshard, shard_layer,
-                   dtensor_from_local, local_map, create_mesh, get_mesh,
-                   set_mesh, use_mesh, shard_constraint)
+                   dtensor_from_local, local_map, create_mesh,
+                   create_hybrid_mesh, get_mesh, set_mesh, use_mesh,
+                   shard_constraint)
 from .collective import (ReduceOp, Group, new_group, all_reduce,  # noqa: F401
                          all_gather, all_gather_object, reduce_scatter,
                          broadcast, reduce, scatter, alltoall,

@@ -167,6 +167,76 @@ def create_mesh(shape_dict: dict[str, int] | None = None, **axes) -> ProcessMesh
     return ProcessMesh(Mesh(dev_arr, names))
 
 
+def create_hybrid_mesh(dcn_axes: dict[str, int] | None = None,
+                       ici_axes: dict[str, int] | None = None,
+                       devices=None) -> ProcessMesh:
+    """Multi-slice mesh: `dcn_axes` are the OUTER (slow) axes that cross
+    slice/host boundaries over DCN; `ici_axes` are the inner axes laid out
+    on the ICI torus within each slice. ≙ the reference fleet's multi-node
+    topology mapping (SURVEY §2.3 hybrid topology; §5 comm backend — "ICI
+    vs DCN from mesh axis placement").
+
+    On real multi-slice hardware this routes through
+    `mesh_utils.create_hybrid_device_mesh`, which groups devices by
+    slice_index so only the dcn axes ride DCN. On a single slice (or the
+    CPU test platform) it factors the flat device list with the dcn axes
+    slowest-varying — the same logical mesh, so shardings and collectives
+    written against it are placement-portable.
+
+    >>> mesh = create_hybrid_mesh(dcn_axes={"dp": 2}, ici_axes={"mp": 4})
+    >>> mesh.dim_names     # ['dp', 'mp'] — shard batch over dp: only data
+    ...                    # gradients' all-reduce crosses DCN
+    """
+    from jax.experimental import mesh_utils
+    dcn_axes = dict(dcn_axes or {})
+    ici_axes = dict(ici_axes or {})
+    if not dcn_axes or not ici_axes:
+        raise ValueError("create_hybrid_mesh needs both dcn_axes and "
+                         "ici_axes (use create_mesh for a flat mesh)")
+    names = tuple(dcn_axes) + tuple(ici_axes)
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate axis name across dcn/ici: {names}")
+    dcn_shape = tuple(dcn_axes.values())
+    ici_shape = tuple(ici_axes.values())
+    devs = list(devices if devices is not None else jax.devices())
+    n_dcn = int(np.prod(dcn_shape))
+    n_ici = int(np.prod(ici_shape))
+    if n_dcn * n_ici > len(devs):
+        raise ValueError(f"hybrid mesh needs {n_dcn * n_ici} devices, "
+                         f"have {len(devs)}")
+    slice_ids = sorted({getattr(d, "slice_index", 0) for d in devs})
+    if len(slice_ids) > 1:
+        # real multi-slice: pick whole slices and the same number of
+        # chips from each (a flat prefix could split slices unevenly and
+        # fail mesh_utils' per-granule device-count check)
+        if len(slice_ids) < n_dcn:
+            raise ValueError(
+                f"hybrid mesh dcn axes need {n_dcn} slices, hardware has "
+                f"{len(slice_ids)}")
+        picked = []
+        for sid in slice_ids[:n_dcn]:
+            in_slice = [d for d in devs
+                        if getattr(d, "slice_index", 0) == sid]
+            if len(in_slice) < n_ici:
+                raise ValueError(
+                    f"hybrid mesh ici axes need {n_ici} chips per slice, "
+                    f"slice {sid} has {len(in_slice)}")
+            picked.extend(in_slice[:n_ici])
+        # per-axis (ici, dcn) factor pairs — dcn axes contribute only to
+        # the dcn factor, ici axes only to ici
+        mesh_shape = (1,) * len(dcn_shape) + ici_shape
+        dcn_mesh_shape = dcn_shape + (1,) * len(ici_shape)
+        dev_arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape, dcn_mesh_shape, devices=picked,
+            allow_split_physical_axes=True).reshape(dcn_shape + ici_shape)
+    else:
+        # single slice / CPU: contiguous device ids form a "slice" for
+        # each dcn coordinate (outer axes slowest-varying)
+        dev_arr = np.asarray(devs[:n_dcn * n_ici]).reshape(
+            dcn_shape + ici_shape)
+    return ProcessMesh(Mesh(dev_arr, names))
+
+
 # -- current mesh context ----------------------------------------------------
 _current_mesh: Optional[ProcessMesh] = None
 

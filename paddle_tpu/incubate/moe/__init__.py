@@ -493,12 +493,16 @@ class MoELayer(Layer):
                         return moe_ffn_dropless_ep_values(
                             x_l, gw_, wg_l, wu_l, wd_l, top_k, ep_size,
                             ep, list(tok_axes), cap, ragged=use_ragged)
+                    from ...distributed.collective import _SM_KW
+                    # check_vma off: the grouped-matmul pallas_call in
+                    # _expert_ffn_rows can't annotate vma on its outputs
                     mapped = _shard_map(
                         body, mesh=mesh.jax_mesh,
                         in_specs=(P(tok_axes, None), P(None, None),
                                   P(ep, None, None), P(ep, None, None),
                                   P(ep, None, None)),
-                        out_specs=(P(tok_axes, None), P(), P()))
+                        out_specs=(P(tok_axes, None), P(), P()),
+                        **_SM_KW)
                     out, aux, drops = mapped(x2, gw, wg, wu, wd)
                     return out.reshape(xv.shape), aux, drops
                 # fall through to capacity path on indivisible shapes

@@ -5,7 +5,10 @@ InterpreterCore (SURVEY.md §3.4) collapsed into ONE mechanism: because every
 eager op in this framework is a traceable JAX computation (including the
 autograd tape and the optimizer update), re-executing the user's eager train
 step under `jax.jit` tracing yields a single fused XLA program per step —
-no bytecode interpretation, no graph breaks, no separate IR.
+no bytecode interpretation, no separate IR. Data-dependent Python control
+flow is a graph break: under `to_static` (full_graph=False, the reference
+default) it logs and falls back to eager (SOT-lite); under full_graph=True
+or `TrainStep` it raises the pointed GraphBreakError.
 
 Key pieces:
 * `to_static(fn_or_layer)`   — jit a function/Layer forward (inference path).
@@ -18,13 +21,14 @@ Key pieces:
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.tensor import Parameter, Tensor
+from ..core.tensor import GraphBreakError, Parameter, Tensor
 from ..tensor.random import default_generator
 
 
@@ -40,14 +44,39 @@ def _spec_of(tree):
         is_leaf=lambda x: isinstance(x, Tensor))
 
 
-class StaticFunction:
-    """jit wrapper for a pure function or a Layer's forward."""
+#: record of every graph break that fell back to eager this process:
+#: list of (qualname, reason) — ≙ the reference SOT's break-graph log
+#: (`sot.opcode_translator` info logs). Inspect with jit.sot_graph_breaks().
+_graph_break_log: list = []
 
-    def __init__(self, function, layer=None, input_spec=None, **kwargs):
+
+def sot_graph_breaks() -> list:
+    """(qualname, reason) for every to_static graph break that fell back
+    to eager execution in this process (SOT-lite diagnostics)."""
+    return list(_graph_break_log)
+
+
+class StaticFunction:
+    """jit wrapper for a pure function or a Layer's forward.
+
+    SOT-lite contract (≙ reference `python/paddle/jit/sot/` [U]): with
+    full_graph=False (the default, matching the reference), data-dependent
+    Python control flow on a traced Tensor does not error — the graph break
+    is logged and the function falls back to EAGER execution (numerics
+    identical, per-op dispatch instead of one fused XLA program). The
+    fallback decision is cached per function: the reference re-traces
+    subgraphs between breaks; here the unit of capture is the whole
+    function, which is the bounded version of the same contract.
+    full_graph=True keeps the pointed GraphBreakError."""
+
+    def __init__(self, function, layer=None, input_spec=None,
+                 full_graph=False, **kwargs):
         self._fn = function
         self._layer = layer
         self._input_spec = input_spec
         self._jitted = None
+        self._full_graph = full_graph
+        self.graph_break_reason = None   # set on first fallback
         functools.update_wrapper(self, function)
 
     def _build(self):
@@ -84,17 +113,48 @@ class StaticFunction:
                 return _tensors_to_values(out)
             self._jitted = jax.jit(pure)
 
+    def _call_eager(self, args, kwargs):
+        # same input normalization as the compiled path (every array leaf
+        # becomes a Tensor) so numerics and types match trace-mode exactly
+        args = jax.tree_util.tree_map(Tensor, _tensors_to_values(list(args)))
+        kwargs = jax.tree_util.tree_map(Tensor,
+                                        _tensors_to_values(dict(kwargs)))
+        return self._fn(*args, **kwargs)
+
     def __call__(self, *args, **kwargs):
+        if self.graph_break_reason is not None:
+            return self._call_eager(args, kwargs)
         if self._jitted is None:
             self._build()
         arg_vals = _tensors_to_values(list(args))
         kw_vals = _tensors_to_values(dict(kwargs))
-        if self._layer is not None:
-            pv = [p._value for p in self._layer.parameters()]
-            bv = [b._value for b in self._layer.buffers()]
-            out_vals = self._jitted(pv, bv, arg_vals, kw_vals)
-        else:
-            out_vals = self._jitted(arg_vals, kw_vals)
+        try:
+            if self._layer is not None:
+                pv = [p._value for p in self._layer.parameters()]
+                bv = [b._value for b in self._layer.buffers()]
+                out_vals = self._jitted(pv, bv, arg_vals, kw_vals)
+            else:
+                out_vals = self._jitted(arg_vals, kw_vals)
+        except (GraphBreakError, jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError) as e:
+            # GraphBreakError: a framework Tensor coercion (`if t:`,
+            # float(t), .item(), .numpy()) under trace; the jax errors:
+            # the same coercions on a raw jax array in user code (the
+            # Array/Integer variants do NOT subclass
+            # ConcretizationTypeError in the installed jax).
+            if self._full_graph:
+                raise
+            reason = str(e).splitlines()[0]
+            self.graph_break_reason = reason
+            name = getattr(self._fn, "__qualname__", repr(self._fn))
+            _graph_break_log.append((name, reason))
+            warnings.warn(
+                f"to_static: graph break in {name!r} — falling back to "
+                f"eager execution for this function (numerics unchanged, "
+                f"no XLA fusion). Reason: {reason}  Pass full_graph=True "
+                "to error instead.", stacklevel=2)
+            return self._call_eager(args, kwargs)
         return jax.tree_util.tree_map(Tensor, out_vals)
 
     @property
@@ -107,18 +167,24 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True, **kwargs):
+              backend=None, full_graph=False, **kwargs):
     """≙ @paddle.jit.to_static. Works on functions of Tensors and on
-    nn.Layer instances (forward gets compiled with params as traced inputs)."""
+    nn.Layer instances (forward gets compiled with params as traced inputs).
+
+    full_graph=False (default, reference parity): graph breaks fall back
+    to eager with a warning (SOT-lite). full_graph=True: graph breaks
+    raise GraphBreakError with a pointed diagnostic."""
     from ..nn.layer.layers import Layer
 
     def decorate(obj):
         if isinstance(obj, Layer):
             sf = StaticFunction(obj.forward, layer=obj,
-                                input_spec=input_spec)
+                                input_spec=input_spec,
+                                full_graph=full_graph)
             obj.forward = sf
             return obj
-        return StaticFunction(obj, input_spec=input_spec)
+        return StaticFunction(obj, input_spec=input_spec,
+                              full_graph=full_graph)
 
     if function is not None:
         return decorate(function)

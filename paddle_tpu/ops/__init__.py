@@ -1,11 +1,21 @@
 """paddle_tpu.ops — TPU kernel library (Pallas/Mosaic), the counterpart of the
 reference's CUDA fused kernels («paddle/phi/kernels/fusion/» [U]).
 Each op ships a Pallas fast path + XLA fallback with identical semantics."""
+import os as _os
+
 import jax as _jax
 
 
 def on_tpu() -> bool:
-    """Shared TPU-detection gate for every Pallas fast path."""
+    """Shared TPU-detection gate for every Pallas fast path.
+
+    PDT_FORCE_MOSAIC=1 reports True on any platform: the offline Mosaic
+    lowering tier (tests/test_mosaic_lowering.py) uses it to route every
+    kernel down its non-interpret Pallas path while tracing on CPU, then
+    cross-lowers for TPU via `jax.export(..., platforms=["tpu"])` — the
+    Mosaic pass (BlockSpec/layout validation) runs without a chip."""
+    if _os.environ.get("PDT_FORCE_MOSAIC") == "1":
+        return True
     return _jax.devices()[0].platform == "tpu"
 
 

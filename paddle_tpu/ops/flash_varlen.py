@@ -4,9 +4,12 @@
 flash_attn_kernel.cu» varlen variants [U], SURVEY.md §2.1 FlashAttention
 row): multiple ragged sequences packed into one (B, S) buffer, attention
 confined to same-segment pairs. TPU-native design: segment ids ride the
-flash grid as (B, S) int32 arrays blocked (1, block) — the minor block
-dim is the 128-multiple block size, satisfying Mosaic's lane alignment —
-and the mask is segment equality fused into the online-softmax tiles.
+flash grid as (B, 1, S) int32 arrays blocked (1, 1, block) — the minor
+block dim is the 128-multiple block size and the singleton middle axis
+keeps the last-two block dims Mosaic-legal (a 2-D (1, block) spec puts
+the 1 on the sublane axis, which Mosaic rejects when B % 8 != 0 —
+chip-verified r5) — and the mask is segment equality fused into the
+online-softmax tiles.
 
 Causality is GLOBAL end-aligned position order, which equals per-segment
 causality when q and k share the packing (the packed-pretraining case,
@@ -72,7 +75,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref,
         s = mxu_dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = _mask(s, sq_ref[0], sk_ref[0], qi, ki, block_q, block_k,
+        s = _mask(s, sq_ref[0, 0], sk_ref[0, 0], qi, ki, block_q, block_k,
                   causal, offset)
         m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -117,7 +120,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = mxu_dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = _mask(s, sq_ref[0], sk_ref[0], qi, ki, block_q, block_k,
+        s = _mask(s, sq_ref[0, 0], sk_ref[0, 0], qi, ki, block_q, block_k,
                   causal, offset)
         lse = jnp.max(lse_ref[0], axis=-1, keepdims=True)
         delta = jnp.max(delta_ref[0], axis=-1, keepdims=True)
@@ -163,7 +166,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = mxu_dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        s = _mask(s, sq_ref[0], sk_ref[0], qi, ki, block_q, block_k,
+        s = _mask(s, sq_ref[0, 0], sk_ref[0, 0], qi, ki, block_q, block_k,
                   causal, offset)
         lse = jnp.max(lse_ref[0], axis=-1, keepdims=True)
         delta = jnp.max(delta_ref[0], axis=-1, keepdims=True)
@@ -213,8 +216,10 @@ def _varlen_fwd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b // heads, i)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // heads, j)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // heads,
+                                                           0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // heads,
+                                                           0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -230,7 +235,7 @@ def _varlen_fwd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, seg_q, seg_k)
+    )(q, k, v, seg_q[:, None, :], seg_k[:, None, :])
     return o, lse
 
 
@@ -258,14 +263,16 @@ def _varlen_bwd(q, k, v, o, lse, do, seg_q, seg_k, scale, causal,
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b // heads, i)),
-            pl.BlockSpec((1, block_k), lambda b, i, j: (b // heads, j)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // heads,
+                                                           0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b // heads,
+                                                           0, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta, seg_q, seg_k)
+    )(q, k, v, do, lse, delta, seg_q[:, None, :], seg_k[:, None, :])
 
     # dk/dv: grid over kv heads; innermost axis fuses (group, q-block) so
     # one scratch accumulates over every q head sharing this kv head
@@ -287,9 +294,10 @@ def _varlen_bwd(q, k, v, o, lse, do, seg_q, seg_k, scale, causal,
             pl.BlockSpec((1, block_q, d), q_map),
             pl.BlockSpec((1, block_q, LANES), q_map),
             pl.BlockSpec((1, block_q, LANES), q_map),
-            pl.BlockSpec((1, block_q), lambda b, j, t: (b // heads_k,
-                                                        t % nq)),
-            pl.BlockSpec((1, block_k), lambda b, j, t: (b // heads_k, j)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, t: (b // heads_k,
+                                                           0, t % nq)),
+            pl.BlockSpec((1, 1, block_k), lambda b, j, t: (b // heads_k,
+                                                           0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
@@ -302,7 +310,7 @@ def _varlen_bwd(q, k, v, o, lse, do, seg_q, seg_k, scale, causal,
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta, seg_q, seg_k)
+    )(q, k, v, do, lse, delta, seg_q[:, None, :], seg_k[:, None, :])
     return dq, dk, dv
 
 

@@ -46,6 +46,39 @@ class TestMesh:
         assert tuple(y._value.sharding.spec) == (None, "mp")
         np.testing.assert_allclose(x.numpy(), y.numpy())
 
+    def test_hybrid_mesh_cpu_factoring(self):
+        """VERDICT r4 #7: hybrid ICI x DCN mesh. On the CPU platform the
+        dcn axes factor the flat list slowest-varying — contiguous device
+        ids form each virtual slice."""
+        _need8()
+        mesh = dist.create_hybrid_mesh(dcn_axes={"dp": 2},
+                                       ici_axes={"mp": 4})
+        assert mesh.dim_names == ["dp", "mp"] and mesh.shape == [2, 4]
+        dev = mesh.jax_mesh.devices
+        # each dcn row is one virtual slice: contiguous ids
+        ids = np.array([[d.id for d in row] for row in dev])
+        assert ids[0].tolist() == sorted(ids[0].tolist())
+        assert set(ids[0]) & set(ids[1]) == set()
+        # a sharded matmul runs over it: dp batch-sharded, mp col-sharded
+        x = dist.shard_tensor(paddle.randn([4, 16]), mesh,
+                              [dist.Shard(0), dist.Replicate()])
+        w = dist.shard_tensor(paddle.randn([16, 8]), mesh,
+                              [dist.Replicate(), dist.Shard(1)])
+        y = paddle.matmul(x, w)
+        np.testing.assert_allclose(
+            y.numpy(), x.numpy() @ w.numpy(), rtol=2e-5, atol=2e-5)
+
+    def test_hybrid_mesh_validation(self):
+        _need8()
+        with pytest.raises(ValueError, match="both dcn_axes and ici_axes"):
+            dist.create_hybrid_mesh(dcn_axes={"dp": 2})
+        with pytest.raises(ValueError, match="duplicate axis"):
+            dist.create_hybrid_mesh(dcn_axes={"dp": 2},
+                                    ici_axes={"dp": 4})
+        with pytest.raises(ValueError, match="devices"):
+            dist.create_hybrid_mesh(dcn_axes={"dp": 64},
+                                    ici_axes={"mp": 64})
+
     def test_spec_roundtrip(self):
         _need8()
         from paddle_tpu.distributed.mesh import (placements_to_spec,

@@ -195,7 +195,7 @@ class TestGraphBreakContract:
                 return x * 2
             return x
 
-        sf = jit.to_static(f)
+        sf = jit.to_static(f, full_graph=True)
         with pytest.raises(GraphBreakError, match="graph break"):
             sf(paddle.to_tensor(np.ones(4, np.float32)))
 
@@ -207,7 +207,7 @@ class TestGraphBreakContract:
                 return x
 
             with pytest.raises(GraphBreakError):
-                jit.to_static(f)(paddle.to_tensor(
+                jit.to_static(f, full_graph=True)(paddle.to_tensor(
                     np.ones(3, np.float32)))
 
     def test_eager_coercions_still_work(self):
@@ -235,3 +235,82 @@ class TestGraphBreakContract:
             step(x, x)
         assert "graph break" in str(ei.value).lower() or \
             isinstance(ei.value, GraphBreakError)
+
+
+class TestSOTLiteFallback:
+    """VERDICT r4 #6: the reference SOT keeps running across a graph break
+    (subgraph + eager resume, «python/paddle/jit/sot/»). SOT-lite contract:
+    full_graph=False (default) logs the break and runs the function eagerly
+    — numerics identical to eager, fallback decision cached."""
+
+    def test_if_tensor_falls_back_with_matching_numerics(self):
+        import paddle_tpu.jit as jit
+
+        def f(x):
+            if x.sum() > 0:          # reference-style migration code
+                return x * 2
+            return x - 1
+
+        sf = jit.to_static(f)        # default full_graph=False
+        xs = [np.ones(4, np.float32), -np.ones(4, np.float32)]
+        with pytest.warns(UserWarning, match="graph break"):
+            out = sf(paddle.to_tensor(xs[0]))
+        np.testing.assert_allclose(out.numpy(), f(paddle.to_tensor(
+            xs[0])).numpy())
+        assert sf.graph_break_reason is not None
+        # cached: both branches of the Python control flow now run
+        out2 = sf(paddle.to_tensor(xs[1]))
+        np.testing.assert_allclose(out2.numpy(), f(paddle.to_tensor(
+            xs[1])).numpy())
+        assert any("f" in name for name, _ in jit.sot_graph_breaks())
+
+    def test_numpy_coercion_falls_back(self):
+        """r5 review: .numpy() under trace must be a graph break (pointed
+        error / SOT fallback), not a raw TracerArrayConversionError."""
+        import paddle_tpu.jit as jit
+
+        def f(x):
+            return x * float(np.max(x.numpy()))
+
+        sf = jit.to_static(f)
+        x = paddle.to_tensor(np.array([1., 4., 2.], np.float32))
+        with pytest.warns(UserWarning, match="graph break"):
+            out = sf(x)
+        np.testing.assert_allclose(out.numpy(), [4., 16., 8.])
+        with pytest.raises(GraphBreakError, match="numpy"):
+            jit.to_static(f, full_graph=True)(x)
+
+    def test_clean_function_still_compiles_once(self):
+        import paddle_tpu.jit as jit
+
+        def g(x):
+            return paddle.where(x > 0, x * 2, x - 1)   # tensor branch: no break
+
+        sf = jit.to_static(g)
+        out = sf(paddle.to_tensor(np.ones(4, np.float32)))
+        assert sf.graph_break_reason is None
+        np.testing.assert_allclose(out.numpy(), np.full(4, 2.0, np.float32))
+
+    def test_layer_forward_falls_back(self):
+        import paddle_tpu.jit as jit
+        from paddle_tpu import nn
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                y = self.fc(x)
+                if y.mean() > 1e9:   # data-dependent break, cold branch
+                    return y * 0
+                return y
+
+        paddle.seed(0)
+        m = M()
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        want = m.fc(x).numpy()
+        jit.to_static(m)
+        with pytest.warns(UserWarning, match="graph break"):
+            got = m.forward(x).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6)

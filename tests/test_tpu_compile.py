@@ -125,8 +125,10 @@ class TestRopeCompile:
         from paddle_tpu.ops.rope import rope_values
 
         x = jnp.zeros((BENCH_B, BENCH_S, BENCH_H, BENCH_D), jnp.bfloat16)
-        cos = jnp.zeros((BENCH_S, BENCH_D), jnp.float32)
-        sin = jnp.zeros((BENCH_S, BENCH_D), jnp.float32)
+        # trig tables are (max_len, D/2) — the kernel's pair convention
+        # (rope_values docstring; models/llama.py precompute_rope)
+        cos = jnp.zeros((BENCH_S, BENCH_D // 2), jnp.float32)
+        sin = jnp.zeros((BENCH_S, BENCH_D // 2), jnp.float32)
         _compile(rope_values, x, cos, sin)
 
         def loss(x):
@@ -207,34 +209,27 @@ class TestInt8MXUCompile:
             xv.reshape(-1, BENCH_HIDDEN), qw, sc), x)
 
     def test_int8_faster_than_bf16_at_large_shape(self):
-        """Measured on-chip speedup check (soft: asserts not slower than
-        0.9x; records the ratio in the output for the round notes)."""
-        import time
+        """Measured on-chip speedup, slope method (r5 chip-gate finding:
+        the axon tunnel adds ~64ms per synchronous roundtrip, so ANY
+        single-dispatch timing — device_get of the result, fused-reduce
+        scalar, block_until_ready — measures transport, not the MXU.
+        bench.bench_int8 times N dependent matmuls inside ONE executable
+        at two values of N; the slope cancels every fixed cost: measured
+        bf16 0.646 ms = 213 TF/s ≈ nominal v5e peak, int8 0.528 ms = 260
+        TOP/s → a real but modest 1.22x, NOT the 2x of the 394-TOPs
+        marketing peak)."""
+        import importlib.util
+        import os
 
-        m, k, n = 4096, 4096, 4096
-        xb = jnp.ones((m, k), jnp.bfloat16)
-        wb = jnp.ones((k, n), jnp.bfloat16)
-        x8 = jnp.ones((m, k), jnp.int8)
-        w8 = jnp.ones((k, n), jnp.int8)
-
-        f_bf = jax.jit(lambda a, b: a @ b)
-        f_i8 = jax.jit(lambda a, b: jax.lax.dot_general(
-            a, b, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32))
-
-        def timeit(f, a, b):
-            jax.device_get(f(a, b))          # compile + warm
-            t0 = time.perf_counter()
-            for _ in range(10):
-                r = f(a, b)
-            jax.device_get(r)
-            return (time.perf_counter() - t0) / 10
-
-        t_bf = timeit(f_bf, xb, wb)
-        t_i8 = timeit(f_i8, x8, w8)
-        print(f"\nint8 vs bf16 matmul {m}x{k}x{n}: bf16 {t_bf*1e3:.3f} "
-              f"ms, int8 {t_i8*1e3:.3f} ms ({t_bf/t_i8:.2f}x)")
-        assert t_i8 < t_bf / 0.9, (t_i8, t_bf)
+        spec = importlib.util.spec_from_file_location(
+            "bench", os.path.join(os.path.dirname(__file__), "..",
+                                  "bench.py"))
+        bench = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench)
+        res = bench.bench_int8(on_tpu=True)
+        print(f"\n{res}")
+        assert "int8_timing_error" not in res, res
+        assert res["int8_speedup_vs_bf16"] > 1.05, res
 
 
 class TestRaggedEPCompile:
@@ -268,11 +263,12 @@ class TestRaggedEPCompile:
                 x_l, gw_, wg_l, wu_l, wd_l, k, 1, "ep", ["ep"], t * k,
                 ragged=True)
 
+        from paddle_tpu.distributed.collective import _SM_KW
         mapped = shard_map(
             body, mesh=mesh,
             in_specs=(P("ep", None), P(None, None), P("ep", None, None),
                       P("ep", None, None), P("ep", None, None)),
-            out_specs=(P("ep", None), P(), P()))
+            out_specs=(P("ep", None), P(), P()), **_SM_KW)
         out, aux, drops = jax.device_get(jax.jit(mapped)(x, gw, wg, wu,
                                                          wd))
         ref, aux_ref = jax.device_get(
@@ -284,15 +280,21 @@ class TestRaggedEPCompile:
 
 
 class TestPagedEngineDecodeCompile:
-    """Round-5: the serving engine's paged decode step (vector-position
-    rope + paged append + paged attention + sampling) at engine shapes,
-    end-to-end on the chip, with outputs checked against the dense
-    engine."""
+    """Round-5: the serving engine's paged decode path on the chip.
 
-    def test_paged_engine_step_matches_dense_on_chip(self):
+    Chip-gate r5 finding: asserting exact greedy-token equality between
+    the paged and dense ENGINES is unsound on silicon — the Pallas paged
+    kernel and the XLA dense attention are both correct but accumulate in
+    different orders (measured max |Δ| = one bf16 ulp), and greedy argmax
+    amplifies a near-tie into a different trajectory after ~10 tokens
+    (interpret mode can't see this: both layouts run the same XLA math
+    there). So the chip test asserts (a) single-step LOGIT parity between
+    a paged and a dense decode step on identical cache state, and (b)
+    both engines run end-to-end producing well-formed outputs."""
+
+    def _tiny(self):
         import paddle_tpu as paddle
         from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
-        from paddle_tpu.models.serving import ContinuousBatchingEngine
 
         paddle.seed(0)
         cfg = LlamaConfig(vocab_size=2048, hidden_size=256,
@@ -301,15 +303,84 @@ class TestPagedEngineDecodeCompile:
                           max_position_embeddings=512)
         m = LlamaForCausalLM(cfg)
         m.eval()
+        return cfg, m
+
+    def test_paged_decode_step_logits_match_dense_on_chip(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.core.tensor import Tensor, no_grad
+        from paddle_tpu.models.llama import PagedKVCacheView
+        from paddle_tpu.ops.paged_attention import paged_prefill_scatter
+
+        cfg, m = self._tiny()
+        hk, hd = cfg.num_key_value_heads, cfg.head_dim
+        page_size, b, s_max = 16, 2, 256
+        pps = s_max // page_size
+        rng = np.random.default_rng(1)
+        p_lens = [27, 41]                      # straddle page boundaries
+        p_max = max(p_lens)
+        ids = np.zeros((b, p_max), np.int64)
+        for i, pl_ in enumerate(p_lens):
+            ids[i, :pl_] = rng.integers(1, cfg.vocab_size, pl_)
+
+        with no_grad():
+            # dense prefill (zero caches + validity mask, the engine's
+            # own prefill contract) -> per-layer (B, S, HK, D) caches
+            zero = [(Tensor(jnp.zeros((b, p_max, hk, hd), jnp.float32)),
+                     Tensor(jnp.zeros((b, p_max, hk, hd), jnp.float32)))
+                    for _ in range(cfg.num_hidden_layers)]
+            am = jnp.arange(p_max)[None, :] < jnp.asarray(p_lens)[:, None]
+            _, caches = m.forward(Tensor(jnp.asarray(ids)),
+                                  attention_mask=Tensor(am),
+                                  past_key_values=zero,
+                                  position_offset=0, use_cache=True)
+            dense, paged = [], []
+            n_pages = 1 + b * pps              # page 0 = trash page
+            for (k, v) in caches:
+                kd = jnp.zeros((b, s_max, hk, hd), k._value.dtype)
+                vd = jnp.zeros_like(kd)
+                kd = kd.at[:, :k.shape[1]].set(k._value)
+                vd = vd.at[:, :v.shape[1]].set(v._value)
+                dense.append((Tensor(kd), Tensor(vd)))
+                kp = jnp.zeros((hk, n_pages, page_size, hd),
+                               k._value.dtype)
+                vp = jnp.zeros_like(kp)
+                for i in range(b):
+                    bt_row = jnp.arange(1 + i * pps, 1 + (i + 1) * pps)
+                    kp, vp = paged_prefill_scatter(
+                        kp, vp, k._value[i, :p_lens[i]].astype(kp.dtype),
+                        v._value[i, :p_lens[i]].astype(kp.dtype),
+                        bt_row, p_lens[i])
+                paged.append((kp, vp))
+            bt = jnp.arange(1, 1 + b * pps, dtype=jnp.int32).reshape(
+                b, pps)
+            tok = jnp.asarray([[7], [11]], jnp.int64)
+            pos = jnp.asarray(p_lens, jnp.int32)
+
+            lg_dense, _ = m.forward(Tensor(tok), past_key_values=dense,
+                                    position_offset=Tensor(pos),
+                                    use_cache=True)
+            pkv = [PagedKVCacheView(kp, vp, bt) for kp, vp in paged]
+            lg_paged, _ = m.forward(Tensor(tok), past_key_values=pkv,
+                                    position_offset=Tensor(pos),
+                                    use_cache=True)
+        np.testing.assert_allclose(
+            np.asarray(lg_paged._value, np.float32),
+            np.asarray(lg_dense._value, np.float32), rtol=2e-2, atol=2e-2)
+
+    def test_both_engine_layouts_run_on_chip(self):
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+        cfg, m = self._tiny()
         rng = np.random.default_rng(1)
         prompts = [list(rng.integers(1, cfg.vocab_size, 12 + 5 * j))
                    for j in range(3)]
-        outs = {}
         for layout in ("paged", "dense"):
             eng = ContinuousBatchingEngine(m, max_batch_size=2,
                                            max_seq_len=256,
                                            kv_layout=layout)
             rids = [eng.add_request(p, 16) for p in prompts]
             res = eng.run()
-            outs[layout] = [res[r] for r in rids]
-        assert outs["paged"] == outs["dense"]
+            assert sorted(res) == sorted(rids)
+            for r in rids:
+                assert len(res[r]) == 16
+                assert all(0 <= t < cfg.vocab_size for t in res[r])
