@@ -24,6 +24,14 @@ rows) — TPU-native:
   never strand a mid-flight request.
 * Greedy decoding by default; temperature / top-k / top-p sampling rides
   the same compiled step via `_sample_token` (seeded, reproducible).
+* `enable_prefix_caching=True` (paged only) turns on vLLM-style
+  AUTOMATIC PREFIX CACHING: a finished request's full-page prompt KV is
+  retained (per-page refcounts, LRU eviction under pool pressure) and a
+  later request with the same token prefix attaches those pages
+  read-only — safe because full pages are immutable, decode only appends
+  past them — and prefills just the suffix with chunked attention over
+  the gathered prefix rows (`position_offset = shared_len`, so rope
+  angles are exact).
 * `kv_layout="dense"` keeps the previous per-slot contiguous caches
   (needed for sliding-window models; also the parity oracle for the
   paged path).
@@ -70,7 +78,9 @@ class ContinuousBatchingEngine:
                  top_k: int = 0,
                  top_p: float = 1.0,
                  seed: int = 0,
-                 max_prefill_programs: int = 8):
+                 max_prefill_programs: int = 8,
+                 enable_prefix_caching: bool = False,
+                 max_prefix_entries: int = 32):
         cfg = model.config
         self.model = model
         self.B = int(max_batch_size)
@@ -110,6 +120,16 @@ class ContinuousBatchingEngine:
         dt = self._params[0]._value.dtype
         self._kv_shape = (L, hk, hd, dt)
         if kv_layout == "dense":
+            if enable_prefix_caching:
+                import warnings
+                warnings.warn(
+                    "enable_prefix_caching requires kv_layout='paged' — "
+                    "prefix caching is DISABLED on the dense layout "
+                    "(and on sliding-window models, which fall back to "
+                    "dense)")
+            self._prefix_enabled = False
+            self.prefix_hits = 0
+            self.prefix_tokens_reused = 0
             self._caches = [
                 (jnp.zeros((self.B, self.S, hk, hd), dt),
                  jnp.zeros((self.B, self.S, hk, hd), dt))
@@ -130,7 +150,29 @@ class ContinuousBatchingEngine:
             self._free: List[int] = list(range(1, self.num_pages))
             self._slot_pages: List[List[int]] = [[] for _ in range(self.B)]
             self._slot_reserved = np.zeros(self.B, np.int64)
-            self._scatter_jits: Dict[int, object] = {}
+            self._scatter_jits: "OrderedDict[int, object]" = OrderedDict()
+            # -- automatic prefix caching (vLLM-style, opt-in) ---------
+            # Full pages are immutable once written (decode only appends
+            # past them), so a finished request's full-page prompt KV can
+            # be SHARED read-only by later requests with the same token
+            # prefix: the new request attaches the cached pages to its
+            # block table and prefills only the suffix (chunked-prefill
+            # attention over the gathered prefix rows). The cache is a
+            # PAGE TRIE (≙ vLLM hash-chain / SGLang radix): one node per
+            # (parent, page-of-tokens), so match/registration are O(p_len)
+            # and key memory is linear, with exact-token keys (no hash-
+            # collision risk). Per-page refcounts arbitrate slots + trie
+            # nodes; childless LRU nodes are evicted under pool pressure.
+            self._prefix_enabled = bool(enable_prefix_caching)
+            self._max_prefix_entries = int(max_prefix_entries)
+            self._page_rc = np.zeros(self.num_pages, np.int32)
+            # node key -> {"page": id, "parent": key|None, "children": n}
+            self._prefix_nodes: "OrderedDict[tuple, dict]" = OrderedDict()
+            self._slot_shared_pages: List[List[int]] = \
+                [[] for _ in range(self.B)]
+            self._suffix_jits: "OrderedDict[tuple, object]" = OrderedDict()
+            self.prefix_hits = 0
+            self.prefix_tokens_reused = 0
         # host-side slot state
         self._pos = np.zeros(self.B, np.int32)        # next write position
         self._tok = np.zeros(self.B, np.int32)        # last emitted token
@@ -212,18 +254,34 @@ class ContinuousBatchingEngine:
         page_bytes = self.page_size * hk * hd * itemsize * 2 * L
         usable = self.num_pages - 1
         in_use = usable - len(self._free)
-        return {"layout": "paged", "page_bytes": page_bytes,
+        info = {"layout": "paged", "page_bytes": page_bytes,
                 "total_pages": usable, "pages_in_use": in_use,
                 "bytes_pool": self.num_pages * page_bytes,
                 "bytes_in_use": in_use * page_bytes,
                 "utilization": in_use / max(usable, 1)}
+        if self._prefix_enabled:
+            cached = {n["page"] for n in self._prefix_nodes.values()}
+            info.update(prefix_entries=len(self._prefix_nodes),
+                        prefix_pages=len(cached),
+                        prefix_hits=self.prefix_hits,
+                        prefix_tokens_reused=self.prefix_tokens_reused)
+        return info
 
     # -- internals -----------------------------------------------------
     def _release_slot(self, slot: int):
+        req = self._slot_req[slot]
         self._slot_req[slot] = None
         if self.layout == "paged":
-            self._free.extend(self._slot_pages[slot])
+            if self._prefix_enabled and req is not None:
+                # register BEFORE the decrefs so the prompt pages never
+                # transit through the free list
+                self._register_prefix(slot, req)
+            for p in self._slot_pages[slot]:
+                self._decref(p)
+            for p in self._slot_shared_pages[slot]:
+                self._decref(p)
             self._slot_pages[slot] = []
+            self._slot_shared_pages[slot] = []
             self._slot_reserved[slot] = 0
             # inactive slots keep decoding garbage; their block-table row
             # must point at the trash page, not at reclaimed pages
@@ -245,11 +303,8 @@ class ContinuousBatchingEngine:
             jit = self._build_prefill(bucket)
             self._prefill_jits[bucket] = jit
             while len(self._prefill_jits) > self._max_prefill:
-                old, _ = self._prefill_jits.popitem(last=False)  # LRU
-                # the paged scatter program is keyed by the same bucket —
-                # evict it together or compiled programs still accumulate
-                if self.layout == "paged":
-                    self._scatter_jits.pop(old, None)
+                self._prefill_jits.popitem(last=False)      # LRU
+                # scatter programs carry their own LRU cap (_get_scatter)
         else:
             self._prefill_jits.move_to_end(bucket)
         return jit
@@ -296,22 +351,42 @@ class ContinuousBatchingEngine:
         while free and self._queue:
             req = self._queue[0]
             p_len = len(req.prompt)
-            if self.layout == "paged" and not self._reserve_ok(req):
+            shared = None
+            if self.layout == "paged" and self._prefix_enabled:
+                shared = self._match_prefix(req.prompt)
+                if shared is not None:
+                    # PIN the matched pages before reservation: under
+                    # pool pressure _reserve_ok may evict the matched
+                    # entry itself, and unpinned pages would land on the
+                    # free list while still referenced by `shared`
+                    shared = list(shared)
+                    for p in shared:
+                        self._incref(p)
+            if self.layout == "paged" and not self._reserve_ok(
+                    req, len(shared) if shared else 0):
+                if shared:
+                    for p in shared:
+                        self._decref(p)    # unpin before waiting
                 break                      # FIFO: wait for pages to free
             slot = free.pop(0)
             self._queue.pop(0)
-            bucket = self._bucket(max(p_len, 1))
-            jit = self._get_prefill(bucket)
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :p_len] = req.prompt
-            tok, rows = jit(
-                [p._value for p in self._params],
-                [b._value for b in self._buffers],
-                jnp.asarray(ids), jnp.int32(p_len), self._next_keys())
-            if self.layout == "paged":
-                self._paged_insert(slot, req, p_len, bucket, rows)
+            if shared:
+                tok = self._admit_shared(slot, req, shared)
+                for p in shared:
+                    self._decref(p)        # unpin: the slot holds refs
             else:
-                self._dense_insert(slot, rows)
+                bucket = self._bucket(max(p_len, 1))
+                jit = self._get_prefill(bucket)
+                ids = np.zeros((1, bucket), np.int32)
+                ids[0, :p_len] = req.prompt
+                tok, rows = jit(
+                    [p._value for p in self._params],
+                    [b._value for b in self._buffers],
+                    jnp.asarray(ids), jnp.int32(p_len), self._next_keys())
+                if self.layout == "paged":
+                    self._paged_insert(slot, req, p_len, bucket, rows)
+                else:
+                    self._dense_insert(slot, rows)
             self._slot_req[slot] = req
             self._pos[slot] = p_len
             self._tok[slot] = int(tok)
@@ -323,6 +398,42 @@ class ContinuousBatchingEngine:
                 self._release_slot(slot)
                 free.insert(0, slot)
         return finished
+
+    def _admit_shared(self, slot: int, req: Request, pages: List[int]):
+        """Admission with a prefix-cache hit: attach the cached pages
+        read-only, then prefill only the suffix (chunked attention over
+        the gathered prefix KV)."""
+        p_len = len(req.prompt)
+        shared_len = len(pages) * self.page_size
+        self._slot_shared_pages[slot] = list(pages)
+        for j, p in enumerate(pages):
+            self._bt[slot, j] = p
+            self._incref(p)
+        self._slot_reserved[slot] = self._worst_pages(req)
+        while (len(pages) + len(self._slot_pages[slot])) \
+                * self.page_size < p_len:
+            self._alloc_page(slot)
+        suffix = req.prompt[shared_len:]
+        bucket = self._bucket(len(suffix))
+        jit = self._get_suffix_prefill(shared_len, bucket)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :len(suffix)] = suffix
+        tok, rows = jit(
+            [p._value for p in self._params],
+            [b._value for b in self._buffers],
+            self._kv, jnp.asarray(np.asarray(pages, np.int32)),
+            jnp.asarray(ids), jnp.int32(len(suffix)), self._next_keys())
+        # scatter the suffix rows into the pages AFTER the shared ones:
+        # shared_len is page-aligned, so a rebased sub-block-table keeps
+        # the per-bucket scatter program shape-stable
+        sub_bt = np.zeros(self.pps, np.int32)
+        sub_bt[:self.pps - len(pages)] = self._bt[slot, len(pages):]
+        sjit = self._get_scatter(bucket)
+        self._kv = sjit(self._kv, rows, jnp.asarray(sub_bt),
+                        jnp.int32(len(suffix)))
+        self.prefix_hits += 1
+        self.prefix_tokens_reused += shared_len
+        return int(tok)
 
     # -- dense layout --------------------------------------------------
     def _dense_insert(self, slot: int, rows):
@@ -344,26 +455,132 @@ class ContinuousBatchingEngine:
         worst_len = min(len(req.prompt) + req.max_new_tokens, self.S)
         return -(-worst_len // self.page_size)
 
-    def _reserve_ok(self, req: Request) -> bool:
-        """Admit only if the request's worst-case page demand fits the
-        pool net of other slots' outstanding (reserved-but-unallocated)
-        pages — lazy growth can then never fail mid-flight."""
+    def _reserve_ok(self, req: Request, shared_pages: int = 0) -> bool:
+        """Admit only if the request's worst-case page demand (net of any
+        shared prefix pages it attaches) fits the pool net of other
+        slots' outstanding (reserved-but-unallocated) pages — lazy
+        growth can then never fail mid-flight. Evicts LRU prefix-cache
+        entries when that frees enough."""
         outstanding = int(sum(
             self._slot_reserved[i] - len(self._slot_pages[i])
+            - len(self._slot_shared_pages[i])
             for i, r in enumerate(self._slot_req) if r is not None))
-        return len(self._free) - outstanding >= self._worst_pages(req)
+        need = self._worst_pages(req) - shared_pages + outstanding
+        if len(self._free) >= need:
+            return True
+        return self._ensure_free(need)
+
+    # -- prefix cache ---------------------------------------------------
+    def _incref(self, page: int):
+        self._page_rc[page] += 1
+
+    def _decref(self, page: int):
+        self._page_rc[page] -= 1
+        if self._page_rc[page] == 0:
+            self._free.append(page)
+
+    def _evict_one(self) -> bool:
+        """Evict the least-recently-used CHILDLESS trie node (leaves
+        first — an inner node's page must outlive its descendants'
+        block-table references into the shared chain)."""
+        for key, node in self._prefix_nodes.items():   # LRU order
+            if node["children"] == 0:
+                del self._prefix_nodes[key]
+                if node["parent"] is not None:
+                    self._prefix_nodes[node["parent"]]["children"] -= 1
+                self._decref(node["page"])
+                return True
+        return False
+
+    def _cache_only_pages(self) -> int:
+        """Pages whose every reference comes from trie nodes — the upper
+        bound on what eviction can return to the free list."""
+        holds: Dict[int, int] = {}
+        for node in self._prefix_nodes.values():
+            holds[node["page"]] = holds.get(node["page"], 0) + 1
+        return sum(1 for p, n in holds.items() if self._page_rc[p] == n)
+
+    def _ensure_free(self, n: int) -> bool:
+        if len(self._free) >= n:
+            return True
+        # feasibility first: draining the whole cache for a request that
+        # still cannot fit would destroy every shared prefix for nothing
+        if len(self._free) + self._cache_only_pages() < n:
+            return False
+        while len(self._free) < n and self._evict_one():
+            pass
+        return len(self._free) >= n
+
+    def _match_prefix(self, toks: List[int]):
+        """Longest cached full-page prefix of `toks` via the page trie —
+        O(p_len) total key work — capped so at least one prompt token
+        remains to prefill (its logits seed decoding)."""
+        max_pages = (len(toks) - 1) // self.page_size
+        pages, parent = [], None
+        for f in range(max_pages):
+            key = (parent, tuple(toks[f * self.page_size:
+                                      (f + 1) * self.page_size]))
+            node = self._prefix_nodes.get(key)
+            if node is None:
+                break
+            self._prefix_nodes.move_to_end(key)     # MRU
+            pages.append(node["page"])
+            parent = key
+        if not pages:
+            return None
+        # attach a POWER-OF-TWO page count: each distinct shared_len is
+        # a separate compiled suffix-prefill program, so an unquantized
+        # match family would thrash the program LRU with multi-second
+        # recompiles that cost more than the prefill they save
+        return pages[:1 << (len(pages).bit_length() - 1)]
+
+    def _register_prefix(self, slot: int, req: Request):
+        # walk/extend the page trie; registration depth is capped at the
+        # entry budget — registering more nodes than the cache can hold
+        # would only churn the LRU
+        full = min(len(req.prompt) // self.page_size,
+                   self._max_prefix_entries)
+        parent = None
+        for f in range(full):
+            key = (parent, tuple(req.prompt[f * self.page_size:
+                                            (f + 1) * self.page_size]))
+            node = self._prefix_nodes.get(key)
+            if node is None:
+                page = int(self._bt[slot, f])
+                self._incref(page)
+                self._prefix_nodes[key] = {"page": page, "parent": parent,
+                                           "children": 0}
+                if parent is not None:
+                    self._prefix_nodes[parent]["children"] += 1
+            else:
+                self._prefix_nodes.move_to_end(key)
+            parent = key
+        while len(self._prefix_nodes) > self._max_prefix_entries:
+            if not self._evict_one():
+                break
 
     def _alloc_page(self, slot: int) -> int:
+        if not self._free:
+            # reservation accounting guarantees this succeeds
+            self._ensure_free(1)
         page = self._free.pop()
+        self._page_rc[page] = 1
         self._slot_pages[slot].append(page)
-        self._bt[slot, len(self._slot_pages[slot]) - 1] = page
+        self._bt[slot, len(self._slot_shared_pages[slot])
+                 + len(self._slot_pages[slot]) - 1] = page
         return page
 
     def _paged_insert(self, slot: int, req: Request, p_len: int,
                       bucket: int, rows):
         self._slot_reserved[slot] = self._worst_pages(req)
-        while len(self._slot_pages[slot]) * self.page_size < p_len:
+        while (len(self._slot_shared_pages[slot])
+               + len(self._slot_pages[slot])) * self.page_size < p_len:
             self._alloc_page(slot)
+        jit = self._get_scatter(bucket)
+        self._kv = jit(self._kv, rows, jnp.asarray(self._bt[slot]),
+                       jnp.int32(p_len))
+
+    def _get_scatter(self, bucket: int):
         jit = self._scatter_jits.get(bucket)
         if jit is None:
             from paddle_tpu.ops.paged_attention import \
@@ -377,8 +594,74 @@ class ContinuousBatchingEngine:
                     for (kp, vp), (rk, rv) in zip(kv, rows_)]
             jit = jax.jit(_scatter, donate_argnums=(0,))
             self._scatter_jits[bucket] = jit
-        self._kv = jit(self._kv, rows, jnp.asarray(self._bt[slot]),
-                       jnp.int32(p_len))
+            # own LRU cap: suffix-prefill admissions reach buckets that
+            # never enter _prefill_jits, so a coupled eviction would leak
+            while len(self._scatter_jits) > self._max_prefill:
+                self._scatter_jits.popitem(last=False)
+        else:
+            self._scatter_jits.move_to_end(bucket)
+        return jit
+
+    def _get_suffix_prefill(self, shared_len: int, bucket: int):
+        key = (shared_len, bucket)
+        jit = self._suffix_jits.get(key)
+        if jit is None:
+            jit = self._build_suffix_prefill(shared_len, bucket)
+            self._suffix_jits[key] = jit
+            # own budget (2x prefill's): keys span shared_len x bucket,
+            # but shared_len is power-of-two-quantized (_match_prefix)
+            # so the space stays log-bounded
+            while len(self._suffix_jits) > 2 * self._max_prefill:
+                self._suffix_jits.popitem(last=False)      # LRU
+        else:
+            self._suffix_jits.move_to_end(key)
+        return jit
+
+    def _build_suffix_prefill(self, shared_len: int, bucket: int):
+        """Compiled program for prefix-hit admission: gather the shared
+        prefix pages to dense rows, run chunked prefill of the suffix
+        over them (end-aligned causal, position_offset = shared_len so
+        rope angles are exact), sample the first token, return the
+        suffix KV rows for scatter. One program per (shared_len,
+        suffix bucket), LRU-capped with the other prefill programs."""
+        model = self.model
+        params, buffers = self._params, self._buffers
+        cfg = model.config
+        hk, hd = cfg.num_key_value_heads, cfg.head_dim
+        strat, temp = self.strategy, self.temperature
+        tk, tp = self.top_k, self.top_p
+
+        def run(pv, bv, kv, bt_prefix, ids, true_len, key):
+            from .generation import bind_state, _sample_token
+            with bind_state(params, buffers, pv, bv), no_grad():
+                caches = []
+                for (kp, vp) in kv:
+                    # (hk, n_pp, ps, hd) -> (1, shared_len, hk, hd)
+                    kd = jnp.transpose(kp[:, bt_prefix],
+                                       (1, 2, 0, 3)).reshape(
+                        1, shared_len, hk, hd)
+                    vd = jnp.transpose(vp[:, bt_prefix],
+                                       (1, 2, 0, 3)).reshape(
+                        1, shared_len, hk, hd)
+                    pad = jnp.zeros((1, bucket, hk, hd), kd.dtype)
+                    caches.append(
+                        (Tensor(jnp.concatenate([kd, pad], 1)),
+                         Tensor(jnp.concatenate([vd, pad], 1))))
+                am = (jnp.arange(shared_len + bucket)
+                      < shared_len + true_len)[None, :]
+                logits, new_caches = model.forward(
+                    Tensor(ids), attention_mask=Tensor(am),
+                    past_key_values=caches, position_offset=shared_len,
+                    use_cache=True)
+                last = logits._value[0, true_len - 1]
+                tok, _ = _sample_token(last[None], key, strat, temp,
+                                       tk, tp)
+                rows = [(k._value[0, shared_len:],
+                         v._value[0, shared_len:])
+                        for k, v in new_caches]
+                return tok[0], rows
+
+        return jax.jit(run)
 
     # -- decode --------------------------------------------------------
     def _build_decode(self):
@@ -423,7 +706,8 @@ class ContinuousBatchingEngine:
                 # lazy growth: next token writes at pos[i] — allocate its
                 # page if the sequence just crossed a page boundary
                 # (guaranteed to succeed by the admission reservation)
-                while len(self._slot_pages[i]) * self.page_size \
+                while (len(self._slot_shared_pages[i])
+                       + len(self._slot_pages[i])) * self.page_size \
                         <= int(self._pos[i]):
                     self._alloc_page(i)
             kv = self._kv

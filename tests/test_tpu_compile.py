@@ -384,3 +384,22 @@ class TestPagedEngineDecodeCompile:
             for r in rids:
                 assert len(res[r]) == 16
                 assert all(0 <= t < cfg.vocab_size for t in res[r])
+
+    def test_prefix_caching_suffix_prefill_on_chip(self):
+        """The prefix-hit admission path (page gather + chunked suffix
+        prefill + rebased scatter) must compile and run on silicon."""
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+        cfg, m = self._tiny()
+        rng = np.random.default_rng(2)
+        base = list(rng.integers(1, cfg.vocab_size, 32))
+        eng = ContinuousBatchingEngine(m, max_batch_size=1,
+                                       max_seq_len=256,
+                                       enable_prefix_caching=True)
+        rids = [eng.add_request(base + [5, 6], 8),
+                eng.add_request(base + [9], 8)]
+        res = eng.run()
+        assert eng.prefix_hits == 1 and eng.prefix_tokens_reused == 32
+        for r in rids:
+            assert len(res[r]) == 8
+            assert all(0 <= t < cfg.vocab_size for t in res[r])
