@@ -216,10 +216,6 @@ class LlamaAttention(nn.Layer):
                 raise ValueError(
                     "paged KV cache is decode-only (seq_len == 1); "
                     "prefill scatters rows via paged_prefill_scatter")
-            if self.sliding_window is not None:
-                raise NotImplementedError(
-                    "sliding_window attention over a paged KV cache is "
-                    "not supported — use the dense cache layout")
             from paddle_tpu.ops.paged_attention import (
                 paged_append_values, paged_attention_values)
             from paddle_tpu.core.tensor import apply as _apply
@@ -241,7 +237,8 @@ class LlamaAttention(nn.Layer):
 
             def fn_attn(qq, kp, vp):
                 return paged_attention_values(qq[:, 0], kp, vp, pos + 1,
-                                              bt)
+                                              bt,
+                                              window=self.sliding_window)
             out = _apply("paged_attention", fn_attn,
                          (q, kp_new, vp_new))
             out = self.o_proj(out.reshape([b, s, -1]))

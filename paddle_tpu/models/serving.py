@@ -32,9 +32,12 @@ rows) — TPU-native:
   past them — and prefills just the suffix with chunked attention over
   the gathered prefix rows (`position_offset = shared_len`, so rope
   angles are exact).
+* Sliding-window models serve on the paged layout too: the paged kernel
+  applies the window band, and pages that slide wholly below the window
+  are RECLAIMED between steps (their block-table entries trash-route),
+  so resident KV is bounded by the window, not the sequence.
 * `kv_layout="dense"` keeps the previous per-slot contiguous caches
-  (needed for sliding-window models; also the parity oracle for the
-  paged path).
+  (also the parity oracle for the paged path).
 """
 from __future__ import annotations
 
@@ -94,16 +97,17 @@ class ContinuousBatchingEngine:
                 f"{cfg.max_position_embeddings})")
         if kv_layout not in ("paged", "dense"):
             raise ValueError(f"kv_layout {kv_layout!r}: paged|dense")
-        if kv_layout == "paged" \
-                and getattr(cfg, "sliding_window", None) is not None:
-            # the paged decode path has no band-mask support yet; a
-            # sliding-window model constructed with the (paged) DEFAULT
-            # must keep working, so fall back rather than crash
+        self._window = getattr(cfg, "sliding_window", None)
+        if kv_layout == "paged" and self._window is not None \
+                and enable_prefix_caching:
+            # slid-out pages are reclaimed and their block-table entries
+            # trash-routed, so a window model's prompt pages are not
+            # stable shareable KV
             import warnings
             warnings.warn(
-                "sliding_window model: paged KV layout is not yet "
-                "supported, falling back to kv_layout='dense'")
-            kv_layout = "dense"
+                "sliding_window model: prefix caching is DISABLED "
+                "(window reclamation invalidates cached prompt pages)")
+            enable_prefix_caching = False
         self.eos = eos_token_id
         self.pad = int(prompt_pad)
         self.layout = kv_layout
@@ -124,9 +128,7 @@ class ContinuousBatchingEngine:
                 import warnings
                 warnings.warn(
                     "enable_prefix_caching requires kv_layout='paged' — "
-                    "prefix caching is DISABLED on the dense layout "
-                    "(and on sliding-window models, which fall back to "
-                    "dense)")
+                    "prefix caching is DISABLED on the dense layout")
             self._prefix_enabled = False
             self.prefix_hits = 0
             self.prefix_tokens_reused = 0
@@ -150,6 +152,11 @@ class ContinuousBatchingEngine:
             self._free: List[int] = list(range(1, self.num_pages))
             self._slot_pages: List[List[int]] = [[] for _ in range(self.B)]
             self._slot_reserved = np.zeros(self.B, np.int64)
+            # pages ever attached (shared + allocated) — the next block-
+            # table index to fill; stays monotonic even after window
+            # reclamation frees leading pages
+            self._slot_next_idx = np.zeros(self.B, np.int64)
+            self._slot_freed = np.zeros(self.B, np.int64)
             self._scatter_jits: "OrderedDict[int, object]" = OrderedDict()
             # -- automatic prefix caching (vLLM-style, opt-in) ---------
             # Full pages are immutable once written (decode only appends
@@ -283,6 +290,8 @@ class ContinuousBatchingEngine:
             self._slot_pages[slot] = []
             self._slot_shared_pages[slot] = []
             self._slot_reserved[slot] = 0
+            self._slot_next_idx[slot] = 0
+            self._slot_freed[slot] = 0
             # inactive slots keep decoding garbage; their block-table row
             # must point at the trash page, not at reclaimed pages
             self._bt[slot] = 0
@@ -409,9 +418,9 @@ class ContinuousBatchingEngine:
         for j, p in enumerate(pages):
             self._bt[slot, j] = p
             self._incref(p)
+        self._slot_next_idx[slot] = len(pages)
         self._slot_reserved[slot] = self._worst_pages(req)
-        while (len(pages) + len(self._slot_pages[slot])) \
-                * self.page_size < p_len:
+        while self._slot_next_idx[slot] * self.page_size < p_len:
             self._alloc_page(slot)
         suffix = req.prompt[shared_len:]
         bucket = self._bucket(len(suffix))
@@ -462,8 +471,7 @@ class ContinuousBatchingEngine:
         growth can then never fail mid-flight. Evicts LRU prefix-cache
         entries when that frees enough."""
         outstanding = int(sum(
-            self._slot_reserved[i] - len(self._slot_pages[i])
-            - len(self._slot_shared_pages[i])
+            self._slot_reserved[i] - self._slot_next_idx[i]
             for i, r in enumerate(self._slot_req) if r is not None))
         need = self._worst_pages(req) - shared_pages + outstanding
         if len(self._free) >= need:
@@ -566,15 +574,14 @@ class ContinuousBatchingEngine:
         page = self._free.pop()
         self._page_rc[page] = 1
         self._slot_pages[slot].append(page)
-        self._bt[slot, len(self._slot_shared_pages[slot])
-                 + len(self._slot_pages[slot]) - 1] = page
+        self._bt[slot, self._slot_next_idx[slot]] = page
+        self._slot_next_idx[slot] += 1
         return page
 
     def _paged_insert(self, slot: int, req: Request, p_len: int,
                       bucket: int, rows):
         self._slot_reserved[slot] = self._worst_pages(req)
-        while (len(self._slot_shared_pages[slot])
-               + len(self._slot_pages[slot])) * self.page_size < p_len:
+        while self._slot_next_idx[slot] * self.page_size < p_len:
             self._alloc_page(slot)
         jit = self._get_scatter(bucket)
         self._kv = jit(self._kv, rows, jnp.asarray(self._bt[slot]),
@@ -706,10 +713,22 @@ class ContinuousBatchingEngine:
                 # lazy growth: next token writes at pos[i] — allocate its
                 # page if the sequence just crossed a page boundary
                 # (guaranteed to succeed by the admission reservation)
-                while (len(self._slot_shared_pages[i])
-                       + len(self._slot_pages[i])) * self.page_size \
+                while self._slot_next_idx[i] * self.page_size \
                         <= int(self._pos[i]):
                     self._alloc_page(i)
+                if self._window is not None:
+                    # reclaim pages that slid wholly below the attention
+                    # window [ctx - w, ctx): the kernel never reads them
+                    ws = int(self._pos[i]) + 1 - self._window
+                    while (self._slot_freed[i] + 1) * self.page_size \
+                            <= ws:
+                        j = int(self._slot_freed[i])
+                        page = int(self._bt[i, j])
+                        if page != 0:
+                            self._slot_pages[i].remove(page)
+                            self._decref(page)
+                            self._bt[i, j] = 0      # trash-route
+                        self._slot_freed[i] += 1
             kv = self._kv
             bt = jnp.asarray(self._bt)
         else:

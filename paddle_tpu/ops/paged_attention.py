@@ -45,7 +45,7 @@ def _interpret() -> bool:
 
 def _paged_kernel(ctx_ref, bt_ref,          # scalar-prefetched
                   q_ref, k_ref, v_ref, o_ref,
-                  acc_ref, m_ref, l_ref, *, scale, page_size):
+                  acc_ref, m_ref, l_ref, *, scale, page_size, window):
     b = pl.program_id(0)
     h = pl.program_id(1)
     i = pl.program_id(2)
@@ -58,8 +58,14 @@ def _paged_kernel(ctx_ref, bt_ref,          # scalar-prefetched
         l_ref[:] = jnp.zeros_like(l_ref)
 
     ctx = ctx_ref[b]
+    # sliding window: the decode query (global position ctx-1) sees keys
+    # in [ctx - window, ctx); pages wholly below the window start skip
+    # their FLOPs (their DMA still runs — static grid)
+    live = i * page_size < ctx
+    if window is not None:
+        live = live & ((i + 1) * page_size > ctx - window)
 
-    @pl.when(i * page_size < ctx)
+    @pl.when(live)
     def _page():
         q = q_ref[0, 0].astype(jnp.float32)          # (G, D)
         k = k_ref[0, 0].astype(jnp.float32)          # (page_size, D)
@@ -69,7 +75,10 @@ def _paged_kernel(ctx_ref, bt_ref,          # scalar-prefetched
             preferred_element_type=jnp.float32) * scale   # (G, page_size)
         pos = i * page_size + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        s = jnp.where(pos < ctx, s, NEG_INF)
+        valid = pos < ctx
+        if window is not None:
+            valid = valid & (pos >= ctx - window)
+        s = jnp.where(valid, s, NEG_INF)
         m_prev = m_ref[:, :1]                         # (G, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
@@ -89,10 +98,11 @@ def _paged_kernel(ctx_ref, bt_ref,          # scalar-prefetched
 
 
 def paged_attention_values(q, k_pages, v_pages, context_lens, block_tables,
-                           scale=None):
+                           scale=None, window=None):
     """q: (B, H, D); k_pages/v_pages: (HK, P, page_size, D);
     context_lens: (B,) int32; block_tables: (B, pages_per_seq) int32.
-    Returns (B, H, D)."""
+    `window`: static sliding-window size — the decode query sees only
+    keys in [ctx - window, ctx). Returns (B, H, D)."""
     b, h, d = q.shape
     hk, _, page_size, _ = k_pages.shape
     g = h // hk
@@ -101,7 +111,7 @@ def paged_attention_values(q, k_pages, v_pages, context_lens, block_tables,
 
     if _interpret():
         return _paged_xla(q, k_pages, v_pages, context_lens, block_tables,
-                          sc)
+                          sc, window)
 
     qh = q.reshape(b, hk, g, d)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -124,14 +134,16 @@ def paged_attention_values(q, k_pages, v_pages, context_lens, block_tables,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_kernel, scale=sc, page_size=page_size),
+        functools.partial(_paged_kernel, scale=sc, page_size=page_size,
+                          window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hk, g, d), q.dtype),
     )(context_lens, block_tables, qh, k_pages, v_pages)
     return out.reshape(b, h, d)
 
 
-def _paged_xla(q, k_pages, v_pages, context_lens, block_tables, scale):
+def _paged_xla(q, k_pages, v_pages, context_lens, block_tables, scale,
+               window=None):
     """Reference/CI path: gather the block table back to a contiguous
     cache, then masked attention. Semantically identical to the kernel."""
     b, h, d = q.shape
@@ -149,6 +161,8 @@ def _paged_xla(q, k_pages, v_pages, context_lens, block_tables, scale):
                         preferred_element_type=jnp.float32) * scale
     pos = jnp.arange(s_max)
     mask = pos[None, :] < context_lens[:, None]       # (B, S_max)
+    if window is not None:
+        mask = mask & (pos[None, :] >= context_lens[:, None] - window)
     logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
     p = jax.nn.softmax(logits, axis=-1).astype(vc.dtype)
     out = jnp.einsum("bkgt,btkd->bkgd", p, vc)
@@ -157,7 +171,7 @@ def _paged_xla(q, k_pages, v_pages, context_lens, block_tables, scale):
 
 def paged_attention(q: Tensor, k_pages: Tensor, v_pages: Tensor,
                     context_lens: Tensor, block_tables: Tensor,
-                    scale=None) -> Tensor:
+                    scale=None, window=None) -> Tensor:
     """Eager/tape entry. Decode-only: output has no grad path."""
     cl = context_lens._value if isinstance(context_lens, Tensor) \
         else jnp.asarray(context_lens, jnp.int32)
@@ -165,7 +179,7 @@ def paged_attention(q: Tensor, k_pages: Tensor, v_pages: Tensor,
         else jnp.asarray(block_tables, jnp.int32)
 
     def fn(qq, kk, vv):
-        return paged_attention_values(qq, kk, vv, cl, bt, scale)
+        return paged_attention_values(qq, kk, vv, cl, bt, scale, window)
     return apply("paged_attention", fn, (q, k_pages, v_pages))
 
 

@@ -119,7 +119,8 @@ class TestRopeLowering:
 
 
 class TestPagedAttentionLowering:
-    def test_decode(self):
+    @pytest.mark.parametrize("window", [None, 64])
+    def test_decode(self, window):
         from paddle_tpu.ops.paged_attention import paged_attention_values
 
         b, pages, page_size = 8, 64, 16
@@ -127,8 +128,8 @@ class TestPagedAttentionLowering:
         kp = jnp.zeros((BENCH_HK, pages, page_size, BENCH_D), jnp.bfloat16)
         ctx = jnp.full((b,), 100, jnp.int32)
         bt = jnp.zeros((b, 8), jnp.int32)
-        _lower(lambda q, kp, vp: paged_attention_values(q, kp, vp, ctx, bt),
-               q, kp, kp)
+        _lower(lambda q, kp, vp: paged_attention_values(
+            q, kp, vp, ctx, bt, window=window), q, kp, kp)
 
 
 class TestGroupedMatmulLowering:

@@ -111,6 +111,32 @@ class TestPagedAttention:
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
                                    atol=1e-5)
 
+    def test_sliding_window_matches_truncated_oracle(self):
+        """window=w must equal full attention over only the last w keys
+        (band semantics of the kernel / XLA gather path)."""
+        args = self._setup(b=3, h=4, hk=2, d=16, page=8, pps=4, seed=6)
+        q, kp, vp, cl, bt = args
+        cl = np.array([5, 20, 32], np.int32)
+        w = 12
+        out = paged_attention_values(jnp.asarray(q), jnp.asarray(kp),
+                                     jnp.asarray(vp), jnp.asarray(cl),
+                                     jnp.asarray(bt), window=w)
+        # oracle: re-gather each sequence keeping only [ctx-w, ctx)
+        b_, h, d = q.shape
+        hk, _, page, _ = kp.shape
+        pps = bt.shape[1]
+        outs = []
+        for i in range(b_):
+            kc = kp[:, bt[i]].reshape(hk, pps * page, d)
+            vc = vp[:, bt[i]].reshape(hk, pps * page, d)
+            lo = max(0, int(cl[i]) - w)
+            kc = np.swapaxes(kc[:, lo:cl[i]], 0, 1)[None]
+            vc = np.swapaxes(vc[:, lo:cl[i]], 0, 1)[None]
+            o = _mha_oracle(q[i][None, None], kc, vc, int(cl[i]) - lo)
+            outs.append(o[0, 0])
+        np.testing.assert_allclose(np.asarray(out), np.stack(outs),
+                                   rtol=1e-4, atol=1e-5)
+
     def test_cache_append(self):
         b, hk, d, page = 2, 2, 8, 4
         cache = PagedKVCache(hk, d, num_pages=8, page_size=page,
@@ -489,10 +515,11 @@ class TestPagedEngine:
         assert tiny_p == greedy
         assert len(s3) == 8
 
-    def test_sliding_window_model_falls_back_to_dense(self):
-        """A sliding-window model constructed with the (paged) DEFAULT
-        keeps working: the engine warns and serves dense (code review
-        r5 — crashing on the default broke existing callers)."""
+    def test_sliding_window_paged_matches_dense(self):
+        """r5: sliding-window models serve on the PAGED layout (window
+        band in the paged kernel) — outputs equal the dense-layout
+        oracle, and pages that slide out of the window are reclaimed so
+        resident KV is bounded by the window, not the sequence."""
         from paddle_tpu.models.serving import ContinuousBatchingEngine
         from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
         cfg = LlamaConfig.tiny()
@@ -500,12 +527,40 @@ class TestPagedEngine:
         paddle.seed(0)
         m = LlamaForCausalLM(cfg)
         m.eval()
-        with pytest.warns(UserWarning, match="dense"):
+        rng_ = np.random.default_rng(4)
+        prompts = [list(rng_.integers(1, cfg.vocab_size, 9 + 4 * j))
+                   for j in range(3)]
+        outs = {}
+        for layout in ("dense", "paged"):
             eng = ContinuousBatchingEngine(m, max_batch_size=2,
-                                           max_seq_len=64)
-        assert eng.layout == "dense"
-        rid = eng.add_request([5, 4, 3], 4)
-        assert len(eng.run()[rid]) == 4
+                                           max_seq_len=64, page_size=8,
+                                           kv_layout=layout)
+            rids = [eng.add_request(p, 30) for p in prompts]
+            res = eng.run()
+            outs[layout] = [res[r] for r in rids]
+        assert outs["paged"] == outs["dense"]
+        assert eng.layout == "paged"
+
+    def test_sliding_window_reclaims_pages(self):
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig.tiny()
+        cfg.sliding_window = 16
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        eng = ContinuousBatchingEngine(m, max_batch_size=1,
+                                       max_seq_len=64, page_size=8)
+        eng.add_request(list(range(1, 10)), 40)   # runs to position ~49
+        max_in_use = 0
+        while eng._queue or any(r is not None for r in eng._slot_req):
+            eng.step()
+            max_in_use = max(max_in_use,
+                             eng.cache_memory_info()["pages_in_use"])
+        # window 16 at page 8 -> at most ceil(16/8)+1 = 3 live pages
+        # (+1 partial write page) ever resident after reclamation
+        assert max_in_use <= 4, max_in_use
+        assert all(eng._page_rc[1:] == 0)         # all reclaimed at end
 
     def test_prefill_program_cache_capped(self):
         from paddle_tpu.models.serving import ContinuousBatchingEngine
@@ -566,6 +621,7 @@ class TestPrefixCaching:
         info = eng.cache_memory_info()
         assert info["prefix_entries"] >= 2 and info["prefix_pages"] >= 2
 
+    @pytest.mark.slow
     def test_whole_prompt_cached_still_decodes(self):
         """Prompt == cached prefix: sharing must cap at one page less so
         the suffix prefill still produces first-token logits."""
@@ -578,6 +634,7 @@ class TestPrefixCaching:
         assert eng.prefix_hits == 1
         assert eng.prefix_tokens_reused == 8   # capped below p_len
 
+    @pytest.mark.slow
     def test_eviction_under_pool_pressure(self):
         """Tiny pool: cached pages must be reclaimed (LRU) so new
         requests still admit; outputs stay correct."""
@@ -602,6 +659,7 @@ class TestPrefixCaching:
         assert all(rc[p] >= 1 for p in cached)
         assert all(rc[p] == 0 for p in eng._free)
 
+    @pytest.mark.slow
     def test_refcounts_zero_after_cache_clear(self):
         m, cfg = self._model()
         base = list(range(1, 25))
@@ -612,6 +670,7 @@ class TestPrefixCaching:
         assert all(eng._page_rc[1:] == 0)
         assert sorted(eng._free) == list(range(1, eng.num_pages))
 
+    @pytest.mark.slow
     def test_eviction_cannot_reclaim_matched_pages(self):
         """r5 review: _reserve_ok may evict the just-matched entry under
         pool pressure; the matched pages must be pinned so they never

@@ -167,6 +167,9 @@ class TestPagedAttentionCompile:
         cl = jnp.full((b,), 2000, jnp.int32)
         _compile(lambda q, kp, vp: paged_attention_values(
             q, kp, vp, cl, bt), q, kp, kp)
+        # sliding-window band variant (serving window models on paged)
+        _compile(lambda q, kp, vp: paged_attention_values(
+            q, kp, vp, cl, bt, window=512), q, kp, kp)
 
 
 class TestGroupedMatmulCompile:
