@@ -86,17 +86,23 @@ class GenerationMixin:
     """Mixin over cache-capable causal LMs; adds `generate()`.
 
     ≙ PaddleNLP `GenerationMixin.generate` surface (greedy_search /
-    sampling strategies; returns (ids, scores) like the reference)."""
+    sampling / beam_search strategies; returns (ids, scores) like the
+    reference — for beam_search, ids is the best beam per row (B, n_new)
+    and scores its length-penalty-normalized log-prob (B,))."""
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  decode_strategy: str = "greedy_search",
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 1.0, eos_token_id: int | None = None,
-                 max_cache_len: int | None = None, use_cache: bool = True):
-        if decode_strategy not in ("greedy_search", "sampling"):
+                 max_cache_len: int | None = None, use_cache: bool = True,
+                 num_beams: int = 1, length_penalty: float = 0.0):
+        if decode_strategy not in ("greedy_search", "sampling",
+                                   "beam_search"):
             raise ValueError(
-                f"decode_strategy {decode_strategy!r}: only greedy_search "
-                "and sampling are supported (beam_search: planned)")
+                f"decode_strategy {decode_strategy!r}: greedy_search, "
+                "sampling, or beam_search")
+        if decode_strategy == "beam_search" and num_beams < 2:
+            raise ValueError("beam_search needs num_beams >= 2")
         cfg = self.config
         ids = input_ids if isinstance(input_ids, Tensor) \
             else Tensor(jnp.asarray(input_ids, jnp.int32))
@@ -121,10 +127,13 @@ class GenerationMixin:
                         for bu in buffers))
         sig = (b, prompt_len, n_new, cache_len, decode_strategy,
                float(temperature), int(top_k), float(top_p), eos_token_id,
-               struct)
+               struct, int(num_beams), float(length_penalty))
         cache = getattr(self, "_generate_cache", None)
         if cache is None or cache[0] != sig:
-            jitted = self._build_generate(sig)
+            if decode_strategy == "beam_search":
+                jitted = self._build_beam_generate(sig)
+            else:
+                jitted = self._build_generate(sig)
             self._generate_cache = (sig, jitted)
         else:
             jitted = cache[1]
@@ -134,9 +143,25 @@ class GenerationMixin:
                               ids._value.astype(jnp.int32), key)
         return Tensor(toks), Tensor(scores)
 
+
+    def _zero_caches_prefill(self, b, cache_len, kv_dtype, ids_v):
+        """Shared by every generate builder: zero-init static KV caches
+        and run the one-pass causal prefill. Returns (logits, caches)."""
+        cfg = self.config
+        caches = [
+            (jnp.zeros((b, cache_len, cfg.num_key_value_heads,
+                        cfg.head_dim), kv_dtype),
+             jnp.zeros((b, cache_len, cfg.num_key_value_heads,
+                        cfg.head_dim), kv_dtype))
+            for _ in range(cfg.num_hidden_layers)]
+        return self.forward(
+            Tensor(ids_v),
+            past_key_values=[(Tensor(k), Tensor(v)) for k, v in caches],
+            position_offset=0, use_cache=True)
+
     def _build_generate(self, sig):
         (b, prompt_len, n_new, cache_len, strategy, temperature, top_k,
-         top_p, eos_token_id, _struct) = sig
+         top_p, eos_token_id, _struct) = sig[:10]
         cfg = self.config
         params = list(self.parameters())
         buffers = list(self.buffers())
@@ -148,16 +173,9 @@ class GenerationMixin:
             with bind_state(params, buffers, pv, bv):
                 kv_dtype = pv[0].dtype
                 with no_grad():
-                    caches = [
-                        (jnp.zeros((b, cache_len, hk, hd), kv_dtype),
-                         jnp.zeros((b, cache_len, hk, hd), kv_dtype))
-                        for _ in range(n_layers)]
                     # ---- prefill: one causal pass over the prompt -------
-                    logits, caches_t = self.forward(
-                        Tensor(ids_v),
-                        past_key_values=[(Tensor(k), Tensor(v))
-                                         for k, v in caches],
-                        position_offset=0, use_cache=True)
+                    logits, caches_t = self._zero_caches_prefill(
+                        b, cache_len, kv_dtype, ids_v)
                     caches_v = tuple(
                         (k._value, v._value) for k, v in caches_t)
                     key0, key_rest = jax.random.split(key)
@@ -202,5 +220,110 @@ class GenerationMixin:
                     else:
                         toks, lps = tok0[:, None], lp0[:, None]
                     return toks, lps
+
+        return jax.jit(run)
+
+    def _build_beam_generate(self, sig):
+        """Beam search as ONE compiled program (≙ PaddleNLP
+        `beam_search` decode strategy). TPU-native shape: the beam batch
+        is a (B*K)-row decode; each scan step does one cached forward,
+        joint top-k over (K*V) candidates, then a GATHER along the batch
+        axis that reorders KV caches / finished flags / emitted
+        sequences to the surviving beams (the XLA equivalent of the
+        reference's `reorder_cache`). Finished beams extend only with
+        EOS at zero added log-prob (score frozen); the best beam per
+        batch row is chosen by length-penalty-normalized score
+        `cum / len**length_penalty` (length_penalty=0 → raw sum, the
+        reference default). Deterministic — the PRNG key is unused."""
+        (b, prompt_len, n_new, cache_len, _strategy, _t, _tk, _tp,
+         eos_token_id, _struct, num_beams, length_penalty) = sig
+        cfg = self.config
+        params = list(self.parameters())
+        buffers = list(self.buffers())
+        n_layers = cfg.num_hidden_layers
+        hk = cfg.num_key_value_heads
+        hd = cfg.head_dim
+        K = num_beams
+        NEG = jnp.float32(NEG_INF)
+
+        def run(pv, bv, ids_v, key):
+            del key
+            with bind_state(params, buffers, pv, bv), no_grad():
+                kv_dtype = pv[0].dtype
+                logits, caches_t = self._zero_caches_prefill(
+                    b, cache_len, kv_dtype, ids_v)
+                logp0 = jax.nn.log_softmax(
+                    logits._value[:, -1].astype(jnp.float32))  # (B, V)
+                v = logp0.shape[-1]
+                # K may exceed V (full-width search on tiny vocabs):
+                # only V real beams exist after the first expansion; the
+                # rest start DEAD at -inf and revive only if later steps
+                # have fewer than K live candidates
+                k0 = min(K, v)
+                cum, tok0 = jax.lax.top_k(logp0, k0)           # (B, k0)
+                if k0 < K:
+                    cum = jnp.concatenate(
+                        [cum, jnp.full((b, K - k0), NEG)], 1)
+                    tok0 = jnp.concatenate(
+                        [tok0, jnp.zeros((b, K - k0), tok0.dtype)], 1)
+                # tile the prompt caches to the beam batch (B*K rows;
+                # beam j of row i lives at i*K + j)
+                caches_v = tuple(
+                    (jnp.repeat(kc._value, K, 0),
+                     jnp.repeat(vc._value, K, 0)) for kc, vc in caches_t)
+                fin = (tok0 == eos_token_id) if eos_token_id is not None \
+                    else jnp.zeros((b, K), bool)
+                seqs = jnp.zeros((b, K, n_new),
+                                 jnp.int32).at[:, :, 0].set(tok0)
+                if eos_token_id is not None:
+                    eos_row = jnp.full((v,), NEG).at[eos_token_id].set(0.0)
+
+                def body(carry, t):
+                    caches_v, tok, cum, fin, seqs = carry
+                    pkv = [(Tensor(kc), Tensor(vc))
+                           for kc, vc in caches_v]
+                    step_logits, new_caches = self.forward(
+                        Tensor(tok.reshape(b * K)[:, None]),
+                        past_key_values=pkv,
+                        position_offset=Tensor(prompt_len - 1 + t),
+                        use_cache=True)
+                    lgp = jax.nn.log_softmax(
+                        step_logits._value[:, 0].astype(jnp.float32)
+                    ).reshape(b, K, v)
+                    if eos_token_id is not None:
+                        lgp = jnp.where(fin[:, :, None],
+                                        eos_row[None, None, :], lgp)
+                    cand = cum[:, :, None] + lgp               # (B, K, V)
+                    ncum, flat = jax.lax.top_k(cand.reshape(b, K * v), K)
+                    src = flat // v                            # (B, K)
+                    ntok = flat % v
+                    gidx = (jnp.arange(b)[:, None] * K + src).reshape(-1)
+                    new_caches_v = tuple(
+                        (kc._value[gidx], vc._value[gidx])
+                        for kc, vc in new_caches)
+                    nfin = jnp.take_along_axis(fin, src, 1)
+                    if eos_token_id is not None:
+                        nfin = nfin | (ntok == eos_token_id)
+                    nseqs = jnp.take_along_axis(
+                        seqs, src[:, :, None], 1).at[:, :, t].set(ntok)
+                    return (new_caches_v, ntok, ncum, nfin, nseqs), None
+
+                if n_new > 1:
+                    carry = (caches_v, tok0, cum, fin, seqs)
+                    (caches_v, _, cum, fin, seqs), _ = jax.lax.scan(
+                        body, carry, jnp.arange(1, n_new))
+                if eos_token_id is not None:
+                    iseos = seqs == eos_token_id
+                    lengths = jnp.where(iseos.any(-1),
+                                        jnp.argmax(iseos, -1) + 1, n_new)
+                else:
+                    lengths = jnp.full((b, K), n_new)
+                norm = cum / jnp.power(lengths.astype(jnp.float32),
+                                       jnp.float32(length_penalty))
+                best = jnp.argmax(norm, axis=1)
+                out = jnp.take_along_axis(
+                    seqs, best[:, None, None], 1)[:, 0]        # (B, n_new)
+                return out, jnp.take_along_axis(
+                    norm, best[:, None], 1)[:, 0]              # (B,)
 
         return jax.jit(run)
