@@ -55,6 +55,22 @@ def _sample_token(logits, key, strategy, temperature, top_k, top_p):
     return tok, jnp.take_along_axis(logp, tok[:, None], -1)[:, 0]
 
 
+def _penalize(logits, seen, t, rp, min_new, eos):
+    """Logit post-processing shared by every decode strategy (≙ the
+    reference's LogitsProcessor stack): CTRL-style repetition penalty on
+    already-seen tokens (positive logits divided by rp, negative
+    multiplied), and EOS suppression while fewer than `min_new_tokens`
+    tokens have been generated. `t` is the index of the token being
+    generated; `seen` is a (..., V) presence mask."""
+    if rp != 1.0:
+        pen = jnp.where(logits > 0, logits / rp, logits * rp)
+        logits = jnp.where(seen, pen, logits)
+    if eos is not None and min_new > 0:
+        col = jnp.arange(logits.shape[-1]) == eos
+        logits = jnp.where(col & (t < min_new), NEG_INF, logits)
+    return logits
+
+
 class bind_state:
     """Context manager: temporarily install traced param/buffer values
     on a model's live Parameter/Tensor objects (the jit-harness pattern
@@ -95,7 +111,9 @@ class GenerationMixin:
                  temperature: float = 1.0, top_k: int = 0,
                  top_p: float = 1.0, eos_token_id: int | None = None,
                  max_cache_len: int | None = None, use_cache: bool = True,
-                 num_beams: int = 1, length_penalty: float = 0.0):
+                 num_beams: int = 1, length_penalty: float = 0.0,
+                 repetition_penalty: float = 1.0,
+                 min_new_tokens: int = 0):
         if decode_strategy not in ("greedy_search", "sampling",
                                    "beam_search"):
             raise ValueError(
@@ -103,6 +121,10 @@ class GenerationMixin:
                 "sampling, or beam_search")
         if decode_strategy == "beam_search" and num_beams < 2:
             raise ValueError("beam_search needs num_beams >= 2")
+        if repetition_penalty <= 0:
+            raise ValueError(
+                f"repetition_penalty must be > 0, got {repetition_penalty}"
+                " (1.0 disables it)")
         cfg = self.config
         ids = input_ids if isinstance(input_ids, Tensor) \
             else Tensor(jnp.asarray(input_ids, jnp.int32))
@@ -127,7 +149,8 @@ class GenerationMixin:
                         for bu in buffers))
         sig = (b, prompt_len, n_new, cache_len, decode_strategy,
                float(temperature), int(top_k), float(top_p), eos_token_id,
-               struct, int(num_beams), float(length_penalty))
+               struct, int(num_beams), float(length_penalty),
+               float(repetition_penalty), int(min_new_tokens))
         cache = getattr(self, "_generate_cache", None)
         if cache is None or cache[0] != sig:
             if decode_strategy == "beam_search":
@@ -162,6 +185,7 @@ class GenerationMixin:
     def _build_generate(self, sig):
         (b, prompt_len, n_new, cache_len, strategy, temperature, top_k,
          top_p, eos_token_id, _struct) = sig[:10]
+        rep_pen, min_new = sig[12], sig[13]
         cfg = self.config
         params = list(self.parameters())
         buffers = list(self.buffers())
@@ -178,16 +202,24 @@ class GenerationMixin:
                         b, cache_len, kv_dtype, ids_v)
                     caches_v = tuple(
                         (k._value, v._value) for k, v in caches_t)
+                    track = rep_pen != 1.0   # static: mask only if used
+                    v_size = logits.shape[-1]
+                    seen = (jnp.zeros((b, v_size), bool).at[
+                        jnp.arange(b)[:, None], ids_v].set(True)
+                        if track else jnp.zeros((), bool))
                     key0, key_rest = jax.random.split(key)
                     tok0, lp0 = _sample_token(
-                        logits._value[:, -1], key0, strategy, temperature,
-                        top_k, top_p)
+                        _penalize(logits._value[:, -1], seen, 0, rep_pen,
+                                  min_new, eos_token_id),
+                        key0, strategy, temperature, top_k, top_p)
+                    if track:
+                        seen = seen.at[jnp.arange(b), tok0].set(True)
                     fin0 = (tok0 == eos_token_id) if eos_token_id is not None \
                         else jnp.zeros((b,), bool)
 
                     # ---- decode: lax.scan, one token per step -----------
-                    def body(carry, _):
-                        caches_v, tok, pos, fin, k = carry
+                    def body(carry, t):
+                        caches_v, tok, pos, fin, seen, k = carry
                         k, sub = jax.random.split(k)
                         pkv = [(Tensor(kc), Tensor(vc))
                                for kc, vc in caches_v]
@@ -196,8 +228,9 @@ class GenerationMixin:
                             past_key_values=pkv,
                             position_offset=Tensor(pos), use_cache=True)
                         nxt, lp = _sample_token(
-                            step_logits._value[:, 0], sub, strategy,
-                            temperature, top_k, top_p)
+                            _penalize(step_logits._value[:, 0], seen, t,
+                                      rep_pen, min_new, eos_token_id),
+                            sub, strategy, temperature, top_k, top_p)
                         if eos_token_id is not None:
                             nxt = jnp.where(fin, eos_token_id, nxt)
                             lp = jnp.where(fin, 0.0, lp)
@@ -206,14 +239,17 @@ class GenerationMixin:
                             new_fin = fin
                         new_caches_v = tuple(
                             (kc._value, vc._value) for kc, vc in new_caches)
-                        return ((new_caches_v, nxt, pos + 1, new_fin, k),
-                                (nxt, lp))
+                        new_seen = (seen.at[jnp.arange(b), nxt].set(True)
+                                    if track else seen)
+                        return ((new_caches_v, nxt, pos + 1, new_fin,
+                                 new_seen, k), (nxt, lp))
 
                     if n_new > 1:
                         carry0 = (caches_v, tok0,
-                                  jnp.int32(prompt_len), fin0, key_rest)
+                                  jnp.int32(prompt_len), fin0, seen,
+                                  key_rest)
                         _, (toks, lps) = jax.lax.scan(
-                            body, carry0, None, length=n_new - 1)
+                            body, carry0, jnp.arange(1, n_new))
                         toks = jnp.concatenate(
                             [tok0[:, None], toks.T], axis=1)
                         lps = jnp.concatenate([lp0[:, None], lps.T], axis=1)
@@ -236,7 +272,8 @@ class GenerationMixin:
         `cum / len**length_penalty` (length_penalty=0 → raw sum, the
         reference default). Deterministic — the PRNG key is unused."""
         (b, prompt_len, n_new, cache_len, _strategy, _t, _tk, _tp,
-         eos_token_id, _struct, num_beams, length_penalty) = sig
+         eos_token_id, _struct, num_beams, length_penalty,
+         rep_pen, min_new) = sig
         cfg = self.config
         params = list(self.parameters())
         buffers = list(self.buffers())
@@ -252,9 +289,14 @@ class GenerationMixin:
                 kv_dtype = pv[0].dtype
                 logits, caches_t = self._zero_caches_prefill(
                     b, cache_len, kv_dtype, ids_v)
+                v = logits.shape[-1]
+                track = rep_pen != 1.0   # static: mask only if used
+                seen0 = (jnp.zeros((b, v), bool).at[
+                    jnp.arange(b)[:, None], ids_v].set(True)
+                    if track else jnp.zeros((), bool))
                 logp0 = jax.nn.log_softmax(
-                    logits._value[:, -1].astype(jnp.float32))  # (B, V)
-                v = logp0.shape[-1]
+                    _penalize(logits._value[:, -1].astype(jnp.float32),
+                              seen0, 0, rep_pen, min_new, eos_token_id))
                 # K may exceed V (full-width search on tiny vocabs):
                 # only V real beams exist after the first expansion; the
                 # rest start DEAD at -inf and revive only if later steps
@@ -275,11 +317,15 @@ class GenerationMixin:
                     else jnp.zeros((b, K), bool)
                 seqs = jnp.zeros((b, K, n_new),
                                  jnp.int32).at[:, :, 0].set(tok0)
+                seen = (jnp.repeat(seen0[:, None], K, 1).at[
+                    jnp.arange(b)[:, None], jnp.arange(K)[None, :],
+                    tok0].set(True)                            # (B, K, V)
+                    if track else jnp.zeros((), bool))
                 if eos_token_id is not None:
                     eos_row = jnp.full((v,), NEG).at[eos_token_id].set(0.0)
 
                 def body(carry, t):
-                    caches_v, tok, cum, fin, seqs = carry
+                    caches_v, tok, cum, fin, seqs, seen = carry
                     pkv = [(Tensor(kc), Tensor(vc))
                            for kc, vc in caches_v]
                     step_logits, new_caches = self.forward(
@@ -288,8 +334,11 @@ class GenerationMixin:
                         position_offset=Tensor(prompt_len - 1 + t),
                         use_cache=True)
                     lgp = jax.nn.log_softmax(
-                        step_logits._value[:, 0].astype(jnp.float32)
-                    ).reshape(b, K, v)
+                        _penalize(
+                            step_logits._value[:, 0].astype(jnp.float32),
+                            seen.reshape(b * K, v) if track else seen,
+                            t, rep_pen, min_new,
+                            eos_token_id)).reshape(b, K, v)
                     if eos_token_id is not None:
                         lgp = jnp.where(fin[:, :, None],
                                         eos_row[None, None, :], lgp)
@@ -306,11 +355,16 @@ class GenerationMixin:
                         nfin = nfin | (ntok == eos_token_id)
                     nseqs = jnp.take_along_axis(
                         seqs, src[:, :, None], 1).at[:, :, t].set(ntok)
-                    return (new_caches_v, ntok, ncum, nfin, nseqs), None
+                    nseen = (jnp.take_along_axis(
+                        seen, src[:, :, None], 1).at[
+                        jnp.arange(b)[:, None], jnp.arange(K)[None, :],
+                        ntok].set(True) if track else seen)
+                    return (new_caches_v, ntok, ncum, nfin, nseqs,
+                            nseen), None
 
                 if n_new > 1:
-                    carry = (caches_v, tok0, cum, fin, seqs)
-                    (caches_v, _, cum, fin, seqs), _ = jax.lax.scan(
+                    carry = (caches_v, tok0, cum, fin, seqs, seen)
+                    (caches_v, _, cum, fin, seqs, _), _ = jax.lax.scan(
                         body, carry, jnp.arange(1, n_new))
                 if eos_token_id is not None:
                     iseos = seqs == eos_token_id

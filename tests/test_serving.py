@@ -796,3 +796,68 @@ class TestBeamSearch:
         with pytest.raises(ValueError, match="num_beams"):
             m.generate(paddle.to_tensor(np.array([[1]], np.int32)),
                        decode_strategy="beam_search", num_beams=1)
+
+
+class TestLogitsProcessors:
+    """repetition_penalty + min_new_tokens (≙ the reference's
+    LogitsProcessor stack in generate). Oracle: an eager re-forward loop
+    applying the identical rule."""
+
+    def _model(self):
+        cfg = LlamaConfig(vocab_size=32, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=2, num_key_value_heads=1,
+                          max_position_embeddings=32)
+        paddle.seed(31)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return cfg, m
+
+    def test_repetition_penalty_matches_eager_rule(self):
+        cfg, m = self._model()
+        rp, n = 1.8, 6
+        ids = np.array([[3, 9, 3]], np.int32)
+        toks, _ = m.generate(paddle.to_tensor(ids), max_new_tokens=n,
+                             decode_strategy="greedy_search",
+                             repetition_penalty=rp)
+        got = [int(t) for t in np.asarray(toks._value)[0]]
+        # eager oracle
+        seen = set(ids[0].tolist())
+        cur, want = ids[0].tolist(), []
+        for _ in range(n):
+            lg = np.array(m(paddle.to_tensor(
+                np.asarray(cur, np.int32)[None]))._value[0, -1],
+                np.float32)
+            for tk in seen:
+                lg[tk] = lg[tk] / rp if lg[tk] > 0 else lg[tk] * rp
+            nxt = int(np.argmax(lg))
+            want.append(nxt)
+            seen.add(nxt)
+            cur.append(nxt)
+        assert got == want, (got, want)
+        # and the penalty actually changes the output for this model
+        plain, _ = m.generate(paddle.to_tensor(ids), max_new_tokens=n)
+        assert got != [int(t) for t in np.asarray(plain._value)[0]]
+
+    def test_min_new_tokens_suppresses_eos(self):
+        cfg, m = self._model()
+        ids = np.array([[5, 6]], np.int32)
+        # pick eos = the unconstrained first greedy token, so generation
+        # would otherwise stop immediately
+        t0, _ = m.generate(paddle.to_tensor(ids), max_new_tokens=1)
+        eos = int(np.asarray(t0._value)[0, 0])
+        toks, _ = m.generate(paddle.to_tensor(ids), max_new_tokens=8,
+                             eos_token_id=eos, min_new_tokens=4)
+        seq = [int(t) for t in np.asarray(toks._value)[0]]
+        assert all(t != eos for t in seq[:4]), seq
+
+    def test_beam_repetition_penalty_runs(self):
+        cfg, m = self._model()
+        ids = np.array([[1, 2]], np.int32)
+        toks, score = m.generate(paddle.to_tensor(ids), max_new_tokens=5,
+                                 decode_strategy="beam_search",
+                                 num_beams=3, repetition_penalty=1.5,
+                                 min_new_tokens=2, eos_token_id=7)
+        seq = [int(t) for t in np.asarray(toks._value)[0]]
+        assert len(seq) == 5 and np.isfinite(float(score[0]))
+        assert all(t != 7 for t in seq[:2])
