@@ -106,16 +106,23 @@ def apply_rope(x: Tensor, cos: Tensor, sin: Tensor, position_offset=0):
            if isinstance(position_offset, Tensor) else position_offset)
 
     if not isinstance(off, int) and jnp.ndim(off) == 1:
-        if x.shape[1] != 1:
-            raise ValueError("vector position_offset needs S == 1")
-
         from paddle_tpu.ops.rope import rope_rotate_values
 
-        def fn_vec(v, c, s):
-            cv = c[off].astype(jnp.float32)[:, None, None, :]  # (B,1,1,half)
-            sv = s[off].astype(jnp.float32)[:, None, None, :]
+        if x.shape[1] == 1:
+            def fn_vec(v, c, s):
+                cv = c[off].astype(jnp.float32)[:, None, None, :]
+                sv = s[off].astype(jnp.float32)[:, None, None, :]
+                return rope_rotate_values(v, cv, sv)  # (B,1,1,half) trig
+            return _apply("rope_vec", fn_vec, (x, cos, sin))
+
+        # (B,) offsets with S > 1 (speculative verify): row i of
+        # sequence b rotates at angle position off[b] + i
+        def fn_vec_s(v, c, s):
+            rows = off[:, None] + jnp.arange(v.shape[1])[None, :]
+            cv = c[rows].astype(jnp.float32)[:, :, None, :]  # (B,S,1,half)
+            sv = s[rows].astype(jnp.float32)[:, :, None, :]
             return rope_rotate_values(v, cv, sv)
-        return _apply("rope_vec", fn_vec, (x, cos, sin))
+        return _apply("rope_vec_s", fn_vec_s, (x, cos, sin))
 
     # use_pallas=False: measured on the v5e (round 3), the XLA rotation
     # fuses into the surrounding projections and beats the standalone
@@ -149,14 +156,22 @@ def _update_kv_cache(cache: Tensor, new: Tensor, offset) -> Tensor:
     off = offset._value if isinstance(offset, Tensor) else offset
 
     if not isinstance(off, int) and jnp.ndim(off) == 1:
-        if new.shape[1] != 1:
-            raise ValueError("vector cache offset needs S == 1")
+        s = new.shape[1]
+        if s == 1:
+            def fn_vec(c, n):
+                b = c.shape[0]
+                return c.at[jnp.arange(b), off].set(
+                    n[:, 0].astype(c.dtype))
+            return _apply("kv_cache_update_vec", fn_vec, (cache, new))
 
-        def fn_vec(c, n):
+        # s > 1 with per-row offsets (speculative verify): row i of
+        # sequence b lands at position off[b] + i
+        def fn_vec_s(c, n):
             b = c.shape[0]
-            return c.at[jnp.arange(b), off].set(
-                n[:, 0].astype(c.dtype))
-        return _apply("kv_cache_update_vec", fn_vec, (cache, new))
+            rows = off[:, None] + jnp.arange(s)[None, :]      # (B, s)
+            return c.at[jnp.arange(b)[:, None], rows].set(
+                n.astype(c.dtype))
+        return _apply("kv_cache_update_vec_s", fn_vec_s, (cache, new))
 
     def fn(c, n):
         return jax.lax.dynamic_update_slice_in_dim(
@@ -264,8 +279,44 @@ class LlamaAttention(nn.Layer):
                 # kernel's native decode convention), window-banded when
                 # sliding_window is set
                 if not isinstance(position_offset, int):
-                    raise ValueError(
-                        "prefill (seq>1) needs a static position_offset")
+                    # traced scalar / (B,) vector offsets (speculative
+                    # VERIFY: the target scores k drafted tokens in one
+                    # forward): attention over the FULL static cache with
+                    # an in-graph end-aligned causal mask — no dynamic
+                    # slicing, so the offsets may differ per row
+                    off = (position_offset._value
+                           if isinstance(position_offset, Tensor)
+                           else jnp.asarray(position_offset, jnp.int32))
+                    offv = jnp.broadcast_to(jnp.atleast_1d(off), (b,))
+                    s_max = k_cache.shape[1]
+                    rows = offv[:, None] + jnp.arange(s)[None, :]
+                    cols = jnp.arange(s_max)
+                    vmask = cols[None, None, None, :] \
+                        <= rows[:, None, :, None]      # (B, 1, s, S_max)
+                    if win is not None:
+                        vmask = vmask & (cols[None, None, None, :]
+                                         > rows[:, None, :, None] - win)
+                    if attention_mask is not None:
+                        am = attention_mask
+                        if not isinstance(am, Tensor):
+                            am = paddle.to_tensor(am)
+                        amv = am._value.astype(bool)
+                        if amv.shape[-1] < s_max:
+                            # conventional (B, prompt-width) key-validity
+                            # masks cover only the prefill window; cache
+                            # cells beyond it hold decode/verify tokens,
+                            # which are valid keys
+                            amv = jnp.pad(
+                                amv,
+                                ((0, 0), (0, s_max - amv.shape[-1])),
+                                constant_values=True)
+                        vmask = vmask & amv[:, None, None, :s_max]
+                    out = F.scaled_dot_product_attention(
+                        q, k_cache, v_cache, attn_mask=Tensor(vmask))
+                    out = self.o_proj(out.reshape([b, s, -1]))
+                    if use_cache:
+                        return out, (k_cache, v_cache)
+                    return out
                 mask = None
                 if attention_mask is not None or win is not None:
                     band = _window_band(s, cur_len, position_offset, win)

@@ -1,0 +1,211 @@
+"""Speculative decoding — draft-model propose, target verify.
+
+≙ the reference serving stack's speculative/draft-model decode
+(PaddleNLP `speculate_*` fused inference path, SURVEY.md §1 L10): a
+small DRAFT model proposes `k` greedy tokens autoregressively, then the
+TARGET scores all of them in ONE forward (the verify pass) and accepts
+the longest prefix that matches its own greedy choices, plus one bonus
+token from the mismatch position. Greedy speculative decoding is
+LOSSLESS: the emitted stream equals target-only greedy exactly, while
+the target runs ~(accepted+1) tokens per forward instead of 1.
+
+TPU-native shape: the WHOLE loop — draft prefill, target prefill, a
+`lax.while_loop` of (draft scan -> one verify forward -> accept/commit)
+— is one compiled XLA program with static shapes throughout:
+
+* the verify forward uses per-row traced position offsets over the full
+  static KV cache (in-graph end-aligned causal mask — llama.py's
+  speculative-verify attention branch);
+* rejected draft positions leave garbage K/V in both caches, which is
+  sound because every future query's mask only admits columns below its
+  own position, and those cells are overwritten when the positions are
+  legitimately reached (same trash-routing idea as the paged engine);
+* emitted tokens scatter into a slack output buffer; rejected lanes
+  route to a trash column.
+
+Rows of a batch advance at different rates (per-row accept counts); the
+loop runs until every row has max_new_tokens or hit EOS.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.autograd import no_grad
+from .generation import bind_state
+
+
+def speculative_generate(target, draft, input_ids,
+                         max_new_tokens: int = 32,
+                         num_draft_tokens: int = 4,
+                         eos_token_id: int | None = None,
+                         max_cache_len: int | None = None):
+    """Greedy speculative decode. Returns (ids (B, max_new_tokens),
+    acceptance_rate scalar — mean fraction of drafted tokens accepted).
+
+    `target` and `draft` must share a vocabulary (hidden sizes/depths
+    may differ — each keeps its own KV cache)."""
+    if target.config.vocab_size != draft.config.vocab_size:
+        raise ValueError(
+            f"target vocab {target.config.vocab_size} != draft vocab "
+            f"{draft.config.vocab_size}")
+    if num_draft_tokens < 1:
+        raise ValueError("num_draft_tokens must be >= 1")
+    ids = input_ids if isinstance(input_ids, Tensor) \
+        else Tensor(jnp.asarray(input_ids, jnp.int32))
+    b, prompt_len = ids.shape
+    n_new, K = int(max_new_tokens), int(num_draft_tokens)
+    cache_len = int(max_cache_len
+                    or min(target.config.max_position_embeddings,
+                           prompt_len + n_new + K + 1))
+    if prompt_len + n_new + K + 1 > cache_len:
+        raise ValueError(
+            f"prompt {prompt_len} + max_new_tokens {n_new} + draft slack "
+            f"{K + 1} exceeds cache length {cache_len}")
+
+    t_params, t_buffers = list(target.parameters()), list(target.buffers())
+    d_params, d_buffers = list(draft.parameters()), list(draft.buffers())
+
+    sig = (b, prompt_len, n_new, K, cache_len, eos_token_id)
+    cache = getattr(target, "_spec_cache", None)
+    if cache is None or cache[0] != sig or cache[1] is not draft:
+        jitted = _build_spec(target, draft, sig)
+        target._spec_cache = (sig, draft, jitted)
+    else:
+        jitted = cache[2]
+    toks, acc = jitted([p._value for p in t_params],
+                       [x._value for x in t_buffers],
+                       [p._value for p in d_params],
+                       [x._value for x in d_buffers],
+                       ids._value.astype(jnp.int32))
+    return Tensor(toks), Tensor(acc)
+
+
+def _build_spec(target, draft, sig):
+    b, prompt_len, n_new, K, cache_len, eos = sig
+    t_params, t_buffers = list(target.parameters()), list(target.buffers())
+    d_params, d_buffers = list(draft.parameters()), list(draft.buffers())
+    PAD = 0
+    trash = n_new + K          # out buffer slack column for rejected lanes
+
+    def run(tpv, tbv, dpv, dbv, ids_v):
+        with bind_state(t_params, t_buffers, tpv, tbv), \
+                bind_state(d_params, d_buffers, dpv, dbv), no_grad():
+            t_dt, d_dt = tpv[0].dtype, dpv[0].dtype
+            # -- prefill both models on the prompt --------------------
+            t_logits, t_caches = target._zero_caches_prefill(
+                b, cache_len, t_dt, ids_v)
+            _, d_caches = draft._zero_caches_prefill(
+                b, cache_len, d_dt, ids_v)
+            t_caches = tuple((k._value, v._value) for k, v in t_caches)
+            d_caches = tuple((k._value, v._value) for k, v in d_caches)
+            tok0 = jnp.argmax(t_logits._value[:, -1], -1).astype(jnp.int32)
+            out = jnp.full((b, n_new + K + 1), PAD, jnp.int32)
+            out = out.at[:, 0].set(tok0)
+            n = jnp.ones((b,), jnp.int32)          # tokens emitted so far
+            pos = jnp.full((b,), prompt_len, jnp.int32)  # cache fill level
+            fin = (tok0 == eos) if eos is not None \
+                else jnp.zeros((b,), bool)
+            drafted_total = jnp.int32(0)
+            accepted_total = jnp.int32(0)
+
+            def cond(carry):
+                _, _, _, n, _, fin, last, _, _ = carry
+                return jnp.any(~fin & (n < n_new))
+
+            def body(carry):
+                t_caches, d_caches, out, n, pos, fin, last, drafted, \
+                    acc_tot = carry
+
+                # 1) draft proposes K greedy tokens, consuming `last`
+                def dstep(c, _):
+                    d_caches, tok, p = c
+                    pkv = [(Tensor(kc), Tensor(vc)) for kc, vc in d_caches]
+                    lg, ncaches = draft.forward(
+                        Tensor(tok[:, None]), past_key_values=pkv,
+                        position_offset=Tensor(p), use_cache=True)
+                    nxt = jnp.argmax(lg._value[:, 0], -1).astype(jnp.int32)
+                    ncv = tuple((kc._value, vc._value) for kc, vc in
+                                ncaches)
+                    return (ncv, nxt, p + 1), nxt
+
+                (d_caches, _, _), props = jax.lax.scan(
+                    dstep, (d_caches, last, pos), None, length=K)
+                props = props.T                     # (B, K)
+
+                # 2) target verifies [last, p1..pK] in ONE forward
+                x = jnp.concatenate([last[:, None], props], 1)  # (B, K+1)
+                pkv = [(Tensor(kc), Tensor(vc)) for kc, vc in t_caches]
+                v_logits, t_new = target.forward(
+                    Tensor(x), past_key_values=pkv,
+                    position_offset=Tensor(pos), use_cache=True)
+                t_caches = tuple((kc._value, vc._value)
+                                 for kc, vc in t_new)
+                # draft CATCH-UP: the propose scan wrote
+                # [last, p1..p_{K-1}] at pos..pos+K-1 but never fed
+                # itself p_K, so after a full-accept round the draft
+                # cache would have a hole at pos+K and the next round's
+                # proposals would attend garbage (observed as self-draft
+                # acceptance 0.67 instead of 1.0). One single-token
+                # draft forward of p_K at pos+K fills exactly the
+                # missing row.
+                dkv = [(Tensor(kc), Tensor(vc)) for kc, vc in d_caches]
+                _, d_new = draft.forward(
+                    Tensor(props[:, K - 1:]), past_key_values=dkv,
+                    position_offset=Tensor(pos + K), use_cache=True)
+                d_caches = tuple((kc._value, vc._value)
+                                 for kc, vc in d_new)
+                g = jnp.argmax(v_logits._value, -1).astype(
+                    jnp.int32)                      # (B, K+1)
+
+                # 3) accept the longest matching prefix + bonus token
+                match = props == g[:, :K]           # (B, K)
+                j = jnp.sum(jnp.cumprod(match.astype(jnp.int32), 1),
+                            1)                      # (B,) accepted count
+                bonus = jnp.take_along_axis(g, j[:, None], 1)[:, 0]
+                i_ar = jnp.arange(K + 1)[None, :]
+                tokmat = jnp.where(
+                    i_ar < j[:, None],
+                    jnp.concatenate([props, props[:, :1]], 1),
+                    bonus[:, None])                 # (B, K+1)
+                keep = (i_ar <= j[:, None]) & ~fin[:, None]
+                if eos is not None:
+                    # trim everything after the first EOS in this round
+                    eos_hit = tokmat == eos
+                    before_eos = jnp.cumsum(
+                        eos_hit.astype(jnp.int32), 1) \
+                        - eos_hit.astype(jnp.int32) == 0
+                    keep = keep & before_eos
+                m = jnp.sum(keep.astype(jnp.int32), 1)   # emitted count
+                idx = jnp.where(keep, n[:, None] + i_ar, trash)
+                out = out.at[jnp.arange(b)[:, None], idx].set(
+                    jnp.where(keep, tokmat, PAD))
+                if eos is not None:
+                    new_fin = fin | jnp.any(keep & (tokmat == eos), 1)
+                else:
+                    new_fin = fin
+                n = n + m
+                # cache fill advances by the verified tokens the target
+                # actually keeps: last + accepted proposals = j + 1 rows
+                # (frozen rows advance nothing)
+                pos = pos + jnp.where(fin, 0, j + 1)
+                last = jnp.where(fin, last, bonus)
+                acc_tot = acc_tot + jnp.sum(
+                    jnp.where(fin, 0, j).astype(jnp.int32))
+                # charge only LIVE rows for their K drafts, or the rate
+                # deflates whenever one batch row finishes early
+                drafted = drafted + K * jnp.sum(
+                    (~fin).astype(jnp.int32))
+                return (t_caches, d_caches, out, n, pos, new_fin, last,
+                        drafted, acc_tot)
+
+            carry = (t_caches, d_caches, out, n, pos, fin, tok0,
+                     drafted_total, accepted_total)
+            (_, _, out, n, pos, fin, _, drafted, acc_tot) = \
+                jax.lax.while_loop(cond, body, carry)
+            acc_rate = acc_tot.astype(jnp.float32) / jnp.maximum(
+                drafted, 1)
+            return out[:, :n_new], acc_rate
+
+    return jax.jit(run)

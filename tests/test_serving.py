@@ -861,3 +861,80 @@ class TestLogitsProcessors:
         seq = [int(t) for t in np.asarray(toks._value)[0]]
         assert len(seq) == 5 and np.isfinite(float(score[0]))
         assert all(t != 7 for t in seq[:2])
+
+
+class TestSpeculativeDecoding:
+    """Greedy speculative decoding is LOSSLESS: the emitted stream must
+    equal target-only greedy exactly, for ANY draft — a random unrelated
+    draft (worst case, low acceptance) and the target itself (best case,
+    full acceptance)."""
+
+    def _models(self):
+        cfg_t = LlamaConfig(vocab_size=64, hidden_size=64,
+                            intermediate_size=128, num_hidden_layers=2,
+                            num_attention_heads=4, num_key_value_heads=2,
+                            max_position_embeddings=64)
+        cfg_d = LlamaConfig(vocab_size=64, hidden_size=32,
+                            intermediate_size=64, num_hidden_layers=1,
+                            num_attention_heads=2, num_key_value_heads=1,
+                            max_position_embeddings=64)
+        paddle.seed(17)
+        t = LlamaForCausalLM(cfg_t)
+        paddle.seed(18)
+        d = LlamaForCausalLM(cfg_d)
+        t.eval(); d.eval()
+        return t, d
+
+    def test_lossless_vs_target_greedy_random_draft(self):
+        from paddle_tpu.models.speculative import speculative_generate
+        t, d = self._models()
+        rng = np.random.default_rng(2)
+        ids = rng.integers(1, 64, (2, 7)).astype(np.int32)
+        n = 12
+        want, _ = t.generate(paddle.to_tensor(ids), max_new_tokens=n)
+        got, acc = speculative_generate(t, d, paddle.to_tensor(ids),
+                                        max_new_tokens=n,
+                                        num_draft_tokens=3)
+        np.testing.assert_array_equal(np.asarray(got._value),
+                                      np.asarray(want._value))
+        assert 0.0 <= float(acc) <= 1.0
+
+    def test_self_draft_full_acceptance(self):
+        from paddle_tpu.models.speculative import speculative_generate
+        t, _ = self._models()
+        ids = np.array([[5, 9, 13]], np.int32)
+        n = 10
+        want, _ = t.generate(paddle.to_tensor(ids), max_new_tokens=n)
+        got, acc = speculative_generate(t, t, paddle.to_tensor(ids),
+                                        max_new_tokens=n,
+                                        num_draft_tokens=4)
+        np.testing.assert_array_equal(np.asarray(got._value),
+                                      np.asarray(want._value))
+        assert float(acc) > 0.95, float(acc)   # target drafts for itself
+
+    def test_eos_stops_early(self):
+        from paddle_tpu.models.speculative import speculative_generate
+        t, d = self._models()
+        ids = np.array([[3, 4]], np.int32)
+        w, _ = t.generate(paddle.to_tensor(ids), max_new_tokens=1)
+        eos = int(np.asarray(w._value)[0, 0])
+        got, _ = speculative_generate(t, d, paddle.to_tensor(ids),
+                                      max_new_tokens=8,
+                                      num_draft_tokens=3,
+                                      eos_token_id=eos)
+        seq = [int(x) for x in np.asarray(got._value)[0]]
+        assert seq[0] == eos
+        assert all(x == 0 for x in seq[1:]), seq   # PAD after EOS
+
+    def test_vocab_mismatch_raises(self):
+        from paddle_tpu.models.speculative import speculative_generate
+        t, _ = self._models()
+        cfg_bad = LlamaConfig(vocab_size=32, hidden_size=32,
+                              intermediate_size=64, num_hidden_layers=1,
+                              num_attention_heads=2,
+                              num_key_value_heads=1,
+                              max_position_embeddings=64)
+        bad = LlamaForCausalLM(cfg_bad)
+        with pytest.raises(ValueError, match="vocab"):
+            speculative_generate(t, bad, paddle.to_tensor(
+                np.array([[1]], np.int32)))

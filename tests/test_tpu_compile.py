@@ -388,6 +388,31 @@ class TestPagedEngineDecodeCompile:
                 assert len(res[r]) == 16
                 assert all(0 <= t < cfg.vocab_size for t in res[r])
 
+    def test_speculative_decode_on_chip(self):
+        """Draft-propose + one-forward verify (vector-offset rope, s>1
+        vector cache writes, in-graph verify mask) compiles and runs on
+        silicon; output must stay lossless vs target greedy."""
+        from paddle_tpu.models.speculative import speculative_generate
+        import paddle_tpu as paddle
+        from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+        cfg, t = self._tiny()
+        paddle.seed(1)
+        d = LlamaForCausalLM(LlamaConfig(
+            vocab_size=cfg.vocab_size, hidden_size=128,
+            intermediate_size=256, num_hidden_layers=1,
+            num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=512))
+        d.eval()
+        ids = np.random.default_rng(3).integers(
+            1, cfg.vocab_size, (2, 9)).astype(np.int32)
+        want, _ = t.generate(paddle.to_tensor(ids), max_new_tokens=12)
+        got, acc = speculative_generate(t, d, paddle.to_tensor(ids),
+                                        max_new_tokens=12,
+                                        num_draft_tokens=4)
+        np.testing.assert_array_equal(np.asarray(got._value),
+                                      np.asarray(want._value))
+
     def test_prefix_caching_suffix_prefill_on_chip(self):
         """The prefix-hit admission path (page gather + chunked suffix
         prefill + rebased scatter) must compile and run on silicon."""
