@@ -16,6 +16,17 @@ from paddle_tpu.optimizer.lr import LinearWarmup
 
 
 class TestBertE2E:
+    def test_forward_smoke(self):
+        """Fast-tier BERT gate: one MLM forward with a finite loss (the
+        full train-loop test is slow-tier)."""
+        paddle.seed(0)
+        cfg = BertConfig.tiny()
+        model = BertForMaskedLM(cfg)
+        ids, labels = synthetic_mlm_batch(2, 16, cfg.vocab_size)
+        loss, _ = model(ids, labels=labels)
+        assert np.isfinite(float(loss))
+
+    @pytest.mark.slow
     def test_mlm_train_loss_decreases(self, tmp_path):
         paddle.seed(0)
         cfg = BertConfig.tiny()

@@ -243,6 +243,7 @@ class TestDroplessMoE:
         losses = [float(step(x, x)) for _ in range(3)]
         assert np.isfinite(losses).all(), losses
 
+    @pytest.mark.slow
     def test_dropless_gradients_flow(self):
         paddle.seed(0)
         layer = MoELayer(hidden_size=8, intermediate_size=16,

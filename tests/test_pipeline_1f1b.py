@@ -53,7 +53,8 @@ def _seq_losses(per_stage, x, m):
 
 
 class TestOneFOneB:
-    @pytest.mark.parametrize("micro", [2, 4, 8])
+    @pytest.mark.parametrize("micro", [
+        2, 4, pytest.param(8, marks=pytest.mark.slow)])
     def test_losses_match_sequential(self, pp_mesh, micro):
         per_stage = _stages(4)
         stacked = stack_stage_params(per_stage)
@@ -365,7 +366,8 @@ class TestInterleaved1F1B:
     def _reduce(y, idx):
         return jnp.sum(y.astype(jnp.float32) ** 2)
 
-    @pytest.mark.parametrize("micro", [4, 6])
+    @pytest.mark.parametrize("micro", [
+        pytest.param(4, marks=pytest.mark.slow), 6])
     def test_losses_match_sequential(self, pp_mesh, micro):
         """micro=6 is NOT divisible by S=4 — the schedule's partial last
         group lifts the old GPipe-interleave M % S == 0 constraint."""

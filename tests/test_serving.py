@@ -308,6 +308,24 @@ class TestGPTGenerate:
             cur = np.concatenate([cur, nxt[:, None]], 1)
         np.testing.assert_array_equal(np.asarray(toks._value), cur[:, 10:])
 
+    def test_beam_search_runs_on_gpt(self):
+        """GenerationMixin strategies are model-family-generic: beam
+        search drives GPT (learned position embeddings) unchanged."""
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+        cfg = GPTConfig(vocab_size=64, hidden_size=32,
+                        num_hidden_layers=1, num_attention_heads=2,
+                        intermediate_size=64, max_position_embeddings=32)
+        paddle.seed(4)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        ids = np.array([[3, 5, 7]], np.int32)
+        toks, score = model.generate(paddle.to_tensor(ids),
+                                     max_new_tokens=6,
+                                     decode_strategy="beam_search",
+                                     num_beams=3)
+        assert np.asarray(toks._value).shape == (1, 6)
+        assert np.isfinite(float(score[0]))
+
 
 @pytest.mark.slow
 class TestContinuousBatching:
@@ -601,6 +619,7 @@ class TestPrefixCaching:
         res = eng.run()
         return [res[r] for r in rids], eng
 
+    @pytest.mark.slow
     def test_hit_outputs_match_uncached(self):
         m, cfg = self._model()
         rng = np.random.default_rng(5)
@@ -813,6 +832,7 @@ class TestLogitsProcessors:
         m.eval()
         return cfg, m
 
+    @pytest.mark.slow
     def test_repetition_penalty_matches_eager_rule(self):
         cfg, m = self._model()
         rp, n = 1.8, 6
@@ -885,6 +905,7 @@ class TestSpeculativeDecoding:
         t.eval(); d.eval()
         return t, d
 
+    @pytest.mark.slow
     def test_lossless_vs_target_greedy_random_draft(self):
         from paddle_tpu.models.speculative import speculative_generate
         t, d = self._models()
@@ -899,6 +920,7 @@ class TestSpeculativeDecoding:
                                       np.asarray(want._value))
         assert 0.0 <= float(acc) <= 1.0
 
+    @pytest.mark.slow
     def test_self_draft_full_acceptance(self):
         from paddle_tpu.models.speculative import speculative_generate
         t, _ = self._models()
