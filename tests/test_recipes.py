@@ -92,6 +92,12 @@ class TestRecipeSmoke:
                   "--seq-len", "64", "--log-every", "0"])
         assert np.isfinite(r.final_loss)
 
+    def test_llama_serve(self):
+        """The serving demo (generate strategies + paged engine with
+        prefix caching + speculative decoding) runs end-to-end."""
+        from recipes.llama_serve import main
+        assert main(["--max-new-tokens", "8", "--num-beams", "2"]) == 0
+
     def test_llama_pretrain_accumulate_recompute(self):
         from recipes.llama_pretrain import main
         r = main(["--size", "tiny", "--steps", "2", "--batch-size", "4",
