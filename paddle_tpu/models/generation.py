@@ -55,6 +55,31 @@ def _sample_token(logits, key, strategy, temperature, top_k, top_p):
     return tok, jnp.take_along_axis(logp, tok[:, None], -1)[:, 0]
 
 
+def _ban_repeat_ngrams(logits, buf, cur, n):
+    """no_repeat_ngram_size processor: ban every token v that would
+    complete an n-gram already present in `buf[:, :cur]` (prompt +
+    emitted so far). buf: (R, L) int32; cur: traced scalar count of
+    valid tokens; logits: (R, V). All static shapes — windows over the
+    whole buffer, invalid ones masked."""
+    r, L = buf.shape
+    v_size = logits.shape[-1]
+    if L < n:
+        return logits
+    # the (n-1)-token suffix being extended
+    suffix = jax.lax.dynamic_slice_in_dim(
+        buf, jnp.maximum(cur - (n - 1), 0), n - 1, 1)       # (R, n-1)
+    starts = jnp.arange(L - n + 1)
+    win_idx = starts[:, None] + jnp.arange(n - 1)[None, :]
+    windows = buf[:, win_idx]                                # (R, W, n-1)
+    match = jnp.all(windows == suffix[:, None, :], -1) \
+        & (starts[None, :] <= cur - n)                       # (R, W)
+    ban_tok = buf[jnp.arange(r)[:, None], starts[None, :] + n - 1]
+    banned = jnp.zeros((r, v_size + 1), bool).at[
+        jnp.arange(r)[:, None],
+        jnp.where(match, ban_tok, v_size)].set(True)[:, :v_size]
+    return jnp.where(banned, NEG_INF, logits)
+
+
 def _penalize(logits, seen, t, rp, min_new, eos):
     """Logit post-processing shared by every decode strategy (≙ the
     reference's LogitsProcessor stack): CTRL-style repetition penalty on
@@ -113,7 +138,8 @@ class GenerationMixin:
                  max_cache_len: int | None = None, use_cache: bool = True,
                  num_beams: int = 1, length_penalty: float = 0.0,
                  repetition_penalty: float = 1.0,
-                 min_new_tokens: int = 0):
+                 min_new_tokens: int = 0,
+                 no_repeat_ngram_size: int = 0):
         if decode_strategy not in ("greedy_search", "sampling",
                                    "beam_search"):
             raise ValueError(
@@ -125,6 +151,10 @@ class GenerationMixin:
             raise ValueError(
                 f"repetition_penalty must be > 0, got {repetition_penalty}"
                 " (1.0 disables it)")
+        if no_repeat_ngram_size < 0:
+            raise ValueError(
+                f"no_repeat_ngram_size must be >= 0, got "
+                f"{no_repeat_ngram_size} (0 disables it)")
         cfg = self.config
         ids = input_ids if isinstance(input_ids, Tensor) \
             else Tensor(jnp.asarray(input_ids, jnp.int32))
@@ -150,7 +180,8 @@ class GenerationMixin:
         sig = (b, prompt_len, n_new, cache_len, decode_strategy,
                float(temperature), int(top_k), float(top_p), eos_token_id,
                struct, int(num_beams), float(length_penalty),
-               float(repetition_penalty), int(min_new_tokens))
+               float(repetition_penalty), int(min_new_tokens),
+               int(no_repeat_ngram_size))
         cache = getattr(self, "_generate_cache", None)
         if cache is None or cache[0] != sig:
             if decode_strategy == "beam_search":
@@ -185,7 +216,7 @@ class GenerationMixin:
     def _build_generate(self, sig):
         (b, prompt_len, n_new, cache_len, strategy, temperature, top_k,
          top_p, eos_token_id, _struct) = sig[:10]
-        rep_pen, min_new = sig[12], sig[13]
+        rep_pen, min_new, ngram = sig[12], sig[13], sig[14]
         cfg = self.config
         params = list(self.parameters())
         buffers = list(self.buffers())
@@ -207,19 +238,30 @@ class GenerationMixin:
                     seen = (jnp.zeros((b, v_size), bool).at[
                         jnp.arange(b)[:, None], ids_v].set(True)
                         if track else jnp.zeros((), bool))
+                    # full-sequence buffer for the n-gram ban (static
+                    # L = prompt + n_new; only when the knob is on)
+                    buf = (jnp.zeros((b, prompt_len + n_new),
+                                     jnp.int32).at[:, :prompt_len].set(
+                        ids_v.astype(jnp.int32))
+                        if ngram else jnp.zeros((), jnp.int32))
                     key0, key_rest = jax.random.split(key)
+                    lg0 = _penalize(logits._value[:, -1], seen, 0,
+                                    rep_pen, min_new, eos_token_id)
+                    if ngram:
+                        lg0 = _ban_repeat_ngrams(
+                            lg0, buf, jnp.int32(prompt_len), ngram)
                     tok0, lp0 = _sample_token(
-                        _penalize(logits._value[:, -1], seen, 0, rep_pen,
-                                  min_new, eos_token_id),
-                        key0, strategy, temperature, top_k, top_p)
+                        lg0, key0, strategy, temperature, top_k, top_p)
                     if track:
                         seen = seen.at[jnp.arange(b), tok0].set(True)
+                    if ngram:
+                        buf = buf.at[:, prompt_len].set(tok0)
                     fin0 = (tok0 == eos_token_id) if eos_token_id is not None \
                         else jnp.zeros((b,), bool)
 
                     # ---- decode: lax.scan, one token per step -----------
                     def body(carry, t):
-                        caches_v, tok, pos, fin, seen, k = carry
+                        caches_v, tok, pos, fin, seen, buf, k = carry
                         k, sub = jax.random.split(k)
                         pkv = [(Tensor(kc), Tensor(vc))
                                for kc, vc in caches_v]
@@ -227,10 +269,13 @@ class GenerationMixin:
                             Tensor(tok[:, None]),
                             past_key_values=pkv,
                             position_offset=Tensor(pos), use_cache=True)
+                        lg = _penalize(step_logits._value[:, 0], seen, t,
+                                       rep_pen, min_new, eos_token_id)
+                        if ngram:
+                            lg = _ban_repeat_ngrams(
+                                lg, buf, prompt_len + t, ngram)
                         nxt, lp = _sample_token(
-                            _penalize(step_logits._value[:, 0], seen, t,
-                                      rep_pen, min_new, eos_token_id),
-                            sub, strategy, temperature, top_k, top_p)
+                            lg, sub, strategy, temperature, top_k, top_p)
                         if eos_token_id is not None:
                             nxt = jnp.where(fin, eos_token_id, nxt)
                             lp = jnp.where(fin, 0.0, lp)
@@ -241,13 +286,16 @@ class GenerationMixin:
                             (kc._value, vc._value) for kc, vc in new_caches)
                         new_seen = (seen.at[jnp.arange(b), nxt].set(True)
                                     if track else seen)
+                        new_buf = (buf.at[jnp.arange(b),
+                                          prompt_len + t].set(nxt)
+                                   if ngram else buf)
                         return ((new_caches_v, nxt, pos + 1, new_fin,
-                                 new_seen, k), (nxt, lp))
+                                 new_seen, new_buf, k), (nxt, lp))
 
                     if n_new > 1:
                         carry0 = (caches_v, tok0,
                                   jnp.int32(prompt_len), fin0, seen,
-                                  key_rest)
+                                  buf, key_rest)
                         _, (toks, lps) = jax.lax.scan(
                             body, carry0, jnp.arange(1, n_new))
                         toks = jnp.concatenate(
@@ -273,7 +321,7 @@ class GenerationMixin:
         reference default). Deterministic — the PRNG key is unused."""
         (b, prompt_len, n_new, cache_len, _strategy, _t, _tk, _tp,
          eos_token_id, _struct, num_beams, length_penalty,
-         rep_pen, min_new) = sig
+         rep_pen, min_new, ngram) = sig
         cfg = self.config
         params = list(self.parameters())
         buffers = list(self.buffers())
@@ -294,9 +342,15 @@ class GenerationMixin:
                 seen0 = (jnp.zeros((b, v), bool).at[
                     jnp.arange(b)[:, None], ids_v].set(True)
                     if track else jnp.zeros((), bool))
-                logp0 = jax.nn.log_softmax(
-                    _penalize(logits._value[:, -1].astype(jnp.float32),
-                              seen0, 0, rep_pen, min_new, eos_token_id))
+                lg0 = _penalize(logits._value[:, -1].astype(jnp.float32),
+                                seen0, 0, rep_pen, min_new, eos_token_id)
+                if ngram:
+                    buf0 = jnp.concatenate(
+                        [ids_v.astype(jnp.int32),
+                         jnp.zeros((b, n_new), jnp.int32)], 1)
+                    lg0 = _ban_repeat_ngrams(
+                        lg0, buf0, jnp.int32(prompt_len), ngram)
+                logp0 = jax.nn.log_softmax(lg0)
                 # K may exceed V (full-width search on tiny vocabs):
                 # only V real beams exist after the first expansion; the
                 # rest start DEAD at -inf and revive only if later steps
@@ -321,11 +375,15 @@ class GenerationMixin:
                     jnp.arange(b)[:, None], jnp.arange(K)[None, :],
                     tok0].set(True)                            # (B, K, V)
                     if track else jnp.zeros((), bool))
+                L = prompt_len + n_new
+                buf = (jnp.repeat(buf0[:, None], K, 1)
+                       .at[:, :, prompt_len].set(tok0)
+                       if ngram else jnp.zeros((), jnp.int32))
                 if eos_token_id is not None:
                     eos_row = jnp.full((v,), NEG).at[eos_token_id].set(0.0)
 
                 def body(carry, t):
-                    caches_v, tok, cum, fin, seqs, seen = carry
+                    caches_v, tok, cum, fin, seqs, seen, buf = carry
                     pkv = [(Tensor(kc), Tensor(vc))
                            for kc, vc in caches_v]
                     step_logits, new_caches = self.forward(
@@ -333,12 +391,15 @@ class GenerationMixin:
                         past_key_values=pkv,
                         position_offset=Tensor(prompt_len - 1 + t),
                         use_cache=True)
-                    lgp = jax.nn.log_softmax(
-                        _penalize(
-                            step_logits._value[:, 0].astype(jnp.float32),
-                            seen.reshape(b * K, v) if track else seen,
-                            t, rep_pen, min_new,
-                            eos_token_id)).reshape(b, K, v)
+                    lgf = _penalize(
+                        step_logits._value[:, 0].astype(jnp.float32),
+                        seen.reshape(b * K, v) if track else seen,
+                        t, rep_pen, min_new, eos_token_id)
+                    if ngram:
+                        lgf = _ban_repeat_ngrams(
+                            lgf, buf.reshape(b * K, L), prompt_len + t,
+                            ngram)
+                    lgp = jax.nn.log_softmax(lgf).reshape(b, K, v)
                     if eos_token_id is not None:
                         lgp = jnp.where(fin[:, :, None],
                                         eos_row[None, None, :], lgp)
@@ -359,12 +420,16 @@ class GenerationMixin:
                         seen, src[:, :, None], 1).at[
                         jnp.arange(b)[:, None], jnp.arange(K)[None, :],
                         ntok].set(True) if track else seen)
+                    nbuf = (jnp.take_along_axis(
+                        buf, src[:, :, None], 1).at[
+                        jnp.arange(b)[:, None], jnp.arange(K)[None, :],
+                        prompt_len + t].set(ntok) if ngram else buf)
                     return (new_caches_v, ntok, ncum, nfin, nseqs,
-                            nseen), None
+                            nseen, nbuf), None
 
                 if n_new > 1:
-                    carry = (caches_v, tok0, cum, fin, seqs, seen)
-                    (caches_v, _, cum, fin, seqs, _), _ = jax.lax.scan(
+                    carry = (caches_v, tok0, cum, fin, seqs, seen, buf)
+                    (caches_v, _, cum, fin, seqs, _, _), _ = jax.lax.scan(
                         body, carry, jnp.arange(1, n_new))
                 if eos_token_id is not None:
                     iseos = seqs == eos_token_id

@@ -960,3 +960,53 @@ class TestSpeculativeDecoding:
         with pytest.raises(ValueError, match="vocab"):
             speculative_generate(t, bad, paddle.to_tensor(
                 np.array([[1]], np.int32)))
+
+
+class TestNoRepeatNgram:
+    def _model(self):
+        cfg = LlamaConfig(vocab_size=32, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=2, num_key_value_heads=1,
+                          max_position_embeddings=32)
+        paddle.seed(31)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return cfg, m
+
+    def test_no_repeat_ngram_matches_eager_rule(self):
+        cfg, m = self._model()
+        n_gram, n = 2, 8
+        ids = np.array([[3, 9, 3]], np.int32)
+        toks, _ = m.generate(paddle.to_tensor(ids), max_new_tokens=n,
+                             no_repeat_ngram_size=n_gram)
+        got = [int(t) for t in np.asarray(toks._value)[0]]
+        cur, want = ids[0].tolist(), []
+        for _ in range(n):
+            lg = np.array(m(paddle.to_tensor(
+                np.asarray(cur, np.int32)[None]))._value[0, -1],
+                np.float32)
+            suffix = tuple(cur[-(n_gram - 1):])
+            for i in range(len(cur) - n_gram + 1):
+                if tuple(cur[i:i + n_gram - 1]) == suffix:
+                    lg[cur[i + n_gram - 1]] = -1e30
+            nxt = int(np.argmax(lg))
+            want.append(nxt)
+            cur.append(nxt)
+        assert got == want, (got, want)
+        # the constraint binds: no repeated bigram in prompt+output
+        grams = set()
+        for a, bb in zip(cur, cur[1:]):
+            assert (a, bb) not in grams, (a, bb, cur)
+            grams.add((a, bb))
+
+    def test_no_repeat_ngram_beam_runs(self):
+        cfg, m = self._model()
+        ids = np.array([[1, 2, 1]], np.int32)
+        toks, score = m.generate(paddle.to_tensor(ids), max_new_tokens=6,
+                                 decode_strategy="beam_search",
+                                 num_beams=3, no_repeat_ngram_size=2)
+        seq = ids[0].tolist() + [int(t) for t in
+                                 np.asarray(toks._value)[0]]
+        grams = list(zip(seq, seq[1:]))
+        assert len(grams) == len(set(grams)), seq
+        assert np.isfinite(float(score[0]))
