@@ -83,7 +83,8 @@ class ContinuousBatchingEngine:
                  seed: int = 0,
                  max_prefill_programs: int = 8,
                  enable_prefix_caching: bool = False,
-                 max_prefix_entries: int = 32):
+                 max_prefix_entries: int = 32,
+                 prefill_chunk: Optional[int] = None):
         cfg = model.config
         self.model = model
         self.B = int(max_batch_size)
@@ -132,6 +133,12 @@ class ContinuousBatchingEngine:
             self._prefix_enabled = False
             self.prefix_hits = 0
             self.prefix_tokens_reused = 0
+            if prefill_chunk:
+                import warnings
+                warnings.warn("prefill_chunk requires kv_layout='paged' "
+                              "— chunked prefill is DISABLED on the "
+                              "dense layout")
+            self._chunk = None      # chunked prefill is paged-only
             self._caches = [
                 (jnp.zeros((self.B, self.S, hk, hd), dt),
                  jnp.zeros((self.B, self.S, hk, hd), dt))
@@ -180,6 +187,26 @@ class ContinuousBatchingEngine:
             self._suffix_jits: "OrderedDict[tuple, object]" = OrderedDict()
             self.prefix_hits = 0
             self.prefix_tokens_reused = 0
+            # chunked prefill (vLLM-style): prompts longer than the
+            # chunk run through ONE compiled fixed-size chunk program
+            # with traced offsets (llama.py's verify-attention branch),
+            # so long prompts never mint new per-bucket programs
+            self._chunk = int(prefill_chunk) if prefill_chunk else None
+            if self._chunk is not None:
+                if self._chunk % self.page_size:
+                    raise ValueError(
+                        f"prefill_chunk {self._chunk} must be a multiple "
+                        f"of page_size {self.page_size} (chunk starts "
+                        "must be page-aligned for the rebased scatter)")
+                if self.S % self._chunk:
+                    # a final chunk crossing S would hit JAX's
+                    # dynamic-slice start clamping and silently shift
+                    # rows to wrong positions
+                    raise ValueError(
+                        f"max_seq_len {self.S} must be a multiple of "
+                        f"prefill_chunk {self._chunk}")
+                self._chunk_jit = None
+                self._sample_jit = None
         # host-side slot state
         self._pos = np.zeros(self.B, np.int32)        # next write position
         self._tok = np.zeros(self.B, np.int32)        # last emitted token
@@ -383,6 +410,9 @@ class ContinuousBatchingEngine:
                 tok = self._admit_shared(slot, req, shared)
                 for p in shared:
                     self._decref(p)        # unpin: the slot holds refs
+            elif self.layout == "paged" and self._chunk \
+                    and p_len >= self._chunk:
+                tok = self._admit_chunked(slot, req, p_len)
             else:
                 bucket = self._bucket(max(p_len, 1))
                 jit = self._get_prefill(bucket)
@@ -419,9 +449,7 @@ class ContinuousBatchingEngine:
             self._bt[slot, j] = p
             self._incref(p)
         self._slot_next_idx[slot] = len(pages)
-        self._slot_reserved[slot] = self._worst_pages(req)
-        while self._slot_next_idx[slot] * self.page_size < p_len:
-            self._alloc_page(slot)
+        self._reserve_and_alloc(slot, req, p_len)
         suffix = req.prompt[shared_len:]
         bucket = self._bucket(len(suffix))
         jit = self._get_suffix_prefill(shared_len, bucket)
@@ -580,9 +608,7 @@ class ContinuousBatchingEngine:
 
     def _paged_insert(self, slot: int, req: Request, p_len: int,
                       bucket: int, rows):
-        self._slot_reserved[slot] = self._worst_pages(req)
-        while self._slot_next_idx[slot] * self.page_size < p_len:
-            self._alloc_page(slot)
+        self._reserve_and_alloc(slot, req, p_len)
         jit = self._get_scatter(bucket)
         self._kv = jit(self._kv, rows, jnp.asarray(self._bt[slot]),
                        jnp.int32(p_len))
@@ -608,6 +634,82 @@ class ContinuousBatchingEngine:
         else:
             self._scatter_jits.move_to_end(bucket)
         return jit
+
+    def _reserve_and_alloc(self, slot: int, req: Request, p_len: int):
+        """Record the slot's worst-case reservation and allocate pages
+        covering the prompt — the common preamble of every paged
+        admission path."""
+        self._slot_reserved[slot] = self._worst_pages(req)
+        while self._slot_next_idx[slot] * self.page_size < p_len:
+            self._alloc_page(slot)
+
+    def _admit_chunked(self, slot: int, req: Request, p_len: int):
+        """Long-prompt admission: fixed-size chunks through ONE compiled
+        program with a traced position offset (the model's verify-
+        attention branch). Padded tail rows of the last chunk leave
+        garbage KV only at positions >= p_len, which decode overwrites
+        sequentially before ever attending them."""
+        C = self._chunk
+        self._reserve_and_alloc(slot, req, p_len)
+        if self._chunk_jit is None:
+            self._chunk_jit = self._build_chunk_prefill(C)
+        cfg = self.model.config
+        hk, hd = cfg.num_key_value_heads, cfg.head_dim
+        dt = self._params[0]._value.dtype
+        work = [(jnp.zeros((1, self.S, hk, hd), dt),
+                 jnp.zeros((1, self.S, hk, hd), dt))
+                for _ in range(cfg.num_hidden_layers)]
+        n_chunks = -(-p_len // C)
+        ids_pad = np.zeros((1, n_chunks * C), np.int32)
+        ids_pad[0, :p_len] = req.prompt
+        pv = [p._value for p in self._params]
+        bv = [b._value for b in self._buffers]
+        sjit = self._get_scatter(C)
+        lg = None
+        for ci in range(n_chunks):
+            off = ci * C
+            lg, rows, work = self._chunk_jit(
+                pv, bv, work, jnp.asarray(ids_pad[:, off:off + C]),
+                jnp.int32(off))
+            # scatter this chunk's rows into the pages after page off/ps
+            k0 = off // self.page_size
+            sub_bt = np.zeros(self.pps, np.int32)
+            sub_bt[:self.pps - k0] = self._bt[slot, k0:]
+            self._kv = sjit(self._kv, rows, jnp.asarray(sub_bt),
+                            jnp.int32(min(C, p_len - off)))
+        if self._sample_jit is None:
+            from .generation import _sample_token
+            strat, temp = self.strategy, self.temperature
+            tk, tp = self.top_k, self.top_p
+            self._sample_jit = jax.jit(
+                lambda row, key: _sample_token(row[None], key, strat,
+                                               temp, tk, tp)[0][0])
+        last_local = p_len - (n_chunks - 1) * C
+        return int(self._sample_jit(lg[last_local - 1],
+                                    self._next_keys()))
+
+    def _build_chunk_prefill(self, C: int):
+        """One program for EVERY chunk of EVERY long prompt: the offset
+        is traced, so no per-length or per-offset recompiles."""
+        model = self.model
+        params, buffers = self._params, self._buffers
+
+        def run(pv, bv, work, ids, off):
+            from .generation import bind_state
+            with bind_state(params, buffers, pv, bv), no_grad():
+                pkv = [(Tensor(k), Tensor(v)) for k, v in work]
+                logits, new = model.forward(
+                    Tensor(ids), past_key_values=pkv,
+                    position_offset=Tensor(off), use_cache=True)
+                rows = [
+                    (jax.lax.dynamic_slice_in_dim(k._value[0], off, C, 0),
+                     jax.lax.dynamic_slice_in_dim(v._value[0], off, C, 0))
+                    for k, v in new]
+                return (logits._value[0],
+                        rows,
+                        [(k._value, v._value) for k, v in new])
+
+        return jax.jit(run, donate_argnums=(2,))
 
     def _get_suffix_prefill(self, shared_len: int, bucket: int):
         key = (shared_len, bucket)

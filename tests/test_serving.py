@@ -1010,3 +1010,46 @@ class TestNoRepeatNgram:
         grams = list(zip(seq, seq[1:]))
         assert len(grams) == len(set(grams)), seq
         assert np.isfinite(float(score[0]))
+
+
+class TestChunkedPrefill:
+    """Chunked prefill (≙ vLLM chunked prefill): long prompts run
+    through ONE fixed-size chunk program with traced offsets instead of
+    minting per-bucket programs. Oracle: the default bucketed engine."""
+
+    def _model(self):
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                          intermediate_size=128, num_hidden_layers=2,
+                          num_attention_heads=4, num_key_value_heads=2,
+                          max_position_embeddings=128)
+        paddle.seed(13)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return cfg, m
+
+    def test_matches_bucketed_engine(self):
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        cfg, m = self._model()
+        rng = np.random.default_rng(7)
+        # short (bucket path), exact multiple, ragged, long
+        prompts = [list(rng.integers(1, cfg.vocab_size, p))
+                   for p in (5, 16, 23, 40)]
+        outs = {}
+        for chunk in (None, 16):
+            eng = ContinuousBatchingEngine(
+                m, max_batch_size=2, max_seq_len=96, page_size=8,
+                prompt_pad=8, prefill_chunk=chunk)
+            rids = [eng.add_request(p, 6) for p in prompts]
+            res = eng.run()
+            outs[chunk] = [res[r] for r in rids]
+            if chunk:
+                # long prompts minted no per-bucket programs: only the
+                # short prompt (5 <= chunk) used the bucket path
+                assert len(eng._prefill_jits) <= 1
+        assert outs[16] == outs[None]
+
+    def test_chunk_must_align_to_pages(self):
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        cfg, m = self._model()
+        with pytest.raises(ValueError, match="multiple of page_size"):
+            ContinuousBatchingEngine(m, page_size=8, prefill_chunk=12)
