@@ -36,13 +36,61 @@ from paddle_tpu.autograd import no_grad
 from .generation import bind_state
 
 
+def _spec_accept(p_logp, q_logp, props, key):
+    """Rejection-sampling acceptance core (Leviathan et al.): given the
+    target's log-probs `p_logp` (R, K+1, V) over positions 0..K, the
+    draft's log-probs `q_logp` (R, K, V) for its proposals `props`
+    (R, K), decide per row how many proposals survive and what the
+    replacement/bonus token is. Returns (j (R,) accepted count,
+    repl (R,) token emitted after the accepted prefix).
+
+    Proposal i is accepted with prob min(1, p_i/q_i); at the first
+    rejection the token is resampled from norm(max(p_i - q_i, 0));
+    after a full accept the bonus samples from p_K. The emitted
+    distribution provably equals target-only sampling for ANY draft."""
+    r, K = props.shape
+    ku, kr = jax.random.split(key)
+    u = jax.random.uniform(ku, (r, K))
+    p_at = jnp.take_along_axis(p_logp[:, :K], props[:, :, None],
+                               2)[:, :, 0]                   # (R, K)
+    q_at = jnp.take_along_axis(q_logp, props[:, :, None], 2)[:, :, 0]
+    accept = u < jnp.exp(jnp.minimum(p_at - q_at, 0.0))      # (R, K)
+    j = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), 1), 1)  # (R,)
+    # residual distribution at the rejection point (j == K -> bonus
+    # position K, where the residual IS p_K since q is absent there)
+    sel = jnp.minimum(j, K)
+    p_j = jnp.take_along_axis(
+        p_logp, sel[:, None, None], 1)[:, 0]                 # (R, V)
+    q_j = jnp.where(
+        (j < K)[:, None],
+        jnp.take_along_axis(q_logp, jnp.minimum(j, K - 1)[:, None, None],
+                            1)[:, 0],
+        -jnp.inf)                                            # (R, V)
+    resid = jnp.maximum(jnp.exp(p_j) - jnp.exp(q_j), 0.0)
+    # degenerate all-zero residual (p == q exactly): fall back to p_j
+    resid = jnp.where(
+        (jnp.sum(resid, -1, keepdims=True) > 0), resid, jnp.exp(p_j))
+    repl = jax.random.categorical(
+        kr, jnp.log(jnp.maximum(resid, 1e-38)), axis=-1).astype(jnp.int32)
+    return j, repl
+
+
 def speculative_generate(target, draft, input_ids,
                          max_new_tokens: int = 32,
                          num_draft_tokens: int = 4,
                          eos_token_id: int | None = None,
-                         max_cache_len: int | None = None):
-    """Greedy speculative decode. Returns (ids (B, max_new_tokens),
+                         max_cache_len: int | None = None,
+                         do_sample: bool = False,
+                         temperature: float = 1.0):
+    """Speculative decode. Returns (ids (B, max_new_tokens),
     acceptance_rate scalar — mean fraction of drafted tokens accepted).
+
+    do_sample=False: greedy matching — the output equals target-only
+    greedy EXACTLY. do_sample=True: rejection sampling (Leviathan et
+    al.) — proposals are sampled from the draft and accepted with prob
+    min(1, p/q); the emitted DISTRIBUTION equals target-only sampling
+    at `temperature` for any draft (trajectories differ — the key
+    stream is spent differently).
 
     `target` and `draft` must share a vocabulary (hidden sizes/depths
     may differ — each keeps its own KV cache)."""
@@ -52,6 +100,10 @@ def speculative_generate(target, draft, input_ids,
             f"{draft.config.vocab_size}")
     if num_draft_tokens < 1:
         raise ValueError("num_draft_tokens must be >= 1")
+    if do_sample and temperature <= 0:
+        raise ValueError(
+            f"temperature must be > 0 with do_sample, got {temperature} "
+            "(use do_sample=False for deterministic greedy)")
     ids = input_ids if isinstance(input_ids, Tensor) \
         else Tensor(jnp.asarray(input_ids, jnp.int32))
     b, prompt_len = ids.shape
@@ -67,29 +119,38 @@ def speculative_generate(target, draft, input_ids,
     t_params, t_buffers = list(target.parameters()), list(target.buffers())
     d_params, d_buffers = list(draft.parameters()), list(draft.buffers())
 
-    sig = (b, prompt_len, n_new, K, cache_len, eos_token_id)
+    # temperature is dead weight under greedy (argmax is invariant):
+    # normalize it out of the program-cache key to avoid recompiles
+    sig = (b, prompt_len, n_new, K, cache_len, eos_token_id,
+           bool(do_sample), float(temperature) if do_sample else 1.0)
     cache = getattr(target, "_spec_cache", None)
     if cache is None or cache[0] != sig or cache[1] is not draft:
         jitted = _build_spec(target, draft, sig)
         target._spec_cache = (sig, draft, jitted)
     else:
         jitted = cache[2]
+    if do_sample:
+        from paddle_tpu.tensor.random import default_generator
+        key = default_generator.next_key()
+    else:
+        # greedy never uses the key; don't perturb the global stream
+        key = jax.random.PRNGKey(0)
     toks, acc = jitted([p._value for p in t_params],
                        [x._value for x in t_buffers],
                        [p._value for p in d_params],
                        [x._value for x in d_buffers],
-                       ids._value.astype(jnp.int32))
+                       ids._value.astype(jnp.int32), key)
     return Tensor(toks), Tensor(acc)
 
 
 def _build_spec(target, draft, sig):
-    b, prompt_len, n_new, K, cache_len, eos = sig
+    b, prompt_len, n_new, K, cache_len, eos, sample, temp = sig
     t_params, t_buffers = list(target.parameters()), list(target.buffers())
     d_params, d_buffers = list(draft.parameters()), list(draft.buffers())
     PAD = 0
     trash = n_new + K          # out buffer slack column for rejected lanes
 
-    def run(tpv, tbv, dpv, dbv, ids_v):
+    def run(tpv, tbv, dpv, dbv, ids_v, key):
         with bind_state(t_params, t_buffers, tpv, tbv), \
                 bind_state(d_params, d_buffers, dpv, dbv), no_grad():
             t_dt, d_dt = tpv[0].dtype, dpv[0].dtype
@@ -100,7 +161,14 @@ def _build_spec(target, draft, sig):
                 b, cache_len, d_dt, ids_v)
             t_caches = tuple((k._value, v._value) for k, v in t_caches)
             d_caches = tuple((k._value, v._value) for k, v in d_caches)
-            tok0 = jnp.argmax(t_logits._value[:, -1], -1).astype(jnp.int32)
+            if sample:
+                key, k0 = jax.random.split(key)
+                tok0 = jax.random.categorical(
+                    k0, t_logits._value[:, -1].astype(jnp.float32)
+                    / temp, axis=-1).astype(jnp.int32)
+            else:
+                tok0 = jnp.argmax(t_logits._value[:, -1],
+                                  -1).astype(jnp.int32)
             out = jnp.full((b, n_new + K + 1), PAD, jnp.int32)
             out = out.at[:, 0].set(tok0)
             n = jnp.ones((b,), jnp.int32)          # tokens emitted so far
@@ -111,28 +179,44 @@ def _build_spec(target, draft, sig):
             accepted_total = jnp.int32(0)
 
             def cond(carry):
-                _, _, _, n, _, fin, last, _, _ = carry
+                _, _, _, n, _, fin, last, _, _, _ = carry
                 return jnp.any(~fin & (n < n_new))
 
             def body(carry):
                 t_caches, d_caches, out, n, pos, fin, last, drafted, \
-                    acc_tot = carry
+                    acc_tot, key = carry
+                key, k_draft, k_round = jax.random.split(key, 3)
 
-                # 1) draft proposes K greedy tokens, consuming `last`
-                def dstep(c, _):
+                # 1) draft proposes K tokens, consuming `last` (greedy,
+                # or sampled from q at `temp` with q_logp recorded for
+                # the rejection test)
+                def dstep(c, kk):
                     d_caches, tok, p = c
                     pkv = [(Tensor(kc), Tensor(vc)) for kc, vc in d_caches]
                     lg, ncaches = draft.forward(
                         Tensor(tok[:, None]), past_key_values=pkv,
                         position_offset=Tensor(p), use_cache=True)
-                    nxt = jnp.argmax(lg._value[:, 0], -1).astype(jnp.int32)
+                    if sample:
+                        logp = jax.nn.log_softmax(
+                            lg._value[:, 0].astype(jnp.float32) / temp)
+                        nxt = jax.random.categorical(
+                            kk, logp, axis=-1).astype(jnp.int32)
+                    else:
+                        # argmax is invariant under log_softmax/temp —
+                        # skip the full-vocab f32 pass in the hot loop
+                        logp = jnp.zeros(
+                            (lg.shape[0], lg.shape[-1]), jnp.float32)
+                        nxt = jnp.argmax(lg._value[:, 0],
+                                         -1).astype(jnp.int32)
                     ncv = tuple((kc._value, vc._value) for kc, vc in
                                 ncaches)
-                    return (ncv, nxt, p + 1), nxt
+                    return (ncv, nxt, p + 1), (nxt, logp)
 
-                (d_caches, _, _), props = jax.lax.scan(
-                    dstep, (d_caches, last, pos), None, length=K)
+                (d_caches, _, _), (props, q_logp) = jax.lax.scan(
+                    dstep, (d_caches, last, pos),
+                    jax.random.split(k_draft, K))
                 props = props.T                     # (B, K)
+                q_logp = jnp.swapaxes(q_logp, 0, 1)  # (B, K, V)
 
                 # 2) target verifies [last, p1..pK] in ONE forward
                 x = jnp.concatenate([last[:, None], props], 1)  # (B, K+1)
@@ -156,14 +240,20 @@ def _build_spec(target, draft, sig):
                     position_offset=Tensor(pos + K), use_cache=True)
                 d_caches = tuple((kc._value, vc._value)
                                  for kc, vc in d_new)
-                g = jnp.argmax(v_logits._value, -1).astype(
-                    jnp.int32)                      # (B, K+1)
-
-                # 3) accept the longest matching prefix + bonus token
-                match = props == g[:, :K]           # (B, K)
-                j = jnp.sum(jnp.cumprod(match.astype(jnp.int32), 1),
-                            1)                      # (B,) accepted count
-                bonus = jnp.take_along_axis(g, j[:, None], 1)[:, 0]
+                # 3) acceptance: greedy prefix-match + argmax bonus, or
+                # rejection sampling with a residual-distribution draw
+                if sample:
+                    p_logp = jax.nn.log_softmax(
+                        v_logits._value.astype(jnp.float32) / temp)
+                    j, bonus = _spec_accept(p_logp, q_logp, props,
+                                            k_round)
+                else:
+                    g = jnp.argmax(v_logits._value, -1).astype(
+                        jnp.int32)                  # (B, K+1)
+                    match = props == g[:, :K]       # (B, K)
+                    j = jnp.sum(jnp.cumprod(match.astype(jnp.int32), 1),
+                                1)                  # (B,) accepted count
+                    bonus = jnp.take_along_axis(g, j[:, None], 1)[:, 0]
                 i_ar = jnp.arange(K + 1)[None, :]
                 tokmat = jnp.where(
                     i_ar < j[:, None],
@@ -198,11 +288,11 @@ def _build_spec(target, draft, sig):
                 drafted = drafted + K * jnp.sum(
                     (~fin).astype(jnp.int32))
                 return (t_caches, d_caches, out, n, pos, new_fin, last,
-                        drafted, acc_tot)
+                        drafted, acc_tot, key)
 
             carry = (t_caches, d_caches, out, n, pos, fin, tok0,
-                     drafted_total, accepted_total)
-            (_, _, out, n, pos, fin, _, drafted, acc_tot) = \
+                     drafted_total, accepted_total, key)
+            (_, _, out, n, pos, fin, _, drafted, acc_tot, _) = \
                 jax.lax.while_loop(cond, body, carry)
             acc_rate = acc_tot.astype(jnp.float32) / jnp.maximum(
                 drafted, 1)

@@ -948,6 +948,43 @@ class TestSpeculativeDecoding:
         assert seq[0] == eos
         assert all(x == 0 for x in seq[1:]), seq   # PAD after EOS
 
+    def test_rejection_sampling_first_token_distribution(self):
+        """The Leviathan guarantee, tested directly on _spec_accept:
+        whatever the draft q, the first emitted token's marginal must
+        equal the target p. 200k vectorized draws vs closed form."""
+        from paddle_tpu.models.speculative import _spec_accept
+        rng = np.random.default_rng(0)
+        V, K, N = 8, 2, 200_000
+        p = rng.dirichlet(np.ones(V), size=K + 1)    # target rows
+        q = rng.dirichlet(np.ones(V) * 0.4, size=K)  # skewed draft rows
+        p_logp = jnp.log(jnp.asarray(p, jnp.float32))[None]
+        q_logp = jnp.log(jnp.asarray(q, jnp.float32))[None]
+
+        def one(key):
+            kq, ka = jax.random.split(key)
+            props = jax.random.categorical(
+                kq, q_logp[0], axis=-1).astype(jnp.int32)[None]  # (1, K)
+            j, repl = _spec_accept(p_logp, q_logp, props, ka)
+            return jnp.where(j[0] >= 1, props[0, 0], repl[0])
+
+        keys = jax.random.split(jax.random.PRNGKey(7), N)
+        toks = np.asarray(jax.jit(jax.vmap(one))(keys))
+        freq = np.bincount(toks, minlength=V) / N
+        np.testing.assert_allclose(freq, p[0], atol=0.006)
+
+    def test_sampling_near_zero_temperature_equals_greedy(self):
+        from paddle_tpu.models.speculative import speculative_generate
+        t, d = self._models()
+        ids = np.array([[4, 8, 15]], np.int32)
+        n = 10
+        want, _ = t.generate(paddle.to_tensor(ids), max_new_tokens=n)
+        got, _ = speculative_generate(t, d, paddle.to_tensor(ids),
+                                      max_new_tokens=n,
+                                      num_draft_tokens=3, do_sample=True,
+                                      temperature=1e-4)
+        np.testing.assert_array_equal(np.asarray(got._value),
+                                      np.asarray(want._value))
+
     def test_vocab_mismatch_raises(self):
         from paddle_tpu.models.speculative import speculative_generate
         t, _ = self._models()
