@@ -7,7 +7,8 @@ from . import bert  # noqa: F401
 
 def __getattr__(name):
     import importlib
-    if name in ("llama", "llama_pipe", "moe", "dit", "gpt"):
+    if name in ("llama", "llama_pipe", "moe", "dit", "gpt", "serving",
+                "speculative", "generation", "ernie"):
         mod = importlib.import_module("." + name, __name__)
         globals()[name] = mod
         return mod
