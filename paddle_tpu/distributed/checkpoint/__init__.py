@@ -59,6 +59,11 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     """Write a (possibly nested) state_dict of Tensors/arrays as a sharded
     orbax checkpoint at `path`. Sharded tensors write only their owned
     shards per host."""
+    # chaos site: fires BEFORE any byte is written, so an injected save
+    # failure leaves no partial checkpoint (the .done marker protocol in
+    # fleet.elastic then ignores interrupted step directories)
+    from ...utils.faults import fault_point
+    fault_point("checkpoint.save")
     import orbax.checkpoint as ocp
     flat = _values(_flatten(state_dict))
     path = os.path.abspath(path)

@@ -113,11 +113,15 @@ class HeartbeatMembership:
     """
 
     def __init__(self, dir: str, rank: Optional[int] = None,
-                 interval: float = 1.0, timeout: float = 5.0):
+                 interval: float = 1.0, timeout: float = 5.0,
+                 clock=None):
         self.dir = dir
         self.rank = rank
         self.interval = interval
         self.timeout = timeout
+        # injectable clock: deterministic freshness tests (the clock
+        # only feeds the mtime comparison, never the beat contents)
+        self._clock = clock if clock is not None else time.time
         self._stop = False
         self._thread = None
         self._last_alive: set = set()
@@ -172,22 +176,40 @@ class HeartbeatMembership:
         return False
 
     # -- watcher side --------------------------------------------------
+    @staticmethod
+    def _beat_valid(path: str) -> bool:
+        """A beat counts only if its payload parses as a timestamp.
+        Our writer is atomic (tmp + rename), but on filesystems without
+        atomic rename (some network/FUSE mounts) — or with foreign
+        writers — a reader can observe a truncated/empty file. Treat
+        any such corrupt beat as STALE rather than raising: a mid-write
+        worker will land a valid beat within one interval, and a watcher
+        crash-looping on a garbage file would be strictly worse."""
+        try:
+            with open(path) as f:
+                float(f.read().strip())
+            return True
+        except (OSError, ValueError):
+            return False
+
     def alive(self) -> set:
         """Ranks with a fresh heartbeat. Freshness uses the heartbeat
         file's mtime (stamped by the filesystem, which on a shared FS is
         the server clock) rather than the writer's embedded timestamp —
-        cross-host clock skew must not misclassify live workers."""
-        now = time.time()
+        cross-host clock skew must not misclassify live workers. A beat
+        exactly `timeout` old still counts; corrupt beats never do."""
+        now = self._clock()
         out = set()
         for name in os.listdir(self.dir):
             m = re.fullmatch(r"worker_(\d+)\.hb", name)
             if not m:
                 continue
+            path = os.path.join(self.dir, name)
             try:
-                ts = os.stat(os.path.join(self.dir, name)).st_mtime
+                ts = os.stat(path).st_mtime
             except OSError:
                 continue
-            if now - ts <= self.timeout:
+            if now - ts <= self.timeout and self._beat_valid(path):
                 out.add(int(m.group(1)))
         return out
 

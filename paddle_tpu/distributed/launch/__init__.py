@@ -13,12 +13,13 @@ SURVEY.md §5 "Failure detection").
 from __future__ import annotations
 
 import os
+import random
 import runpy
 import subprocess
 import sys
 import time
 
-__all__ = ["main", "launch"]
+__all__ = ["main", "launch", "restart_backoff"]
 
 
 def _parse(argv):
@@ -41,6 +42,13 @@ def _parse(argv):
                    help=">0: restart the script on failure (checkpoint-"
                         "restart elasticity), up to --max_restart times")
     p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--restart_backoff", type=float, default=1.0,
+                   help="base seconds between restarts; doubles per "
+                        "consecutive failure with +/-50%% jitter so a "
+                        "crash-looping fleet does not hammer the "
+                        "coordinator in lockstep (0 disables)")
+    p.add_argument("--restart_backoff_max", type=float, default=60.0,
+                   help="backoff ceiling in seconds")
     p.add_argument("--devices", default=None,
                    help="ignored on TPU (all host chips attach to the one "
                         "process); kept for CLI compat")
@@ -78,13 +86,31 @@ def _run_logged(cmd, env, log_path):
         return proc.wait()
 
 
-def launch(args):
+def restart_backoff(attempt: int, base: float, cap: float,
+                    rng: random.Random) -> float:
+    """Delay before restart `attempt` (1-based): exponential
+    base * 2^(attempt-1) with +/-50% multiplicative jitter (restarting
+    ranks decorrelate instead of stampeding the rendezvous coordinator
+    in lockstep), clamped to `cap` AFTER jitter — the cap is a hard
+    ceiling."""
+    if base <= 0:
+        return 0.0
+    return min(cap, base * (2.0 ** (attempt - 1)) * (0.5 + rng.random()))
+
+
+def launch(args, *, sleep=time.sleep, rng: random.Random | None = None):
     env = _child_env(args)
     log_path = None
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
         log_path = os.path.join(
             args.log_dir, f"{args.job_id}.rank{args.rank}.log")
+    # duck-typed args objects (tests, embedders) predate the backoff
+    # knobs: default them to NO backoff so legacy callers keep their
+    # immediate-restart behavior (CLI users get 1.0 from argparse)
+    base = getattr(args, "restart_backoff", 0.0)
+    cap = getattr(args, "restart_backoff_max", 60.0)
+    rng = rng if rng is not None else random.Random()
     attempt = 0
     while True:
         t0 = time.time()
@@ -95,13 +121,17 @@ def launch(args):
         attempt += 1
         if args.elastic_level <= 0 or attempt > args.max_restart:
             return rc
+        delay = restart_backoff(attempt, base, cap, rng)
         msg = (f"[launch] script exited {rc} after "
                f"{time.time() - t0:.0f}s — restart {attempt}/"
-               f"{args.max_restart} (elastic checkpoint-restart)")
+               f"{args.max_restart} in {delay:.1f}s (elastic "
+               "checkpoint-restart, exponential backoff)")
         print(msg, file=sys.stderr)
         if log_path:
             with open(log_path, "a") as f:
                 f.write(msg + "\n")
+        if delay > 0:
+            sleep(delay)
 
 
 def main(argv=None):

@@ -28,6 +28,26 @@ from paddle_tpu.tensor.random import default_generator
 NEG_INF = -1e30
 
 
+class RequestStatus:
+    """Request lifecycle states shared by the serving engine and any
+    generation-level caller that tracks in-flight work (≙ the reference
+    serving stack's per-request state machine). A request is QUEUED on
+    admission-queue entry, RUNNING while it owns a slot, and ends in
+    exactly one terminal state: FINISHED (eos / max_new_tokens / cache
+    end), TIMEOUT (deadline or max_queue_time expired), FAILED (prefill
+    or dispatch error — the engine keeps serving others), or PREEMPTED
+    (evicted for pool pressure more than `max_preemptions` times —
+    the starvation guard)."""
+
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    TIMEOUT = "timeout"
+    FAILED = "failed"
+    PREEMPTED = "preempted"
+    TERMINAL = frozenset({FINISHED, TIMEOUT, FAILED, PREEMPTED})
+
+
 def _sample_token(logits, key, strategy, temperature, top_k, top_p):
     """logits: (B, V) f32 -> (tokens (B,), log-prob of chosen (B,))."""
     logits = logits.astype(jnp.float32)

@@ -38,12 +38,29 @@ rows) — TPU-native:
   so resident KV is bounded by the window, not the sequence.
 * `kv_layout="dense"` keeps the previous per-slot contiguous caches
   (also the parity oracle for the paged path).
+* REQUEST LIFECYCLE HARDENING (≙ production TPU serving stacks, which
+  treat KV-pool exhaustion and preemption as first-class events): a
+  monotonic-clock tick per step expires requests past their deadline /
+  max_queue_time (status `timeout`); `max_waiting` bounds the admission
+  queue with explicit backpressure (`EngineOverloaded`) plus an
+  `admission_policy` hook; a failed prefill finalizes only THAT request
+  (status `failed`) and the engine keeps serving; decode-time page
+  exhaustion preempts the youngest running request — its pages are
+  released and it re-enters the queue head with generated tokens folded
+  into the re-prefill prompt (prefix caching makes that cheap), with a
+  starvation guard after `max_preemptions` evictions. `fault_point()`
+  sites (`serving.alloc_page` / `serving.prefill` / `serving.decode`)
+  make every failure branch forcible by deterministic chaos tests on the
+  CPU mesh, and `check_invariants()` (every step under
+  `PDT_CHECK_INVARIANTS=1`) proves page accounting stays consistent.
 """
 from __future__ import annotations
 
+import os
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 import jax
@@ -51,8 +68,27 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..autograd import no_grad
+from ..utils.faults import FaultError, fault_point
+from .generation import RequestStatus
 
-__all__ = ["ContinuousBatchingEngine", "Request"]
+__all__ = ["ContinuousBatchingEngine", "Request", "RequestStatus",
+           "EngineOverloaded", "PoolExhausted", "EngineInvariantError"]
+
+
+class EngineOverloaded(RuntimeError):
+    """add_request refused: the bounded admission queue is full or the
+    admission policy rejected the request. Callers shed load or retry
+    later (≙ a serving front end's 429)."""
+
+
+class PoolExhausted(RuntimeError):
+    """A KV page allocation could not be satisfied even after prefix-
+    cache eviction. Admission reservation makes this unreachable on the
+    healthy path; decode-time growth converts it into preemption."""
+
+
+class EngineInvariantError(AssertionError):
+    """check_invariants() found inconsistent page accounting."""
 
 
 @dataclass
@@ -62,6 +98,12 @@ class Request:
     max_new_tokens: int
     output: List[int] = field(default_factory=list)
     done: bool = False
+    status: str = RequestStatus.QUEUED
+    deadline: Optional[float] = None     # absolute engine-clock time
+    max_queue_time: Optional[float] = None
+    enqueue_time: float = 0.0
+    preemptions: int = 0
+    error: Optional[str] = None
 
 
 class ContinuousBatchingEngine:
@@ -84,7 +126,16 @@ class ContinuousBatchingEngine:
                  max_prefill_programs: int = 8,
                  enable_prefix_caching: bool = False,
                  max_prefix_entries: int = 32,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 max_waiting: Optional[int] = None,
+                 request_timeout: Optional[float] = None,
+                 max_queue_time: Optional[float] = None,
+                 max_preemptions: int = 3,
+                 max_decode_retries: int = 3,
+                 admission_policy: Optional[
+                     Callable[["ContinuousBatchingEngine", Request],
+                              bool]] = None,
+                 clock: Optional[Callable[[], float]] = None):
         cfg = model.config
         self.model = model
         self.B = int(max_batch_size)
@@ -213,12 +264,38 @@ class ContinuousBatchingEngine:
         self._slot_req: List[Optional[Request]] = [None] * self.B
         self._queue: List[Request] = []
         self._next_rid = 0
+        # -- request-lifecycle robustness (deadlines / backpressure /
+        # preemption — module docstring, last bullet) ------------------
+        self.max_waiting = None if max_waiting is None else int(max_waiting)
+        self.request_timeout = request_timeout
+        self.max_queue_time = max_queue_time
+        self.max_preemptions = int(max_preemptions)
+        self.max_decode_retries = int(max_decode_retries)
+        self.admission_policy = admission_policy
+        self._clock = clock if clock is not None else time.monotonic
+        self.num_timeouts = 0
+        self.num_failures = 0
+        self.num_preemptions = 0
+        self.num_decode_retries = 0
+        self._consec_decode_faults = 0
+        self._finished_backlog: List[Request] = []
+        self._admit_seq = 0                 # global admission order
+        self._slot_seq = np.zeros(self.B, np.int64)
         self._decode_jit = None
         self._insert_jit = None
         self._prefill_jits: "OrderedDict[int, object]" = OrderedDict()
 
     # -- public API ----------------------------------------------------
-    def add_request(self, prompt, max_new_tokens: int = 32) -> int:
+    def add_request(self, prompt, max_new_tokens: int = 32,
+                    deadline: Optional[float] = None,
+                    max_queue_time: Optional[float] = None) -> int:
+        """Queue a request. `deadline` is a completion budget in seconds
+        from now on the engine's monotonic clock (overrides the engine
+        `request_timeout` default); `max_queue_time` bounds time spent
+        WAITING for a slot. Expired requests finalize with status
+        `timeout` at the next step tick. Raises EngineOverloaded when
+        the bounded queue is full (`max_waiting`) or the admission
+        policy rejects the request."""
         toks = [int(t) for t in np.asarray(prompt).ravel()]
         if not toks:
             raise ValueError("empty prompt")
@@ -229,7 +306,18 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prompt length {len(toks)} does not fit max_seq_len "
                 f"{self.S} (need at least one decode position)")
-        r = Request(self._next_rid, toks, int(max_new_tokens))
+        if self.max_waiting is not None \
+                and len(self._queue) >= self.max_waiting:
+            raise EngineOverloaded(
+                f"admission queue full ({self.max_waiting} waiting) — "
+                "shed load or retry after in-flight requests drain")
+        now = self._clock()
+        budget = deadline if deadline is not None else self.request_timeout
+        r = Request(self._next_rid, toks, int(max_new_tokens),
+                    enqueue_time=now,
+                    deadline=None if budget is None else now + budget,
+                    max_queue_time=max_queue_time
+                    if max_queue_time is not None else self.max_queue_time)
         if self.layout == "paged":
             usable = self.num_pages - 1
             need = self._worst_pages(r)
@@ -240,6 +328,11 @@ class ContinuousBatchingEngine:
                     f"page_size {self.page_size}) but the pool has only "
                     f"{usable} usable pages — it could never be "
                     f"admitted; raise num_pages")
+        if self.admission_policy is not None \
+                and not self.admission_policy(self, r):
+            raise EngineOverloaded(
+                f"admission policy rejected request (prompt {len(toks)} "
+                f"tokens, max_new_tokens {max_new_tokens})")
         self._next_rid += 1
         self._queue.append(r)
         return r.rid
@@ -256,24 +349,99 @@ class ContinuousBatchingEngine:
     def step(self) -> List[Request]:
         """Admit waiting requests into free slots, decode ONE token for
         every active slot, release finished slots. Returns the requests
-        that finished this step."""
-        finished = self._admit()
-        active = [i for i, r in enumerate(self._slot_req)
-                  if r is not None]
-        if not active:
-            return finished
-        self._decode()
-        for i in active:
-            r = self._slot_req[i]
-            tok = int(self._tok[i])
-            r.output.append(tok)
-            hit_eos = self.eos is not None and tok == self.eos
-            if hit_eos or len(r.output) >= r.max_new_tokens \
-                    or int(self._pos[i]) >= self.S - 1:
-                r.done = True
-                finished.append(r)
+        that reached a TERMINAL state this step (finished / timeout /
+        failed / preempted-out — check `.status`). One monotonic-clock
+        tick per step drives deadline and queue-time expiry."""
+        finished = self._finished_backlog + self._expire()
+        self._finished_backlog = []
+        try:
+            finished += self._admit()
+            active = [i for i, r in enumerate(self._slot_req)
+                      if r is not None]
+            if active:
+                try:
+                    # _decode appends starvation-guard finalizations
+                    # into `finished` BEFORE its dispatch, so they
+                    # survive an injected dispatch fault below
+                    self._decode(finished)
+                except FaultError:
+                    # transient dispatch fault: it fires BEFORE the
+                    # compiled step runs, so slot/page state is
+                    # consistent and the next step() simply retries —
+                    # bounded so an always-on fault cannot livelock
+                    # run()
+                    self.num_decode_retries += 1
+                    self._consec_decode_faults += 1
+                    if self._consec_decode_faults \
+                            > self.max_decode_retries:
+                        raise
+                    if self._invariants_enabled():
+                        self.check_invariants()
+                    return finished
+                self._consec_decode_faults = 0
+                for i in active:
+                    r = self._slot_req[i]
+                    if r is None:
+                        continue    # preempted/finalized during decode
+                    tok = int(self._tok[i])
+                    r.output.append(tok)
+                    hit_eos = self.eos is not None and tok == self.eos
+                    if hit_eos or len(r.output) >= r.max_new_tokens \
+                            or int(self._pos[i]) >= self.S - 1:
+                        self._finalize(r, RequestStatus.FINISHED, None,
+                                       finished)
+                        self._release_slot(i)
+        except BaseException:
+            # ANY escaping error: requests already finalized this step
+            # must not be lost in the raise — the next step() (if the
+            # caller keeps going) delivers them
+            self._finished_backlog = finished
+            raise
+        if self._invariants_enabled():
+            self.check_invariants()
+        return finished
+
+    def lifecycle_info(self) -> Dict[str, int]:
+        """Robustness counters + queue depth (≙ serving-stack SLO
+        telemetry)."""
+        return {"waiting": len(self._queue),
+                "running": sum(r is not None for r in self._slot_req),
+                "timeouts": self.num_timeouts,
+                "failures": self.num_failures,
+                "preemptions": self.num_preemptions,
+                "decode_retries": self.num_decode_retries}
+
+    def _expire(self) -> List[Request]:
+        """Monotonic-clock tick: finalize queued/running requests whose
+        deadline (or queue-time budget) has passed. Granularity is one
+        engine step — a request never decodes past the step in which
+        its deadline elapsed."""
+        now = self._clock()
+        finished: List[Request] = []
+        keep: List[Request] = []
+        for req in self._queue:
+            if (req.deadline is not None and now >= req.deadline) \
+                    or (req.max_queue_time is not None
+                        and now - req.enqueue_time >= req.max_queue_time):
+                self.num_timeouts += 1
+                self._finalize(req, RequestStatus.TIMEOUT,
+                               "expired while waiting for a slot",
+                               finished)
+            else:
+                keep.append(req)
+        self._queue = keep
+        for i, req in enumerate(self._slot_req):
+            if req is not None and req.deadline is not None \
+                    and now >= req.deadline:
+                self.num_timeouts += 1
+                self._finalize(req, RequestStatus.TIMEOUT,
+                               "deadline expired mid-decode", finished)
                 self._release_slot(i)
         return finished
+
+    def _invariants_enabled(self) -> bool:
+        # read dynamically so test fixtures can flip it per-module
+        return os.environ.get("PDT_CHECK_INVARIANTS") == "1"
 
     def cache_memory_info(self) -> Dict[str, float]:
         """KV-cache HBM accounting. For the paged layout `bytes_in_use`
@@ -301,12 +469,98 @@ class ContinuousBatchingEngine:
                         prefix_tokens_reused=self.prefix_tokens_reused)
         return info
 
+    def check_invariants(self):
+        """Page-accounting invariant checker (runs after every step
+        under `PDT_CHECK_INVARIANTS=1`): every page's refcount equals
+        its holder count (slot-owned + slot-attached + prefix-trie
+        nodes), the free list is duplicate-free and is EXACTLY the
+        rc==0 pages (no leaks after `_release_slot`, no premature
+        frees), released slots hold nothing, and each active slot's
+        live block-table window points only at allocated pages while
+        everything outside it trash-routes to page 0. Raises
+        EngineInvariantError listing every violation."""
+        if self.layout != "paged":
+            return
+        errs: List[str] = []
+        free = list(self._free)
+        free_set = set(free)
+        if len(free_set) != len(free):
+            errs.append(f"free list has duplicates: {sorted(free)}")
+        if 0 in free_set:
+            errs.append("reserved trash page 0 is on the free list")
+        expected = np.zeros(self.num_pages, np.int64)
+        for i, r in enumerate(self._slot_req):
+            if r is None and (self._slot_pages[i]
+                              or self._slot_shared_pages[i]
+                              or np.any(self._bt[i] != 0)):
+                errs.append(
+                    f"released slot {i} still holds pages "
+                    f"{self._slot_pages[i]} shared "
+                    f"{self._slot_shared_pages[i]} or a nonzero "
+                    "block-table row")
+            for p in self._slot_pages[i]:
+                expected[p] += 1
+            for p in self._slot_shared_pages[i]:
+                expected[p] += 1
+        for node in self._prefix_nodes.values():
+            expected[node["page"]] += 1
+        for p in range(1, self.num_pages):
+            rc = int(self._page_rc[p])
+            if rc != int(expected[p]):
+                errs.append(f"page {p}: refcount {rc} != "
+                            f"{int(expected[p])} holders "
+                            "(slots + prefix nodes)")
+            if rc == 0 and p not in free_set:
+                errs.append(f"page {p} LEAKED: refcount 0 but absent "
+                            "from the free list")
+            if rc > 0 and p in free_set:
+                errs.append(f"page {p} on the free list with refcount "
+                            f"{rc}")
+        for i, r in enumerate(self._slot_req):
+            if r is None:
+                continue
+            lo = int(self._slot_freed[i])
+            hi = int(self._slot_next_idx[i])
+            for j in range(self.pps):
+                p = int(self._bt[i, j])
+                if lo <= j < hi:
+                    if p == 0 or int(self._page_rc[p]) < 1:
+                        errs.append(
+                            f"slot {i} block-table[{j}] -> page {p} is "
+                            "not an allocated page")
+                elif p != 0:
+                    errs.append(
+                        f"slot {i} block-table[{j}] = {p} outside the "
+                        f"live window [{lo}, {hi}) must trash-route "
+                        "to 0")
+        if errs:
+            raise EngineInvariantError(
+                "engine invariant violations:\n  " + "\n  ".join(errs))
+
     # -- internals -----------------------------------------------------
-    def _release_slot(self, slot: int):
+    @staticmethod
+    def _finalize(req: Request, status: str, error: Optional[str],
+                  finished: List[Request]):
+        """The one place a request enters a terminal state."""
+        req.done = True
+        req.status = status
+        req.error = error
+        finished.append(req)
+
+    def _effective_prompt(self, req: Request) -> List[int]:
+        """What admission prefills: the original prompt plus everything
+        already generated — a preempted request resumes by re-prefilling
+        its full context (cheap when the prefix cache retained it)."""
+        return req.prompt + req.output if req.output else req.prompt
+
+    def _release_slot(self, slot: int, register: bool = True):
+        # register=False skips prefix registration — a failed prefill
+        # leaves garbage KV in the slot's pages, which must never enter
+        # the shared cache
         req = self._slot_req[slot]
         self._slot_req[slot] = None
         if self.layout == "paged":
-            if self._prefix_enabled and req is not None:
+            if self._prefix_enabled and req is not None and register:
                 # register BEFORE the decrefs so the prompt pages never
                 # transit through the free list
                 self._register_prefix(slot, req)
@@ -386,10 +640,11 @@ class ContinuousBatchingEngine:
         free = [i for i, r in enumerate(self._slot_req) if r is None]
         while free and self._queue:
             req = self._queue[0]
-            p_len = len(req.prompt)
+            prompt = self._effective_prompt(req)
+            p_len = len(prompt)
             shared = None
             if self.layout == "paged" and self._prefix_enabled:
-                shared = self._match_prefix(req.prompt)
+                shared = self._match_prefix(prompt)
                 if shared is not None:
                     # PIN the matched pages before reservation: under
                     # pool pressure _reserve_ok may evict the matched
@@ -406,43 +661,89 @@ class ContinuousBatchingEngine:
                 break                      # FIFO: wait for pages to free
             slot = free.pop(0)
             self._queue.pop(0)
-            if shared:
-                tok = self._admit_shared(slot, req, shared)
-                for p in shared:
-                    self._decref(p)        # unpin: the slot holds refs
-            elif self.layout == "paged" and self._chunk \
-                    and p_len >= self._chunk:
-                tok = self._admit_chunked(slot, req, p_len)
-            else:
-                bucket = self._bucket(max(p_len, 1))
-                jit = self._get_prefill(bucket)
-                ids = np.zeros((1, bucket), np.int32)
-                ids[0, :p_len] = req.prompt
-                tok, rows = jit(
-                    [p._value for p in self._params],
-                    [b._value for b in self._buffers],
-                    jnp.asarray(ids), jnp.int32(p_len), self._next_keys())
-                if self.layout == "paged":
-                    self._paged_insert(slot, req, p_len, bucket, rows)
-                else:
-                    self._dense_insert(slot, rows)
+            # slot ownership is recorded BEFORE dispatch so a failed
+            # prefill can release partially-built slot state uniformly
             self._slot_req[slot] = req
+            req.status = RequestStatus.RUNNING
+            self._slot_seq[slot] = self._admit_seq
+            self._admit_seq += 1
+            try:
+                try:
+                    fault_point("serving.prefill")
+                    if shared:
+                        tok = self._admit_shared(slot, req, prompt,
+                                                 shared)
+                    elif self.layout == "paged" and self._chunk \
+                            and p_len >= self._chunk:
+                        tok = self._admit_chunked(slot, req, p_len,
+                                                  prompt)
+                    else:
+                        bucket = self._bucket(max(p_len, 1))
+                        jit = self._get_prefill(bucket)
+                        ids = np.zeros((1, bucket), np.int32)
+                        ids[0, :p_len] = prompt
+                        tok, rows = jit(
+                            [p._value for p in self._params],
+                            [b._value for b in self._buffers],
+                            jnp.asarray(ids), jnp.int32(p_len),
+                            self._next_keys())
+                        if self.layout == "paged":
+                            self._paged_insert(slot, req, p_len, bucket,
+                                               rows)
+                        else:
+                            self._dense_insert(slot, rows)
+                finally:
+                    if shared:
+                        for p in shared:
+                            self._decref(p)  # unpin: the slot holds refs
+            except PoolExhausted:
+                # admission-time allocation failed (injected, or an
+                # accounting bug): back out and REQUEUE — pages free as
+                # running requests complete — under the same starvation
+                # guard as decode-time preemption. register=False: the
+                # prefilled rows were never scattered into the pages.
+                self._release_slot(slot, register=False)
+                free.insert(0, slot)
+                self._requeue_or_starve(req, finished)
+                if req.done:
+                    continue       # starved out: try the next request
+                break              # pool exhausted: stop admitting
+            except Exception as e:
+                # isolable only while the shared KV is intact: a failure
+                # DURING a donating dispatch (scatter/insert consume the
+                # old buffers) leaves self._kv/_caches deleted, and
+                # "keep serving" would just crash one step later with
+                # the root cause buried — re-raise instead
+                arr = (self._kv if self.layout == "paged"
+                       else self._caches)[0][0]
+                if getattr(arr, "is_deleted", lambda: False)():
+                    raise
+                # isolate the failure: finalize THIS request, free the
+                # slot's partial state, keep serving everything else
+                self.num_failures += 1
+                self._finalize(req, RequestStatus.FAILED,
+                               f"{type(e).__name__}: {e}", finished)
+                self._release_slot(slot, register=False)
+                free.insert(0, slot)
+                continue
             self._pos[slot] = p_len
             self._tok[slot] = int(tok)
             req.output.append(int(tok))
             if (self.eos is not None and int(tok) == self.eos) \
-                    or req.max_new_tokens <= 1:
-                req.done = True
-                finished.append(req)
+                    or len(req.output) >= req.max_new_tokens:
+                self._finalize(req, RequestStatus.FINISHED, None,
+                               finished)
                 self._release_slot(slot)
                 free.insert(0, slot)
         return finished
 
-    def _admit_shared(self, slot: int, req: Request, pages: List[int]):
+    def _admit_shared(self, slot: int, req: Request, prompt: List[int],
+                      pages: List[int]):
         """Admission with a prefix-cache hit: attach the cached pages
         read-only, then prefill only the suffix (chunked attention over
-        the gathered prefix KV)."""
-        p_len = len(req.prompt)
+        the gathered prefix KV). `prompt` is the effective prompt
+        (original + any tokens generated before a preemption)."""
+        p_len = len(prompt)
         shared_len = len(pages) * self.page_size
         self._slot_shared_pages[slot] = list(pages)
         for j, p in enumerate(pages):
@@ -450,7 +751,7 @@ class ContinuousBatchingEngine:
             self._incref(p)
         self._slot_next_idx[slot] = len(pages)
         self._reserve_and_alloc(slot, req, p_len)
-        suffix = req.prompt[shared_len:]
+        suffix = prompt[shared_len:]
         bucket = self._bucket(len(suffix))
         jit = self._get_suffix_prefill(shared_len, bucket)
         ids = np.zeros((1, bucket), np.int32)
@@ -596,9 +897,15 @@ class ContinuousBatchingEngine:
                 break
 
     def _alloc_page(self, slot: int) -> int:
+        # chaos tests arm this site (exc=PoolExhausted) to force the
+        # preemption path that reservation accounting makes unreachable
+        fault_point("serving.alloc_page")
         if not self._free:
-            # reservation accounting guarantees this succeeds
             self._ensure_free(1)
+        if not self._free:
+            raise PoolExhausted(
+                f"KV page pool exhausted ({self.num_pages - 1} usable "
+                "pages, none free after prefix-cache eviction)")
         page = self._free.pop()
         self._page_rc[page] = 1
         self._slot_pages[slot].append(page)
@@ -643,7 +950,8 @@ class ContinuousBatchingEngine:
         while self._slot_next_idx[slot] * self.page_size < p_len:
             self._alloc_page(slot)
 
-    def _admit_chunked(self, slot: int, req: Request, p_len: int):
+    def _admit_chunked(self, slot: int, req: Request, p_len: int,
+                       prompt: List[int]):
         """Long-prompt admission: fixed-size chunks through ONE compiled
         program with a traced position offset (the model's verify-
         attention branch). Padded tail rows of the last chunk leave
@@ -661,7 +969,7 @@ class ContinuousBatchingEngine:
                 for _ in range(cfg.num_hidden_layers)]
         n_chunks = -(-p_len // C)
         ids_pad = np.zeros((1, n_chunks * C), np.int32)
-        ids_pad[0, :p_len] = req.prompt
+        ids_pad[0, :p_len] = prompt
         pv = [p._value for p in self._params]
         bv = [b._value for b in self._buffers]
         sjit = self._get_scatter(C)
@@ -800,7 +1108,71 @@ class ContinuousBatchingEngine:
 
         return jax.jit(run, donate_argnums=(2,))
 
-    def _decode(self):
+    def _requeue_or_starve(self, req: Request,
+                           finished: List[Request]):
+        """Shared tail of both preemption paths (decode-time eviction,
+        admission-time allocation failure): bump the counters, then
+        requeue at the queue HEAD — or finalize PREEMPTED past
+        `max_preemptions` (starvation guard). `enqueue_time` restarts:
+        `max_queue_time` bounds each contiguous wait for a slot (time
+        spent RUNNING before a preemption must not count as waiting);
+        end-to-end budgets belong to `deadline`, and repeated bouncing
+        is bounded by the starvation guard."""
+        self.num_preemptions += 1
+        req.preemptions += 1
+        if req.preemptions > self.max_preemptions:
+            self._finalize(req, RequestStatus.PREEMPTED,
+                           f"preempted {req.preemptions}x under pool "
+                           "pressure (starvation guard)", finished)
+        else:
+            req.status = RequestStatus.QUEUED
+            req.enqueue_time = self._clock()
+            self._queue.insert(0, req)
+
+    def _preempt_youngest(self,
+                          finished: List[Request]) -> Optional[int]:
+        """Release the most-recently-admitted running slot to free its
+        pages. The victim re-enters the queue HEAD with its generated
+        tokens folded into the re-prefill prompt (the prefix cache, when
+        enabled, keeps its prompt pages so re-prefill is cheap); past
+        `max_preemptions` evictions the starvation guard finalizes it
+        PREEMPTED instead of bouncing forever. Returns the released
+        slot, or None if nothing is running."""
+        running = [i for i, r in enumerate(self._slot_req)
+                   if r is not None]
+        if not running:
+            return None
+        slot = max(running, key=lambda i: int(self._slot_seq[i]))
+        req = self._slot_req[slot]
+        # prompt full pages hold valid prefilled KV, so registration is
+        # safe — and cache-only pages remain evictable under pressure
+        self._release_slot(slot)
+        self._requeue_or_starve(req, finished)
+        return slot
+
+    def _grow_slot(self, slot: int, finished: List[Request]) -> bool:
+        """Lazy page growth for `slot`'s next decode write. On pool
+        exhaustion (reachable only via fault injection or an accounting
+        bug — admission reserves worst-case demand) preempt the
+        youngest running request and retry. Returns False if `slot`
+        itself was preempted away."""
+        while self._slot_next_idx[slot] * self.page_size \
+                <= int(self._pos[slot]):
+            try:
+                self._alloc_page(slot)
+            except PoolExhausted:
+                victim = self._preempt_youngest(finished)
+                if victim is None:
+                    raise
+                if victim == slot:
+                    return False
+        return True
+
+    def _decode(self, finished: List[Request]):
+        """One batched decode step for every active slot. Starvation-
+        guard finalizations are appended to the CALLER's `finished`
+        before the dispatch, so they survive an injected dispatch
+        fault."""
         if self._decode_jit is None:
             self._decode_jit = self._build_decode()
         # inactive slots decode garbage at a clamped position; their
@@ -812,12 +1184,8 @@ class ContinuousBatchingEngine:
             for i, r in enumerate(self._slot_req):
                 if r is None:
                     continue
-                # lazy growth: next token writes at pos[i] — allocate its
-                # page if the sequence just crossed a page boundary
-                # (guaranteed to succeed by the admission reservation)
-                while self._slot_next_idx[i] * self.page_size \
-                        <= int(self._pos[i]):
-                    self._alloc_page(i)
+                if not self._grow_slot(i, finished):
+                    continue          # slot i itself was preempted
                 if self._window is not None:
                     # reclaim pages that slid wholly below the attention
                     # window [ctx - w, ctx): the kernel never reads them
@@ -831,11 +1199,16 @@ class ContinuousBatchingEngine:
                             self._decref(page)
                             self._bt[i, j] = 0      # trash-route
                         self._slot_freed[i] += 1
+            if not any(r is not None for r in self._slot_req):
+                return                # every slot preempted away
             kv = self._kv
             bt = jnp.asarray(self._bt)
         else:
             kv = self._caches
             bt = jnp.zeros((), jnp.int32)     # unused placeholder
+        # fault BEFORE the dispatch (and before the PRNG key advances):
+        # a retried step replays an identical sampling stream
+        fault_point("serving.decode")
         nxt, new_kv = self._decode_jit(
             [p._value for p in self._params],
             [b._value for b in self._buffers],

@@ -31,6 +31,7 @@ def run_check():
 from . import dlpack  # noqa: F401,E402
 from . import unique_name  # noqa: F401,E402
 from . import memory  # noqa: F401,E402
+from . import faults  # noqa: F401,E402
 
 
 def require_version(min_version: str, max_version: str | None = None):

@@ -1,0 +1,167 @@
+"""Deterministic, process-local fault injection for chaos testing.
+
+Production serving/training stacks must recover from page-pool
+exhaustion, failed dispatches, and interrupted checkpoint writes — but
+those branches are unreachable on a healthy CPU test mesh. This module
+makes every failure path *forcible and reproducible*: code under test
+declares named fault sites (``fault_point("serving.alloc_page")``) and
+chaos tests arm them with deterministic triggers.
+
+Design:
+
+* **Process-local scoping**: injectors form a context-manager stack
+  (innermost wins per site). Nothing is armed globally — leaving the
+  ``with`` block disarms everything, so chaos tests cannot leak faults
+  into later tests.
+* **Deterministic**: ``nth=`` fires on exactly the N-th visit of the
+  site; ``probability=`` draws from the injector's own seeded
+  ``random.Random`` (independent of global RNG state); ``always=True``
+  fires on every visit. ``times=`` caps total firings.
+* **Typed**: each rule raises its configured exception class
+  (default :class:`FaultError`), so call sites can simulate *specific*
+  failures — e.g. arm ``serving.alloc_page`` with the engine's
+  ``PoolExhausted`` to force the preemption path.
+* **Zero cost when idle**: ``fault_point`` is a dict-free early return
+  when no injector is active.
+
+Usage::
+
+    from paddle_tpu.utils.faults import FaultInjector
+
+    with FaultInjector(seed=0) as fi:
+        fi.arm("serving.prefill", nth=1)          # fail first prefill
+        fi.arm("serving.alloc_page", nth=5, exc=PoolExhausted)
+        engine.run()                              # failure paths forced
+    assert fi.trips("serving.prefill") == 1
+
+Instrumented sites (grep ``fault_point(`` for the live list):
+``serving.alloc_page``, ``serving.prefill``, ``serving.decode``,
+``checkpoint.save``.
+"""
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Type
+
+__all__ = ["FaultError", "FaultInjector", "fault_point"]
+
+
+class FaultError(RuntimeError):
+    """An injected fault. ``site`` names the fault point that fired."""
+
+    def __init__(self, message: str, site: str = ""):
+        super().__init__(message)
+        self.site = site
+
+
+@dataclass
+class _Rule:
+    site: str
+    nth: Optional[int]
+    probability: Optional[float]
+    always: bool
+    times: Optional[int]           # max firings; None = unlimited
+    exc: Type[BaseException]
+    calls: int = 0
+    trips: int = 0
+
+
+# innermost (most recently entered) injector last
+_ACTIVE: List["FaultInjector"] = []
+
+
+class FaultInjector:
+    """Seedable, scoped registry of fault rules (see module docstring)."""
+
+    def __init__(self, seed: int = 0):
+        self._rules: Dict[str, _Rule] = {}
+        self._rng = random.Random(seed)
+
+    # -- arming --------------------------------------------------------
+    def arm(self, site: str, *, nth: Optional[int] = None,
+            probability: Optional[float] = None, always: bool = False,
+            times: Optional[int] = None,
+            exc: Type[BaseException] = FaultError) -> "FaultInjector":
+        """Arm `site` with exactly one trigger mode:
+
+        * ``nth=k``       — fire on the k-th visit (1-based), once
+        * ``probability=p`` — fire each visit with prob. p (seeded RNG)
+        * ``always=True`` — fire on every visit
+
+        ``times`` caps total firings (default: 1 for ``nth``, unlimited
+        otherwise). ``exc`` is the exception class raised (it receives
+        one message argument). Re-arming a site replaces its rule."""
+        modes = (nth is not None) + (probability is not None) + bool(always)
+        if modes != 1:
+            raise ValueError(
+                "arm() needs exactly one of nth=, probability=, always=")
+        if nth is not None and nth < 1:
+            raise ValueError(f"nth must be >= 1, got {nth}")
+        if probability is not None and not 0.0 <= probability <= 1.0:
+            raise ValueError(f"probability must be in [0, 1], got "
+                             f"{probability}")
+        if times is None and nth is not None:
+            times = 1
+        self._rules[site] = _Rule(site, nth, probability, always, times,
+                                  exc)
+        return self
+
+    def disarm(self, site: str):
+        self._rules.pop(site, None)
+
+    # -- introspection -------------------------------------------------
+    def calls(self, site: str) -> int:
+        """Visits to `site` while this injector was active."""
+        r = self._rules.get(site)
+        return r.calls if r else 0
+
+    def trips(self, site: str) -> int:
+        """Faults actually raised at `site` by this injector."""
+        r = self._rules.get(site)
+        return r.trips if r else 0
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        return {s: {"calls": r.calls, "trips": r.trips}
+                for s, r in self._rules.items()}
+
+    # -- scoping -------------------------------------------------------
+    def __enter__(self) -> "FaultInjector":
+        _ACTIVE.append(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _ACTIVE.remove(self)
+        return False
+
+    # -- firing --------------------------------------------------------
+    def _visit(self, site: str):
+        rule = self._rules[site]
+        rule.calls += 1
+        if rule.times is not None and rule.trips >= rule.times:
+            return
+        fire = (rule.always
+                or (rule.nth is not None and rule.calls == rule.nth)
+                or (rule.probability is not None
+                    and self._rng.random() < rule.probability))
+        if not fire:
+            return
+        rule.trips += 1
+        msg = f"injected fault at {site!r} (visit #{rule.calls})"
+        err = rule.exc(msg)
+        if isinstance(err, FaultError):
+            err.site = site
+        raise err
+
+
+def fault_point(site: str) -> None:
+    """Declare a named fault site. No-op unless an active
+    :class:`FaultInjector` armed `site` — then the INNERMOST injector
+    with a rule for `site` decides alone (it shadows outer rules, even
+    when it declines to fire)."""
+    if not _ACTIVE:
+        return
+    for inj in reversed(_ACTIVE):
+        if site in inj._rules:
+            inj._visit(site)
+            return

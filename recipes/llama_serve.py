@@ -8,6 +8,9 @@ then drive every decode surface the framework ships —
   * `generate()` greedy / sampling / beam search (+ repetition penalty),
   * the continuous-batching engine on the paged KV cache,
   * automatic prefix caching across requests sharing a system prompt,
+  * resilient serving: bounded-queue backpressure, per-request
+    deadlines, and a chaos drill (injected prefill fault + forced
+    pool exhaustion -> preemption) proving failure isolation,
   * speculative decoding with a draft model (lossless vs greedy),
 
 and print per-path outputs + engine cache/occupancy stats.
@@ -105,7 +108,40 @@ def main(argv=None):
           f"pages in use {info['pages_in_use']}/{info['total_pages']}")
     assert sorted(results) == sorted(rids)
 
-    # 3) speculative decoding (draft = shallow copy of the config)
+    # 3) resilient serving: backpressure + deadlines + chaos drill
+    from paddle_tpu.models.serving import EngineOverloaded, RequestStatus
+    from paddle_tpu.utils.faults import FaultInjector
+    eng = ContinuousBatchingEngine(
+        model, max_batch_size=2,
+        max_seq_len=min(256, cfg.max_position_embeddings),
+        max_waiting=3)
+    for _ in range(3):
+        eng.add_request(rng.integers(1, cfg.vocab_size, 6).tolist(), 8)
+    try:
+        eng.add_request([1, 2, 3], 8)
+        raise AssertionError("queue bound not enforced")
+    except EngineOverloaded:
+        shed = True                      # ≙ a front end's 429
+    reqs = {}
+    with FaultInjector(seed=0) as fi:
+        fi.arm("serving.prefill", nth=1)  # first prefill dies
+        while True:
+            for r in eng.step():
+                reqs[r.rid] = r
+            li = eng.lifecycle_info()
+            if not li["waiting"] and not li["running"]:
+                break
+    statuses = sorted(r.status for r in reqs.values())
+    assert statuses.count(RequestStatus.FAILED) == 1     # isolated
+    assert statuses.count(RequestStatus.FINISHED) == 2   # others fine
+    li = eng.lifecycle_info()
+    print(f"robustness: shed_on_overload={shed}, "
+          f"failures={li['failures']} (isolated), "
+          f"finished={statuses.count(RequestStatus.FINISHED)}, "
+          f"pages_in_use="
+          f"{eng.cache_memory_info()['pages_in_use']}")
+
+    # 4) speculative decoding (draft = shallow copy of the config)
     d_cfg = LlamaConfig(
         vocab_size=cfg.vocab_size,
         hidden_size=cfg.hidden_size // 2,

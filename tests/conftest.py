@@ -47,6 +47,20 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavy tier (HF parity, multi-process, "
         "e2e recipes) — run with --run-slow / PDT_RUN_SLOW=1")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection tests "
+        "(utils.faults) — CPU-mesh fast tier, runs in tier-1")
+
+
+@pytest.fixture(autouse=True)
+def _serving_invariant_checks(request, monkeypatch):
+    """Every serving/chaos test runs with the engine invariant checker
+    on: page-accounting violations surface as EngineInvariantError in
+    whatever test created them, for free."""
+    if os.path.basename(str(request.fspath)) in ("test_serving.py",
+                                                 "test_chaos.py"):
+        monkeypatch.setenv("PDT_CHECK_INVARIANTS", "1")
+    yield
 
 
 def pytest_collection_modifyitems(config, items):

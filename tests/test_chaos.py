@@ -1,0 +1,358 @@
+"""Deterministic chaos tests (fast tier, `chaos` marker): force every
+failure branch of the serving engine on the CPU mesh via
+`utils.faults.FaultInjector` — pool exhaustion mid-decode (preempt ->
+requeue -> identical tokens), injected prefill failure (request fails,
+engine keeps serving), deadline / queue-time expiry, transient decode
+faults, and interrupted checkpoint saves. conftest enables
+PDT_CHECK_INVARIANTS=1 for this file, so page accounting is re-proved
+after every engine step of every test."""
+import random
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import (ContinuousBatchingEngine,
+                                       EngineInvariantError,
+                                       EngineOverloaded, PoolExhausted,
+                                       RequestStatus)
+from paddle_tpu.utils.faults import FaultError, FaultInjector, fault_point
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      num_key_value_heads=1, max_position_embeddings=64)
+    paddle.seed(7)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", 4)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _drain(eng):
+    """run(), but keep the Request objects (status/error/preemptions)."""
+    reqs = {}
+    while eng._queue or any(r is not None for r in eng._slot_req):
+        for r in eng.step():
+            reqs[r.rid] = r
+    return reqs
+
+
+class TestFaultInjector:
+    def test_nth_fires_once_deterministically(self):
+        with FaultInjector() as fi:
+            fi.arm("x", nth=3)
+            for i in range(1, 6):
+                if i == 3:
+                    with pytest.raises(FaultError) as e:
+                        fault_point("x")
+                    assert e.value.site == "x"
+                else:
+                    fault_point("x")
+            assert fi.calls("x") == 5 and fi.trips("x") == 1
+        fault_point("x")      # scope exited: disarmed, no raise
+
+    def test_probability_reproducible_with_seed(self):
+        def run(seed):
+            fired = []
+            with FaultInjector(seed=seed) as fi:
+                fi.arm("p", probability=0.5)
+                for _ in range(24):
+                    try:
+                        fault_point("p")
+                        fired.append(False)
+                    except FaultError:
+                        fired.append(True)
+            return fired
+
+        a, b, c = run(1), run(1), run(2)
+        assert a == b                 # seeded: bit-identical
+        assert a != c                 # and seed-sensitive
+        assert any(a) and not all(a)
+
+    def test_always_with_times_cap_and_custom_exc(self):
+        with FaultInjector() as fi:
+            fi.arm("a", always=True, times=2, exc=PoolExhausted)
+            for _ in range(2):
+                with pytest.raises(PoolExhausted):
+                    fault_point("a")
+            fault_point("a")          # cap reached: no more firings
+            assert fi.stats()["a"] == {"calls": 3, "trips": 2}
+
+    def test_nested_scopes_inner_wins_and_unwinds(self):
+        with FaultInjector() as outer:
+            outer.arm("s", nth=1)
+            with FaultInjector() as inner:
+                inner.arm("s", always=True, exc=ValueError)
+                with pytest.raises(ValueError):
+                    fault_point("s")  # innermost injector consulted first
+            with pytest.raises(FaultError):
+                fault_point("s")      # outer's nth=1 still pending
+            fault_point("s")
+
+    def test_inner_scope_shadows_even_when_declining(self):
+        with FaultInjector() as outer:
+            outer.arm("s", always=True)
+            with FaultInjector() as inner:
+                inner.arm("s", nth=5)
+                fault_point("s")      # inner declines AND shadows outer
+                assert inner.calls("s") == 1 and outer.calls("s") == 0
+            with pytest.raises(FaultError):
+                fault_point("s")      # outer visible again
+
+    def test_arm_validation(self):
+        fi = FaultInjector()
+        with pytest.raises(ValueError):
+            fi.arm("x")
+        with pytest.raises(ValueError):
+            fi.arm("x", nth=1, always=True)
+        with pytest.raises(ValueError):
+            fi.arm("x", nth=0)
+        with pytest.raises(ValueError):
+            fi.arm("x", probability=1.5)
+
+
+class TestEngineChaos:
+    def _ref(self, model, jobs, **kw):
+        eng = _engine(model, **kw)
+        rids = [eng.add_request(p, n) for p, n in jobs]
+        res = eng.run()
+        return [res[r] for r in rids]
+
+    def test_pool_exhaustion_preempts_and_recovers(self, model):
+        """Forced exhaustion mid-decode: the youngest request is
+        preempted, requeued, re-prefilled — and the final token streams
+        are IDENTICAL to an unfaulted run."""
+        jobs = [([5, 4, 3, 2, 6, 7], 8), ([9, 1, 2], 6)]
+        ref = self._ref(model, jobs)
+        eng = _engine(model)
+        rids = [eng.add_request(p, n) for p, n in jobs]
+        with FaultInjector() as fi:
+            # admission allocates pages 1-3 (prompts of 6 and 3 tokens
+            # at page_size 4); visit #4 is the first decode-time lazy
+            # growth -> exhaustion mid-decode
+            fi.arm("serving.alloc_page", nth=4, exc=PoolExhausted)
+            reqs = _drain(eng)
+        assert [reqs[r].output for r in rids] == ref
+        assert eng.num_preemptions == 1
+        assert all(reqs[r].status == RequestStatus.FINISHED
+                   for r in rids)
+        assert reqs[rids[1]].preemptions == 1   # youngest took the hit
+        assert eng.cache_memory_info()["pages_in_use"] == 0
+
+    def test_self_preemption_resumes_and_matches(self, model):
+        """Single slot: the faulting slot IS the youngest. It must
+        release itself, requeue prompt+generated, and still emit the
+        unfaulted greedy stream."""
+        job = ([5, 4, 3, 2, 6, 7], 8)
+        ref = self._ref(model, [job], max_batch_size=1)
+        eng = _engine(model, max_batch_size=1)
+        rid = eng.add_request(*job)
+        with FaultInjector() as fi:
+            fi.arm("serving.alloc_page", nth=3, exc=PoolExhausted)
+            reqs = _drain(eng)
+        assert reqs[rid].output == ref[0]
+        assert reqs[rid].status == RequestStatus.FINISHED
+        assert reqs[rid].preemptions == 1
+        assert eng.cache_memory_info()["pages_in_use"] == 0
+
+    def test_admission_alloc_exhaustion_requeues(self, model):
+        """Pool exhaustion during ADMISSION-time allocation must not
+        fail the request: it backs out, requeues, and admits cleanly
+        on a later step — outputs identical to an unfaulted run."""
+        jobs = [([5, 4, 3, 2, 6, 7], 4), ([9, 1, 2], 6)]
+        ref = self._ref(model, jobs, max_batch_size=1)
+        eng = _engine(model, max_batch_size=1)
+        rids = [eng.add_request(p, n) for p, n in jobs]
+        with FaultInjector() as fi:
+            # visits 1-3: request 0 (2 admission allocs + 1 growth);
+            # visit 4 lands in request 1's admission _reserve_and_alloc
+            fi.arm("serving.alloc_page", nth=4, exc=PoolExhausted)
+            reqs = _drain(eng)
+        assert [reqs[r].output for r in rids] == ref
+        assert all(reqs[r].status == RequestStatus.FINISHED
+                   for r in rids)
+        assert eng.num_preemptions == 1 and eng.num_failures == 0
+        assert reqs[rids[1]].preemptions == 1
+        assert eng.cache_memory_info()["pages_in_use"] == 0
+
+    def test_preemption_starvation_guard(self, model):
+        eng = _engine(model, max_batch_size=1, max_preemptions=0)
+        rid = eng.add_request([5, 4, 3, 2, 6, 7], 8)
+        with FaultInjector() as fi:
+            fi.arm("serving.alloc_page", nth=3, exc=PoolExhausted)
+            reqs = _drain(eng)
+        assert reqs[rid].status == RequestStatus.PREEMPTED
+        assert reqs[rid].done and eng.num_preemptions == 1
+        assert "starvation" in reqs[rid].error
+        assert len(reqs[rid].output) > 0        # partial output kept
+        assert eng.cache_memory_info()["pages_in_use"] == 0
+
+    def test_prefill_failure_isolates_request(self, model):
+        jobs = [([5, 4, 3, 2, 6, 7], 8), ([9, 1, 2], 6)]
+        ref = self._ref(model, jobs)
+        eng = _engine(model)
+        a, b = [eng.add_request(p, n) for p, n in jobs]
+        with FaultInjector() as fi:
+            fi.arm("serving.prefill", nth=1)
+            reqs = _drain(eng)
+        assert reqs[a].status == RequestStatus.FAILED
+        assert reqs[a].output == []
+        assert "FaultError" in reqs[a].error
+        assert reqs[b].status == RequestStatus.FINISHED
+        assert reqs[b].output == ref[1]         # untouched by the fault
+        assert eng.num_failures == 1
+        # the engine keeps serving after the failure
+        c = eng.add_request(jobs[0][0], 8)
+        assert eng.run()[c] == ref[0]
+        assert eng.cache_memory_info()["pages_in_use"] == 0
+
+    def test_deadline_expiry_mid_decode(self, model):
+        clk = FakeClock()
+        eng = _engine(model, clock=clk)
+        rid = eng.add_request([5, 4, 3, 2, 6, 7], 32, deadline=10.0)
+        assert eng.step() == []                 # admit + first decode
+        clk.advance(11.0)
+        done = eng.step()
+        assert [r.rid for r in done] == [rid]
+        assert done[0].status == RequestStatus.TIMEOUT
+        assert 0 < len(done[0].output) < 32     # partial output retained
+        assert eng.num_timeouts == 1
+        assert eng.cache_memory_info()["pages_in_use"] == 0
+
+    def test_max_queue_time_expires_waiting_request(self, model):
+        clk = FakeClock()
+        eng = _engine(model, max_batch_size=1, clock=clk)
+        a = eng.add_request([5, 4, 3], 24)
+        b = eng.add_request([9, 1, 2], 8, max_queue_time=5.0)
+        assert eng.step() == []                 # a holds the only slot
+        clk.advance(6.0)
+        done = {r.rid: r for r in eng.step()}
+        assert done[b].status == RequestStatus.TIMEOUT
+        assert done[b].output == []             # expired before running
+        reqs = _drain(eng)                      # a is unaffected
+        assert reqs[a].status == RequestStatus.FINISHED
+        assert len(reqs[a].output) == 24
+
+    def test_backpressure_and_admission_policy(self, model):
+        eng = _engine(model, max_waiting=2)
+        eng.add_request([1, 2], 2)
+        eng.add_request([3, 4], 2)
+        with pytest.raises(EngineOverloaded, match="queue full"):
+            eng.add_request([5, 6], 2)
+        eng.run()                               # drained: queue reopens
+        eng.add_request([7, 8], 2)
+        eng.run()
+        eng = _engine(
+            model, admission_policy=lambda e, r: len(r.prompt) <= 4)
+        eng.add_request([1, 2, 3, 4], 2)
+        with pytest.raises(EngineOverloaded, match="policy"):
+            eng.add_request([1, 2, 3, 4, 5], 2)
+        eng.run()
+
+    def test_decode_fault_retries_transparently(self, model):
+        ref = self._ref(model, [([5, 4, 3], 6)])
+        eng = _engine(model)
+        rid = eng.add_request([5, 4, 3], 6)
+        with FaultInjector() as fi:
+            fi.arm("serving.decode", nth=2)
+            reqs = _drain(eng)
+        assert reqs[rid].output == ref[0]       # retry is lossless
+        assert eng.num_decode_retries == 1
+
+    def test_decode_fault_persistent_raises_after_cap(self, model):
+        eng = _engine(model, max_decode_retries=2)
+        eng.add_request([5, 4, 3], 6)
+        with FaultInjector() as fi:
+            fi.arm("serving.decode", always=True)
+            with pytest.raises(FaultError):
+                eng.run()
+        assert eng.num_decode_retries == 3      # 2 retries + the raiser
+
+    def test_starvation_finalize_survives_decode_fault(self, model):
+        """A request finalized by the starvation guard inside _decode
+        must still be returned by step() when the SAME decode dispatch
+        then faults — terminal requests must never be silently lost."""
+        eng = _engine(model, max_preemptions=0)
+        a = eng.add_request([5, 4, 3, 2, 6, 7], 8)
+        b = eng.add_request([9, 1, 2], 6)
+        with FaultInjector() as fi:
+            fi.arm("serving.alloc_page", nth=4, exc=PoolExhausted)
+            fi.arm("serving.decode", nth=2)   # same step as the guard
+            reqs = _drain(eng)
+        assert reqs[b].status == RequestStatus.PREEMPTED   # not lost
+        assert reqs[a].status == RequestStatus.FINISHED
+        assert eng.num_decode_retries == 1
+
+    def test_finished_backlog_survives_retry_cap_raise(self, model):
+        """When the decode-retry cap forces step() to re-raise, requests
+        already finalized in that same step are delivered by the next
+        step() instead of being silently dropped with the exception."""
+        eng = _engine(model, max_preemptions=0, max_decode_retries=0)
+        a = eng.add_request([5, 4, 3, 2, 6, 7], 8)
+        b = eng.add_request([9, 1, 2], 6)
+        with FaultInjector() as fi:
+            fi.arm("serving.alloc_page", nth=4, exc=PoolExhausted)
+            fi.arm("serving.decode", nth=2)   # same step, cap 0 -> raise
+            with pytest.raises(FaultError):
+                eng.run()
+        reqs = _drain(eng)                    # fault cleared: continue
+        assert reqs[b].status == RequestStatus.PREEMPTED  # delivered
+        assert reqs[a].status == RequestStatus.FINISHED
+        assert eng.cache_memory_info()["pages_in_use"] == 0
+
+    def test_invariant_checker_catches_corruption(self, model):
+        eng = _engine(model)
+        eng.check_invariants()                  # clean engine passes
+        leaked = eng._free.pop()                # rc==0 page off the list
+        with pytest.raises(EngineInvariantError, match="LEAKED"):
+            eng.check_invariants()
+        eng._free.append(leaked)
+        eng.check_invariants()
+        eng._free.append(leaked)                # duplicate free entry
+        with pytest.raises(EngineInvariantError, match="duplicates"):
+            eng.check_invariants()
+
+
+class TestCheckpointChaos:
+    def test_injected_save_failure_leaves_no_partial_checkpoint(
+            self, tmp_path):
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticManager, latest_checkpoint)
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        em = ElasticManager(str(tmp_path), save_interval_steps=1)
+        em.save(0, net)
+        assert latest_checkpoint(str(tmp_path)).endswith("step_0")
+        with FaultInjector() as fi:
+            fi.arm("checkpoint.save", always=True)
+            with pytest.raises(FaultError):
+                em.save(1, net)
+        # the interrupted save wrote no .done marker: resume discovery
+        # still picks the last COMPLETE checkpoint
+        assert latest_checkpoint(str(tmp_path)).endswith("step_0")
+        em.save(2, net)                          # heals once fault clears
+        assert latest_checkpoint(str(tmp_path)).endswith("step_2")

@@ -1,0 +1,120 @@
+"""Elastic-infra robustness (fast tier): launch restart backoff with a
+fake clock, HeartbeatMembership corrupt-beat tolerance, stale-beat
+eviction, and scale_up/scale_down classification edge cases (including
+a beat exactly at the timeout boundary)."""
+import os
+import random
+import types
+
+import pytest
+
+from paddle_tpu.distributed.launch import launch, restart_backoff
+from paddle_tpu.distributed.fleet.elastic import HeartbeatMembership
+
+
+class TestRestartBackoff:
+    def test_exponential_envelope_jitter_and_cap(self):
+        rng = random.Random(0)
+        delays = [restart_backoff(a, 1.0, 60.0, rng)
+                  for a in range(1, 9)]
+        for k, d in enumerate(delays, start=1):
+            # +/-50% multiplicative jitter around the exponential,
+            # clamped to the cap as a HARD ceiling
+            assert min(0.5 * 2.0 ** (k - 1), 60.0) <= d <= 60.0, (k, d)
+            assert d <= 1.5 * 2.0 ** (k - 1)
+        assert delays[7] == 60.0          # 0.5 * 2^7 = 64 > cap: pinned
+        # deterministic given the rng
+        rng2 = random.Random(0)
+        assert delays == [restart_backoff(a, 1.0, 60.0, rng2)
+                          for a in range(1, 9)]
+        assert restart_backoff(3, 0.0, 60.0, rng) == 0.0   # disabled
+
+    def test_launch_backs_off_and_caps_restarts(self, tmp_path):
+        script = tmp_path / "fail.py"
+        script.write_text("import sys; sys.exit(7)\n")
+        args = types.SimpleNamespace(
+            master=None, nnodes=1, rank=0, job_id="bo", log_dir=None,
+            elastic_level=1, max_restart=3, restart_backoff=2.0,
+            restart_backoff_max=5.0, script=str(script), script_args=[])
+        slept = []
+        rc = launch(args, sleep=slept.append, rng=random.Random(42))
+        assert rc == 7                    # max_restarts cap: rc propagated
+        assert len(slept) == 3            # one backoff per restart
+        rng = random.Random(42)
+        assert slept == [restart_backoff(a, 2.0, 5.0, rng)
+                         for a in (1, 2, 3)]
+        assert all(d <= 5.0 for d in slept)    # hard cap
+
+    def test_launch_args_without_backoff_fields_still_work(self, tmp_path):
+        # duck-typed args objects predating the backoff knobs
+        script = tmp_path / "ok.py"
+        script.write_text("print('ok')\n")
+        args = types.SimpleNamespace(
+            master=None, nnodes=1, rank=0, job_id="t", log_dir=None,
+            elastic_level=0, max_restart=1, script=str(script),
+            script_args=[])
+        assert launch(args, sleep=lambda _: None) == 0
+
+
+class TestHeartbeatRobustness:
+    def test_corrupt_beat_is_stale_not_fatal(self, tmp_path):
+        hb = HeartbeatMembership(str(tmp_path), rank=0, timeout=5.0)
+        hb.heartbeat()
+        assert hb.alive() == {0}
+        # a non-atomic writer observed mid-write: truncated / garbage
+        with open(hb._beat_path(1), "w"):
+            pass                              # empty file, fresh mtime
+        with open(hb._beat_path(2), "w") as f:
+            f.write("not-a-timestamp\x00")
+        assert hb.alive() == {0}              # corrupt = stale, no raise
+        assert hb.poll()["alive"] == {0}
+        # the corrupt worker recovers on its next good beat
+        HeartbeatMembership(str(tmp_path), rank=1).heartbeat()
+        assert hb.alive() == {0, 1}
+
+    def test_exactly_at_timeout_beat_is_alive(self, tmp_path):
+        t0 = 1000.0
+        hb = HeartbeatMembership(str(tmp_path), rank=0, timeout=5.0,
+                                 clock=lambda: t0 + 5.0)
+        hb.heartbeat()
+        os.utime(hb._beat_path(0), (t0, t0))  # beat exactly timeout old
+        assert hb.alive() == {0}              # boundary is inclusive
+        hb._clock = lambda: t0 + 5.0 + 1e-3
+        assert hb.alive() == set()            # a hair past: dead
+
+    def test_stale_eviction_and_scale_classification(self, tmp_path):
+        clk = {"t": 1000.0}
+        watch = HeartbeatMembership(str(tmp_path), timeout=5.0,
+                                    clock=lambda: clk["t"])
+
+        def beat(rank):
+            HeartbeatMembership(str(tmp_path), rank=rank).heartbeat()
+            path = os.path.join(str(tmp_path), f"worker_{rank}.hb")
+            os.utime(path, (clk["t"], clk["t"]))
+
+        beat(0)
+        beat(1)
+        d = watch.poll()
+        assert d["alive"] == {0, 1}
+        assert d["event"] is None             # first sighting: no event
+        beat(2)                               # join -> scale_up
+        d = watch.poll()
+        assert d["joined"] == {2} and d["event"] == "scale_up"
+        # worker 0 goes silent past the timeout -> evicted, scale_down
+        os.utime(os.path.join(str(tmp_path), "worker_0.hb"),
+                 (clk["t"] - 6.0, clk["t"] - 6.0))
+        d = watch.poll()
+        assert d["dead"] == {0} and d["event"] == "scale_down"
+        assert d["alive"] == {1, 2}
+        # death + join in the same poll: scale_down wins (relaunch must
+        # not be masked by a simultaneous join)
+        os.utime(os.path.join(str(tmp_path), "worker_1.hb"),
+                 (clk["t"] - 6.0, clk["t"] - 6.0))
+        beat(3)
+        d = watch.poll()
+        assert d["dead"] == {1} and d["joined"] == {3}
+        assert d["event"] == "scale_down"
+        # everyone silent
+        clk["t"] += 100.0
+        d = watch.poll()
+        assert d["alive"] == set() and d["event"] == "scale_down"
