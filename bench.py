@@ -79,6 +79,7 @@ def bench_decode(model, cfg, on_tpu: bool) -> dict:
     engine (VERDICT r4 #1: the decode number must ride bench.py's JSON
     so the driver captures it). Returns a detail sub-dict."""
     import numpy as np
+    import paddle_tpu.observability as telemetry
     from paddle_tpu.models.serving import ContinuousBatchingEngine
 
     model.eval()
@@ -89,20 +90,58 @@ def bench_decode(model, cfg, on_tpu: bool) -> dict:
     eng = ContinuousBatchingEngine(model, max_batch_size=slots,
                                    max_seq_len=max_seq)
     rng = np.random.default_rng(0)
-    for _ in range(slots):
-        eng.add_request(list(rng.integers(1, cfg.vocab_size, p_len)),
-                        max_new_tokens=max_seq - p_len - 1)
-    for _ in range(warm):          # admit + compile prefill/decode
-        eng.step()
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        eng.step()
-    dt = time.perf_counter() - t0
-    model.train()
+    # engine telemetry rides the same JSON (ISSUE 2): BENCH_r*.json
+    # trajectories carry serving signals, not just matmul timings
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        for _ in range(slots):
+            eng.add_request(list(rng.integers(1, cfg.vocab_size, p_len)),
+                            max_new_tokens=max_seq - p_len - 1)
+        for _ in range(warm):      # admit + compile prefill/decode
+            eng.step()
+        warm_snap = telemetry.snapshot()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            eng.step()
+        dt = time.perf_counter() - t0
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.disable(clear_override=True)
+        model.train()
+    # every request is admitted during the warm phase, so TTFT here
+    # spans the first prefill compile — a COLD-START number, named so
+    # it can't be read as steady-state serving latency
+    ttft = snap["histograms"].get("pdt_serving_ttft_seconds",
+                                  {}).get("", {})
+    # steady-state decode only: diff the histogram across the timed
+    # window so compile-heavy warm steps don't skew the average
+    dstep = dict(snap["histograms"].get(
+        "pdt_serving_decode_step_seconds", {}).get("", {}))
+    warm_dstep = warm_snap["histograms"].get(
+        "pdt_serving_decode_step_seconds", {}).get("", {})
+    if dstep:
+        dstep["count"] -= warm_dstep.get("count", 0)
+        dstep["sum"] -= warm_dstep.get("sum", 0.0)
     return {
         "decode_tokens_per_sec": round(slots * steps / dt, 1),
         "decode_batch_slots": slots,
         "decode_step_ms": round(dt / steps * 1e3, 3),
+        "engine_telemetry": {
+            "ttft_cold_avg_s": round(ttft["sum"] / ttft["count"], 4)
+            if ttft.get("count") else None,
+            "decode_step_avg_ms": round(
+                1e3 * dstep["sum"] / dstep["count"], 3)
+            if dstep.get("count") else None,
+            "decode_tokens_per_sec_last_step": round(telemetry.value(
+                "pdt_serving_tokens_per_sec"), 1),
+            "decode_tokens_total": int(telemetry.value(
+                "pdt_serving_decode_tokens_total")),
+            "preemptions": int(telemetry.value(
+                "pdt_serving_preemptions_total")),
+            "page_occupancy": round(telemetry.value(
+                "pdt_serving_page_occupancy"), 4),
+        },
     }
 
 

@@ -37,6 +37,7 @@ from . import jit  # noqa: F401
 from . import linalg  # noqa: F401
 from . import metric  # noqa: F401
 from . import nn  # noqa: F401
+from . import observability  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import profiler  # noqa: F401
 from . import utils  # noqa: F401
@@ -138,6 +139,11 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
 # distributed is imported lazily (it pulls in mesh machinery); exposed as
 # attribute for `paddle_tpu.distributed.*`
 def __getattr__(name):
+    if name == "telemetry":
+        # alias: `paddle_tpu.telemetry` is the observability subsystem
+        from . import observability
+        globals()["telemetry"] = observability
+        return observability
     if name == "distributed":
         import importlib
         mod = importlib.import_module(".distributed", __name__)

@@ -17,9 +17,23 @@ from typing import Any, Dict, Optional
 import numpy as np
 import jax
 
+from ... import observability as telemetry
 from ...core.tensor import Parameter, Tensor
 
 __all__ = ["save_state_dict", "load_state_dict", "load_state_dict_raw"]
+
+_M_CKPT_OPS = telemetry.counter(
+    "pdt_checkpoint_ops_total",
+    "Completed checkpoint operations, by direction.", ("op",))
+_M_CKPT_BYTES = telemetry.counter(
+    "pdt_checkpoint_bytes_total",
+    "Array bytes moved through checkpoint operations, by direction.",
+    ("op",))
+
+
+def _nbytes(vals) -> int:
+    return int(sum(getattr(v, "nbytes", 0) for v in vals
+                   if v is not None))
 
 
 def _flatten(d, prefix=""):
@@ -63,14 +77,34 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     # failure leaves no partial checkpoint (the .done marker protocol in
     # fleet.elastic then ignores interrupted step directories)
     from ...utils.faults import fault_point
-    fault_point("checkpoint.save")
-    import orbax.checkpoint as ocp
-    flat = _values(_flatten(state_dict))
     path = os.path.abspath(path)
-    ckptr = (ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
-             if async_save else ocp.PyTreeCheckpointer())
-    ckptr.save(path, flat, force=True)
+    with telemetry.span("checkpoint.save", path=path,
+                        async_save=bool(async_save)):
+        fault_point("checkpoint.save")
+        import orbax.checkpoint as ocp
+        flat = _values(_flatten(state_dict))
+        ckptr = (ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+                 if async_save else ocp.PyTreeCheckpointer())
+        ckptr.save(path, flat, force=True)
+        nbytes = _nbytes(flat.values())
+        if not async_save:
+            _M_CKPT_OPS.inc(op="save")
+            _M_CKPT_BYTES.inc(nbytes, op="save")
     if async_save:
+        # an async save has only been DISPATCHED here — counting it as
+        # completed would report a save that may still fail in flight.
+        # Count when the caller's wait_until_finished() returns clean.
+        orig_wait = ckptr.wait_until_finished
+
+        def _wait_and_count(*a, _done=[False], **kw):
+            out = orig_wait(*a, **kw)
+            if not _done[0]:
+                _done[0] = True
+                _M_CKPT_OPS.inc(op="save")
+                _M_CKPT_BYTES.inc(nbytes, op="save")
+            return out
+
+        ckptr.wait_until_finished = _wait_and_count
         return ckptr  # caller may wait_until_finished()
     return None
 
@@ -81,24 +115,27 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     checkpoint values resharded to that tensor's CURRENT sharding — the
     cross-mesh reshard plan of the reference, done by tensorstore reads."""
     import orbax.checkpoint as ocp
-    flat_t = _flatten(state_dict)
-    restore_args = {}
-    targets = {}
-    for k, t in flat_t.items():
-        if isinstance(t, Tensor):
-            v = t._value
-            sharding = getattr(v, "sharding", None)
-            restore_args[k] = ocp.ArrayRestoreArgs(
-                sharding=sharding, global_shape=tuple(v.shape),
-                dtype=v.dtype)
-            targets[k] = t
-    ckptr = ocp.PyTreeCheckpointer()
-    restored = ckptr.restore(
-        os.path.abspath(path),
-        args=ocp.args.PyTreeRestore(restore_args=restore_args))
-    for k, arr in restored.items():
-        if k in targets and arr is not None:
-            targets[k]._value = arr
+    path = os.path.abspath(path)
+    with telemetry.span("checkpoint.load", path=path):
+        flat_t = _flatten(state_dict)
+        restore_args = {}
+        targets = {}
+        for k, t in flat_t.items():
+            if isinstance(t, Tensor):
+                v = t._value
+                sharding = getattr(v, "sharding", None)
+                restore_args[k] = ocp.ArrayRestoreArgs(
+                    sharding=sharding, global_shape=tuple(v.shape),
+                    dtype=v.dtype)
+                targets[k] = t
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(restore_args=restore_args))
+        for k, arr in restored.items():
+            if k in targets and arr is not None:
+                targets[k]._value = arr
+        _M_CKPT_OPS.inc(op="load")
+        _M_CKPT_BYTES.inc(_nbytes(restored.values()), op="load")
     return state_dict
 
 
@@ -107,5 +144,10 @@ def load_state_dict_raw(path: str) -> Dict[str, Any]:
     {dotted_key: jax.Array} dict as saved. For consumers whose state is
     created lazily (optimizer accumulators) — feed into set_state_dict."""
     import orbax.checkpoint as ocp
-    ckptr = ocp.PyTreeCheckpointer()
-    return ckptr.restore(os.path.abspath(path))
+    path = os.path.abspath(path)
+    with telemetry.span("checkpoint.load", path=path, raw=True):
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(path)
+        _M_CKPT_OPS.inc(op="load")
+        _M_CKPT_BYTES.inc(_nbytes(restored.values()), op="load")
+    return restored

@@ -19,7 +19,18 @@ import re
 import time
 from typing import Optional
 
+from ... import observability as telemetry
+
 __all__ = ["ElasticManager", "latest_checkpoint", "HeartbeatMembership"]
+
+_M_HB_STALENESS = telemetry.gauge(
+    "pdt_elastic_heartbeat_staleness_seconds",
+    "Seconds since each worker's last heartbeat, sampled at alive().",
+    ("rank",))
+_M_MEMBERSHIP_EVENTS = telemetry.counter(
+    "pdt_elastic_membership_events_total",
+    "Membership deltas observed by poll(), by classification.",
+    ("event",))
 
 
 def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
@@ -125,6 +136,8 @@ class HeartbeatMembership:
         self._stop = False
         self._thread = None
         self._last_alive: set = set()
+        self._staleness_ranks: set = set()  # gauge series this watcher
+        # exported; retired when the beat file disappears
         os.makedirs(dir, exist_ok=True)
 
     # -- worker side ---------------------------------------------------
@@ -200,6 +213,7 @@ class HeartbeatMembership:
         exactly `timeout` old still counts; corrupt beats never do."""
         now = self._clock()
         out = set()
+        seen_ranks = set()
         for name in os.listdir(self.dir):
             m = re.fullmatch(r"worker_(\d+)\.hb", name)
             if not m:
@@ -209,8 +223,15 @@ class HeartbeatMembership:
                 ts = os.stat(path).st_mtime
             except OSError:
                 continue
+            seen_ranks.add(m.group(1))
+            _M_HB_STALENESS.set(now - ts, rank=m.group(1))
             if now - ts <= self.timeout and self._beat_valid(path):
                 out.add(int(m.group(1)))
+        # a departed worker (stop() removed its beat file) must not keep
+        # exporting its last staleness value forever — retire the series
+        for rank in self._staleness_ranks - seen_ranks:
+            _M_HB_STALENESS.remove(rank=rank)
+        self._staleness_ranks = seen_ranks
         return out
 
     def wait_for_peers(self, np_: int, timeout: float = 60.0) -> set:
@@ -239,5 +260,10 @@ class HeartbeatMembership:
         elif joined and self._last_alive:
             event = "scale_up"
         self._last_alive = a
+        if event is not None:
+            _M_MEMBERSHIP_EVENTS.inc(event=event)
+            telemetry.event("elastic.membership", event=event,
+                            alive=sorted(a), joined=sorted(joined),
+                            dead=sorted(dead))
         return {"alive": a, "joined": joined, "dead": dead,
                 "event": event}

@@ -19,7 +19,16 @@ import subprocess
 import sys
 import time
 
+from ... import observability as telemetry
+
 __all__ = ["main", "launch", "restart_backoff"]
+
+_M_RESTARTS = telemetry.counter(
+    "pdt_launch_restarts_total",
+    "Elastic restarts of the training script, by job id.", ("job",))
+_M_BACKOFF = telemetry.histogram(
+    "pdt_launch_restart_backoff_seconds",
+    "Backoff delays slept before elastic restarts.")
 
 
 def _parse(argv):
@@ -122,6 +131,11 @@ def launch(args, *, sleep=time.sleep, rng: random.Random | None = None):
         if args.elastic_level <= 0 or attempt > args.max_restart:
             return rc
         delay = restart_backoff(attempt, base, cap, rng)
+        _M_RESTARTS.inc(job=getattr(args, "job_id", "default"))
+        _M_BACKOFF.observe(delay)
+        telemetry.event("launch.restart", rc=rc, attempt=attempt,
+                        delay_s=delay,
+                        job=getattr(args, "job_id", "default"))
         msg = (f"[launch] script exited {rc} after "
                f"{time.time() - t0:.0f}s — restart {attempt}/"
                f"{args.max_restart} in {delay:.1f}s (elastic "

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.autograd import no_grad
+from paddle_tpu.observability import span as telemetry_span
 from paddle_tpu.tensor.random import default_generator
 
 NEG_INF = -1e30
@@ -204,17 +205,27 @@ class GenerationMixin:
                int(no_repeat_ngram_size))
         cache = getattr(self, "_generate_cache", None)
         if cache is None or cache[0] != sig:
-            if decode_strategy == "beam_search":
-                jitted = self._build_beam_generate(sig)
-            else:
-                jitted = self._build_generate(sig)
+            with telemetry_span("generate.build",
+                                strategy=decode_strategy, batch=b,
+                                prompt_len=prompt_len, n_new=n_new):
+                if decode_strategy == "beam_search":
+                    jitted = self._build_beam_generate(sig)
+                else:
+                    jitted = self._build_generate(sig)
             self._generate_cache = (sig, jitted)
         else:
             jitted = cache[1]
 
-        toks, scores = jitted([p._value for p in params],
-                              [bu._value for bu in buffers],
-                              ids._value.astype(jnp.int32), key)
+        # one span for the whole compiled program: prefill + the decode
+        # scan are a single dispatch, and generate() stays async — the
+        # span times host dispatch; device time lives on the XLA
+        # timeline via the span's RecordEvent interop
+        with telemetry_span("generate.dispatch",
+                            strategy=decode_strategy, batch=b,
+                            prompt_len=prompt_len, n_new=n_new):
+            toks, scores = jitted([p._value for p in params],
+                                  [bu._value for bu in buffers],
+                                  ids._value.astype(jnp.int32), key)
         return Tensor(toks), Tensor(scores)
 
 

@@ -69,10 +69,61 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor
 from ..autograd import no_grad
 from ..utils.faults import FaultError, fault_point
+from .. import observability as telemetry
 from .generation import RequestStatus
 
 __all__ = ["ContinuousBatchingEngine", "Request", "RequestStatus",
            "EngineOverloaded", "PoolExhausted", "EngineInvariantError"]
+
+
+# -- telemetry (docs/serving.md "Observability" metric catalog) --------
+# Instruments are process-global (all engines in a process aggregate)
+# and created unconditionally — recording is a no-op unless telemetry
+# is enabled (PDT_TELEMETRY=1 / telemetry.enable()).
+_M_QUEUE_DEPTH = telemetry.gauge(
+    "pdt_serving_queue_depth", "Requests waiting for a slot.")
+_M_RUNNING = telemetry.gauge(
+    "pdt_serving_running_slots", "Slots with an in-flight request.")
+_M_ADMISSIONS = telemetry.counter(
+    "pdt_serving_admissions_total",
+    "Requests admitted into a slot (prefill dispatched successfully).")
+_M_REJECTIONS = telemetry.counter(
+    "pdt_serving_rejections_total",
+    "add_request refusals by reason.", ("reason",))
+_M_TERMINAL = telemetry.counter(
+    "pdt_serving_requests_terminal_total",
+    "Requests reaching a terminal state, by final status.", ("status",))
+_M_TTFT = telemetry.histogram(
+    "pdt_serving_ttft_seconds",
+    "Time to first token: enqueue to first prefill token, engine clock.")
+_M_TPOT = telemetry.histogram(
+    "pdt_serving_tpot_seconds",
+    "Time per output token after the first, finished requests.",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5))
+_M_DECODE_STEP = telemetry.histogram(
+    "pdt_serving_decode_step_seconds",
+    "Wall time of one batched decode dispatch.")
+_M_DECODE_TOKENS = telemetry.counter(
+    "pdt_serving_decode_tokens_total",
+    "Tokens emitted by decode steps (excludes prefill first tokens).")
+_M_TOKENS_PER_SEC = telemetry.gauge(
+    "pdt_serving_tokens_per_sec",
+    "Decode throughput of the most recent step (active slots / wall).")
+_M_PREEMPTIONS = telemetry.counter(
+    "pdt_serving_preemptions_total",
+    "Preemption events (requeues and starvation finalizations).")
+_M_DECODE_RETRIES = telemetry.counter(
+    "pdt_serving_decode_retries_total",
+    "Transient decode-dispatch faults retried.")
+_M_PAGES_IN_USE = telemetry.gauge(
+    "pdt_serving_pages_in_use", "Allocated KV pages (paged layout).")
+_M_PAGE_OCCUPANCY = telemetry.gauge(
+    "pdt_serving_page_occupancy",
+    "Fraction of usable KV pages allocated (paged layout).")
+_M_INVARIANT_SECONDS = telemetry.histogram(
+    "pdt_serving_invariant_check_seconds",
+    "Duration of check_invariants() page-accounting sweeps.")
 
 
 class EngineOverloaded(RuntimeError):
@@ -104,6 +155,9 @@ class Request:
     enqueue_time: float = 0.0
     preemptions: int = 0
     error: Optional[str] = None
+    first_token_time: Optional[float] = None  # engine clock; TTFT/TPOT
+    arrival_time: float = 0.0      # original add_request tick: TTFT base
+    # (enqueue_time restarts on requeue — it feeds max_queue_time)
 
 
 class ContinuousBatchingEngine:
@@ -308,13 +362,14 @@ class ContinuousBatchingEngine:
                 f"{self.S} (need at least one decode position)")
         if self.max_waiting is not None \
                 and len(self._queue) >= self.max_waiting:
+            _M_REJECTIONS.inc(reason="queue_full")
             raise EngineOverloaded(
                 f"admission queue full ({self.max_waiting} waiting) — "
                 "shed load or retry after in-flight requests drain")
         now = self._clock()
         budget = deadline if deadline is not None else self.request_timeout
         r = Request(self._next_rid, toks, int(max_new_tokens),
-                    enqueue_time=now,
+                    enqueue_time=now, arrival_time=now,
                     deadline=None if budget is None else now + budget,
                     max_queue_time=max_queue_time
                     if max_queue_time is not None else self.max_queue_time)
@@ -330,11 +385,13 @@ class ContinuousBatchingEngine:
                     f"admitted; raise num_pages")
         if self.admission_policy is not None \
                 and not self.admission_policy(self, r):
+            _M_REJECTIONS.inc(reason="policy")
             raise EngineOverloaded(
                 f"admission policy rejected request (prompt {len(toks)} "
                 f"tokens, max_new_tokens {max_new_tokens})")
         self._next_rid += 1
         self._queue.append(r)
+        _M_QUEUE_DEPTH.set(len(self._queue))
         return r.rid
 
     def run(self) -> Dict[int, List[int]]:
@@ -371,12 +428,14 @@ class ContinuousBatchingEngine:
                     # bounded so an always-on fault cannot livelock
                     # run()
                     self.num_decode_retries += 1
+                    _M_DECODE_RETRIES.inc()
                     self._consec_decode_faults += 1
                     if self._consec_decode_faults \
                             > self.max_decode_retries:
                         raise
                     if self._invariants_enabled():
                         self.check_invariants()
+                    self._update_telemetry_gauges()
                     return finished
                 self._consec_decode_faults = 0
                 for i in active:
@@ -399,7 +458,21 @@ class ContinuousBatchingEngine:
             raise
         if self._invariants_enabled():
             self.check_invariants()
+        self._update_telemetry_gauges()
         return finished
+
+    def _update_telemetry_gauges(self):
+        """Refresh the point-in-time gauges once per step tick (queue
+        depth, running slots, page occupancy)."""
+        if not telemetry.enabled():
+            return
+        _M_QUEUE_DEPTH.set(len(self._queue))
+        _M_RUNNING.set(sum(r is not None for r in self._slot_req))
+        if self.layout == "paged":
+            usable = self.num_pages - 1
+            in_use = usable - len(self._free)
+            _M_PAGES_IN_USE.set(in_use)
+            _M_PAGE_OCCUPANCY.set(in_use / max(usable, 1))
 
     def lifecycle_info(self) -> Dict[str, int]:
         """Robustness counters + queue depth (≙ serving-stack SLO
@@ -481,6 +554,10 @@ class ContinuousBatchingEngine:
         EngineInvariantError listing every violation."""
         if self.layout != "paged":
             return
+        with _M_INVARIANT_SECONDS.time():
+            self._check_invariants_paged()
+
+    def _check_invariants_paged(self):
         errs: List[str] = []
         free = list(self._free)
         free_set = set(free)
@@ -538,14 +615,25 @@ class ContinuousBatchingEngine:
                 "engine invariant violations:\n  " + "\n  ".join(errs))
 
     # -- internals -----------------------------------------------------
-    @staticmethod
-    def _finalize(req: Request, status: str, error: Optional[str],
+    def _finalize(self, req: Request, status: str, error: Optional[str],
                   finished: List[Request]):
-        """The one place a request enters a terminal state."""
+        """The one place a request enters a terminal state — so the
+        per-status terminal counters reconcile EXACTLY with the request
+        objects handed back by step()."""
         req.done = True
         req.status = status
         req.error = error
         finished.append(req)
+        _M_TERMINAL.inc(status=status)
+        if telemetry.enabled():
+            n = len(req.output)
+            if status == RequestStatus.FINISHED and n >= 2 \
+                    and req.first_token_time is not None:
+                _M_TPOT.observe((self._clock() - req.first_token_time)
+                                / (n - 1))
+            telemetry.event("serving.terminal", rid=req.rid,
+                            status=status, tokens=n,
+                            preemptions=req.preemptions)
 
     def _effective_prompt(self, req: Request) -> List[int]:
         """What admission prefills: the original prompt plus everything
@@ -668,34 +756,39 @@ class ContinuousBatchingEngine:
             self._slot_seq[slot] = self._admit_seq
             self._admit_seq += 1
             try:
-                try:
-                    fault_point("serving.prefill")
-                    if shared:
-                        tok = self._admit_shared(slot, req, prompt,
-                                                 shared)
-                    elif self.layout == "paged" and self._chunk \
-                            and p_len >= self._chunk:
-                        tok = self._admit_chunked(slot, req, p_len,
-                                                  prompt)
-                    else:
-                        bucket = self._bucket(max(p_len, 1))
-                        jit = self._get_prefill(bucket)
-                        ids = np.zeros((1, bucket), np.int32)
-                        ids[0, :p_len] = prompt
-                        tok, rows = jit(
-                            [p._value for p in self._params],
-                            [b._value for b in self._buffers],
-                            jnp.asarray(ids), jnp.int32(p_len),
-                            self._next_keys())
-                        if self.layout == "paged":
-                            self._paged_insert(slot, req, p_len, bucket,
-                                               rows)
+                with telemetry.span("serving.prefill", rid=req.rid,
+                                    prompt_len=p_len,
+                                    shared_pages=len(shared)
+                                    if shared else 0):
+                    try:
+                        fault_point("serving.prefill")
+                        if shared:
+                            tok = self._admit_shared(slot, req, prompt,
+                                                     shared)
+                        elif self.layout == "paged" and self._chunk \
+                                and p_len >= self._chunk:
+                            tok = self._admit_chunked(slot, req, p_len,
+                                                      prompt)
                         else:
-                            self._dense_insert(slot, rows)
-                finally:
-                    if shared:
-                        for p in shared:
-                            self._decref(p)  # unpin: the slot holds refs
+                            bucket = self._bucket(max(p_len, 1))
+                            jit = self._get_prefill(bucket)
+                            ids = np.zeros((1, bucket), np.int32)
+                            ids[0, :p_len] = prompt
+                            tok, rows = jit(
+                                [p._value for p in self._params],
+                                [b._value for b in self._buffers],
+                                jnp.asarray(ids), jnp.int32(p_len),
+                                self._next_keys())
+                            if self.layout == "paged":
+                                self._paged_insert(slot, req, p_len,
+                                                   bucket, rows)
+                            else:
+                                self._dense_insert(slot, rows)
+                    finally:
+                        if shared:
+                            for p in shared:
+                                # unpin: the slot holds refs
+                                self._decref(p)
             except PoolExhausted:
                 # admission-time allocation failed (injected, or an
                 # accounting bug): back out and REQUEUE — pages free as
@@ -729,6 +822,12 @@ class ContinuousBatchingEngine:
             self._pos[slot] = p_len
             self._tok[slot] = int(tok)
             req.output.append(int(tok))
+            _M_ADMISSIONS.inc()
+            if telemetry.enabled() and req.first_token_time is None:
+                # once per request: a preempted request's re-admission
+                # must not re-observe TTFT
+                req.first_token_time = self._clock()
+                _M_TTFT.observe(req.first_token_time - req.arrival_time)
             if (self.eos is not None and int(tok) == self.eos) \
                     or len(req.output) >= req.max_new_tokens:
                 self._finalize(req, RequestStatus.FINISHED, None,
@@ -1119,6 +1218,10 @@ class ContinuousBatchingEngine:
         end-to-end budgets belong to `deadline`, and repeated bouncing
         is bounded by the starvation guard."""
         self.num_preemptions += 1
+        _M_PREEMPTIONS.inc()
+        telemetry.event("serving.preempt", rid=req.rid,
+                        preemptions=req.preemptions + 1,
+                        tokens=len(req.output))
         req.preemptions += 1
         if req.preemptions > self.max_preemptions:
             self._finalize(req, RequestStatus.PREEMPTED,
@@ -1209,16 +1312,27 @@ class ContinuousBatchingEngine:
         # fault BEFORE the dispatch (and before the PRNG key advances):
         # a retried step replays an identical sampling stream
         fault_point("serving.decode")
-        nxt, new_kv = self._decode_jit(
-            [p._value for p in self._params],
-            [b._value for b in self._buffers],
-            kv, jnp.asarray(self._tok), jnp.asarray(pos), bt,
-            self._next_keys())
-        if self.layout == "paged":
-            self._kv = new_kv
-        else:
-            self._caches = new_kv
-        nxt = np.asarray(nxt)
+        n_active = sum(r is not None for r in self._slot_req)
+        with telemetry.span("serving.decode_step", slots=n_active):
+            t0 = time.perf_counter()
+            nxt, new_kv = self._decode_jit(
+                [p._value for p in self._params],
+                [b._value for b in self._buffers],
+                kv, jnp.asarray(self._tok), jnp.asarray(pos), bt,
+                self._next_keys())
+            if self.layout == "paged":
+                self._kv = new_kv
+            else:
+                self._caches = new_kv
+            # the D2H copy is the step's sync point — dispatch alone
+            # returns before the device finishes, so time through it
+            nxt = np.asarray(nxt)
+            dt = time.perf_counter() - t0
+        if telemetry.enabled():
+            _M_DECODE_STEP.observe(dt)
+            _M_DECODE_TOKENS.inc(n_active)
+            if dt > 0:
+                _M_TOKENS_PER_SEC.set(n_active / dt)
         for i, r in enumerate(self._slot_req):
             if r is not None:
                 self._tok[i] = nxt[i]

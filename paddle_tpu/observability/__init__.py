@@ -1,0 +1,50 @@
+"""Runtime telemetry: metrics registry, span tracing, Prom/JSONL export.
+
+The measurement substrate for the production-serving north star —
+process-local, stdlib-only, and a guaranteed no-op unless enabled:
+
+    import paddle_tpu.observability as telemetry
+
+    telemetry.enable()               # or PDT_TELEMETRY=1 in the env
+    ...serve / train...
+    snap = telemetry.snapshot()      # JSON-safe programmatic view
+    print(telemetry.to_prometheus()) # text exposition for scrapers
+
+Three modules:
+
+* `registry` — typed Counter/Gauge/Histogram instruments (labels,
+  fixed bucket boundaries, monotonic-clock timers) behind the global
+  `REGISTRY`.
+* `trace` — nestable `span()` / point `event()` -> structured JSONL
+  into a bounded ring buffer + optional file sink
+  (`PDT_TELEMETRY_TRACE_FILE=`), interoperating with
+  `profiler.RecordEvent` so spans land in the XLA timeline too.
+* `export` — Prometheus text exposition + JSON snapshot, with a
+  `parse_prometheus()` round-trip verifier.
+
+Instrumented out of the box: the continuous-batching engine (TTFT,
+time-per-output-token, tokens/sec, queue depth, admissions/rejections,
+preemptions, page occupancy, terminal-status counters, invariant-check
+duration), `generate()` compile/dispatch, fault-injection fires,
+elastic launcher restarts + heartbeat staleness, and checkpoint
+save/load spans + bytes. Metric catalog: docs/serving.md
+"Observability".
+"""
+from __future__ import annotations
+
+from .registry import (DEFAULT_BUCKETS, REGISTRY, Counter, Gauge,  # noqa: F401
+                       Histogram, Registry, counter, disable, enable,
+                       enabled, gauge, histogram, reset, snapshot, value)
+from .trace import (clear as clear_events, event, events,  # noqa: F401
+                    set_trace_file, span, trace_file)
+from .export import (parse_prometheus, to_json, to_prometheus,  # noqa: F401
+                     write_json)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+    "DEFAULT_BUCKETS", "counter", "gauge", "histogram",
+    "enable", "disable", "enabled", "reset", "snapshot", "value",
+    "span", "event", "events", "clear_events", "set_trace_file",
+    "trace_file", "to_prometheus", "to_json", "write_json",
+    "parse_prometheus",
+]

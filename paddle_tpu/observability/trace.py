@@ -1,0 +1,191 @@
+"""Span tracing: nestable host-side spans -> structured JSONL events.
+
+Each completed span (and each point `event()`) becomes one dict —
+`{"name", "attrs", "ts", "dur_s", "seq", "depth", "parent"}` — appended
+to a bounded in-memory ring buffer (oldest dropped first, so a serving
+process can trace forever in O(1) memory) and, when a file sink is
+configured (`set_trace_file()` or `PDT_TELEMETRY_TRACE_FILE=`), written
+as one JSON line for offline tooling (`jq`, pandas, Perfetto
+converters).
+
+Spans NEST via a per-thread stack: `depth` and `parent` (the enclosing
+span's seq no) reconstruct the tree, and `seq` is a process-global
+monotone sequence so interleaved threads stay ordered. Timing is the
+monotonic clock (`time.perf_counter`); `ts` is wall time for log
+correlation only.
+
+Interop with the profiler shim: when telemetry is enabled, each span
+also enters a `paddle_tpu.profiler.RecordEvent`, so the same host span
+lands in the XLA timeline (TraceAnnotation) and in
+`Profiler.summary()`'s host-stats table. The import is lazy and
+fault-tolerant — the ring buffer works in processes that never import
+jax.
+
+Like the metrics registry, spans are a guaranteed no-op while telemetry
+is disabled: `span()` returns a singleton null context manager and
+`event()` returns immediately.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .registry import enabled
+
+__all__ = ["span", "event", "events", "clear", "set_trace_file",
+           "trace_file"]
+
+_RING_CAP = int(os.environ.get("PDT_TELEMETRY_TRACE_CAP", "4096"))
+_LOCK = threading.Lock()
+_RING: "deque[dict]" = deque(maxlen=_RING_CAP)
+_SEQ = itertools.count()
+_TLS = threading.local()
+
+_SINK_PATH: Optional[str] = None
+_SINK_FILE = None
+# True once the sink target is settled — either set_trace_file() was
+# called (its choice is final, including an explicit None = off) or the
+# env var has been consulted; _emit must not re-read the env after that
+_SINK_RESOLVED = False
+
+# paddle_tpu.profiler.RecordEvent, resolved lazily; False = unavailable
+_RECORD_EVENT = None
+
+
+def _record_event_cls():
+    global _RECORD_EVENT
+    if _RECORD_EVENT is None:
+        try:
+            from ..profiler import RecordEvent
+            _RECORD_EVENT = RecordEvent
+        except Exception:
+            _RECORD_EVENT = False
+    return _RECORD_EVENT
+
+
+def set_trace_file(path: Optional[str]):
+    """Route every event to `path` as JSON lines (append). None closes
+    the sink. Overrides `PDT_TELEMETRY_TRACE_FILE` either way — after
+    set_trace_file(None) the env var is NOT re-consulted."""
+    global _SINK_PATH, _SINK_FILE, _SINK_RESOLVED
+    with _LOCK:
+        if _SINK_FILE is not None:
+            _SINK_FILE.close()
+            _SINK_FILE = None
+        _SINK_PATH = path
+        _SINK_RESOLVED = True
+
+
+def trace_file() -> Optional[str]:
+    return _SINK_PATH
+
+
+def _emit(ev: dict):
+    global _SINK_PATH, _SINK_FILE, _SINK_RESOLVED
+    with _LOCK:
+        _RING.append(ev)
+        if not _SINK_RESOLVED:
+            _SINK_PATH = os.environ.get("PDT_TELEMETRY_TRACE_FILE") \
+                or None
+            _SINK_RESOLVED = True      # consult the env only once
+        if _SINK_PATH is not None:
+            if _SINK_FILE is None:
+                _SINK_FILE = open(_SINK_PATH, "a", buffering=1)
+            _SINK_FILE.write(json.dumps(ev) + "\n")
+
+
+def events() -> List[dict]:
+    """Snapshot of the ring buffer, oldest first."""
+    with _LOCK:
+        return list(_RING)
+
+
+def clear():
+    with _LOCK:
+        _RING.clear()
+
+
+class _NullSpan:
+    """Disabled-mode span: no state, no clock reads, reusable."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "_t0", "_ts", "_seq", "_depth",
+                 "_parent", "_rec")
+
+    def __init__(self, name: str, attrs: Dict[str, object]):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        stack = getattr(_TLS, "stack", None)
+        if stack is None:
+            stack = _TLS.stack = []
+        self._seq = next(_SEQ)
+        self._depth = len(stack)
+        self._parent = stack[-1] if stack else None
+        stack.append(self._seq)
+        rec_cls = _record_event_cls()
+        self._rec = None
+        if rec_cls:
+            try:
+                self._rec = rec_cls(self.name)
+                self._rec.begin()
+            except Exception:
+                self._rec = None       # profiler backend unavailable
+        self._ts = time.time()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur = time.perf_counter() - self._t0
+        if self._rec is not None:
+            try:
+                self._rec.end()
+            except Exception:
+                pass
+        stack = _TLS.stack
+        if stack and stack[-1] == self._seq:
+            stack.pop()
+        ev = {"name": self.name, "attrs": self.attrs, "ts": self._ts,
+              "dur_s": dur, "seq": self._seq, "depth": self._depth,
+              "parent": self._parent}
+        if exc_type is not None:
+            ev["attrs"] = dict(self.attrs,
+                               error=f"{exc_type.__name__}: {exc}")
+        _emit(ev)
+        return False
+
+
+def span(name: str, **attrs):
+    """`with span("serving.decode_step", slots=3): ...` — records one
+    JSONL event on exit (duration, nesting, attrs; an escaping
+    exception lands in `attrs["error"]`). No-op while disabled."""
+    if not enabled():
+        return _NULL_SPAN
+    return _Span(name, attrs)
+
+
+def event(name: str, **attrs):
+    """Point event (zero-duration span): fault fires, restarts,
+    membership changes. No-op while disabled."""
+    if not enabled():
+        return
+    stack = getattr(_TLS, "stack", None) or []
+    _emit({"name": name, "attrs": attrs, "ts": time.time(),
+           "dur_s": 0.0, "seq": next(_SEQ), "depth": len(stack),
+           "parent": stack[-1] if stack else None})

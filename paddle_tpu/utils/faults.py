@@ -44,7 +44,15 @@ import random
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Type
 
+from .. import observability as telemetry
+
 __all__ = ["FaultError", "FaultInjector", "fault_point"]
+
+# chaos runs assert fault counts via telemetry.snapshot() (site label),
+# not only via exception side effects — docs/serving.md "Observability"
+_M_FAULT_FIRES = telemetry.counter(
+    "pdt_faults_fired_total",
+    "Injected faults raised, by fault-point site.", ("site",))
 
 
 class FaultError(RuntimeError):
@@ -147,6 +155,9 @@ class FaultInjector:
         if not fire:
             return
         rule.trips += 1
+        _M_FAULT_FIRES.inc(site=site)
+        telemetry.event("fault.fire", site=site, visit=rule.calls,
+                        exc=rule.exc.__name__)
         msg = f"injected fault at {site!r} (visit #{rule.calls})"
         err = rule.exc(msg)
         if isinstance(err, FaultError):

@@ -39,9 +39,14 @@ def main(argv=None):
 
     import numpy as np
     import paddle_tpu as paddle
+    import paddle_tpu.observability as telemetry
     from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
     from paddle_tpu.models.serving import ContinuousBatchingEngine
     from paddle_tpu.models.speculative import speculative_generate
+
+    # live demo of the metric catalog: every path below records, and the
+    # recipe ends with the Prometheus dump a scraper would see
+    telemetry.enable()
 
     if args.hf:
         # transformers loads the checkpoint; the converter copies weights
@@ -140,6 +145,18 @@ def main(argv=None):
           f"finished={statuses.count(RequestStatus.FINISHED)}, "
           f"pages_in_use="
           f"{eng.cache_memory_info()['pages_in_use']}")
+
+    # 3b) telemetry: the serving + chaos drill above populated the
+    # metric catalog — dump the text exposition a Prometheus scraper
+    # would collect, and prove it reconciles with what we observed
+    snap = telemetry.snapshot()
+    term = snap["counters"]["pdt_serving_requests_terminal_total"]
+    assert term['status="failed"'] == statuses.count(RequestStatus.FAILED)
+    assert telemetry.value("pdt_faults_fired_total",
+                           site="serving.prefill") == 1
+    print("--- telemetry (Prometheus text exposition) ---")
+    print(telemetry.to_prometheus(), end="")
+    print("--- end telemetry ---")
 
     # 4) speculative decoding (draft = shallow copy of the config)
     d_cfg = LlamaConfig(

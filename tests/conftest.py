@@ -50,6 +50,45 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: deterministic fault-injection tests "
         "(utils.faults) — CPU-mesh fast tier, runs in tier-1")
+    config.addinivalue_line(
+        "markers", "telemetry: observability-subsystem tests "
+        "(paddle_tpu.observability) — CPU-mesh fast tier, runs in "
+        "tier-1")
+
+
+# serving/chaos/telemetry suites run with telemetry RECORDING on, each
+# test from a zeroed registry/ring, so (a) the instrumentation paths are
+# exercised by the whole engine suite for free and (b) a failing test's
+# report carries a telemetry snapshot for post-mortem debugging
+_TELEMETRY_FILES = ("test_serving.py", "test_chaos.py",
+                    "test_telemetry.py")
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_enabled(request, monkeypatch):
+    if os.path.basename(str(request.fspath)) in _TELEMETRY_FILES:
+        import paddle_tpu.observability as telemetry
+        monkeypatch.setenv("PDT_TELEMETRY", "1")
+        telemetry.reset()
+        telemetry.clear_events()
+    yield
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    rep = outcome.get_result()
+    if rep.when == "call" and rep.failed and os.path.basename(
+            str(item.fspath)) in _TELEMETRY_FILES:
+        try:
+            import json
+            import paddle_tpu.observability as telemetry
+            rep.sections.append(
+                ("telemetry snapshot",
+                 json.dumps(telemetry.snapshot(), indent=1,
+                            sort_keys=True, default=str)))
+        except Exception:
+            pass        # a broken dump must never mask the real failure
 
 
 @pytest.fixture(autouse=True)

@@ -12,6 +12,7 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+import paddle_tpu.observability as telemetry
 from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.models.serving import (ContinuousBatchingEngine,
                                        EngineInvariantError,
@@ -161,6 +162,11 @@ class TestEngineChaos:
                    for r in rids)
         assert reqs[rids[1]].preemptions == 1   # youngest took the hit
         assert eng.cache_memory_info()["pages_in_use"] == 0
+        # chaos runs are assertable via telemetry, not just side effects
+        assert telemetry.value("pdt_faults_fired_total",
+                               site="serving.alloc_page") \
+            == fi.trips("serving.alloc_page") == 1
+        assert telemetry.value("pdt_serving_preemptions_total") == 1
 
     def test_self_preemption_resumes_and_matches(self, model):
         """Single slot: the faulting slot IS the youngest. It must
@@ -224,6 +230,14 @@ class TestEngineChaos:
         assert reqs[b].status == RequestStatus.FINISHED
         assert reqs[b].output == ref[1]         # untouched by the fault
         assert eng.num_failures == 1
+        snap = telemetry.snapshot()
+        assert snap["counters"]["pdt_faults_fired_total"][
+            'site="serving.prefill"'] == 1
+        assert snap["counters"]["pdt_serving_requests_terminal_total"][
+            'status="failed"'] == 1
+        assert any(e["name"] == "fault.fire"
+                   and e["attrs"]["site"] == "serving.prefill"
+                   for e in telemetry.events())
         # the engine keeps serving after the failure
         c = eng.add_request(jobs[0][0], 8)
         assert eng.run()[c] == ref[0]
