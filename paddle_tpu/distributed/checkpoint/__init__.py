@@ -8,6 +8,12 @@ that mechanism, mature — every array is written as a sharded tensorstore
 with a global-shape manifest, and restore hands each tensor its NEW
 NamedSharding so resharding happens on read (different dp/mp/pp degrees,
 different device counts).
+
+Durability layer (`manifest.py`, protocol in docs/checkpointing.md):
+integrity manifests (`build_manifest`/`write_manifest`), commit markers
+(`write_done`/`parse_done`), and `verify_checkpoint` — also a CLI:
+`python -m paddle_tpu.distributed.checkpoint verify <dir>`. The atomic
+tmp+rename commit protocol itself lives in `fleet.elastic.ElasticManager`.
 """
 from __future__ import annotations
 
@@ -19,8 +25,20 @@ import jax
 
 from ... import observability as telemetry
 from ...core.tensor import Parameter, Tensor
+from .manifest import (CheckpointIntegrityError, DONE_NAME,  # noqa: F401
+                       MANIFEST_NAME, VerifyResult, array_checksum,
+                       build_manifest, describe_arrays, parse_done,
+                       read_manifest, verify_checkpoint, write_done,
+                       write_manifest)
 
-__all__ = ["save_state_dict", "load_state_dict", "load_state_dict_raw"]
+__all__ = [
+    "save_state_dict", "load_state_dict", "load_state_dict_raw",
+    # durability layer (manifest.py; protocol in docs/checkpointing.md)
+    "MANIFEST_NAME", "DONE_NAME", "CheckpointIntegrityError",
+    "VerifyResult", "array_checksum", "describe_arrays",
+    "build_manifest", "write_manifest", "read_manifest", "write_done",
+    "parse_done", "verify_checkpoint", "flat_arrays",
+]
 
 _M_CKPT_OPS = telemetry.counter(
     "pdt_checkpoint_ops_total",
@@ -67,6 +85,13 @@ def _values(flat):
     return vals
 
 
+def flat_arrays(state_dict: Dict[str, Any]) -> Dict[str, Any]:
+    """Flatten a (possibly nested) state_dict of Tensors/arrays into the
+    {dotted_key: jax.Array} form the on-disk checkpoint uses — the same
+    keys `save_state_dict` writes and manifests describe."""
+    return _values(_flatten(state_dict))
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     async_save: bool = False):
@@ -86,8 +111,15 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         ckptr = (ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
                  if async_save else ocp.PyTreeCheckpointer())
         ckptr.save(path, flat, force=True)
+        # chaos site: fires AFTER this group's bytes are on disk — an
+        # injected write failure mid-protocol leaves a torn multi-group
+        # checkpoint (some groups written, no manifest), which is what
+        # resume-time verification must catch. An async save has only
+        # been DISPATCHED here, so the site fires in
+        # wait_until_finished() instead, once the bytes actually land.
         nbytes = _nbytes(flat.values())
         if not async_save:
+            fault_point("checkpoint.write")
             _M_CKPT_OPS.inc(op="save")
             _M_CKPT_BYTES.inc(nbytes, op="save")
     if async_save:
@@ -99,6 +131,7 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         def _wait_and_count(*a, _done=[False], **kw):
             out = orig_wait(*a, **kw)
             if not _done[0]:
+                fault_point("checkpoint.write")
                 _done[0] = True
                 _M_CKPT_OPS.inc(op="save")
                 _M_CKPT_BYTES.inc(nbytes, op="save")
@@ -114,9 +147,11 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     """Restore `path` INTO state_dict (in place): every Tensor receives the
     checkpoint values resharded to that tensor's CURRENT sharding — the
     cross-mesh reshard plan of the reference, done by tensorstore reads."""
+    from ...utils.faults import fault_point
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     with telemetry.span("checkpoint.load", path=path):
+        fault_point("checkpoint.load")
         flat_t = _flatten(state_dict)
         restore_args = {}
         targets = {}
@@ -143,9 +178,11 @@ def load_state_dict_raw(path: str) -> Dict[str, Any]:
     """Restore a checkpoint WITHOUT a target structure: returns the flat
     {dotted_key: jax.Array} dict as saved. For consumers whose state is
     created lazily (optimizer accumulators) — feed into set_state_dict."""
+    from ...utils.faults import fault_point
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     with telemetry.span("checkpoint.load", path=path, raw=True):
+        fault_point("checkpoint.load")
         ckptr = ocp.PyTreeCheckpointer()
         restored = ckptr.restore(path)
         _M_CKPT_OPS.inc(op="load")

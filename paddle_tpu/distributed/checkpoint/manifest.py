@@ -1,0 +1,341 @@
+"""Integrity manifests + verification for durable checkpoints.
+
+A sharded checkpoint is MANY files committed independently (GSPMD-style
+arrays, one tensorstore per array — arxiv 2105.04663), so partial
+failure is the common case, not the rare one. The durability protocol
+(docs/checkpointing.md) therefore records, next to the data, a
+`MANIFEST.json` describing every array the writer intended to commit:
+
+    {
+      "format": "paddle-tpu-ckpt-manifest",
+      "version": 1,
+      "step": 42,
+      "wall_time": 1722700000.0,
+      "mesh": {"device_count": 8, "process_count": 1},
+      "groups": {
+        "model": {
+          "layers.0.attn.q_proj.weight": {
+            "shape": [256, 256], "dtype": "float32",
+            "nbytes": 262144, "checksum": "sha256:ab12...",
+            "sharding": "PartitionSpec('mp', None)"
+          }, ...
+        },
+        "opt": {...}
+      }
+    }
+
+`verify_checkpoint` replays that intent against what is actually on
+disk: manifest present and parsable, `.done` marker valid, every group
+restorable, key sets equal, shapes/dtypes/nbytes matching — and, with
+`rehash=True`, content checksums re-hashed so silently flipped bytes
+are caught, not just torn writes. `ElasticManager.resume` runs this
+before trusting a checkpoint; the CLI form is
+
+    python -m paddle_tpu.distributed.checkpoint verify <dir> [--rehash]
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ... import observability as telemetry
+
+__all__ = [
+    "MANIFEST_NAME", "DONE_NAME", "CheckpointIntegrityError",
+    "VerifyResult", "array_checksum", "describe_arrays",
+    "build_manifest", "write_manifest", "read_manifest", "write_done",
+    "parse_done", "verify_checkpoint",
+]
+
+MANIFEST_NAME = "MANIFEST.json"
+DONE_NAME = ".done"
+_FORMAT = "paddle-tpu-ckpt-manifest"
+_VERSION = 1
+
+_M_VERIFY_SECONDS = telemetry.histogram(
+    "pdt_checkpoint_verify_seconds",
+    "Wall time of verify_checkpoint passes.")
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint failed its integrity manifest (missing, torn, or
+    content-mismatched). `errors` carries the individual findings."""
+
+    def __init__(self, path: str, errors: List[str]):
+        super().__init__(
+            f"checkpoint {path!r} failed integrity verification: "
+            + "; ".join(errors))
+        self.path = path
+        self.errors = errors
+
+
+@dataclass
+class VerifyResult:
+    """Outcome of one `verify_checkpoint` pass."""
+    path: str
+    errors: List[str] = field(default_factory=list)
+    arrays_checked: int = 0
+    rehashed: bool = False
+    step: Optional[int] = None
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def raise_if_failed(self):
+        if self.errors:
+            raise CheckpointIntegrityError(self.path, self.errors)
+        return self
+
+
+def array_checksum(arr) -> str:
+    """Content checksum of one (possibly sharded) array: sha256 over the
+    row-major host bytes. Sharded jax.Arrays are gathered to the host
+    first — fine at single-process scale; multi-host writers would hash
+    per-shard instead (noted in docs/checkpointing.md)."""
+    import numpy as np
+    host = np.ascontiguousarray(np.asarray(arr))
+    return "sha256:" + hashlib.sha256(host.tobytes()).hexdigest()
+
+
+def _sharding_summary(arr) -> Optional[str]:
+    sharding = getattr(arr, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    return str(spec) if spec is not None else None
+
+
+def describe_arrays(flat: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Manifest entries for a flat {dotted_key: array} dict."""
+    out = {}
+    for key, arr in sorted(flat.items()):
+        entry = {
+            "shape": [int(d) for d in getattr(arr, "shape", ())],
+            "dtype": str(getattr(arr, "dtype", "")),
+            "nbytes": int(getattr(arr, "nbytes", 0)),
+            "checksum": array_checksum(arr),
+        }
+        spec = _sharding_summary(arr)
+        if spec is not None:
+            entry["sharding"] = spec
+        out[key] = entry
+    return out
+
+
+def build_manifest(groups: Dict[str, Dict[str, Any]],
+                   step: Optional[int] = None,
+                   wall_time: Optional[float] = None) -> Dict[str, Any]:
+    """Assemble the manifest dict for {group_name: flat_arrays}.
+    Pass `wall_time` when the caller runs on an injectable clock (as
+    ElasticManager does) so the manifest and the `.done` marker tell
+    the same post-mortem timeline."""
+    import jax
+    return {
+        "format": _FORMAT,
+        "version": _VERSION,
+        "step": step,
+        "wall_time": time.time() if wall_time is None else wall_time,
+        "mesh": {
+            "device_count": jax.device_count(),
+            "process_count": jax.process_count(),
+        },
+        "groups": {g: describe_arrays(flat)
+                   for g, flat in groups.items()},
+    }
+
+
+def _atomic_write_text(path: str, text: str):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def write_manifest(ckpt_dir: str, manifest: Dict[str, Any]) -> str:
+    """Write MANIFEST.json into `ckpt_dir` atomically (tmp + rename —
+    the same discipline as heartbeat files: a reader must never observe
+    a truncated manifest from a healthy writer)."""
+    path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    _atomic_write_text(path, json.dumps(manifest, indent=1, sort_keys=True))
+    return path
+
+
+def read_manifest(ckpt_dir: str) -> Dict[str, Any]:
+    """Load and structurally validate MANIFEST.json; raises
+    :class:`CheckpointIntegrityError` when absent or unparsable."""
+    path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except OSError:
+        raise CheckpointIntegrityError(
+            ckpt_dir, [f"missing {MANIFEST_NAME}"])
+    except ValueError as e:
+        raise CheckpointIntegrityError(
+            ckpt_dir, [f"unparsable {MANIFEST_NAME}: {e}"])
+    if (not isinstance(manifest, dict)
+            or manifest.get("format") != _FORMAT
+            or not isinstance(manifest.get("groups"), dict)):
+        raise CheckpointIntegrityError(
+            ckpt_dir, [f"malformed {MANIFEST_NAME}: not a "
+                       f"{_FORMAT} document"])
+    return manifest
+
+
+def write_done(ckpt_dir: str, step: Optional[int] = None,
+               wall_time: Optional[float] = None) -> str:
+    """Commit marker, written atomically AFTER the data + manifest are
+    in place. JSON payload so `parse_done` can reject torn markers."""
+    path = os.path.join(ckpt_dir, DONE_NAME)
+    payload = {"step": step,
+               "time": time.time() if wall_time is None else wall_time}
+    _atomic_write_text(path, json.dumps(payload))
+    return path
+
+
+def parse_done(done_path: str) -> Optional[Dict[str, Any]]:
+    """Parse a `.done` marker. Returns its payload dict, or None when
+    the marker is missing, empty, or garbage — a zero-byte `.done` from
+    a non-atomic writer must read as NOT committed. Accepts the legacy
+    bare-float payload (pre-manifest checkpoints) for backward compat."""
+    try:
+        with open(done_path) as f:
+            raw = f.read().strip()
+    except OSError:
+        return None
+    if not raw:
+        return None
+    try:
+        payload = json.loads(raw)
+        if isinstance(payload, dict):
+            return payload
+        # bool is an int subclass: a garbage marker reading "true" must
+        # NOT parse as a legacy bare-float timestamp
+        if (isinstance(payload, (int, float))
+                and not isinstance(payload, bool)):
+            return {"step": None, "time": float(payload)}
+        return None
+    except ValueError:
+        pass
+    try:
+        return {"step": None, "time": float(raw)}
+    except ValueError:
+        return None
+
+
+def _restore_raw(path: str) -> Dict[str, Any]:
+    # direct orbax restore: verify reads must not count as checkpoint
+    # "load" traffic in pdt_checkpoint_ops_total
+    import orbax.checkpoint as ocp
+    return ocp.PyTreeCheckpointer().restore(path)
+
+
+def _metadata_raw(path: str) -> Dict[str, Any]:
+    # tensorstore-spec read only — no array bytes touched, which is
+    # what makes the light verify tier cheap on multi-GB checkpoints
+    import orbax.checkpoint as ocp
+    md = ocp.PyTreeCheckpointer().metadata(path)
+    if md is None:
+        raise FileNotFoundError(f"no checkpoint metadata under {path}")
+    return md
+
+
+def verify_checkpoint(path: str, rehash: bool = False) -> VerifyResult:
+    """Integrity pass over one committed checkpoint directory (a
+    `step_N` produced by the atomic commit protocol).
+
+    Checks, accumulating every finding instead of stopping at the
+    first: MANIFEST.json present/parsable, `.done` marker valid, each
+    group directory readable, on-disk key set == manifest key set, and
+    per-array shape/dtype/nbytes match. With `rehash=False` (the light
+    tier) the group check reads only checkpoint *metadata* — no array
+    bytes are materialized, so it stays cheap on multi-GB checkpoints.
+    With `rehash=True` every array is restored and its content checksum
+    recomputed — the only check that catches silently flipped bytes
+    that still deserialize.
+    """
+    res = VerifyResult(path=os.path.abspath(path), rehashed=rehash)
+    t0 = time.monotonic()
+    try:
+        with telemetry.span("checkpoint.verify", path=res.path,
+                            rehash=rehash):
+            _verify_into(res, path, rehash)
+    finally:
+        _M_VERIFY_SECONDS.observe(time.monotonic() - t0)
+    return res
+
+
+def _verify_into(res: VerifyResult, path: str, rehash: bool):
+    if not os.path.isdir(path):
+        res.errors.append("not a directory")
+        return
+    try:
+        manifest = read_manifest(path)
+    except CheckpointIntegrityError as e:
+        res.errors.extend(e.errors)
+        return
+    res.step = manifest.get("step")
+    if parse_done(os.path.join(path, DONE_NAME)) is None:
+        res.errors.append(f"missing or unparsable {DONE_NAME} marker")
+    for group, expected in sorted(manifest["groups"].items()):
+        gdir = os.path.join(path, group)
+        try:
+            restored = _restore_raw(gdir) if rehash else _metadata_raw(gdir)
+        except Exception as e:      # torn tensorstore, missing dir, ...
+            res.errors.append(
+                f"group {group!r} unrestorable: "
+                f"{type(e).__name__}: {e}")
+            continue
+        missing = sorted(set(expected) - set(restored))
+        unexpected = sorted(set(restored) - set(expected))
+        if missing:
+            res.errors.append(
+                f"group {group!r} missing arrays: {missing}")
+        if unexpected:
+            res.errors.append(
+                f"group {group!r} has arrays absent from the "
+                f"manifest: {unexpected}")
+        for key in sorted(set(expected) & set(restored)):
+            want, arr = expected[key], restored[key]
+            res.arrays_checked += 1
+            got_shape = [int(d) for d in getattr(arr, "shape", ())]
+            if got_shape != list(want.get("shape", [])):
+                res.errors.append(
+                    f"{group}/{key}: shape {got_shape} != manifest "
+                    f"{want.get('shape')}")
+            if str(getattr(arr, "dtype", "")) != want.get("dtype"):
+                res.errors.append(
+                    f"{group}/{key}: dtype "
+                    f"{getattr(arr, 'dtype', None)} != manifest "
+                    f"{want.get('dtype')}")
+            got_nbytes = _entry_nbytes(arr, got_shape)
+            if got_nbytes is not None and got_nbytes != want.get("nbytes"):
+                res.errors.append(
+                    f"{group}/{key}: nbytes {got_nbytes} != manifest "
+                    f"{want.get('nbytes')}")
+            elif rehash and array_checksum(arr) != want.get("checksum"):
+                res.errors.append(
+                    f"{group}/{key}: content checksum mismatch "
+                    "(flipped bytes?)")
+
+
+def _entry_nbytes(arr, shape: List[int]) -> Optional[int]:
+    """On-disk byte size of one verified entry. Restored arrays carry
+    it; metadata-only objects (light tier) don't, so it is derived from
+    the on-disk shape x dtype itemsize. None when the dtype is unknown
+    (reported upstream as a dtype mismatch, not a phantom nbytes one)."""
+    nbytes = getattr(arr, "nbytes", None)
+    if nbytes is not None:
+        return int(nbytes)
+    import numpy as np
+    try:
+        itemsize = int(np.dtype(str(getattr(arr, "dtype", ""))).itemsize)
+    except TypeError:
+        return None
+    size = 1
+    for d in shape:
+        size *= d
+    return size * itemsize

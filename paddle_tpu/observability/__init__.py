@@ -26,9 +26,10 @@ Instrumented out of the box: the continuous-batching engine (TTFT,
 time-per-output-token, tokens/sec, queue depth, admissions/rejections,
 preemptions, page occupancy, terminal-status counters, invariant-check
 duration), `generate()` compile/dispatch, fault-injection fires,
-elastic launcher restarts + heartbeat staleness, and checkpoint
-save/load spans + bytes. Metric catalog: docs/serving.md
-"Observability".
+elastic launcher restarts + heartbeat staleness, checkpoint save/load
+spans + bytes, and checkpoint durability (save retries, quarantines,
+resume fallback depth, verify duration — docs/checkpointing.md).
+Metric catalog: docs/serving.md "Observability".
 """
 from __future__ import annotations
 

@@ -35,8 +35,19 @@ Usage::
     assert fi.trips("serving.prefill") == 1
 
 Instrumented sites (grep ``fault_point(`` for the live list):
-``serving.alloc_page``, ``serving.prefill``, ``serving.decode``,
-``checkpoint.save``.
+
+* ``serving.alloc_page``, ``serving.prefill``, ``serving.decode`` —
+  continuous-batching engine (models/serving.py);
+* ``checkpoint.save`` — before any byte of a state-dict write;
+  ``checkpoint.write`` — after one group's bytes land (fires between
+  groups of a multi-group save: forces torn ``step_N.tmp`` dirs; for
+  ``async_save`` it fires in ``wait_until_finished()``, where the
+  bytes actually land);
+  ``checkpoint.finalize`` — before the tmp->final rename + ``.done``
+  commit; ``checkpoint.load`` — before a restore
+  (distributed/checkpoint/);
+* ``elastic.gc`` — checkpoint garbage collection
+  (fleet/elastic.py ``ElasticManager._gc``).
 """
 from __future__ import annotations
 
@@ -46,7 +57,8 @@ from typing import Dict, List, Optional, Type
 
 from .. import observability as telemetry
 
-__all__ = ["FaultError", "FaultInjector", "fault_point"]
+__all__ = ["FaultError", "FaultInjector", "fault_point",
+           "flip_ocdbt_shards"]
 
 # chaos runs assert fault counts via telemetry.snapshot() (site label),
 # not only via exception side effects — docs/serving.md "Observability"
@@ -163,6 +175,26 @@ class FaultInjector:
         if isinstance(err, FaultError):
             err.site = site
         raise err
+
+
+def flip_ocdbt_shards(step_dir, group: str = "model") -> int:
+    """Corrupt one byte in every OCDBT data file of a checkpoint
+    group — silent disk damage under a still-valid `.done` marker, the
+    disk-level sibling of the exception injection above (chaos tests +
+    the docs/checkpointing.md resume drill). Asserts data files exist
+    so a future orbax layout change fails loudly here, not in a
+    downstream resume assertion. Returns the number of files damaged."""
+    import glob
+    import os
+    files = glob.glob(os.path.join(str(step_dir), group, "d", "*"))
+    assert files, f"no OCDBT data files under {step_dir}/{group}/d"
+    for p in files:
+        with open(p, "r+b") as f:
+            blob = bytearray(f.read())
+            blob[len(blob) // 2] ^= 0xFF
+            f.seek(0)
+            f.write(blob)
+    return len(files)
 
 
 def fault_point(site: str) -> None:

@@ -6,9 +6,12 @@ the bench.py shape). ≙ BASELINE.json configs[1] / SURVEY.md §6.
     python recipes/llama_pretrain.py --size bench --recompute \
         --accumulate-steps 4
     python recipes/llama_pretrain.py --mesh dp=2,sharding=4    # 8-dev CPU
+    python recipes/llama_pretrain.py --steps 2 --size tiny --resume-drill
 
 `--mesh` shards the step over a device mesh (GSPMD; batch on dp,
-ZeRO on sharding, Megatron placements on mp).
+ZeRO on sharding, Megatron placements on mp). `--resume-drill` runs the
+durable-checkpoint save->corrupt->resume drill (docs/checkpointing.md)
+and prints its telemetry snapshot.
 """
 import os
 import sys
@@ -18,6 +21,46 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 from recipes.common import RecipeResult, run_train, std_parser, \
     token_source  # noqa: E402
+
+
+def run_resume_drill(model, optimizer, ckpt_dir):
+    """Save -> corrupt -> resume drill (docs/checkpointing.md): commit
+    two checkpoints through the atomic protocol, verify both, flip
+    bytes in the newest one's shards, and prove `ElasticManager.resume`
+    quarantines it and falls back — then print the telemetry a real
+    incident would leave behind (as llama_serve.py does for serving)."""
+    import paddle_tpu.observability as telemetry
+    from paddle_tpu.distributed.checkpoint import verify_checkpoint
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    from paddle_tpu.utils.faults import flip_ocdbt_shards
+
+    telemetry.enable()
+    print("--- durable-checkpoint resume drill ---")
+    em = ElasticManager(ckpt_dir, save_interval_steps=1,
+                        sleep=lambda _: None)
+    em.save(0, model, optimizer)
+    em.save(1, model, optimizer)
+    for step in (0, 1):
+        res = verify_checkpoint(os.path.join(ckpt_dir, f"step_{step}"),
+                                rehash=True)
+        print(f"verify step_{step}: ok={res.ok} "
+              f"({res.arrays_checked} arrays re-hashed)")
+    # flip one byte in every OCDBT data file of the newest checkpoint's
+    # model group — a silent disk corruption, .done marker still valid
+    n = flip_ocdbt_shards(os.path.join(ckpt_dir, "step_1"))
+    print(f"corrupted step_1 (flipped bytes in {n} model shards)")
+    start = em.resume(model, optimizer)
+    quarantined = sorted(n for n in os.listdir(ckpt_dir)
+                         if n.endswith(".corrupt"))
+    print(f"resume fell back to start step {start} "
+          f"(quarantined: {quarantined})")
+    assert start == 1 and quarantined == ["step_1.corrupt"], (
+        start, quarantined)
+    print("--- checkpoint telemetry (Prometheus text exposition) ---")
+    for line in telemetry.to_prometheus().splitlines():
+        if "pdt_checkpoint" in line:
+            print(line)
+    print("--- end drill ---")
 
 
 def parse_mesh(spec: str):
@@ -36,6 +79,9 @@ def main(argv=None):
     p.add_argument("--bf16", action="store_true")
     p.add_argument("--mesh", type=str, default=None,
                    help="e.g. dp=2,sharding=2,mp=2")
+    p.add_argument("--resume-drill", action="store_true",
+                   help="after training, run the save->corrupt->resume "
+                        "durability drill and print its telemetry")
     args = p.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -101,6 +147,12 @@ def main(argv=None):
     if args.save:
         paddle.save(model.state_dict(), args.save)
         print(f"saved {args.save}")
+    if args.resume_drill:
+        import tempfile
+        opt = AdamW(learning_rate=args.lr,
+                    parameters=model.parameters(), weight_decay=0.01)
+        with tempfile.TemporaryDirectory(prefix="pdt_ckpt_drill_") as d:
+            run_resume_drill(model, opt, d)
     return RecipeResult(final, args.steps)
 
 

@@ -61,7 +61,7 @@ def pytest_configure(config):
 # exercised by the whole engine suite for free and (b) a failing test's
 # report carries a telemetry snapshot for post-mortem debugging
 _TELEMETRY_FILES = ("test_serving.py", "test_chaos.py",
-                    "test_telemetry.py")
+                    "test_telemetry.py", "test_elastic_robustness.py")
 
 
 @pytest.fixture(autouse=True)

@@ -370,3 +370,157 @@ class TestCheckpointChaos:
         assert latest_checkpoint(str(tmp_path)).endswith("step_0")
         em.save(2, net)                          # heals once fault clears
         assert latest_checkpoint(str(tmp_path)).endswith("step_2")
+
+    def test_kill_mid_save_resumes_previous_and_quarantines(
+            self, tmp_path):
+        """The acceptance drill (ISSUE 3): torn tmp from a write fault,
+        a .done-marked dir with no loadable data, and a bit-flipped
+        newest checkpoint — resume() must land on the last good step,
+        quarantine the bad ones, and the telemetry must reconcile
+        exactly with the injected damage."""
+        import os
+
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.checkpoint import (verify_checkpoint,
+                                                       write_done)
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticManager, latest_checkpoint)
+        from paddle_tpu.optimizer import Adam
+
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        opt = Adam(learning_rate=1e-2, parameters=net.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = (net(x) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        em = ElasticManager(str(tmp_path), save_interval_steps=1,
+                            save_retries=1, sleep=lambda _: None)
+        em.save(0, net, opt)
+        w0 = net.weight.numpy().copy()
+        em.save(1, net, opt)
+
+        # damage 1: kill mid-save of step 2 — write fault with no
+        # retries budget leaves a torn step_2.tmp (model group written,
+        # no manifest, never renamed)
+        with FaultInjector() as fi:
+            fi.arm("checkpoint.write", nth=1)
+            with pytest.raises(FaultError):
+                em.save(2, net, opt)
+        assert (tmp_path / "step_2.tmp").exists()
+        # damage 2: a committed-looking dir whose data never landed
+        (tmp_path / "step_3").mkdir()
+        write_done(str(tmp_path / "step_3"), step=3)
+        # damage 3: silent bit flips in the newest real checkpoint
+        from paddle_tpu.utils.faults import flip_ocdbt_shards
+        flip_ocdbt_shards(tmp_path / "step_1")
+        assert latest_checkpoint(str(tmp_path)).endswith("step_3")
+
+        paddle.seed(1)
+        net2 = nn.Linear(4, 4)
+        opt2 = Adam(learning_rate=1e-2, parameters=net2.parameters())
+        start = em.resume(net2, opt2)
+
+        # landed on the previous complete step, with its exact weights
+        assert start == 1
+        np.testing.assert_array_equal(net2.weight.numpy(), w0)
+        names = {p.name for p in tmp_path.iterdir()}
+        assert "step_3.corrupt" in names     # missing data: load fail
+        assert "step_1.corrupt" in names     # flipped bytes: verify
+        assert "step_2.tmp" in names         # ignored, never trusted
+        assert "step_0" in names
+        # telemetry reconciles 1:1 with the injected damage
+        assert telemetry.value("pdt_faults_fired_total",
+                               site="checkpoint.write") == 1
+        assert telemetry.value("pdt_checkpoint_corrupt_total",
+                               reason="load") == 1
+        assert telemetry.value("pdt_checkpoint_corrupt_total",
+                               reason="verify") == 1
+        assert telemetry.value(
+            "pdt_checkpoint_resume_fallbacks_total") == 2
+        assert telemetry.value(
+            "pdt_checkpoint_resume_fallback_depth") == 2
+        # the survivor still verifies clean, checksums and all
+        assert verify_checkpoint(str(tmp_path / "step_0"),
+                                 rehash=True).ok
+
+    def test_interrupted_then_retried_save_verifies_clean(
+            self, tmp_path):
+        """Acceptance: a save interrupted at EVERY protocol stage and
+        then retried (in-place via backoff, or by a fresh call) must
+        commit a checkpoint that verifies clean — no half-written state
+        leaks across attempts."""
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.checkpoint import verify_checkpoint
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        em = ElasticManager(str(tmp_path), save_interval_steps=1,
+                            save_retries=3, keep_last=3,
+                            sleep=lambda _: None)
+        with FaultInjector() as fi:
+            fi.arm("checkpoint.write", nth=1)     # retried in place
+            em.save(0, net)
+        with FaultInjector() as fi:
+            fi.arm("checkpoint.finalize", nth=1)  # retried in place
+            em.save(1, net)
+        # exhaust retries entirely, then heal with a fresh save() call
+        em2 = ElasticManager(str(tmp_path), save_interval_steps=1,
+                             save_retries=1, keep_last=3,
+                             sleep=lambda _: None)
+        with FaultInjector() as fi:
+            fi.arm("checkpoint.write", always=True)
+            with pytest.raises(FaultError):
+                em2.save(2, net)
+        em2.save(2, net)
+        for step in (0, 1, 2):
+            res = verify_checkpoint(
+                str(tmp_path / f"step_{step}"), rehash=True)
+            assert res.ok, (step, res.errors)
+        assert telemetry.value(
+            "pdt_checkpoint_save_retries_total") == 2
+
+    def test_load_fault_site_forces_fallback(self, tmp_path):
+        """checkpoint.load is armable: a PERSISTENT restore failure on
+        the newest checkpoint (every one of its `load_retries` attempts
+        fails) quarantines it and falls back instead of crash-looping."""
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        em = ElasticManager(str(tmp_path), save_interval_steps=1,
+                            sleep=lambda _: None)
+        em.save(0, net)
+        em.save(1, net)
+        with FaultInjector() as fi:
+            # step_1 gets load_retries=2 attempts, both fail; the cap
+            # then lets step_0's load through clean
+            fi.arm("checkpoint.load", always=True, times=2)
+            assert em.resume(net) == 1
+        assert fi.trips("checkpoint.load") == 2
+        assert (tmp_path / "step_1.corrupt").exists()
+        assert telemetry.value("pdt_checkpoint_load_retries_total") == 1
+
+    def test_transient_load_fault_is_retried_not_quarantined(self, tmp_path):
+        """One flaky I/O error must not cost a save interval: the load
+        is retried in place and the newest checkpoint stays trusted."""
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        paddle.seed(0)
+        net = nn.Linear(4, 4)
+        em = ElasticManager(str(tmp_path), save_interval_steps=1,
+                            sleep=lambda _: None)
+        em.save(0, net)
+        em.save(1, net)
+        with FaultInjector() as fi:
+            fi.arm("checkpoint.load", nth=1)     # first attempt only
+            assert em.resume(net) == 2           # newest step restored
+        assert fi.trips("checkpoint.load") == 1
+        assert not (tmp_path / "step_1.corrupt").exists()
+        assert telemetry.value("pdt_checkpoint_load_retries_total") == 1
+        assert telemetry.value(
+            "pdt_checkpoint_resume_fallbacks_total") == 0

@@ -118,3 +118,487 @@ class TestHeartbeatRobustness:
         clk["t"] += 100.0
         d = watch.poll()
         assert d["alive"] == set() and d["event"] == "scale_down"
+
+
+# ---------------------------------------------------------------------
+# Durable checkpoints (docs/checkpointing.md): atomic commit protocol,
+# integrity manifests, corruption-tolerant resume, GC safety. Fast
+# tier: tiny Linear state dicts keep orbax writes cheap.
+
+import json
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as telemetry
+from paddle_tpu import nn
+from paddle_tpu.distributed.checkpoint import (MANIFEST_NAME, parse_done,
+                                               save_state_dict,
+                                               verify_checkpoint)
+from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                  complete_checkpoints,
+                                                  latest_checkpoint)
+from paddle_tpu.utils.faults import FaultError, FaultInjector
+
+
+def _net(seed=0):
+    paddle.seed(seed)
+    return nn.Linear(4, 4)
+
+
+def _em(tmp_path, **kw):
+    kw.setdefault("save_interval_steps", 1)
+    kw.setdefault("sleep", lambda _: None)   # no real backoff waits
+    return ElasticManager(str(tmp_path), **kw)
+
+
+from paddle_tpu.utils.faults import \
+    flip_ocdbt_shards as _flip_shards  # noqa: E402
+
+
+class TestAtomicCommitProtocol:
+    def test_save_commits_manifest_done_and_verifies(self, tmp_path):
+        net = _net()
+        _em(tmp_path).save(0, net)
+        step = tmp_path / "step_0"
+        # no droppings from the commit protocol
+        assert sorted(p.name for p in tmp_path.iterdir()) == ["step_0"]
+        manifest = json.loads((step / MANIFEST_NAME).read_text())
+        assert manifest["format"] == "paddle-tpu-ckpt-manifest"
+        assert manifest["step"] == 0
+        assert manifest["wall_time"] > 0
+        assert manifest["mesh"]["device_count"] >= 1
+        arrays = manifest["groups"]["model"]
+        assert set(arrays) == {"weight", "bias"}
+        w = arrays["weight"]
+        assert w["shape"] == [4, 4] and w["dtype"] == "float32"
+        assert w["nbytes"] == 64
+        assert w["checksum"].startswith("sha256:")
+        # .done is a JSON payload committed atomically after the rename
+        done = parse_done(str(step / ".done"))
+        assert done["step"] == 0 and done["time"] > 0
+        res = verify_checkpoint(str(step), rehash=True)
+        assert res.ok and res.arrays_checked == 2 and res.step == 0
+
+    def test_latest_checkpoint_rejects_unparsable_done(self, tmp_path):
+        good = tmp_path / "step_1"
+        good.mkdir()
+        (good / ".done").write_text('{"step": 1, "time": 5.0}')
+        for name, payload in (("step_2", ""),              # zero-byte
+                              ("step_3", "not-a-time\x00"),
+                              ("step_4", "[1, 2]"),        # wrong type
+                              ("step_5", "true")):  # bool is NOT a time
+            d = tmp_path / name
+            d.mkdir()
+            (d / ".done").write_text(payload)
+        assert latest_checkpoint(str(tmp_path)).endswith("step_1")
+        # legacy bare-float payloads stay accepted
+        (tmp_path / "step_2" / ".done").write_text("1234.5")
+        assert latest_checkpoint(str(tmp_path)).endswith("step_2")
+        assert [s for s, _ in complete_checkpoints(str(tmp_path))] == \
+            [2, 1]
+
+    @pytest.mark.chaos
+    def test_finalize_fault_retries_in_place(self, tmp_path):
+        sleeps = []
+        em = _em(tmp_path, save_retries=3, sleep=sleeps.append,
+                 rng=random.Random(7))
+        net = _net()
+        with FaultInjector() as fi:
+            fi.arm("checkpoint.finalize", nth=1)
+            em.save(0, net)                     # succeeds on attempt 2
+        assert fi.trips("checkpoint.finalize") == 1
+        assert len(sleeps) == 1
+        from paddle_tpu.distributed.launch import restart_backoff
+        assert sleeps == [restart_backoff(1, em.retry_backoff,
+                                          em.retry_backoff_max,
+                                          random.Random(7))]
+        assert telemetry.value("pdt_checkpoint_save_retries_total") == 1
+        assert verify_checkpoint(str(tmp_path / "step_0"),
+                                 rehash=True).ok
+        assert not (tmp_path / "step_0.tmp").exists()
+
+    @pytest.mark.chaos
+    def test_write_fault_exhausts_retries_leaves_torn_tmp(self, tmp_path):
+        em = _em(tmp_path, save_retries=2)
+        net = _net()
+        with FaultInjector() as fi:
+            fi.arm("checkpoint.write", always=True)
+            with pytest.raises(FaultError):
+                em.save(0, net)
+        assert fi.trips("checkpoint.write") == 2    # both attempts
+        assert telemetry.value("pdt_checkpoint_save_retries_total") == 1
+        # the kill-mid-save disk state: torn tmp, never a step_0
+        assert not (tmp_path / "step_0").exists()
+        assert (tmp_path / "step_0.tmp").exists()
+        assert not (tmp_path / "step_0.tmp" / MANIFEST_NAME).exists()
+        assert latest_checkpoint(str(tmp_path)) is None
+        em.save(0, net)                   # fault cleared: tmp reclaimed
+        assert verify_checkpoint(str(tmp_path / "step_0"),
+                                 rehash=True).ok
+        assert not (tmp_path / "step_0.tmp").exists()
+
+    def test_resave_same_step_replaces_without_droppings(self, tmp_path):
+        net = _net()
+        em = _em(tmp_path)
+        em.save(0, net)
+        w0 = net.weight.numpy().copy()
+        net.weight._value = paddle.to_tensor(w0 + 1.0)._value
+        em.save(0, net)          # resumed job repeating the interval
+        # fresh data won wholesale; the moved-aside old dir is gone
+        assert sorted(p.name for p in tmp_path.iterdir()) == ["step_0"]
+        assert verify_checkpoint(str(tmp_path / "step_0"),
+                                 rehash=True).ok
+        net2 = _net(seed=9)
+        assert em.resume(net2) == 1
+        np.testing.assert_array_equal(net2.weight.numpy(), w0 + 1.0)
+
+    def test_crashed_resave_recovers_moved_aside_checkpoint(
+            self, tmp_path):
+        """Kill inside _commit's re-save window: the only complete copy
+        of the step sits under step_N.old and a torn uncommitted
+        step_N squats on the name. resume() must rename the complete
+        copy back instead of returning 0 (and letting stale GC destroy
+        the data)."""
+        net = _net()
+        em = _em(tmp_path)
+        em.save(0, net)
+        # the crash state: complete copy moved aside, fresh dir renamed
+        # into place but killed before its .done landed
+        os.replace(tmp_path / "step_0", tmp_path / "step_0.old")
+        (tmp_path / "step_0").mkdir()
+        (tmp_path / "step_0" / MANIFEST_NAME).write_text("{}")  # torn
+        assert latest_checkpoint(str(tmp_path)) is None
+        net2 = _net(seed=9)
+        assert em.resume(net2) == 1
+        np.testing.assert_array_equal(net2.weight.numpy(),
+                                      net.weight.numpy())
+        assert not (tmp_path / "step_0.old").exists()
+        assert verify_checkpoint(str(tmp_path / "step_0"),
+                                 rehash=True).ok
+
+    def test_failed_recovery_rename_degrades_not_crashes(
+            self, tmp_path, monkeypatch):
+        """If the squatter's deletion partially fails and the recovery
+        rename errors, resume() must skip recovery for this restart
+        (keeping the .old for a later attempt) — not crash-loop."""
+        net = _net()
+        em = _em(tmp_path)
+        em.save(0, net)
+        os.replace(tmp_path / "step_0", tmp_path / "step_0.old")
+        (tmp_path / "step_0").mkdir()
+        (tmp_path / "step_0" / MANIFEST_NAME).write_text("{}")
+        real_replace = os.replace
+
+        def flaky_replace(src, dst, **kw):
+            if str(src).endswith("step_0.old"):
+                raise OSError("Directory not empty")
+            return real_replace(src, dst, **kw)
+
+        monkeypatch.setattr(os, "replace", flaky_replace)
+        assert em.resume(_net(seed=9)) == 0      # degraded, no raise
+        assert (tmp_path / "step_0.old").exists()  # kept for later
+        monkeypatch.undo()
+        assert em.resume(_net(seed=9)) == 1      # next restart recovers
+
+    def test_verify_cli(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint.__main__ import main
+        em = _em(tmp_path)
+        net = _net()
+        em.save(0, net)
+        em.save(1, net)
+        assert main(["verify", str(tmp_path), "--rehash"]) == 0
+        assert main(["verify", str(tmp_path / "step_1")]) == 0
+        _flip_shards(str(tmp_path / "step_1"))
+        assert main(["verify", str(tmp_path), "--rehash"]) == 1
+        assert main(["verify", str(tmp_path / "empty-root")]) == 1
+
+
+class TestLightVerifyTier:
+    """verify_on_resume='light' / verify_checkpoint(rehash=False): the
+    documented cheap tier for multi-GB checkpoints — reads checkpoint
+    metadata only, never array bytes."""
+
+    def test_light_checks_structure_against_metadata(self, tmp_path):
+        em = _em(tmp_path)
+        em.save(0, _net())
+        step = str(tmp_path / "step_0")
+        res = verify_checkpoint(step)            # rehash=False
+        assert res.ok and res.arrays_checked == 2 and not res.rehashed
+        # manifest drift is caught from metadata alone: shape, dtype,
+        # and the shape*itemsize-derived nbytes
+        mpath = tmp_path / "step_0" / MANIFEST_NAME
+        m = json.loads(mpath.read_text())
+        m["groups"]["model"]["weight"]["shape"] = [999]
+        m["groups"]["model"]["weight"]["nbytes"] = 1
+        m["groups"]["model"]["bias"]["dtype"] = "int8"
+        mpath.write_text(json.dumps(m))
+        res = verify_checkpoint(step)
+        assert not res.ok
+        assert any("shape" in e for e in res.errors)
+        assert any("nbytes" in e for e in res.errors)
+        assert any("dtype" in e for e in res.errors)
+
+    def test_checksums_are_the_rehash_tiers_job(self, tmp_path):
+        # the tier boundary: light never reads array bytes, so a wrong
+        # stored checksum (standing in for silent content damage the
+        # storage layer can't see) sails through; rehash catches it
+        em = _em(tmp_path)
+        em.save(0, _net())
+        step = str(tmp_path / "step_0")
+        mpath = tmp_path / "step_0" / MANIFEST_NAME
+        m = json.loads(mpath.read_text())
+        m["groups"]["model"]["weight"]["checksum"] = "sha256:" + "0" * 64
+        mpath.write_text(json.dumps(m))
+        assert verify_checkpoint(step).ok
+        res = verify_checkpoint(step, rehash=True)
+        assert not res.ok and any("checksum" in e for e in res.errors)
+
+    @pytest.mark.chaos
+    def test_light_resume_still_quarantines_torn_storage(self, tmp_path):
+        # flipped OCDBT files damage the format's own structure nodes,
+        # so even the metadata-only read reports the group unrestorable:
+        # light mode still quarantines at the verify stage
+        net = _net()
+        em = _em(tmp_path, verify_on_resume="light")
+        em.save(0, net)
+        em.save(1, net)
+        _flip_shards(str(tmp_path / "step_1"))
+        assert em.resume(_net(seed=9)) == 1
+        assert (tmp_path / "step_1.corrupt").exists()
+        assert telemetry.value("pdt_checkpoint_corrupt_total",
+                               reason="verify") == 1
+
+
+@pytest.mark.chaos
+class TestCorruptionTolerantResume:
+    def test_flipped_shard_quarantined_falls_back(self, tmp_path):
+        net = _net()
+        em = _em(tmp_path)
+        em.save(0, net)
+        w0 = net.weight.numpy().copy()
+        net.weight._value = paddle.to_tensor(w0 + 1.0)._value
+        em.save(1, net)
+        _flip_shards(str(tmp_path / "step_1"))
+        net2 = _net(seed=9)
+        assert em.resume(net2) == 1          # fell back to step_0 + 1
+        np.testing.assert_array_equal(net2.weight.numpy(), w0)
+        assert (tmp_path / "step_1.corrupt").exists()
+        assert not (tmp_path / "step_1").exists()
+        assert telemetry.value("pdt_checkpoint_corrupt_total",
+                               reason="verify") == 1
+        assert telemetry.value(
+            "pdt_checkpoint_resume_fallbacks_total") == 1
+        assert telemetry.value(
+            "pdt_checkpoint_resume_fallback_depth") == 1
+
+    def test_load_failure_quarantines_when_verify_off(self, tmp_path):
+        net = _net()
+        em = _em(tmp_path, verify_on_resume="off")
+        em.save(0, net)
+        em.save(1, net)
+        _flip_shards(str(tmp_path / "step_1"))
+        assert em.resume(_net(seed=9)) == 1
+        assert (tmp_path / "step_1.corrupt").exists()
+        assert telemetry.value("pdt_checkpoint_corrupt_total",
+                               reason="load") == 1
+
+    def test_truncated_manifest_quarantined(self, tmp_path):
+        net = _net()
+        em = _em(tmp_path)
+        em.save(0, net)
+        em.save(1, net)
+        m = tmp_path / "step_1" / MANIFEST_NAME
+        m.write_text(m.read_text()[: m.stat().st_size // 2])
+        assert em.resume(_net(seed=9)) == 1
+        assert (tmp_path / "step_1.corrupt").exists()
+
+    def test_legacy_checkpoint_without_manifest_loads(self, tmp_path):
+        # pre-manifest format: data + bare-float .done, no MANIFEST.json
+        net = _net()
+        save_state_dict(net.state_dict(), str(tmp_path / "step_0" /
+                                              "model"))
+        (tmp_path / "step_0" / ".done").write_text("1234.5")
+        net2 = _net(seed=9)
+        assert _em(tmp_path).resume(net2) == 1       # no quarantine
+        np.testing.assert_array_equal(net2.weight.numpy(),
+                                      net.weight.numpy())
+        assert (tmp_path / "step_0").exists()
+        assert telemetry.value("pdt_checkpoint_corrupt_total",
+                               reason="verify") == 0
+
+    def test_partial_load_then_exhaustion_raises_not_fresh(
+            self, tmp_path):
+        """A quarantined attempt that already assigned the model's
+        weights must not fall through to a silent "train fresh" return:
+        the model is tainted, so resume() raises instead of returning
+        0 (verify_on_resume='off' is the only path that can get that
+        far with a half-bad checkpoint)."""
+        import paddle_tpu.optimizer as opt_mod
+        net = _net()
+        opt = opt_mod.Adam(learning_rate=1e-2,
+                           parameters=net.parameters())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = (net(x) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        em = _em(tmp_path, verify_on_resume="off")
+        em.save(0, net, opt)
+        _flip_shards(tmp_path / "step_0", group="opt")  # model intact
+        with pytest.raises(RuntimeError, match="tainted|reinitialize"):
+            em.resume(_net(seed=9), opt)
+        assert (tmp_path / "step_0.corrupt").exists()
+
+    def test_all_corrupt_resumes_fresh(self, tmp_path):
+        net = _net()
+        em = _em(tmp_path)
+        em.save(0, net)
+        em.save(1, net)
+        _flip_shards(str(tmp_path / "step_0"))
+        _flip_shards(str(tmp_path / "step_1"))
+        assert em.resume(_net(seed=9)) == 0
+        assert telemetry.value(
+            "pdt_checkpoint_resume_fallback_depth") == 2
+        assert {p.name for p in tmp_path.iterdir()} == \
+            {"step_0.corrupt", "step_1.corrupt"}
+
+
+class TestDurableGc:
+    def test_incomplete_dirs_do_not_count_toward_keep_last(self,
+                                                           tmp_path):
+        em = _em(tmp_path, keep_last=2)
+        net = _net()
+        em.save(0, net)
+        em.save(1, net)
+        # newer-numbered but UNcommitted droppings a crash left behind
+        (tmp_path / "step_5").mkdir()
+        (tmp_path / "step_6.tmp").mkdir()
+        (tmp_path / "step_2.corrupt").mkdir()
+        em.save(2, net)
+        names = {p.name for p in tmp_path.iterdir()}
+        # keep_last=2 COMPLETE checkpoints survive; the fresh (within
+        # stale_grace) incomplete dirs are not swept, and never consumed
+        # a keep_last slot
+        assert names == {"step_1", "step_2", "step_5", "step_6.tmp",
+                         "step_2.corrupt"}
+
+    def test_newest_complete_never_deleted(self, tmp_path):
+        em = _em(tmp_path, keep_last=0)      # pathological config
+        net = _net()
+        em.save(0, net)
+        em.save(1, net)
+        assert latest_checkpoint(str(tmp_path)).endswith("step_1")
+
+    def test_stale_incomplete_dirs_swept_fresh_kept(self, tmp_path):
+        clk = {"t": 1_000_000.0}
+        em = _em(tmp_path, stale_grace=100.0, clock=lambda: clk["t"])
+        net = _net()
+        em.save(0, net)
+        for name in ("step_5", "step_6.tmp", "step_3.corrupt"):
+            (tmp_path / name).mkdir()
+            os.utime(tmp_path / name, (clk["t"] - 200, clk["t"] - 200))
+        (tmp_path / "step_7.tmp").mkdir()    # a LIVE writer's tmp
+        os.utime(tmp_path / "step_7.tmp", (clk["t"] - 5, clk["t"] - 5))
+        # complete checkpoints are NEVER age-swept
+        os.utime(tmp_path / "step_0", (clk["t"] - 900, clk["t"] - 900))
+        em._gc()
+        assert {p.name for p in tmp_path.iterdir()} == \
+            {"step_0", "step_7.tmp"}
+
+    def test_gc_removes_done_before_rmtree(self, tmp_path, monkeypatch):
+        """rmtree is not atomic: a kill mid-delete must not leave a
+        half-deleted dir that discovery still trusts. Deletion drops the
+        commit marker first, so a deletion that stops right there
+        already untrusts the directory."""
+        em = _em(tmp_path, keep_last=2)
+        net = _net()
+        em.save(0, net)
+        em.save(1, net)
+        em.keep_last = 1                     # step_0 now expired
+        monkeypatch.setattr(
+            "paddle_tpu.distributed.fleet.elastic.shutil.rmtree",
+            lambda *a, **k: None)            # the kill: no file removed
+        em._gc()
+        assert (tmp_path / "step_0").exists()          # half-deleted...
+        assert not (tmp_path / "step_0" / ".done").exists()
+        # ...but no longer a complete checkpoint
+        assert [s for s, _ in complete_checkpoints(str(tmp_path))] == [1]
+
+    def test_quarantined_dir_survives_stale_gc(self, tmp_path):
+        """os.replace keeps old data mtimes: without the quarantine-time
+        touch, a checkpoint older than stale_grace would be quarantined
+        by resume() and destroyed by the very next save's _gc — losing
+        the post-mortem evidence quarantine exists to preserve."""
+        clk = {"t": 1_000_000.0}
+        em = _em(tmp_path, stale_grace=100.0, clock=lambda: clk["t"])
+        net = _net()
+        em.save(0, net)
+        em.save(1, net)
+        _flip_shards(str(tmp_path / "step_1"))
+        # the data was written long "ago": age every mtime past grace
+        for root, dirs, files in os.walk(tmp_path):
+            for name in dirs + files:
+                os.utime(os.path.join(root, name),
+                         (clk["t"] - 900, clk["t"] - 900))
+        assert em.resume(_net(seed=9)) == 1
+        assert (tmp_path / "step_1.corrupt").exists()
+        em._gc()                   # what the very next save would run
+        assert (tmp_path / "step_1.corrupt").exists()  # evidence kept
+        clk["t"] += 200            # ...until it genuinely goes stale
+        em._gc()
+        assert not (tmp_path / "step_1.corrupt").exists()
+
+    @pytest.mark.chaos
+    def test_gc_fault_does_not_lose_the_committed_save(self, tmp_path):
+        em = _em(tmp_path)
+        net = _net()
+        with FaultInjector() as fi:
+            fi.arm("elastic.gc", always=True)
+            em.save(0, net)                  # must NOT raise
+        assert fi.trips("elastic.gc") == 1
+        assert latest_checkpoint(str(tmp_path)).endswith("step_0")
+        assert verify_checkpoint(str(tmp_path / "step_0")).ok
+
+
+class TestWaitForPeersClock:
+    def test_deadline_runs_on_injected_clock(self, tmp_path):
+        clk = {"t": 1000.0}
+        hb = HeartbeatMembership(str(tmp_path), timeout=5.0,
+                                 interval=1.0, clock=lambda: clk["t"])
+        sleeps = []
+
+        def fake_sleep(dt):
+            sleeps.append(dt)
+            clk["t"] += dt                   # time passes only here
+
+        with pytest.raises(TimeoutError):
+            hb.wait_for_peers(1, timeout=10.0, sleep=fake_sleep)
+        # deterministic: exactly timeout / (interval/2) sleeps, and the
+        # fake clock is all that advanced — no wall-clock dependence
+        assert sleeps == [0.5] * 20
+        assert clk["t"] == 1010.0
+
+    def test_returns_once_peers_register(self, tmp_path):
+        clk = {"t": 1000.0}
+        hb = HeartbeatMembership(str(tmp_path), timeout=5.0,
+                                 interval=1.0, clock=lambda: clk["t"])
+
+        def beat_then_advance(dt):
+            clk["t"] += dt
+            if len(os.listdir(str(tmp_path))) == 0:
+                HeartbeatMembership(str(tmp_path), rank=3).heartbeat()
+                path = os.path.join(str(tmp_path), "worker_3.hb")
+                os.utime(path, (clk["t"], clk["t"]))
+
+        assert hb.wait_for_peers(1, timeout=10.0,
+                                 sleep=beat_then_advance) == {3}
+
+    def test_zero_timeout_still_checks_once(self, tmp_path):
+        hb = HeartbeatMembership(str(tmp_path), timeout=5.0,
+                                 clock=lambda: 1000.0)
+        HeartbeatMembership(str(tmp_path), rank=0).heartbeat()
+        os.utime(os.path.join(str(tmp_path), "worker_0.hb"),
+                 (1000.0, 1000.0))
+        assert hb.wait_for_peers(1, timeout=0.0,
+                                 sleep=lambda _: None) == {0}
