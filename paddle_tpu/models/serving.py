@@ -158,6 +158,12 @@ class Request:
     first_token_time: Optional[float] = None  # engine clock; TTFT/TPOT
     arrival_time: float = 0.0      # original add_request tick: TTFT base
     # (enqueue_time restarts on requeue — it feeds max_queue_time)
+    # stable caller-scoped identity: `rid` is engine-local and restarts
+    # from 0 in every engine, so a fleet router re-dispatching a request
+    # onto a survivor replica needs an id that follows the request
+    # across engines. Surfaced in telemetry events and failover logs;
+    # defaults to str(rid) for single-engine callers.
+    request_id: str = ""
 
 
 class ContinuousBatchingEngine:
@@ -342,14 +348,19 @@ class ContinuousBatchingEngine:
     # -- public API ----------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32,
                     deadline: Optional[float] = None,
-                    max_queue_time: Optional[float] = None) -> int:
+                    max_queue_time: Optional[float] = None,
+                    request_id: Optional[str] = None) -> int:
         """Queue a request. `deadline` is a completion budget in seconds
         from now on the engine's monotonic clock (overrides the engine
         `request_timeout` default); `max_queue_time` bounds time spent
-        WAITING for a slot. Expired requests finalize with status
-        `timeout` at the next step tick. Raises EngineOverloaded when
-        the bounded queue is full (`max_waiting`) or the admission
-        policy rejects the request."""
+        WAITING for a slot. `request_id` is a stable caller-scoped
+        identity carried through telemetry and failover logs (defaults
+        to the engine-local rid) — a fleet router passes the same id on
+        every re-dispatch so the request stays traceable across
+        replicas. Expired requests finalize with status `timeout` at
+        the next step tick. Raises EngineOverloaded when the bounded
+        queue is full (`max_waiting`) or the admission policy rejects
+        the request."""
         toks = [int(t) for t in np.asarray(prompt).ravel()]
         if not toks:
             raise ValueError("empty prompt")
@@ -372,7 +383,9 @@ class ContinuousBatchingEngine:
                     enqueue_time=now, arrival_time=now,
                     deadline=None if budget is None else now + budget,
                     max_queue_time=max_queue_time
-                    if max_queue_time is not None else self.max_queue_time)
+                    if max_queue_time is not None else self.max_queue_time,
+                    request_id=request_id if request_id is not None
+                    else str(self._next_rid))
         if self.layout == "paged":
             usable = self.num_pages - 1
             need = self._worst_pages(r)
@@ -483,6 +496,19 @@ class ContinuousBatchingEngine:
                 "failures": self.num_failures,
                 "preemptions": self.num_preemptions,
                 "decode_retries": self.num_decode_retries}
+
+    def get_request(self, rid: int) -> Optional[Request]:
+        """The live (queued or running) Request with engine-local id
+        `rid`, or None once it reached a terminal state. A fleet router
+        holds this reference to mirror the token stream a replica has
+        produced so far — the basis of zero-loss failover re-prefill."""
+        for req in self._queue:
+            if req.rid == rid:
+                return req
+        for req in self._slot_req:
+            if req is not None and req.rid == rid:
+                return req
+        return None
 
     def _expire(self) -> List[Request]:
         """Monotonic-clock tick: finalize queued/running requests whose
@@ -632,6 +658,7 @@ class ContinuousBatchingEngine:
                 _M_TPOT.observe((self._clock() - req.first_token_time)
                                 / (n - 1))
             telemetry.event("serving.terminal", rid=req.rid,
+                            request_id=req.request_id,
                             status=status, tokens=n,
                             preemptions=req.preemptions)
 
@@ -1220,6 +1247,7 @@ class ContinuousBatchingEngine:
         self.num_preemptions += 1
         _M_PREEMPTIONS.inc()
         telemetry.event("serving.preempt", rid=req.rid,
+                        request_id=req.request_id,
                         preemptions=req.preemptions + 1,
                         tokens=len(req.output))
         req.preemptions += 1

@@ -1,0 +1,46 @@
+"""Multi-replica serving fleet: the layer above the batching engine.
+
+`models/serving.py` is one engine — one compiled decode program, one KV
+page pool, one process worth of HBM. This package scales it
+horizontally and makes its death survivable, the way TPU serving
+deployments actually run (a replica fleet behind a router — Ragged
+Paged Attention, arXiv:2604.15464; Gemma serving on Cloud TPU,
+arXiv:2605.25645):
+
+* `replica.py`  — `ReplicaHandle`: one engine under a health state
+  machine (HEALTHY -> DEGRADED -> DRAINING -> DEAD) with SIGKILL-shaped
+  death and backoff-paced restarts.
+* `policy.py`   — pluggable dispatch (`round_robin`,
+  `least_outstanding`, `prefix_affinity` — co-locate page-aligned
+  shared prefixes with the replica whose prefix-cache trie is warm).
+* `router.py`   — `ServingRouter`: deterministic step-driven admission
+  through bounded per-replica queues (`FleetOverloaded` + retry-after),
+  replica supervision via the `router.*` fault sites, and ZERO-LOSS
+  failover (streamed tokens fold into a survivor's re-prefill — the
+  engine-preemption recovery shape, one level up).
+
+Telemetry rides `pdt_router_*` (docs/serving.md "Fleet"); every future
+scale layer (disaggregated prefill, autoscaling, multi-host replicas)
+builds on this one.
+
+    from paddle_tpu.serving import ServingRouter
+
+    router = ServingRouter(lambda i: ContinuousBatchingEngine(model),
+                           num_replicas=4, policy="prefix_affinity",
+                           page_size=16)
+    rid = router.submit(prompt, max_new_tokens=64)
+    outputs = router.run()          # {request_id: tokens}
+"""
+from .policy import (DispatchPolicy, LeastOutstandingPolicy,  # noqa: F401
+                     POLICIES, PrefixAffinityPolicy, RoundRobinPolicy,
+                     make_policy)
+from .replica import ReplicaHandle, ReplicaState  # noqa: F401
+from .router import (FleetOverloaded, FleetRequest,  # noqa: F401
+                     ServingRouter)
+
+__all__ = [
+    "ServingRouter", "FleetRequest", "FleetOverloaded",
+    "ReplicaHandle", "ReplicaState",
+    "DispatchPolicy", "RoundRobinPolicy", "LeastOutstandingPolicy",
+    "PrefixAffinityPolicy", "POLICIES", "make_policy",
+]

@@ -1,0 +1,168 @@
+"""Pluggable dispatch policies for the serving fleet router.
+
+A policy answers one question: given the replicas currently willing to
+accept traffic, where should this prompt go? Three built-ins:
+
+* ``round_robin`` — rotate through accepting replicas; the baseline.
+* ``least_outstanding`` — fewest waiting+running requests wins (ties
+  break to the lowest index); the classic load balancer.
+* ``prefix_affinity`` — the TPU-serving-shaped one. The engines run
+  vLLM-style automatic prefix caching keyed on PAGE-ALIGNED token
+  prefixes (models/serving.py), so which replica a prompt lands on
+  directly decides whether its system-prompt KV is recomputed or
+  attached read-only from the replica's page trie. The policy mirrors
+  that structure host-side: every dispatched prompt's page-aligned
+  prefix is folded into a per-replica set of rolling chain hashes
+  (h_f = hash((h_{f-1}, page_f tokens)) — one hash per full page, same
+  parent-chain shape as the engine trie), and a new prompt prefers the
+  replica holding its LONGEST warm chain, falling back to
+  least-outstanding when nothing is warm or scores tie. Replica death
+  forgets that replica's chains (its cache died with it).
+
+Policies are deterministic given the same dispatch sequence — no RNG,
+no wall clock — so fleet placement (and therefore the whole router) is
+reproducible in tests.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+from .replica import ReplicaHandle
+
+__all__ = ["DispatchPolicy", "RoundRobinPolicy", "LeastOutstandingPolicy",
+           "PrefixAffinityPolicy", "POLICIES", "make_policy"]
+
+
+class DispatchPolicy:
+    """Interface: `select` picks a replica from the accepting
+    candidates (never empty); `on_dispatch` observes the router's final
+    placement (including forced failover placements, so warmth tracking
+    follows the requests); `forget` drops per-replica state when a
+    replica dies."""
+
+    name = "base"
+
+    def select(self, candidates: Sequence[ReplicaHandle],
+               prompt: List[int]) -> ReplicaHandle:
+        raise NotImplementedError
+
+    def on_dispatch(self, replica: ReplicaHandle, prompt: List[int]):
+        pass
+
+    def forget(self, replica_index: int):
+        pass
+
+
+class RoundRobinPolicy(DispatchPolicy):
+    name = "round_robin"
+
+    def __init__(self):
+        self._next = 0
+
+    def select(self, candidates, prompt):
+        # rotate over replica INDICES, not the candidate list: with a
+        # replica missing from the candidates the remaining ones must
+        # still alternate instead of collapsing onto one
+        chosen = min(candidates,
+                     key=lambda h: ((h.index - self._next)
+                                    % (max(c.index for c in candidates)
+                                       + 1), h.index))
+        self._next = chosen.index + 1
+        return chosen
+
+
+class LeastOutstandingPolicy(DispatchPolicy):
+    name = "least_outstanding"
+
+    def select(self, candidates, prompt):
+        return min(candidates, key=lambda h: (h.outstanding(), h.index))
+
+
+class PrefixAffinityPolicy(DispatchPolicy):
+    """Prefer the replica whose prefix cache is warm for this prompt's
+    page-aligned prefix; fall back by load (module docstring)."""
+
+    name = "prefix_affinity"
+
+    def __init__(self, page_size: int = 16, max_tracked: int = 4096):
+        self.page_size = int(page_size)
+        self.max_tracked = int(max_tracked)
+        # replica index -> LRU set of warm chain hashes
+        self._warm: Dict[int, "OrderedDict[int, None]"] = {}
+        # select() diagnostics the router reads for the hit-rate metric
+        self.last_match_pages = 0
+
+    def _chain_hashes(self, prompt: List[int]) -> List[int]:
+        """Rolling hash per FULL page of the prompt, capped one page
+        short of the whole prompt (the engine can never share the final
+        token — its logits seed decoding), mirroring
+        `ContinuousBatchingEngine._match_prefix`. Tuple-of-int hashing
+        is stable within a process and unsalted across runs."""
+        ps = self.page_size
+        n = (len(prompt) - 1) // ps
+        hashes, h = [], 0
+        for f in range(n):
+            h = hash((h, tuple(prompt[f * ps:(f + 1) * ps])))
+            hashes.append(h)
+        return hashes
+
+    def _longest_warm(self, replica_index: int,
+                      hashes: List[int]) -> int:
+        warm = self._warm.get(replica_index)
+        if not warm:
+            return 0
+        depth = 0
+        for h in hashes:
+            if h not in warm:
+                break
+            depth += 1
+        return depth
+
+    def select(self, candidates, prompt):
+        hashes = self._chain_hashes(prompt)
+        best: Optional[ReplicaHandle] = None
+        best_depth = 0
+        for h in candidates:
+            depth = self._longest_warm(h.index, hashes)
+            if depth > best_depth:
+                best, best_depth = h, depth
+        self.last_match_pages = best_depth
+        if best is not None:
+            return best
+        # nothing warm: place by load so cold prefixes spread out
+        return min(candidates, key=lambda h: (h.outstanding(), h.index))
+
+    def on_dispatch(self, replica, prompt):
+        warm = self._warm.setdefault(replica.index, OrderedDict())
+        for h in self._chain_hashes(prompt):
+            if h in warm:
+                warm.move_to_end(h)
+            else:
+                warm[h] = None
+        while len(warm) > self.max_tracked:
+            warm.popitem(last=False)
+
+    def forget(self, replica_index: int):
+        self._warm.pop(replica_index, None)
+
+
+POLICIES = {
+    RoundRobinPolicy.name: RoundRobinPolicy,
+    LeastOutstandingPolicy.name: LeastOutstandingPolicy,
+    PrefixAffinityPolicy.name: PrefixAffinityPolicy,
+}
+
+
+def make_policy(policy, page_size: int = 16) -> DispatchPolicy:
+    """Accepts a policy NAME (see `POLICIES`) or an instance.
+    `page_size` seeds prefix-affinity hashing and must match the
+    engines' page size for warmth tracking to mirror their tries."""
+    if isinstance(policy, DispatchPolicy):
+        return policy
+    if policy in POLICIES:
+        if policy == PrefixAffinityPolicy.name:
+            return PrefixAffinityPolicy(page_size=page_size)
+        return POLICIES[policy]()
+    raise ValueError(f"unknown dispatch policy {policy!r}: "
+                     f"{sorted(POLICIES)} or a DispatchPolicy instance")

@@ -1,0 +1,316 @@
+"""Replica supervision: one engine behind a health state machine.
+
+A `ReplicaHandle` wraps one :class:`ContinuousBatchingEngine` the way a
+fleet supervisor wraps a serving process: the engine object stands in
+for a whole replica (its HBM-resident KV pool included), and the handle
+tracks whether that replica should receive traffic at all.
+
+Health state machine (driven by the router's injectable clock — no
+wall-clock reads, so every transition is forcible in tests)::
+
+    HEALTHY --consecutive failures >= degraded_after--> DEGRADED
+    DEGRADED --one successful step--> HEALTHY
+    DEGRADED --consecutive failures >= dead_after--> DEAD
+    HEALTHY|DEGRADED --no step progress for wedge_timeout s
+                       while work is outstanding--> DEAD   ("wedged")
+    any live state --drain()--> DRAINING
+    DRAINING --in-flight work reaches zero--> DEAD         ("drained")
+    DEAD --router restart after exponential backoff--> HEALTHY
+
+Death is SIGKILL-shaped: the engine object is DISCARDED the moment the
+replica dies (``self.engine = None``) — its queues, slots, and KV pages
+are unrecoverable, exactly as if the serving process had been killed.
+Zero-loss failover therefore lives one layer up: the router mirrors
+every replica's token stream as it is produced (the tokens a real
+router would have streamed to clients already) and re-prefills
+survivors from that mirror (`router.py`).
+
+Fault sites (`utils.faults`): ``router.dispatch`` fires before a
+request is handed to the engine; ``router.step`` fires before a step of
+a replica that has outstanding work (so `nth=`/`times=` arming can
+target one replica of a fleet deterministically — idle replicas do not
+consume visits); ``router.health`` fires inside every health probe.
+"""
+from __future__ import annotations
+
+import random
+from typing import Callable, List, Optional
+
+from .. import observability as telemetry
+from ..distributed.launch import restart_backoff
+from ..models.serving import ContinuousBatchingEngine, Request
+from ..utils.faults import fault_point
+
+__all__ = ["ReplicaHandle", "ReplicaState"]
+
+
+class ReplicaState:
+    """Replica health states + the numeric encoding exported on the
+    `pdt_router_replica_state` gauge (higher = less healthy)."""
+
+    HEALTHY = "healthy"
+    DEGRADED = "degraded"
+    DRAINING = "draining"
+    DEAD = "dead"
+    LIVE = frozenset({HEALTHY, DEGRADED, DRAINING})
+    # gauge encoding: docs/serving.md "Fleet" metric catalog
+    CODE = {HEALTHY: 0, DEGRADED: 1, DRAINING: 2, DEAD: 3}
+
+
+_M_STATE = telemetry.gauge(
+    "pdt_router_replica_state",
+    "Replica health state (0=healthy 1=degraded 2=draining 3=dead).",
+    ("replica",))
+_M_QDEPTH = telemetry.gauge(
+    "pdt_router_replica_queue_depth",
+    "Outstanding (waiting + running) requests per replica.",
+    ("replica",))
+_M_RESTARTS = telemetry.counter(
+    "pdt_router_replica_restarts_total",
+    "Replica restarts after death, by replica.", ("replica",))
+
+
+class ReplicaHandle:
+    """One engine + its health state (see module docstring).
+
+    `engine_factory(index)` builds a fresh engine — called at
+    construction and again on every restart, so a restarted replica
+    comes back with empty queues and a cold KV pool, like a respawned
+    process. Restart pacing reuses the elastic launcher's
+    `restart_backoff` shape (exponential, jittered via the injectable
+    `rng`, capped) expressed as a *next-restart deadline* on the
+    injectable clock rather than a sleep — the router is step-driven.
+    """
+
+    def __init__(self, index: int,
+                 engine_factory: Callable[[int], ContinuousBatchingEngine],
+                 *, clock: Callable[[], float],
+                 degraded_after: int = 1,
+                 dead_after: int = 3,
+                 wedge_timeout: Optional[float] = None,
+                 max_outstanding: Optional[int] = None,
+                 restart_backoff_base: float = 1.0,
+                 restart_backoff_max: float = 60.0,
+                 max_restarts: Optional[int] = 5,
+                 rng: Optional[random.Random] = None):
+        self.index = int(index)
+        self._factory = engine_factory
+        self._clock = clock
+        self.degraded_after = int(degraded_after)
+        self.dead_after = int(dead_after)
+        self.wedge_timeout = wedge_timeout
+        self.max_outstanding = max_outstanding
+        self._backoff_base = float(restart_backoff_base)
+        self._backoff_cap = float(restart_backoff_max)
+        self.max_restarts = max_restarts
+        self._rng = rng if rng is not None else random.Random(index)
+        self.engine: Optional[ContinuousBatchingEngine] = engine_factory(
+            self.index)
+        # bumped on every restart: a request dispatched to generation g
+        # is STRANDED once the handle runs generation g+1 — the fresh
+        # engine never heard of it, however alive the replica looks
+        self.generation = 0
+        self.state = ReplicaState.HEALTHY
+        self.consecutive_failures = 0
+        self.last_error: Optional[str] = None
+        self.death_reason: Optional[str] = None
+        self.restarts = 0                  # completed restarts
+        self.restart_attempt = 0           # backoff exponent (resets on
+        self._stabilizing = False          # first post-restart success)
+        self.next_restart_time: Optional[float] = None
+        self.auto_restart = True           # False for drained replicas
+        self.last_progress = clock()
+        # prefix-cache counters folded in from engines this handle has
+        # already discarded, so fleet aggregates survive replica death
+        self.retired_prefix_hits = 0
+        self.retired_prefix_tokens_reused = 0
+        _M_STATE.set(ReplicaState.CODE[self.state], replica=str(index))
+
+    # -- introspection ---------------------------------------------------
+    def outstanding(self) -> int:
+        """Waiting + running requests on this replica (0 when dead)."""
+        if self.engine is None:
+            return 0
+        info = self.engine.lifecycle_info()
+        return info["waiting"] + info["running"]
+
+    def can_accept(self) -> bool:
+        """Eligible for NEW dispatches: healthy/degraded with room in
+        the bounded per-replica queue. Draining and dead replicas never
+        accept (failover force-dispatch uses `alive()` instead)."""
+        if self.state not in (ReplicaState.HEALTHY, ReplicaState.DEGRADED):
+            return False
+        return (self.max_outstanding is None
+                or self.outstanding() < self.max_outstanding)
+
+    def alive(self) -> bool:
+        return self.state in ReplicaState.LIVE and self.engine is not None
+
+    def prefix_hits(self) -> int:
+        live = self.engine.prefix_hits if self.engine is not None else 0
+        return self.retired_prefix_hits + live
+
+    def prefix_tokens_reused(self) -> int:
+        live = (self.engine.prefix_tokens_reused
+                if self.engine is not None else 0)
+        return self.retired_prefix_tokens_reused + live
+
+    # -- traffic ---------------------------------------------------------
+    def dispatch(self, prompt: List[int], max_new_tokens: int,
+                 request_id: str,
+                 deadline: Optional[float] = None,
+                 max_queue_time: Optional[float] = None) -> Request:
+        """Hand one request to this replica's engine; returns the live
+        engine Request so the router can mirror its token stream."""
+        fault_point("router.dispatch")
+        assert self.engine is not None, f"dispatch to dead replica " \
+                                        f"{self.index}"
+        rid = self.engine.add_request(prompt, max_new_tokens,
+                                      deadline=deadline,
+                                      max_queue_time=max_queue_time,
+                                      request_id=request_id)
+        req = self.engine.get_request(rid)
+        assert req is not None
+        return req
+
+    def step(self) -> List[Request]:
+        """One engine step. The `router.step` fault site fires only when
+        this replica has outstanding work, so chaos tests can target a
+        specific busy replica with visit counting."""
+        if self.outstanding():
+            fault_point("router.step")
+        return self.engine.step()
+
+    # -- health state machine --------------------------------------------
+    def _transition(self, state: str, reason: str):
+        if state == self.state:
+            return
+        prev, self.state = self.state, state
+        _M_STATE.set(ReplicaState.CODE[state], replica=str(self.index))
+        telemetry.event("router.replica_state", replica=self.index,
+                        prev=prev, state=state, reason=reason)
+
+    def note_success(self, now: float, did_work: bool = True):
+        """A step completed: progress happened, failures stop counting,
+        a DEGRADED replica recovers. The restart-backoff budget resets
+        only when the step served REAL work (`did_work`) — an idle tick
+        after a restart proves nothing, and resetting on it would let a
+        dies-under-load replica restart forever."""
+        self.consecutive_failures = 0
+        self.last_progress = now
+        if self._stabilizing and did_work:
+            self._stabilizing = False
+            self.restart_attempt = 0       # backoff resets once stable
+        if self.state == ReplicaState.DEGRADED:
+            self._transition(ReplicaState.HEALTHY, "recovered")
+
+    def note_failure(self, now: float, error: BaseException) -> bool:
+        """A step / dispatch / health probe failed. Returns True when
+        the failure killed the replica (caller must fail over)."""
+        self.consecutive_failures += 1
+        self.last_error = f"{type(error).__name__}: {error}"
+        if self.state == ReplicaState.DEAD:
+            return False
+        if self.consecutive_failures >= self.dead_after:
+            self.die("failures", now)
+            return True
+        if self.state == ReplicaState.HEALTHY \
+                and self.consecutive_failures >= self.degraded_after:
+            self._transition(ReplicaState.DEGRADED, self.last_error)
+        return False
+
+    def check_health(self, now: float):
+        """Health probe, run by the router once per step tick. Raises
+        (counted as a failure by the caller) when the armed
+        `router.health` fault site fires; kills the replica directly
+        when it is WEDGED — outstanding work but no step progress for
+        `wedge_timeout` seconds on the injectable clock."""
+        if not self.alive():
+            return
+        fault_point("router.health")
+        if self.wedge_timeout is not None and self.outstanding() > 0 \
+                and now - self.last_progress > self.wedge_timeout:
+            self.die("wedged", now)
+
+    def drain(self):
+        """Stop dispatching to this replica; in-flight work completes,
+        then the replica parks DEAD (reason `drained`) without
+        auto-restart — `ServingRouter.restore_replica` brings it back.
+        auto_restart drops immediately: a replica that dies MID-drain
+        (wedge, failure storm) must stay decommissioned too, not
+        restart itself back into traffic."""
+        if self.state in (ReplicaState.HEALTHY, ReplicaState.DEGRADED):
+            self.auto_restart = False
+            self._transition(ReplicaState.DRAINING, "drain requested")
+
+    def finish_drain_if_empty(self, now: float):
+        if self.state == ReplicaState.DRAINING and self.outstanding() == 0:
+            self.auto_restart = False
+            self.die("drained", now)
+
+    def die(self, reason: str, now: float):
+        """SIGKILL-shaped death: the engine object (queues, slots, KV
+        pool) is discarded outright. The router re-routes this
+        replica's in-flight requests from its own mirror."""
+        if self.state == ReplicaState.DEAD:
+            return
+        if self.engine is not None:        # fold counters before discard
+            self.retired_prefix_hits += self.engine.prefix_hits
+            self.retired_prefix_tokens_reused += \
+                self.engine.prefix_tokens_reused
+        self.engine = None
+        self.death_reason = reason
+        self._transition(ReplicaState.DEAD, reason)
+        _M_QDEPTH.set(0, replica=str(self.index))
+        if self.auto_restart and (self.max_restarts is None
+                                  or self.restart_attempt
+                                  < self.max_restarts):
+            self.restart_attempt += 1
+            delay = restart_backoff(self.restart_attempt,
+                                    self._backoff_base,
+                                    self._backoff_cap, self._rng)
+            self.next_restart_time = now + delay
+            telemetry.event("router.replica_death", replica=self.index,
+                            reason=reason, restart_in_s=delay,
+                            attempt=self.restart_attempt)
+        else:
+            self.next_restart_time = None  # permanently out
+            telemetry.event("router.replica_death", replica=self.index,
+                            reason=reason, restart_in_s=None,
+                            attempt=self.restart_attempt)
+
+    def maybe_restart(self, now: float) -> bool:
+        """Restart a dead replica once its backoff deadline passes:
+        fresh engine from the factory, HEALTHY, cold caches. Returns
+        True when a restart happened this tick."""
+        if self.state != ReplicaState.DEAD \
+                or self.next_restart_time is None \
+                or now < self.next_restart_time:
+            return False
+        self.engine = self._factory(self.index)
+        self.generation += 1
+        self.consecutive_failures = 0
+        self.death_reason = None
+        self.next_restart_time = None
+        self.last_progress = now
+        self.restarts += 1
+        self._stabilizing = True
+        self._transition(ReplicaState.HEALTHY, "restarted")
+        _M_RESTARTS.inc(replica=str(self.index))
+        telemetry.event("router.replica_restart", replica=self.index,
+                        restarts=self.restarts)
+        return True
+
+    def restore(self, now: float):
+        """Manually bring back a drained (or permanently dead) replica:
+        immediate fresh engine, no backoff — an operator action, not a
+        crash recovery."""
+        if self.state != ReplicaState.DEAD:
+            return
+        self.auto_restart = True
+        self.restart_attempt = 0
+        self.next_restart_time = now
+        self.maybe_restart(now)
+
+    def update_gauges(self):
+        _M_QDEPTH.set(self.outstanding(), replica=str(self.index))

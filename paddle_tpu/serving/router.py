@@ -1,0 +1,563 @@
+"""Health-aware fleet router over replica engines: prefix-affinity
+dispatch, replica supervision, zero-loss failover.
+
+The layer above `models/serving.py`: one `ServingRouter` fronts N
+`ReplicaHandle`s (each wrapping a `ContinuousBatchingEngine`), the way
+a TPU serving deployment fronts a replica fleet with a request router —
+dispatch policy decides KV prefix-cache hit rate and tail latency, the
+supervisor decides whether a replica kill is an outage or a blip.
+
+Design (everything is step-driven and clock-injectable — deterministic
+on the CPU test mesh, no threads, no sleeps inside `step()`):
+
+* **Admission** — `submit()` routes through the pluggable policy
+  (`policy.py`) over replicas that `can_accept()` (healthy/degraded
+  with room in their bounded queue). When no replica can take the
+  request the router sheds load FLEET-WIDE: `FleetOverloaded`
+  (a subclass of the engine's `EngineOverloaded`, so front ends treat
+  both as a 429) carrying a `retry_after` hint — queue-depth-derived
+  when replicas are merely full, next-restart-derived when the whole
+  fleet is down.
+* **Mirroring** — the router keeps a `FleetRequest` per submission and,
+  after every replica step, copies the tokens each live engine Request
+  has produced (`folded + output`). This is exactly the information a
+  real router already holds — the tokens it streamed to the client —
+  and it is what makes failover zero-loss without reading a dead
+  engine.
+* **Supervision** — each step tick: restart-due replicas come back
+  (exponential backoff with jitter, the launcher's `restart_backoff`
+  shape), health probes run (`router.health` fault site + wedge
+  detection on the injectable clock), every live replica steps
+  (`router.step` fault site), and step/dispatch/health failures drive
+  the HEALTHY -> DEGRADED -> DEAD machine in `replica.py`.
+* **Failover** — when a replica dies (consecutive failures, wedge,
+  or `kill_replica`), its engine is already gone (SIGKILL semantics).
+  Every non-terminal mirrored request assigned to it is re-dispatched
+  to a survivor with its streamed tokens FOLDED INTO the re-prefill
+  prompt and its token budget reduced by what was already produced —
+  the same recovery shape as the engine's own preemption (PR 1), so
+  greedy outputs are bit-identical to an unfaulted run. Re-dispatch is
+  idempotent per `request_id`; with no survivor the request parks
+  orphaned and retries after the next restart.
+
+Telemetry (`pdt_router_*`, docs/serving.md "Fleet"): dispatch counters
+by {policy, replica}, failover/restart counters, per-replica state and
+queue-depth gauges, affinity hit-rate, fleet terminal counters that
+reconcile exactly with the engines' `pdt_serving_*` counters.
+"""
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from .. import observability as telemetry
+from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
+                              Request, RequestStatus)
+from .policy import DispatchPolicy, PrefixAffinityPolicy, make_policy
+from .replica import ReplicaHandle, ReplicaState
+
+__all__ = ["ServingRouter", "FleetRequest", "FleetOverloaded"]
+
+
+_M_DISPATCH = telemetry.counter(
+    "pdt_router_dispatch_total",
+    "Requests dispatched to a replica, by policy and replica "
+    "(failover re-dispatches included).", ("policy", "replica"))
+_M_REJECTIONS = telemetry.counter(
+    "pdt_router_rejections_total",
+    "Fleet-level submit refusals by reason.", ("reason",))
+_M_FAILOVERS = telemetry.counter(
+    "pdt_router_failovers_total",
+    "In-flight requests re-routed off a dead replica.")
+_M_TERMINAL = telemetry.counter(
+    "pdt_router_requests_terminal_total",
+    "Fleet requests reaching a terminal state, by final status.",
+    ("status",))
+_M_AFF_LOOKUPS = telemetry.counter(
+    "pdt_router_affinity_lookups_total",
+    "Prefix-affinity placement decisions.")
+_M_AFF_HITS = telemetry.counter(
+    "pdt_router_affinity_hits_total",
+    "Placements that found a warm prefix chain on some replica.")
+_M_AFF_RATE = telemetry.gauge(
+    "pdt_router_affinity_hit_rate",
+    "Warm-placement fraction of prefix-affinity decisions so far.")
+_M_STEPS = telemetry.counter(
+    "pdt_router_steps_total", "Router step ticks.")
+
+
+class FleetOverloaded(EngineOverloaded):
+    """Fleet-wide admission refusal. `retry_after` hints (seconds) when
+    capacity is likely back: queue-drain-derived when replicas are
+    full, restart-backoff-derived when the whole fleet is down."""
+
+    def __init__(self, message: str, retry_after: float):
+        super().__init__(f"{message} (retry after ~{retry_after:.2f}s)")
+        self.retry_after = retry_after
+
+
+@dataclass
+class FleetRequest:
+    """Router-side mirror of one submitted request (module docstring:
+    the basis of zero-loss failover). `tokens` is the full stream the
+    fleet has produced; `folded` is the part baked into the CURRENT
+    replica's re-prefill prompt after failovers."""
+
+    request_id: str
+    prompt: List[int]
+    max_new_tokens: int
+    deadline_abs: Optional[float] = None    # router-clock absolute
+    max_queue_time: Optional[float] = None
+    status: str = RequestStatus.QUEUED
+    tokens: List[int] = field(default_factory=list)
+    folded: List[int] = field(default_factory=list)
+    replica: Optional[int] = None
+    generation: int = -1       # replica incarnation it was dispatched to
+    engine_req: Optional[Request] = None
+    dispatches: int = 0
+    failovers: int = 0
+    error: Optional[str] = None
+
+    @property
+    def done(self) -> bool:
+        return self.status in RequestStatus.TERMINAL
+
+
+class ServingRouter:
+    """Deterministic, step-driven router over a replica fleet.
+
+    `engine_factory(index)` builds one replica's engine; it is called
+    N times up front and again on every restart. Pass the router's
+    `clock` into the engines it builds when per-request deadlines must
+    stay exact across failover (the router re-derives the remaining
+    budget on the same clock).
+
+    Drive it like the engine: `submit()` then `run()`, or `step()`
+    yourself. `sleep` is only used by `run()` while the whole fleet
+    waits on a restart backoff (tests pass the fake clock's `advance`).
+    """
+
+    def __init__(self, engine_factory:
+                 Callable[[int], ContinuousBatchingEngine],
+                 num_replicas: int = 2,
+                 policy="least_outstanding",
+                 *, page_size: int = 16,
+                 max_replica_outstanding: Optional[int] = None,
+                 degraded_after: int = 1,
+                 dead_after: int = 3,
+                 wedge_timeout: Optional[float] = None,
+                 restart_backoff_base: float = 1.0,
+                 restart_backoff_max: float = 60.0,
+                 max_restarts: Optional[int] = 5,
+                 retry_after_per_request: float = 0.05,
+                 clock: Optional[Callable[[], float]] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 seed: int = 0):
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got "
+                             f"{num_replicas}")
+        self._clock = clock if clock is not None else time.monotonic
+        self._sleep = sleep
+        self.policy: DispatchPolicy = make_policy(policy,
+                                                  page_size=page_size)
+        self._retry_cost = float(retry_after_per_request)
+        rng = random.Random(seed)
+        self.replicas: List[ReplicaHandle] = [
+            ReplicaHandle(i, engine_factory, clock=self._clock,
+                          degraded_after=degraded_after,
+                          dead_after=dead_after,
+                          wedge_timeout=wedge_timeout,
+                          max_outstanding=max_replica_outstanding,
+                          restart_backoff_base=restart_backoff_base,
+                          restart_backoff_max=restart_backoff_max,
+                          max_restarts=max_restarts,
+                          rng=random.Random(rng.random()))
+            for i in range(num_replicas)]
+        self.requests: Dict[str, FleetRequest] = {}
+        # non-terminal requests only: the per-step harvest/failover
+        # scans iterate THIS index, not every request ever submitted
+        self._live: Dict[str, FleetRequest] = {}
+        self._next_id = 0
+        self.num_failovers = 0
+        self.num_restarts = 0
+        # requests finalized OUTSIDE the step tick (e.g. a deadline that
+        # expires during a submit-time failover) are delivered by the
+        # next step() — same never-lose-a-terminal shape as the engine's
+        # _finished_backlog
+        self._terminal_backlog: List[FleetRequest] = []
+
+    # -- admission -------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int = 32,
+               request_id: Optional[str] = None,
+               deadline: Optional[float] = None,
+               max_queue_time: Optional[float] = None) -> str:
+        """Admit one request into the fleet; returns its stable
+        request_id. Re-submitting an id already known to the router is
+        a no-op returning the same id (idempotent retries: a client
+        that lost the response resubmits without double-generating).
+        Raises FleetOverloaded when no replica can accept."""
+        if request_id is not None and request_id in self.requests:
+            return request_id
+        if request_id is None:
+            # skip ids the caller already used — colliding would
+            # silently overwrite an in-flight record
+            while f"fleet-{self._next_id}" in self.requests:
+                self._next_id += 1
+            request_id = f"fleet-{self._next_id}"
+            self._next_id += 1
+        toks = [int(t) for t in prompt]
+        now = self._clock()
+        rec = FleetRequest(
+            request_id, toks, int(max_new_tokens),
+            deadline_abs=None if deadline is None else now + deadline,
+            max_queue_time=max_queue_time)
+        self._dispatch(rec, forced=False)
+        self.requests[request_id] = rec
+        self._live[request_id] = rec
+        return request_id
+
+    def _accepting(self) -> List[ReplicaHandle]:
+        """Replicas eligible for new work, HEALTHY before DEGRADED (a
+        degraded replica takes traffic only when no healthy one can)."""
+        healthy = [h for h in self.replicas
+                   if h.state == ReplicaState.HEALTHY and h.can_accept()]
+        if healthy:
+            return healthy
+        return [h for h in self.replicas
+                if h.state == ReplicaState.DEGRADED and h.can_accept()]
+
+    def _overloaded(self) -> FleetOverloaded:
+        now = self._clock()
+        # DRAINING replicas are alive but their capacity is never
+        # coming back for NEW work — they must not feed a
+        # queue-will-drain retry hint
+        alive = [h for h in self.replicas
+                 if h.state in (ReplicaState.HEALTHY,
+                                ReplicaState.DEGRADED)
+                 and h.engine is not None]
+        if alive:
+            _M_REJECTIONS.inc(reason="fleet_full")
+            depth = min(h.outstanding() for h in alive)
+            return FleetOverloaded(
+                f"every replica queue is full "
+                f"({len(alive)} alive, min depth {depth})",
+                retry_after=max(self._retry_cost,
+                                depth * self._retry_cost))
+        _M_REJECTIONS.inc(reason="no_replicas")
+        pending = [h.next_restart_time - now for h in self.replicas
+                   if h.next_restart_time is not None]
+        return FleetOverloaded(
+            "no live replicas",
+            retry_after=max(0.001, min(pending)) if pending else 1.0)
+
+    def _dispatch(self, rec: FleetRequest, forced: bool):
+        """Place `rec` on a replica. `forced` (failover) ignores the
+        bounded-queue cap — zero-loss beats backpressure for work the
+        fleet already accepted — but still respects health states.
+        A dispatch failure counts against that replica's health and the
+        next candidate is tried (each replica at most once per call);
+        with none left: FleetOverloaded (fresh submits) or an orphaned
+        park (failovers, retried next step)."""
+        tried = set()
+        while True:
+            if forced:
+                cands = ([h for h in self.replicas
+                          if h.state == ReplicaState.HEALTHY]
+                         or [h for h in self.replicas
+                             if h.state == ReplicaState.DEGRADED])
+            else:
+                cands = self._accepting()
+            cands = [h for h in cands if h.index not in tried]
+            if not cands:
+                if forced:
+                    rec.replica, rec.engine_req = None, None
+                    rec.status = RequestStatus.QUEUED
+                    return
+                raise self._overloaded()
+            h = self.policy.select(cands, self._effective_prompt(rec))
+            if isinstance(self.policy, PrefixAffinityPolicy):
+                _M_AFF_LOOKUPS.inc()
+                if self.policy.last_match_pages > 0:
+                    _M_AFF_HITS.inc()
+                if telemetry.enabled():
+                    lookups = telemetry.value(
+                        "pdt_router_affinity_lookups_total")
+                    if lookups:
+                        _M_AFF_RATE.set(telemetry.value(
+                            "pdt_router_affinity_hits_total") / lookups)
+            tried.add(h.index)
+            try:
+                rec.engine_req = h.dispatch(
+                    self._effective_prompt(rec),
+                    self._remaining_budget(rec), rec.request_id,
+                    deadline=self._remaining_deadline(rec),
+                    max_queue_time=rec.max_queue_time)
+            except EngineOverloaded:
+                # the engine's OWN admission bound refused (a factory
+                # that set max_waiting): not a health event — try the
+                # next replica
+                continue
+            except ValueError as e:
+                # request-shaped refusal (empty prompt, zero budget,
+                # a prompt that could never fit the pool): the
+                # CALLER's fault, not the replica's — charging it to
+                # health would let one malformed submit degrade the
+                # whole fleet
+                if not forced:
+                    raise
+                rec.status = RequestStatus.FAILED
+                rec.error = f"failover re-dispatch rejected: {e}"
+                rec.engine_req = None
+                self._terminal_backlog.append(rec)
+                self._live.pop(rec.request_id, None)
+                _M_TERMINAL.inc(status=rec.status)
+                telemetry.event("router.terminal",
+                                request_id=rec.request_id,
+                                status=rec.status, replica=None,
+                                tokens=len(rec.tokens),
+                                failovers=rec.failovers)
+                return
+            except Exception as e:          # router.dispatch fault etc.
+                if h.note_failure(self._clock(), e):
+                    self._failover_replica(h)
+                continue
+            rec.replica = h.index
+            rec.generation = h.generation
+            rec.folded = list(rec.tokens)
+            rec.status = RequestStatus.QUEUED
+            rec.dispatches += 1
+            self.policy.on_dispatch(h, self._effective_prompt(rec))
+            _M_DISPATCH.inc(policy=self.policy.name,
+                            replica=str(h.index))
+            return
+
+    def _effective_prompt(self, rec: FleetRequest) -> List[int]:
+        """What the next replica must prefill: the original prompt plus
+        every token the fleet already streamed (the engine-preemption
+        fold-in shape, one level up)."""
+        return rec.prompt + rec.tokens if rec.tokens else rec.prompt
+
+    def _remaining_budget(self, rec: FleetRequest) -> int:
+        return rec.max_new_tokens - len(rec.tokens)
+
+    def _remaining_deadline(self, rec: FleetRequest) -> Optional[float]:
+        if rec.deadline_abs is None:
+            return None
+        return rec.deadline_abs - self._clock()
+
+    # -- the step tick ---------------------------------------------------
+    def step(self) -> List[FleetRequest]:
+        """One fleet tick: restarts due -> health probes -> step every
+        live replica (harvesting token streams and terminal requests)
+        -> fail over work stranded on replicas that died this tick.
+        Returns the fleet requests that reached a terminal state."""
+        _M_STEPS.inc()
+        now = self._clock()
+        finished = self._terminal_backlog
+        self._terminal_backlog = []
+        for h in self.replicas:
+            if h.maybe_restart(now):
+                self.num_restarts += 1
+        unhealthy = set()
+        for h in self.replicas:
+            try:
+                h.check_health(now)     # may kill a wedged replica
+            except Exception as e:      # router.health fault fired
+                h.note_failure(now, e)
+                # a replica that just failed its probe sits this tick
+                # out — otherwise an immediately-successful step would
+                # erase the probe failure and the probe would mean
+                # nothing
+                unhealthy.add(h.index)
+        for h in self.replicas:
+            if not h.alive() or h.index in unhealthy:
+                continue
+            busy = h.outstanding() > 0
+            try:
+                done = h.step()
+            except Exception as e:
+                h.note_failure(self._clock(), e)
+                continue
+            # an idle tick is not evidence of stability: only steps that
+            # served real work reset the restart-backoff budget
+            h.note_success(self._clock(), did_work=busy or bool(done))
+            for req in done:
+                rec = self.requests.get(req.request_id)
+                if rec is not None:
+                    self._finalize(rec, req, finished)
+            self._harvest(h)
+            h.finish_drain_if_empty(self._clock())
+        # failover pass: anything mirrored onto a replica that is no
+        # longer alive (died in the health or step pass, or was killed
+        # between ticks), plus orphans parked by an earlier all-dead tick
+        for h in self.replicas:
+            if not h.alive():
+                self.policy.forget(h.index)    # its warm cache is gone
+        for rec in list(self._live.values()):
+            if rec.done:
+                continue
+            h = (self.replicas[rec.replica]
+                 if rec.replica is not None else None)
+            if h is None or not h.alive() \
+                    or rec.generation != h.generation:
+                # a generation mismatch means the replica died AND
+                # restarted since this request was dispatched — the
+                # fresh engine never heard of it, however alive the
+                # handle looks now
+                self._failover_one(rec)
+        finished += self._terminal_backlog
+        self._terminal_backlog = []
+        for h in self.replicas:
+            h.update_gauges()
+        return finished
+
+    def _harvest(self, h: ReplicaHandle):
+        """Mirror the token streams of this replica's live requests —
+        the 'already streamed to the client' state failover folds in."""
+        for rec in self._live.values():
+            if rec.replica == h.index and not rec.done \
+                    and rec.generation == h.generation \
+                    and rec.engine_req is not None:
+                rec.tokens = rec.folded + list(rec.engine_req.output)
+
+    def _finalize(self, rec: FleetRequest, req: Request,
+                  finished: List[FleetRequest]):
+        rec.tokens = rec.folded + list(req.output)
+        rec.status = req.status
+        rec.error = req.error
+        rec.engine_req = None
+        self._live.pop(rec.request_id, None)
+        finished.append(rec)
+        _M_TERMINAL.inc(status=rec.status)
+        telemetry.event("router.terminal", request_id=rec.request_id,
+                        status=rec.status, replica=rec.replica,
+                        tokens=len(rec.tokens),
+                        failovers=rec.failovers)
+
+    def _failover_replica(self, h: ReplicaHandle):
+        """Re-route everything mirrored onto `h` (which just died)."""
+        self.policy.forget(h.index)
+        for rec in list(self._live.values()):
+            if rec.replica == h.index and not rec.done:
+                self._failover_one(rec)
+
+    def _failover_one(self, rec: FleetRequest):
+        """Zero-loss re-dispatch of one stranded request: streamed
+        tokens fold into the survivor's re-prefill, budget shrinks by
+        what was already produced, the id stays stable (idempotent)."""
+        from_replica = rec.replica
+        if rec.deadline_abs is not None \
+                and self._clock() >= rec.deadline_abs:
+            # its budget elapsed while its replica was dead: finalize
+            # honestly instead of re-prefilling doomed work
+            rec.status = RequestStatus.TIMEOUT
+            rec.error = "deadline expired during failover"
+            rec.engine_req = None
+            self._live.pop(rec.request_id, None)
+            self._terminal_backlog.append(rec)
+            _M_TERMINAL.inc(status=rec.status)
+            telemetry.event("router.terminal",
+                            request_id=rec.request_id,
+                            status=rec.status, replica=from_replica,
+                            tokens=len(rec.tokens),
+                            failovers=rec.failovers)
+            return
+        if from_replica is not None:
+            # an orphan being retried (replica=None) already counted
+            # when it left its dead replica — don't inflate per retry
+            rec.failovers += 1
+            self.num_failovers += 1
+            _M_FAILOVERS.inc()
+            telemetry.event("router.failover",
+                            request_id=rec.request_id,
+                            from_replica=from_replica,
+                            tokens_folded=len(rec.tokens),
+                            budget_left=self._remaining_budget(rec))
+        self._dispatch(rec, forced=True)
+        if rec.replica is None:
+            telemetry.event("router.orphaned",
+                            request_id=rec.request_id,
+                            tokens_folded=len(rec.tokens))
+
+    # -- operator surface ------------------------------------------------
+    def kill_replica(self, index: int, reason: str = "killed"):
+        """SIGKILL-style drill switch: the replica dies NOW (engine
+        discarded), restart is scheduled with backoff, and the next
+        step() re-routes its in-flight work. `tests/test_chaos.py` and
+        the llama_serve drill use this for deterministic mid-decode
+        kills."""
+        h = self.replicas[index]
+        h.die(reason, self._clock())
+        self.policy.forget(index)
+
+    def drain_replica(self, index: int):
+        """Graceful decommission: no new traffic, in-flight completes,
+        then the replica parks dead until `restore_replica`."""
+        self.replicas[index].drain()
+
+    def restore_replica(self, index: int):
+        self.replicas[index].restore(self._clock())
+
+    def release_request(self, request_id: str):
+        """Drop a TERMINAL request's record once its result has been
+        delivered — a long-running fleet must evict, or `requests`
+        grows without bound. Releasing a live request is refused."""
+        rec = self.requests.get(request_id)
+        if rec is None:
+            return
+        if not rec.done:
+            raise ValueError(f"request {request_id!r} is still "
+                             f"{rec.status}; only terminal requests "
+                             "can be released")
+        del self.requests[request_id]
+
+    # -- drive-to-completion --------------------------------------------
+    def run(self) -> Dict[str, List[int]]:
+        """Step until every submitted request is terminal; returns
+        {request_id: tokens}. While the WHOLE fleet is down awaiting a
+        restart backoff, waits via the injectable `sleep` (pass the
+        fake clock's `advance` in tests). Raises RuntimeError if work
+        remains but every replica is permanently dead."""
+        while True:
+            pending = [r for r in self._live.values() if not r.done]
+            if not pending:
+                return {rid: rec.tokens
+                        for rid, rec in self.requests.items()}
+            if not any(h.alive() for h in self.replicas):
+                now = self._clock()
+                waits = [h.next_restart_time - now
+                         for h in self.replicas
+                         if h.next_restart_time is not None]
+                if not waits:
+                    raise RuntimeError(
+                        f"{len(pending)} requests pending but every "
+                        "replica is permanently dead (restart budget "
+                        "exhausted or drained)")
+                if max(0.0, min(waits)) > 0:
+                    self._sleep(min(waits))
+            self.step()
+
+    # -- introspection ---------------------------------------------------
+    def fleet_info(self) -> Dict[str, object]:
+        """Operator snapshot: per-replica state/queue/restarts plus
+        fleet counters and the prefix-cache aggregate (hits survive
+        replica death — the handles fold in retired engine counters)."""
+        pending = len(self._live)
+        return {
+            "replicas": [
+                {"index": h.index, "state": h.state,
+                 "outstanding": h.outstanding(),
+                 "consecutive_failures": h.consecutive_failures,
+                 "restarts": h.restarts,
+                 "death_reason": h.death_reason}
+                for h in self.replicas],
+            "pending": pending,
+            "submitted": len(self.requests),
+            "failovers": self.num_failovers,
+            "restarts": self.num_restarts,
+            "prefix_hits": sum(h.prefix_hits() for h in self.replicas),
+            "prefix_tokens_reused": sum(h.prefix_tokens_reused()
+                                        for h in self.replicas),
+        }

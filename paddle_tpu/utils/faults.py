@@ -38,6 +38,12 @@ Instrumented sites (grep ``fault_point(`` for the live list):
 
 * ``serving.alloc_page``, ``serving.prefill``, ``serving.decode`` —
   continuous-batching engine (models/serving.py);
+* ``router.dispatch`` — before a request is handed to a replica's
+  engine; ``router.step`` — before a replica with outstanding work
+  steps (idle replicas do not consume visits, so ``nth=`` targets a
+  specific busy replica of a fleet); ``router.health`` — inside every
+  replica health probe (serving/replica.py — failures drive the
+  HEALTHY -> DEGRADED -> DEAD machine and zero-loss failover);
 * ``checkpoint.save`` — before any byte of a state-dict write;
   ``checkpoint.write`` — after one group's bytes land (fires between
   groups of a multi-group save: forces torn ``step_N.tmp`` dirs; for

@@ -11,6 +11,10 @@ then drive every decode surface the framework ships —
   * resilient serving: bounded-queue backpressure, per-request
     deadlines, and a chaos drill (injected prefill fault + forced
     pool exhaustion -> preemption) proving failure isolation,
+  * the multi-replica fleet (`--replicas N`): prefix-affinity dispatch
+    over N engines plus a kill-a-replica failover drill — SIGKILL one
+    replica mid-decode, prove zero loss (outputs identical to an
+    unkilled fleet), and print the `pdt_router_*` Prometheus dump,
   * speculative decoding with a draft model (lossless vs greedy),
 
 and print per-path outputs + engine cache/occupancy stats.
@@ -35,6 +39,8 @@ def main(argv=None):
     p.add_argument("--max-new-tokens", type=int, default=24)
     p.add_argument("--num-beams", type=int, default=4)
     p.add_argument("--draft-layers", type=int, default=1)
+    p.add_argument("--replicas", type=int, default=3,
+                   help="fleet size for the router failover drill")
     args = p.parse_args(argv)
 
     import numpy as np
@@ -157,6 +163,50 @@ def main(argv=None):
     print("--- telemetry (Prometheus text exposition) ---")
     print(telemetry.to_prometheus(), end="")
     print("--- end telemetry ---")
+
+    # 3c) the serving fleet: prefix-affinity dispatch over --replicas
+    # engines, then the failover drill — SIGKILL a replica mid-decode
+    # and prove zero loss against an unkilled fleet's outputs
+    from paddle_tpu.serving import ServingRouter
+
+    def fleet():
+        return ServingRouter(
+            lambda i: ContinuousBatchingEngine(
+                model, max_batch_size=2,
+                max_seq_len=min(256, cfg.max_position_embeddings),
+                enable_prefix_caching=True),
+            num_replicas=args.replicas, policy="prefix_affinity",
+            page_size=16)
+
+    fleet_jobs = [system + rng.integers(
+        1, cfg.vocab_size, int(rng.integers(4, 10))).tolist()
+        for _ in range(2 * args.replicas)]
+    ref_router = fleet()
+    ref_ids = [ref_router.submit(pr, n) for pr in fleet_jobs]
+    want_out = ref_router.run()                  # the unkilled oracle
+
+    router = fleet()
+    ids_f = [router.submit(pr, n) for pr in fleet_jobs]
+    router.step()
+    router.step()                                # mid-decode everywhere
+    victim = router.requests[ids_f[0]].replica
+    router.kill_replica(victim)                  # SIGKILL-shaped
+    got_out = router.run()
+    assert [got_out[i] for i in ids_f] \
+        == [want_out[i] for i in ref_ids], "failover changed outputs"
+    info = router.fleet_info()
+    print(f"fleet: {args.replicas} replicas, killed replica {victim} "
+          f"mid-decode -> {info['failovers']} failover(s), "
+          f"{info['pending']} lost, outputs identical; "
+          f"prefix hits {info['prefix_hits']} "
+          f"({info['prefix_tokens_reused']} tokens reused), "
+          f"affinity hit rate "
+          f"{telemetry.value('pdt_router_affinity_hit_rate'):.2f}")
+    assert info["failovers"] >= 1 and info["pending"] == 0
+    print("--- router telemetry (Prometheus text exposition) ---")
+    print("\n".join(line for line in telemetry.to_prometheus()
+                    .splitlines() if "pdt_router" in line))
+    print("--- end router telemetry ---")
 
     # 4) speculative decoding (draft = shallow copy of the config)
     d_cfg = LlamaConfig(

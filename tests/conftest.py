@@ -61,7 +61,8 @@ def pytest_configure(config):
 # exercised by the whole engine suite for free and (b) a failing test's
 # report carries a telemetry snapshot for post-mortem debugging
 _TELEMETRY_FILES = ("test_serving.py", "test_chaos.py",
-                    "test_telemetry.py", "test_elastic_robustness.py")
+                    "test_telemetry.py", "test_elastic_robustness.py",
+                    "test_router.py")
 
 
 @pytest.fixture(autouse=True)
@@ -97,7 +98,8 @@ def _serving_invariant_checks(request, monkeypatch):
     on: page-accounting violations surface as EngineInvariantError in
     whatever test created them, for free."""
     if os.path.basename(str(request.fspath)) in ("test_serving.py",
-                                                 "test_chaos.py"):
+                                                 "test_chaos.py",
+                                                 "test_router.py"):
         monkeypatch.setenv("PDT_CHECK_INVARIANTS", "1")
     yield
 

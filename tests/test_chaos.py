@@ -3,7 +3,12 @@ failure branch of the serving engine on the CPU mesh via
 `utils.faults.FaultInjector` — pool exhaustion mid-decode (preempt ->
 requeue -> identical tokens), injected prefill failure (request fails,
 engine keeps serving), deadline / queue-time expiry, transient decode
-faults, and interrupted checkpoint saves. conftest enables
+faults, interrupted checkpoint saves, and fleet-level drills against
+the multi-replica `ServingRouter` (SIGKILL a replica mid-decode: every
+in-flight request completes on survivors with bit-identical greedy
+output, all four terminal fates reconcile exactly across the
+`pdt_router_*` / `pdt_serving_*` counters, and the dead replica
+restarts with backoff and resumes taking traffic). conftest enables
 PDT_CHECK_INVARIANTS=1 for this file, so page accounting is re-proved
 after every engine step of every test."""
 import random
@@ -18,6 +23,7 @@ from paddle_tpu.models.serving import (ContinuousBatchingEngine,
                                        EngineInvariantError,
                                        EngineOverloaded, PoolExhausted,
                                        RequestStatus)
+from paddle_tpu.serving import ReplicaState, ServingRouter
 from paddle_tpu.utils.faults import FaultError, FaultInjector, fault_point
 
 pytestmark = pytest.mark.chaos
@@ -524,3 +530,163 @@ class TestCheckpointChaos:
         assert telemetry.value("pdt_checkpoint_load_retries_total") == 1
         assert telemetry.value(
             "pdt_checkpoint_resume_fallbacks_total") == 0
+
+
+class TestRouterFleetChaos:
+    """Fleet-level drills over `paddle_tpu.serving.ServingRouter`:
+    deterministic SIGKILL of a replica mid-decode (the acceptance drill
+    for the multi-replica subsystem) plus fault-site storms against the
+    `router.*` sites. Same FakeClock discipline as the engine tests —
+    the router, the engines, and every deadline share one injectable
+    clock, so every transition is forced, never awaited."""
+
+    def _fleet(self, model, n=3, clock=None, engine_kw=None, **kw):
+        clock = clock if clock is not None else FakeClock()
+        ekw = dict(max_batch_size=2, max_seq_len=64, page_size=4)
+        ekw.update(engine_kw or {})
+        kw.setdefault("page_size", 4)
+        kw.setdefault("sleep", clock.advance)
+        router = ServingRouter(
+            lambda i: ContinuousBatchingEngine(model, clock=clock, **ekw),
+            num_replicas=n, policy="round_robin", clock=clock, **kw)
+        return router, clock
+
+    def _ref(self, model, jobs, **kw):
+        kw.setdefault("max_batch_size", 2)
+        kw.setdefault("max_seq_len", 64)
+        kw.setdefault("page_size", 4)
+        eng = ContinuousBatchingEngine(model, **kw)
+        rids = [eng.add_request(p, m) for p, m in jobs]
+        res = eng.run()
+        return [res[r] for r in rids]
+
+    def test_replica_kill_four_fates_reconcile(self, model):
+        """The ISSUE-4 acceptance drill. One fleet run produces every
+        terminal fate — PREEMPTED (starvation guard under forced pool
+        exhaustion), FAILED (injected prefill fault, replica stays
+        healthy), TIMEOUT (deadline expires mid-decode), FINISHED
+        (including one request SIGKILLed off its replica mid-decode and
+        re-prefilled on a survivor) — and the fleet-level
+        `pdt_router_requests_terminal_total` reconciles EXACTLY, per
+        status, with the engines' `pdt_serving_requests_terminal_total`.
+        Then the dead replica restarts with backoff and demonstrably
+        takes traffic again."""
+        jobs = [([5, 4, 3, 2, 6, 7], 8), ([9, 1, 2], 6), ([7, 7, 1, 2], 5)]
+        ref = self._ref(model, jobs)
+        # the oracle engine above ticked the GLOBAL pdt_serving_*
+        # counters; baseline them so the reconciliation below measures
+        # the fleet run alone
+        statuses = (RequestStatus.FINISHED, RequestStatus.FAILED,
+                    RequestStatus.TIMEOUT, RequestStatus.PREEMPTED)
+        eng_base = {s: telemetry.value(
+            "pdt_serving_requests_terminal_total", status=s)
+            for s in statuses}
+        adm_base = telemetry.value("pdt_serving_admissions_total")
+        router, clock = self._fleet(
+            model, n=3, restart_backoff_base=3.0, restart_backoff_max=3.0,
+            engine_kw=dict(max_preemptions=0))
+
+        # fate 1 — PREEMPTED: replica 0 is the only busy engine, so the
+        # alloc-visit counting is single-engine deterministic (admission
+        # takes visits 1-2 for the 6-token prompt, visit 3 is the first
+        # lazy growth mid-decode); max_preemptions=0 turns the preempt
+        # into the starvation-guard terminal
+        d = router.submit([5, 4, 3, 2, 6, 7], 8)        # round robin: r0
+        with FaultInjector() as fi:
+            fi.arm("serving.alloc_page", nth=3, exc=PoolExhausted)
+            while not router.requests[d].done:
+                router.step()
+        rec_d = router.requests[d]
+        assert rec_d.status == RequestStatus.PREEMPTED
+        assert len(rec_d.tokens) > 0            # partial output retained
+        assert router.replicas[0].state == ReplicaState.HEALTHY
+
+        # fate 2 — FAILED: an injected prefill fault is a REQUEST
+        # failure, isolated by the engine — not a replica health event
+        c = router.submit([9, 1, 2], 6)                 # round robin: r1
+        with FaultInjector() as fi:
+            fi.arm("serving.prefill", nth=1)
+            while not router.requests[c].done:
+                router.step()
+        assert router.requests[c].status == RequestStatus.FAILED
+        assert router.replicas[1].state == ReplicaState.HEALTHY
+
+        # fates 3+4 — TIMEOUT and FINISHED-after-failover: three normal
+        # requests and one doomed deadline, placements fixed by round
+        # robin (a1->r2, a2->r0, a3->r1, b->r2)
+        a1, a2, a3 = [router.submit(p, m) for p, m in jobs]
+        b = router.submit([1, 2, 3], 40, deadline=5.0)
+        router.step()
+        router.step()                           # mid-decode everywhere
+        assert not router.requests[a2].done
+        router.kill_replica(0)                  # SIGKILL: a2 stranded
+        clock.advance(6.0)                      # past b's deadline AND
+        out = router.run()                      # past r0's backoff
+        assert [out[i] for i in (a1, a2, a3)] == ref   # zero loss,
+        #                                          bit-identical greedy
+        assert router.requests[a2].failovers == 1
+        assert router.requests[b].status == RequestStatus.TIMEOUT
+
+        # the dead replica restarted with backoff and takes traffic:
+        # three more submissions necessarily cover every replica index
+        assert router.replicas[0].state == ReplicaState.HEALTHY
+        assert router.replicas[0].restarts == 1
+        extra = [router.submit(p, m) for p, m in jobs]
+        assert {router.requests[i].replica for i in extra} == {0, 1, 2}
+        out = router.run()
+        assert [out[i] for i in extra] == ref
+
+        # exact reconciliation, fleet vs engines, per status: every
+        # request reaches an ENGINE terminal exactly once (the request
+        # killed mid-decode produced no terminal on the dead engine),
+        # and the router mirrors each one
+        fates = {RequestStatus.FINISHED: 6, RequestStatus.FAILED: 1,
+                 RequestStatus.TIMEOUT: 1, RequestStatus.PREEMPTED: 1}
+        for status, want in fates.items():
+            assert telemetry.value("pdt_router_requests_terminal_total",
+                                   status=status) == want, status
+            assert telemetry.value("pdt_serving_requests_terminal_total",
+                                   status=status) \
+                - eng_base[status] == want, status
+        assert sum(fates.values()) == len(router.requests)
+        assert telemetry.value("pdt_router_failovers_total") == 1 \
+            == router.num_failovers
+        # every dispatch that PREFILLED is an engine admission:
+        # originals + the one failover, minus the prefill-faulted
+        # request (admissions count successful prefills only)
+        assert telemetry.value("pdt_serving_admissions_total") - adm_base \
+            == len(router.requests) + router.num_failovers - 1
+        assert telemetry.value("pdt_router_replica_restarts_total",
+                               replica="0") == 1
+        # the failover event stream carries the stable request_id
+        moved = [e for e in telemetry.events()
+                 if e["name"] == "router.failover"]
+        assert [e["attrs"]["request_id"] for e in moved] == [a2]
+
+    def test_step_fault_storm_kills_and_recovers_zero_loss(self, model):
+        """A persistent `router.step` fault storm (the wedged-process
+        shape) rides a replica down HEALTHY -> DEGRADED -> DEAD; its
+        work re-prefills on survivors with identical output, and the
+        storm's end lets the backoff restart bring it back."""
+        jobs = [([5, 4, 3, 2, 6, 7], 8), ([9, 1, 2], 6)]
+        ref = self._ref(model, jobs)
+        router, clock = self._fleet(
+            model, n=2, degraded_after=1, dead_after=2,
+            restart_backoff_base=2.0, restart_backoff_max=2.0)
+        a = router.submit(*jobs[0])             # round robin: replica 0
+        with FaultInjector() as fi:
+            # idle replicas do not consume router.step visits, so the
+            # storm lands entirely on replica 0 — the only busy one
+            fi.arm("router.step", always=True, times=2)
+            router.step()
+            assert router.replicas[0].state == ReplicaState.DEGRADED
+            router.step()
+            assert router.replicas[0].state == ReplicaState.DEAD
+            assert fi.trips("router.step") == 2
+        b = router.submit(*jobs[1])             # survivor takes it
+        out = router.run()                      # failover completes all
+        assert [out[i] for i in (a, b)] == ref
+        assert router.requests[a].failovers == 1
+        clock.advance(2.5)
+        router.step()
+        assert router.replicas[0].state == ReplicaState.HEALTHY

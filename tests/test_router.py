@@ -1,0 +1,533 @@
+"""Serving-fleet router (paddle_tpu/serving/): dispatch policies,
+replica health state machine, drain, fleet backpressure, prefix-
+affinity determinism, and zero-loss failover. Chaos-marker siblings
+(replica kill mid-decode with exact telemetry reconciliation) live in
+tests/test_chaos.py. conftest runs this file with PDT_TELEMETRY=1 and
+PDT_CHECK_INVARIANTS=1, so every engine step of every fleet re-proves
+page accounting and the pdt_router_* instrumentation is exercised for
+free."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as telemetry
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import (ContinuousBatchingEngine,
+                                       EngineOverloaded, RequestStatus)
+from paddle_tpu.serving import (DispatchPolicy, FleetOverloaded,
+                                PrefixAffinityPolicy, ReplicaState,
+                                ServingRouter, make_policy)
+from paddle_tpu.utils.faults import FaultError, FaultInjector
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      num_key_value_heads=1, max_position_embeddings=64)
+    paddle.seed(7)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _factory(model, clock=None, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", 4)
+
+    def make(index):
+        return ContinuousBatchingEngine(model, clock=clock, **kw)
+
+    return make
+
+
+def _router(model, n=2, policy="round_robin", clock=None, engine_kw=None,
+            **kw):
+    clock = clock if clock is not None else FakeClock()
+    kw.setdefault("page_size", 4)
+    kw.setdefault("sleep", clock.advance)
+    return ServingRouter(_factory(model, clock=clock, **(engine_kw or {})),
+                         num_replicas=n, policy=policy, clock=clock,
+                         **kw), clock
+
+
+def _reference(model, jobs, **kw):
+    """Single-engine greedy outputs — the fleet-level oracle."""
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", 4)
+    eng = ContinuousBatchingEngine(model, **kw)
+    rids = [eng.add_request(p, n) for p, n in jobs]
+    res = eng.run()
+    return [res[r] for r in rids]
+
+
+JOBS = [([5, 4, 3, 2, 6, 7], 8), ([9, 1, 2], 6), ([7, 7, 1, 2], 5)]
+
+
+class TestPolicies:
+    def test_round_robin_cycles_replicas(self, model):
+        router, _ = _router(model, n=3)
+        ids = [router.submit(p, n) for p, n in JOBS]
+        assert [router.requests[i].replica for i in ids] == [0, 1, 2]
+        router.submit([1, 2, 3], 4)
+        snap = telemetry.snapshot()["counters"]["pdt_router_dispatch_total"]
+        assert snap['policy="round_robin",replica="0"'] == 2
+        router.run()
+
+    def test_least_outstanding_prefers_idle_replica(self, model):
+        router, _ = _router(model, n=2, policy="least_outstanding")
+        a = router.submit(*JOBS[0])
+        b = router.submit(*JOBS[1])     # replica 0 busy -> goes to 1
+        c = router.submit(*JOBS[2])     # both depth 1 -> lowest index
+        recs = router.requests
+        assert (recs[a].replica, recs[b].replica, recs[c].replica) \
+            == (0, 1, 0)
+        router.run()
+
+    def test_policies_skip_non_accepting_states(self, model):
+        router, _ = _router(model, n=3)
+        router.replicas[1].drain()
+        router.kill_replica(2)
+        ids = [router.submit(p, n) for p, n in JOBS]
+        assert all(router.requests[i].replica == 0 for i in ids)
+        router.run()
+
+    def test_degraded_is_last_resort(self, model):
+        router, clock = _router(model, n=2, degraded_after=1,
+                                dead_after=5)
+        router.replicas[0].note_failure(clock(), RuntimeError("x"))
+        assert router.replicas[0].state == ReplicaState.DEGRADED
+        a = router.submit(*JOBS[0])
+        assert router.requests[a].replica == 1    # healthy wins
+        router.kill_replica(1)
+        b = router.submit(*JOBS[1])               # only degraded left
+        assert router.requests[b].replica == 0
+        router.run()
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError, match="unknown dispatch policy"):
+            make_policy("fastest_first")
+
+    def test_affinity_colocates_shared_prefixes(self, model):
+        rng = np.random.default_rng(3)
+        g1 = list(rng.integers(1, 64, 8))     # two full 4-token pages
+        g2 = list(rng.integers(1, 64, 8))
+        router, _ = _router(model, n=4, policy="prefix_affinity",
+                            engine_kw=dict(enable_prefix_caching=True))
+        placements = {}
+        for g, tag in ((g1, "a"), (g2, "b")) * 3:
+            rid = router.submit(g + list(rng.integers(1, 64, 3)), 4)
+            placements.setdefault(tag, set()).add(
+                router.requests[rid].replica)
+        # every request of a group landed on ONE replica, groups split
+        assert len(placements["a"]) == 1 and len(placements["b"]) == 1
+        assert placements["a"] != placements["b"]
+        assert telemetry.value("pdt_router_affinity_hits_total") == 4
+        assert telemetry.value("pdt_router_affinity_lookups_total") == 6
+        router.run()
+
+    def test_affinity_placement_is_deterministic(self, model):
+        rng = np.random.default_rng(5)
+        jobs = [(list(rng.integers(1, 64, 8))
+                 + list(rng.integers(1, 64, 3)), 4) for _ in range(8)]
+
+        def place():
+            router, _ = _router(model, n=3, policy="prefix_affinity",
+                                engine_kw=dict(
+                                    enable_prefix_caching=True))
+            ids = [router.submit(p, n) for p, n in jobs]
+            out = router.run()
+            return ([router.requests[i].replica for i in ids],
+                    [out[i] for i in ids])
+
+        p1, o1 = place()
+        p2, o2 = place()
+        assert p1 == p2 and o1 == o2
+
+    def test_affinity_beats_round_robin_on_shared_prefixes(self, model):
+        """Acceptance: on a deterministic shared-prefix workload the
+        prefix-affinity fleet reuses cached prompt KV (engine
+        pdt_serving prefix hits) where round-robin recomputes it."""
+        rng = np.random.default_rng(0)
+        groups = [list(rng.integers(1, 64, 8)) for _ in range(3)]
+        jobs = [(g + list(rng.integers(1, 64, 3)), 4)
+                for _ in range(4) for g in groups]
+
+        def fleet_hits(policy):
+            telemetry.reset()
+            router, _ = _router(model, n=4, policy=policy,
+                                engine_kw=dict(
+                                    enable_prefix_caching=True))
+            for p, n in jobs:
+                router.submit(p, n)
+            router.run()
+            info = router.fleet_info()
+            return info["prefix_hits"], info["prefix_tokens_reused"]
+
+        rr_hits, rr_reused = fleet_hits("round_robin")
+        af_hits, af_reused = fleet_hits("prefix_affinity")
+        assert af_hits > rr_hits
+        assert af_reused > rr_reused
+        assert telemetry.value("pdt_router_affinity_hit_rate") > 0.5
+
+    def test_affinity_hash_is_page_aligned(self):
+        pol = PrefixAffinityPolicy(page_size=4)
+        # 9 tokens = 2 full pages; the 9th token never hashes (the
+        # engine can never share the final prompt token)
+        assert len(pol._chain_hashes(list(range(9)))) == 2
+        # 8 tokens: only 1 full page is shareable (cap keeps one token)
+        assert len(pol._chain_hashes(list(range(8)))) == 1
+        a = pol._chain_hashes([1, 2, 3, 4, 5, 6, 7, 8, 9])
+        b = pol._chain_hashes([1, 2, 3, 4, 9, 9, 9, 9, 9])
+        assert a[0] == b[0] and a[1] != b[1]    # chained per page
+
+
+class TestHealthMachine:
+    def test_consecutive_failures_degrade_then_recover(self, model):
+        router, _ = _router(model, n=1, degraded_after=2, dead_after=5)
+        router.submit(*JOBS[0])
+        with FaultInjector() as fi:
+            fi.arm("router.step", always=True, times=2)
+            router.step()
+            assert router.replicas[0].state == ReplicaState.HEALTHY
+            router.step()
+            assert router.replicas[0].state == ReplicaState.DEGRADED
+        router.step()           # fault cleared: one success recovers
+        assert router.replicas[0].state == ReplicaState.HEALTHY
+        assert router.replicas[0].consecutive_failures == 0
+        router.run()
+
+    def test_failures_kill_then_restart_with_backoff(self, model):
+        router, clock = _router(model, n=1, degraded_after=1,
+                                dead_after=3, restart_backoff_base=2.0,
+                                restart_backoff_max=2.0)
+        rid = router.submit(*JOBS[0])
+        ref = _reference(model, [JOBS[0]])
+        with FaultInjector() as fi:
+            fi.arm("router.step", always=True, times=3)
+            for _ in range(3):
+                router.step()
+        h = router.replicas[0]
+        assert h.state == ReplicaState.DEAD
+        assert h.death_reason == "failures"
+        assert h.engine is None                  # SIGKILL-shaped
+        # backoff gates the restart: stepping before the deadline is a
+        # no-op, stepping after brings a fresh engine back
+        router.step()
+        assert h.state == ReplicaState.DEAD
+        clock.advance(2.1)                       # cap=2.0 bounds jitter
+        router.step()
+        assert h.state == ReplicaState.HEALTHY
+        assert h.restarts == 1
+        assert telemetry.value("pdt_router_replica_restarts_total",
+                               replica="0") == 1
+        out = router.run()
+        assert out[rid] == ref[0]                # zero-loss through death
+        assert router.requests[rid].failovers == 1
+
+    def test_wedged_replica_detected_via_clock(self, model):
+        router, clock = _router(model, n=1, degraded_after=1,
+                                dead_after=100, wedge_timeout=5.0)
+        router.submit(*JOBS[0])
+        with FaultInjector() as fi:
+            # steps keep failing but never reach dead_after: only the
+            # wedge detector can declare this replica gone
+            fi.arm("router.step", always=True)
+            router.step()
+            clock.advance(6.0)
+            router.step()
+        assert router.replicas[0].state == ReplicaState.DEAD
+        assert router.replicas[0].death_reason == "wedged"
+
+    def test_health_probe_fault_counts_as_failure(self, model):
+        router, _ = _router(model, n=1, degraded_after=1, dead_after=5)
+        router.submit(*JOBS[0])
+        with FaultInjector() as fi:
+            fi.arm("router.health", nth=1)
+            router.step()
+        assert router.replicas[0].state == ReplicaState.DEGRADED
+        assert "FaultError" in router.replicas[0].last_error
+        router.run()
+
+    def test_dispatch_fault_steers_to_survivor(self, model):
+        router, _ = _router(model, n=2, degraded_after=1, dead_after=3)
+        with FaultInjector() as fi:
+            fi.arm("router.dispatch", nth=1)
+            rid = router.submit(*JOBS[0])
+        # first candidate's dispatch faulted; the request still landed
+        assert router.requests[rid].replica is not None
+        assert sum(h.consecutive_failures for h in router.replicas) == 1
+        router.run()
+
+    def test_restart_budget_exhausts_permanently(self, model):
+        router, clock = _router(model, n=1, degraded_after=1,
+                                dead_after=1, max_restarts=1,
+                                restart_backoff_base=1.0,
+                                restart_backoff_max=1.0)
+        router.submit(*JOBS[0])
+        with FaultInjector() as fi:
+            fi.arm("router.step", always=True)
+            router.step()                        # death #1
+            assert router.replicas[0].next_restart_time is not None
+            clock.advance(1.1)
+            router.step()                        # restart, dies again
+            router.step()
+        assert router.replicas[0].state == ReplicaState.DEAD
+        assert router.replicas[0].next_restart_time is None  # no budget
+        with pytest.raises(RuntimeError, match="permanently dead"):
+            router.run()
+
+
+class TestDrainAndBackpressure:
+    def test_drain_completes_inflight_then_parks(self, model):
+        router, _ = _router(model, n=2)
+        ref = _reference(model, JOBS)
+        ids = [router.submit(p, n) for p, n in JOBS]
+        router.step()
+        router.drain_replica(0)
+        assert router.replicas[0].state == ReplicaState.DRAINING
+        # new traffic avoids the draining replica
+        extra = router.submit([3, 3, 3], 4)
+        assert router.requests[extra].replica == 1
+        out = router.run()
+        assert [out[i] for i in ids] == ref      # in-flight unharmed
+        h = router.replicas[0]
+        assert h.state == ReplicaState.DEAD
+        assert h.death_reason == "drained"
+        assert h.next_restart_time is None       # no auto-restart
+        router.restore_replica(0)
+        assert h.state == ReplicaState.HEALTHY
+        rid = router.submit(*JOBS[0])
+        assert router.requests[rid].replica == 0
+        router.run()
+
+    def test_fleet_backpressure_with_retry_after(self, model):
+        router, _ = _router(model, n=2, max_replica_outstanding=1)
+        router.submit(*JOBS[0])
+        router.submit(*JOBS[1])
+        with pytest.raises(FleetOverloaded) as e:
+            router.submit(*JOBS[2])
+        assert isinstance(e.value, EngineOverloaded)  # front ends: 429
+        assert e.value.retry_after > 0
+        assert telemetry.value("pdt_router_rejections_total",
+                               reason="fleet_full") == 1
+        router.run()
+        router.submit(*JOBS[2])                  # drained: reopens
+        router.run()
+
+    def test_all_dead_fleet_refuses_with_restart_hint(self, model):
+        router, _ = _router(model, n=2, restart_backoff_base=4.0,
+                            restart_backoff_max=4.0)
+        router.kill_replica(0)
+        router.kill_replica(1)
+        with pytest.raises(FleetOverloaded) as e:
+            router.submit(*JOBS[0])
+        assert 0 < e.value.retry_after <= 4.0
+        assert telemetry.value("pdt_router_rejections_total",
+                               reason="no_replicas") == 1
+
+    def test_submit_is_idempotent_per_request_id(self, model):
+        router, _ = _router(model, n=2)
+        a = router.submit(*JOBS[0], request_id="job-1")
+        b = router.submit(*JOBS[1], request_id="job-1")  # retry dupe
+        assert a == b == "job-1"
+        assert len(router.requests) == 1
+        assert router.requests["job-1"].dispatches == 1
+        out = router.run()
+        assert out["job-1"] == _reference(model, [JOBS[0]])[0]
+
+    def test_generated_ids_skip_caller_supplied(self, model):
+        router, _ = _router(model, n=1)
+        a = router.submit(*JOBS[0], request_id="fleet-0")
+        b = router.submit(*JOBS[1])     # must NOT overwrite "fleet-0"
+        assert b != a and len(router.requests) == 2
+        router.run()
+
+    def test_malformed_submit_rejected_without_health_penalty(
+            self, model):
+        """A request-shaped refusal (empty prompt) is the caller's
+        error — it must surface as ValueError, not degrade replicas."""
+        router, _ = _router(model, n=2, degraded_after=1)
+        with pytest.raises(ValueError, match="empty prompt"):
+            router.submit([], 4)
+        assert all(h.state == ReplicaState.HEALTHY
+                   and h.consecutive_failures == 0
+                   for h in router.replicas)
+        assert len(router.requests) == 0
+
+    def test_drain_sticks_through_mid_drain_death(self, model):
+        """A replica killed WHILE draining stays decommissioned — it
+        must not restart itself back into traffic."""
+        router, clock = _router(model, n=2)
+        router.submit(*JOBS[0])
+        router.step()
+        router.drain_replica(0)
+        router.kill_replica(0, reason="died mid-drain")
+        assert router.replicas[0].next_restart_time is None
+        clock.advance(120.0)
+        router.run()
+        assert router.replicas[0].state == ReplicaState.DEAD
+
+    def test_release_request_evicts_terminal_only(self, model):
+        router, _ = _router(model, n=1)
+        rid = router.submit(*JOBS[0])
+        with pytest.raises(ValueError, match="still"):
+            router.release_request(rid)
+        router.run()
+        router.release_request(rid)
+        assert rid not in router.requests
+        router.release_request(rid)              # idempotent
+
+    def test_engine_level_overload_steers_not_kills(self, model):
+        # a factory with its own max_waiting: the engine's bound refuses
+        # but the request steers to the next replica and the refused
+        # replica is NOT penalized as unhealthy
+
+        class AlwaysLowest(DispatchPolicy):
+            name = "always_lowest"
+
+            def select(self, candidates, prompt):
+                return min(candidates, key=lambda h: h.index)
+
+        router, _ = _router(model, n=2, policy=AlwaysLowest(),
+                            engine_kw=dict(max_batch_size=1,
+                                           max_waiting=1))
+        a = router.submit(*JOBS[0])
+        b = router.submit(*JOBS[1])   # replica 0 full: engine refusal
+        #                               must steer here, not kill there
+        assert {router.requests[a].replica,
+                router.requests[b].replica} == {0, 1}
+        with pytest.raises(FleetOverloaded):
+            router.submit(*JOBS[2])
+        assert all(h.state == ReplicaState.HEALTHY
+                   for h in router.replicas)
+        router.run()
+
+
+class TestFailover:
+    def test_kill_mid_decode_outputs_identical(self, model):
+        ref = _reference(model, JOBS)
+        router, _ = _router(model, n=3)
+        ids = [router.submit(p, n) for p, n in JOBS]
+        router.step()
+        router.step()                            # mid-decode
+        router.kill_replica(1)
+        out = router.run()
+        assert [out[i] for i in ids] == ref
+        assert router.num_failovers == 1
+        assert telemetry.value("pdt_router_failovers_total") == 1
+        # the request id is traceable through the failover event stream
+        moved = [e for e in telemetry.events()
+                 if e["name"] == "router.failover"]
+        assert len(moved) == 1
+        rid = moved[0]["attrs"]["request_id"]
+        assert router.requests[rid].failovers == 1
+        terminal = [e for e in telemetry.events()
+                    if e["name"] == "serving.terminal"
+                    and e["attrs"]["request_id"] == rid]
+        assert len(terminal) == 1                # finished exactly once
+
+    def test_all_dead_orphans_then_restart_revives(self, model):
+        ref = _reference(model, [JOBS[0]])
+        router, clock = _router(model, n=2, restart_backoff_base=3.0,
+                                restart_backoff_max=3.0)
+        rid = router.submit(*JOBS[0])
+        router.step()
+        router.kill_replica(0)
+        router.kill_replica(1)
+        done = router.step()                     # nowhere to go: orphan
+        assert done == []
+        rec = router.requests[rid]
+        assert rec.replica is None and not rec.done
+        # run() waits out the backoff via the injected sleep (the fake
+        # clock's advance), restarts a replica, and finishes the work
+        out = router.run()
+        assert out[rid] == ref[0]
+        assert router.num_restarts >= 1
+        assert rec.failovers == 1                # orphan retries don't
+        assert telemetry.value("pdt_router_failovers_total") == 1
+
+    def test_failover_respects_deadline(self, model):
+        router, clock = _router(model, n=2)
+        rid = router.submit(*JOBS[0], deadline=5.0)
+        router.step()
+        router.kill_replica(0)
+        router.kill_replica(1)
+        clock.advance(6.0)                       # budget dies with fleet
+        done = router.step()
+        assert [r.request_id for r in done] == [rid]
+        assert done[0].status == RequestStatus.TIMEOUT
+        assert telemetry.value("pdt_router_requests_terminal_total",
+                               status="timeout") == 1
+
+    def test_fleet_and_engine_terminal_counters_reconcile(self, model):
+        router, _ = _router(model, n=3)
+        ids = [router.submit(p, n) for p, n in JOBS]
+        router.step()
+        router.kill_replica(0)
+        router.run()
+        fleet_fin = telemetry.value("pdt_router_requests_terminal_total",
+                                    status="finished")
+        engine_fin = telemetry.value("pdt_serving_requests_terminal_total",
+                                     status="finished")
+        assert fleet_fin == engine_fin == len(ids)
+        # every admission is a dispatch: original placements + failovers
+        assert telemetry.value("pdt_serving_admissions_total") \
+            == len(ids) + router.num_failovers
+
+
+class TestRouterSurface:
+    def test_run_returns_request_id_keyed_outputs(self, model):
+        router, _ = _router(model, n=2)
+        ids = [router.submit(p, n) for p, n in JOBS]
+        out = router.run()
+        assert sorted(out) == sorted(ids)
+        assert all(i.startswith("fleet-") for i in ids)
+
+    def test_fleet_info_shape(self, model):
+        router, _ = _router(model, n=2)
+        router.submit(*JOBS[0])
+        info = router.fleet_info()
+        assert info["submitted"] == 1 and info["pending"] == 1
+        assert [r["state"] for r in info["replicas"]] \
+            == [ReplicaState.HEALTHY] * 2
+        router.run()
+        assert router.fleet_info()["pending"] == 0
+
+    def test_single_replica_fleet_matches_engine(self, model):
+        ref = _reference(model, JOBS)
+        router, _ = _router(model, n=1)
+        ids = [router.submit(p, n) for p, n in JOBS]
+        out = router.run()
+        assert [out[i] for i in ids] == ref
+
+    def test_num_replicas_validated(self, model):
+        with pytest.raises(ValueError, match="num_replicas"):
+            ServingRouter(_factory(model), num_replicas=0)
+
+    def test_state_gauges_track_fleet(self, model):
+        router, _ = _router(model, n=2)
+        assert telemetry.value("pdt_router_replica_state",
+                               replica="0") == 0
+        router.kill_replica(0)
+        assert telemetry.value("pdt_router_replica_state",
+                               replica="0") == 3
+        router.submit(*JOBS[0])
+        router.step()
+        assert telemetry.value("pdt_router_replica_queue_depth",
+                               replica="1") >= 0
