@@ -5,6 +5,18 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline is MFU / 0.40 — the BASELINE.json north-star target MFU
 (no published reference numbers exist; see BASELINE.md).
 
+Serving-latency detail now carries TTFT/TPOT p50/p95/p99 (the SLO axes,
+interpolated from the telemetry histograms via
+`observability.slo.quantile_from_buckets`) under
+`detail.engine_telemetry` and each `detail.router` fleet run.
+
+Regression gate: `bench.py --check-regression PREV.json
+[--regression-threshold PCT]` runs the bench, emits the JSON line as
+usual, then diffs the throughput metrics against the prior BENCH_r*.json
+and exits NON-ZERO when any regressed more than PCT % (default 10).
+`--current CUR.json` compares two saved results without running
+anything (the CI-friendly form).
+
 Model size is chosen to exercise the chip seriously while fitting one
 v5e (≈16 GiB HBM) with AdamW fp32 state: ≈255M params, bf16 compute.
 
@@ -16,6 +28,7 @@ falls back to the CPU platform; and every exit path — including an
 unexpected exception — prints the JSON line, with an "error" field when
 something went wrong, so the driver always captures a parseable result.
 """
+import argparse
 import json
 import os
 import subprocess
@@ -88,6 +101,75 @@ def emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+def _hist_quantiles(series, qs=(0.5, 0.95, 0.99)):
+    """{"p50": ..., "p95": ..., "p99": ...} seconds from a snapshot
+    histogram series via the SLO quantile API; None when the series
+    never recorded."""
+    from paddle_tpu.observability.slo import quantile_from_buckets
+    if not series or not series.get("count"):
+        return None
+    return {f"p{round(q * 100)}":
+            round(quantile_from_buckets(series["buckets"], q), 6)
+            for q in qs}
+
+
+def _hist_diff(cur, warm):
+    """Subtract a warm-phase snapshot histogram series from the final
+    one (count, sum, AND the cumulative buckets), so steady-state
+    quantiles/averages exclude compile-heavy warm-up observations.
+    Returns a fresh series dict; `cur` may be None/empty."""
+    if not cur:
+        return cur
+    warm = warm or {}
+    wb = warm.get("buckets", {})
+    return {
+        "count": cur["count"] - warm.get("count", 0),
+        "sum": cur["sum"] - warm.get("sum", 0.0),
+        "buckets": {le: c - wb.get(le, 0)
+                    for le, c in cur.get("buckets", {}).items()},
+    }
+
+
+# dotted paths into the bench JSON that gate regressions (tokens/sec
+# family: higher is better)
+REGRESSION_METRICS = (
+    "detail.tokens_per_sec_per_chip",
+    "detail.decode_tokens_per_sec",
+    "detail.router.replicas_1_affinity.tokens_per_sec",
+    "detail.router.replicas_4_affinity.tokens_per_sec",
+)
+
+
+def _dig(d, dotted):
+    for part in dotted.split("."):
+        if not isinstance(d, dict) or part not in d:
+            return None
+        d = d[part]
+    return d
+
+
+def check_regression(prev: dict, cur: dict,
+                     threshold_pct: float = 10.0):
+    """Diff the throughput metrics of two bench results. Returns
+    (regressions, compared): human-readable strings for every metric
+    that dropped more than `threshold_pct` %, and how many metrics
+    were comparable at all (0 = nothing to compare, itself a red
+    flag)."""
+    regressions, compared = [], 0
+    for path in REGRESSION_METRICS:
+        p, c = _dig(prev, path), _dig(cur, path)
+        if not isinstance(p, (int, float)) or isinstance(p, bool) \
+                or not isinstance(c, (int, float)) \
+                or isinstance(c, bool) or p <= 0:
+            continue
+        compared += 1
+        if c < p * (1.0 - threshold_pct / 100.0):
+            regressions.append(
+                f"{path}: {p:g} -> {c:g} ({(c / p - 1) * 100:+.1f}%, "
+                f"threshold -{threshold_pct:g}%)")
+    return regressions, compared
+
+
 def bench_decode(model, cfg, on_tpu: bool) -> dict:
     """Steady-state continuous-batching decode throughput on the paged
     engine (VERDICT r4 #1: the decode number must ride bench.py's JSON
@@ -128,15 +210,14 @@ def bench_decode(model, cfg, on_tpu: bool) -> dict:
     # it can't be read as steady-state serving latency
     ttft = snap["histograms"].get("pdt_serving_ttft_seconds",
                                   {}).get("", {})
-    # steady-state decode only: diff the histogram across the timed
-    # window so compile-heavy warm steps don't skew the average
-    dstep = dict(snap["histograms"].get(
-        "pdt_serving_decode_step_seconds", {}).get("", {}))
-    warm_dstep = warm_snap["histograms"].get(
-        "pdt_serving_decode_step_seconds", {}).get("", {})
-    if dstep:
-        dstep["count"] -= warm_dstep.get("count", 0)
-        dstep["sum"] -= warm_dstep.get("sum", 0.0)
+    # steady-state decode only: diff the histogram (count, sum, AND
+    # buckets) across the timed window so compile-heavy warm steps
+    # skew neither the average nor the quantiles
+    dstep = _hist_diff(
+        snap["histograms"].get("pdt_serving_decode_step_seconds",
+                               {}).get("", {}),
+        warm_snap["histograms"].get("pdt_serving_decode_step_seconds",
+                                    {}).get("", {}))
     return {
         "decode_tokens_per_sec": round(slots * steps / dt, 1),
         "decode_batch_slots": slots,
@@ -144,6 +225,14 @@ def bench_decode(model, cfg, on_tpu: bool) -> dict:
         "engine_telemetry": {
             "ttft_cold_avg_s": round(ttft["sum"] / ttft["count"], 4)
             if ttft.get("count") else None,
+            # SLO axes (interpolated from the le buckets; TTFT here is
+            # cold-start — see the comment above)
+            "ttft_quantiles_s": _hist_quantiles(ttft),
+            "tpot_quantiles_s": _hist_quantiles(
+                snap["histograms"].get("pdt_serving_tpot_seconds",
+                                       {}).get("")),
+            # steady-state: the warm-phase buckets are diffed out
+            "decode_step_quantiles_s": _hist_quantiles(dstep),
             "decode_step_avg_ms": round(
                 1e3 * dstep["sum"] / dstep["count"], 3)
             if dstep.get("count") else None,
@@ -209,6 +298,7 @@ def bench_router(model, cfg, on_tpu: bool) -> dict:
             admissions = telemetry.value("pdt_serving_admissions_total")
             aff = telemetry.value("pdt_router_affinity_hit_rate") \
                 if policy == "prefix_affinity" else None
+            hists = telemetry.snapshot()["histograms"]
         finally:
             telemetry.disable(clear_override=True)
         toks = sum(len(v) for v in out.values())
@@ -218,6 +308,12 @@ def bench_router(model, cfg, on_tpu: bool) -> dict:
                                      / max(1, admissions), 4),
             "prefix_tokens_reused": int(info["prefix_tokens_reused"]),
             "affinity_hit_rate": aff if aff is None else round(aff, 4),
+            # fleet-wide SLO axes for this run (all replicas aggregate
+            # into the same process-global histograms)
+            "ttft_quantiles_s": _hist_quantiles(
+                hists.get("pdt_serving_ttft_seconds", {}).get("")),
+            "tpot_quantiles_s": _hist_quantiles(
+                hists.get("pdt_serving_tpot_seconds", {}).get("")),
         }
 
     try:
@@ -393,7 +489,53 @@ def run_bench(on_tpu: bool) -> dict:
     }
 
 
-def main():
+def _parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="paddle_tpu bench (one JSON line on stdout)")
+    ap.add_argument("--check-regression", metavar="PREV.json",
+                    default=None,
+                    help="after the run, diff tokens/sec metrics "
+                         "against this prior bench JSON and exit "
+                         "non-zero on regression")
+    ap.add_argument("--current", metavar="CUR.json", default=None,
+                    help="with --check-regression: compare two saved "
+                         "results instead of running the bench")
+    ap.add_argument("--regression-threshold", type=float, default=10.0,
+                    metavar="PCT", help="allowed drop in percent "
+                                        "(default 10)")
+    return ap.parse_args(argv)
+
+
+def _regression_verdict(prev_path: str, cur: dict,
+                        threshold: float) -> int:
+    with open(prev_path) as f:
+        prev = json.load(f)
+    regressions, compared = check_regression(prev, cur, threshold)
+    if compared == 0:
+        sys.stderr.write("bench: regression check compared 0 metrics "
+                         "(malformed prev/current JSON?)\n")
+        return 2
+    for r in regressions:
+        sys.stderr.write(f"bench: REGRESSION {r}\n")
+    if not regressions:
+        sys.stderr.write(f"bench: regression check OK "
+                         f"({compared} metrics within "
+                         f"{threshold:g}%)\n")
+    return 1 if regressions else 0
+
+
+def main(argv=None):
+    args = _parse_args(argv)
+    if args.current is not None:
+        if not args.check_regression:
+            sys.stderr.write("bench: --current requires "
+                             "--check-regression\n")
+            return 2
+        with open(args.current) as f:
+            cur = json.load(f)
+        return _regression_verdict(args.check_regression, cur,
+                                   args.regression_threshold)
+
     error = None
     on_tpu = False
     if SKIP_TPU:
@@ -434,13 +576,19 @@ def main():
             + traceback.format_exc(limit=5)[-1500:],
         }
         emit(result)
-        return
+        return (_regression_verdict(args.check_regression, result,
+                                    args.regression_threshold)
+                if args.check_regression else 0)
     finally:
         signal.alarm(0)
     if error:
         result["error"] = error
     emit(result)
+    if args.check_regression:
+        return _regression_verdict(args.check_regression, result,
+                                   args.regression_threshold)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

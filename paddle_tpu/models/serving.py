@@ -783,7 +783,11 @@ class ContinuousBatchingEngine:
             self._slot_seq[slot] = self._admit_seq
             self._admit_seq += 1
             try:
+                # request_id joins the request's distributed trace when
+                # a fleet router opened one (trace.start_trace) — the
+                # engine itself needs no router awareness
                 with telemetry.span("serving.prefill", rid=req.rid,
+                                    request_id=req.request_id,
                                     prompt_len=p_len,
                                     shared_pages=len(shared)
                                     if shared else 0):
@@ -854,7 +858,11 @@ class ContinuousBatchingEngine:
                 # once per request: a preempted request's re-admission
                 # must not re-observe TTFT
                 req.first_token_time = self._clock()
-                _M_TTFT.observe(req.first_token_time - req.arrival_time)
+                ttft = req.first_token_time - req.arrival_time
+                _M_TTFT.observe(ttft)
+                telemetry.event("serving.first_token", rid=req.rid,
+                                request_id=req.request_id,
+                                ttft_s=ttft)
             if (self.eos is not None and int(tok) == self.eos) \
                     or len(req.output) >= req.max_new_tokens:
                 self._finalize(req, RequestStatus.FINISHED, None,
@@ -1341,7 +1349,13 @@ class ContinuousBatchingEngine:
         # a retried step replays an identical sampling stream
         fault_point("serving.decode")
         n_active = sum(r is not None for r in self._slot_req)
-        with telemetry.span("serving.decode_step", slots=n_active):
+        # rids: the request_ids this batched step decodes for — the
+        # Chrome exporter fans the span out into each request's
+        # timeline row, and request_tree() fans it into each tree
+        rids = ([r.request_id for r in self._slot_req if r is not None]
+                if telemetry.enabled() else ())
+        with telemetry.span("serving.decode_step", slots=n_active,
+                            rids=rids):
             t0 = time.perf_counter()
             nxt, new_kv = self._decode_jit(
                 [p._value for p in self._params],

@@ -18,9 +18,22 @@ Three modules:
 * `trace` — nestable `span()` / point `event()` -> structured JSONL
   into a bounded ring buffer + optional file sink
   (`PDT_TELEMETRY_TRACE_FILE=`), interoperating with
-  `profiler.RecordEvent` so spans land in the XLA timeline too.
+  `profiler.RecordEvent` so spans land in the XLA timeline too. PLUS
+  request-scoped distributed traces: `start_trace(request_id)` opens a
+  trace whose carrier any span/event carrying that `request_id` attr
+  joins automatically (router -> replica -> engine), `request_tree()`
+  rebuilds one request's causal tree, and `export_chrome_trace()`
+  renders Perfetto/chrome://tracing JSON (pid=replica, tid=request).
 * `export` — Prometheus text exposition + JSON snapshot, with a
-  `parse_prometheus()` round-trip verifier.
+  `parse_prometheus()` round-trip verifier and an offline
+  `render_prometheus(snapshot)` for saved snapshots.
+* `slo` — streaming quantiles (le-bucket interpolation + an exact
+  windowed reservoir) and the `SloMonitor` grading declarative
+  objectives (TTFT/TPOT percentiles, error rate, availability) into
+  pass/warn/breach with burn rates, exported as `pdt_slo_*` gauges.
+* `status` — `render_fleet_status()`: the human-readable fleet report.
+* `__main__` — the operator CLI (`python -m paddle_tpu.observability
+  snapshot|slo|trace ...`, installed as `paddle-tpu-obs`).
 
 Instrumented out of the box: the continuous-batching engine (TTFT,
 time-per-output-token, tokens/sec, queue depth, admissions/rejections,
@@ -37,15 +50,29 @@ from .registry import (DEFAULT_BUCKETS, REGISTRY, Counter, Gauge,  # noqa: F401
                        Histogram, Registry, counter, disable, enable,
                        enabled, gauge, histogram, reset, snapshot, value)
 from .trace import (clear as clear_events, event, events,  # noqa: F401
-                    set_trace_file, span, trace_file)
-from .export import (parse_prometheus, to_json, to_prometheus,  # noqa: F401
-                     write_json)
+                    set_trace_file, span, trace_file, start_trace,
+                    end_trace, trace_of, attach as trace_attach,
+                    request_tree, export_chrome_trace,
+                    load_trace_jsonl)
+from .export import (parse_prometheus, render_prometheus,  # noqa: F401
+                     to_json, to_prometheus, write_json)
+from .slo import (Reservoir, SloMonitor, SloObjective,  # noqa: F401
+                  SloStatus, default_serving_objectives,
+                  evaluate_snapshot, format_slo_report,
+                  objectives_from_spec, quantile_from_buckets)
+from .status import render_fleet_status  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "DEFAULT_BUCKETS", "counter", "gauge", "histogram",
     "enable", "disable", "enabled", "reset", "snapshot", "value",
     "span", "event", "events", "clear_events", "set_trace_file",
-    "trace_file", "to_prometheus", "to_json", "write_json",
-    "parse_prometheus",
+    "trace_file", "start_trace", "end_trace", "trace_of",
+    "trace_attach", "request_tree", "export_chrome_trace",
+    "load_trace_jsonl", "to_prometheus", "render_prometheus",
+    "to_json", "write_json", "parse_prometheus",
+    "Reservoir", "SloMonitor", "SloObjective", "SloStatus",
+    "default_serving_objectives", "evaluate_snapshot",
+    "format_slo_report", "objectives_from_spec",
+    "quantile_from_buckets", "render_fleet_status",
 ]

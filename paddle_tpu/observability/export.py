@@ -20,7 +20,8 @@ from typing import Dict, Optional
 from .registry import (REGISTRY, Counter, Gauge, Histogram, Registry,
                        _fmt_float)
 
-__all__ = ["to_prometheus", "to_json", "write_json", "parse_prometheus"]
+__all__ = ["to_prometheus", "render_prometheus", "to_json",
+           "write_json", "parse_prometheus"]
 
 
 def _sample(name: str, labels: str, v) -> str:
@@ -36,17 +37,31 @@ def to_prometheus(registry: Optional[Registry] = None) -> str:
     """Text exposition of every live series, deterministically ordered
     (by instrument name, then label string)."""
     reg = registry if registry is not None else REGISTRY
-    snap = reg.snapshot()
     insts = reg.instruments()
+    return _render(reg.snapshot(),
+                   {n: i.help for n, i in insts.items() if i.help})
+
+
+def render_prometheus(snapshot: Dict[str, object]) -> str:
+    """Text exposition straight from a bare `snapshot()` STRUCTURE —
+    no live registry required, so offline tooling (the
+    `python -m paddle_tpu.observability snapshot` CLI) can convert a
+    saved JSON snapshot into scrape text. `# HELP` lines are omitted
+    (snapshots do not carry help strings);
+    `parse_prometheus(render_prometheus(snap))` still round-trips to
+    the same values."""
+    return _render(snapshot, {})
+
+
+def _render(snap: Dict[str, object], helps: Dict[str, str]) -> str:
     lines = []
     for kind, section in (("counter", "counters"), ("gauge", "gauges"),
                           ("histogram", "histograms")):
-        for name, series in sorted(snap[section].items()):
+        for name, series in sorted(snap.get(section, {}).items()):
             if not series:
                 continue
-            inst = insts.get(name)
-            if inst is not None and inst.help:
-                lines.append(f"# HELP {name} {inst.help}")
+            if name in helps:
+                lines.append(f"# HELP {name} {helps[name]}")
             lines.append(f"# TYPE {name} {kind}")
             for labels, val in sorted(series.items()):
                 if kind == "histogram":

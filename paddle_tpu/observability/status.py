@@ -1,0 +1,48 @@
+"""Human-readable fleet status: the operator's one-glance surface.
+
+`render_fleet_status` turns `ServingRouter.fleet_info()` (per-replica
+health, queue depths, restart counts, the prefix-cache aggregate, and —
+when an `SloMonitor` is attached — per-replica and fleet-level SLO
+verdicts) into the fixed-width report `recipes/llama_serve.py` prints
+after its drills. Pure formatting: no registry reads, no side effects,
+so it can render a `fleet_info()` dict captured anywhere (a log line, a
+post-mortem dump, a test)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+__all__ = ["render_fleet_status"]
+
+
+def render_fleet_status(info: Dict[str, object]) -> str:
+    """Format one `ServingRouter.fleet_info()` snapshot."""
+    lines: List[str] = ["fleet status"]
+    lines.append(f"  {'replica':<8} {'state':<9} {'outstanding':>11} "
+                 f"{'restarts':>8} {'slo':<7} note")
+    for r in info.get("replicas", []):
+        slo = r.get("slo")
+        note = r.get("death_reason") or ""
+        if r.get("consecutive_failures"):
+            note = (note + " " if note else "") \
+                + f"{r['consecutive_failures']} consecutive failures"
+        lines.append(
+            f"  {r['index']:<8} {r['state']:<9} "
+            f"{r['outstanding']:>11} {r['restarts']:>8} "
+            f"{(slo.upper() if slo else '-'):<7} {note}".rstrip())
+    lines.append(
+        f"  requests: {info.get('submitted', 0)} submitted, "
+        f"{info.get('pending', 0)} pending; "
+        f"failovers {info.get('failovers', 0)}, "
+        f"restarts {info.get('restarts', 0)}")
+    lines.append(
+        f"  prefix cache: {info.get('prefix_hits', 0)} hits, "
+        f"{info.get('prefix_tokens_reused', 0)} tokens reused")
+    slo: Optional[Dict[str, dict]] = info.get("slo")  # type: ignore
+    if slo:
+        parts = []
+        for name, st in slo.items():
+            value = st.get("value")
+            shown = "-" if value is None else f"{value:.4g}"
+            parts.append(f"{name}={st['state'].upper()}({shown})")
+        lines.append("  slo: " + " ".join(parts))
+    return "\n".join(lines)

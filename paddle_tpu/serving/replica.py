@@ -176,10 +176,17 @@ class ReplicaHandle:
     def step(self) -> List[Request]:
         """One engine step. The `router.step` fault site fires only when
         this replica has outstanding work, so chaos tests can target a
-        specific busy replica with visit counting."""
-        if self.outstanding():
-            fault_point("router.step")
-        return self.engine.step()
+        specific busy replica with visit counting. Busy steps run under
+        a `router.replica_step` span carrying the replica index, so
+        every engine span inside (prefill, decode) has a replica
+        ancestor — that is how the Chrome-trace exporter assigns
+        pid=replica to engine-side work."""
+        if not self.outstanding():
+            return self.engine.step()
+        fault_point("router.step")
+        with telemetry.span("router.replica_step", replica=self.index,
+                            generation=self.generation):
+            return self.engine.step()
 
     # -- health state machine --------------------------------------------
     def _transition(self, state: str, reason: str):

@@ -44,6 +44,19 @@ Telemetry (`pdt_router_*`, docs/serving.md "Fleet"): dispatch counters
 by {policy, replica}, failover/restart counters, per-replica state and
 queue-depth gauges, affinity hit-rate, fleet terminal counters that
 reconcile exactly with the engines' `pdt_serving_*` counters.
+
+Observability (docs/observability.md): `submit()` opens a REQUEST-
+SCOPED TRACE keyed by the stable request_id (`trace.start_trace`);
+every dispatch attempt runs under a `router.dispatch` span, and the
+engine's prefill/decode spans + terminal/failover events join the same
+trace automatically via their `request_id` attrs — so one request's
+dispatch, queue wait, prefill, decode steps, and failover re-dispatch
+form a single causal tree across replicas, exportable as a Perfetto
+trace. An optional read-only `slo_monitor=` (observability.slo) is fed
+each terminal outcome + the fleet-level TTFT (submit to first mirrored
+token on the router clock — robust across failover), and
+`fleet_info()` then reports fleet and per-replica SLO state alongside
+health.
 """
 from __future__ import annotations
 
@@ -53,6 +66,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from .. import observability as telemetry
+from ..observability import trace as tracing
 from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
                               Request, RequestStatus)
 from .policy import DispatchPolicy, PrefixAffinityPolicy, make_policy
@@ -110,6 +124,13 @@ class FleetRequest:
     max_new_tokens: int
     deadline_abs: Optional[float] = None    # router-clock absolute
     max_queue_time: Optional[float] = None
+    # router-clock request timeline: TTFT for SLO purposes is measured
+    # HERE (first mirrored token minus submit), not on any one engine's
+    # clock — an engine's arrival_time resets on every failover
+    # re-dispatch, which would under-report exactly when failover
+    # added the latency
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
     status: str = RequestStatus.QUEUED
     tokens: List[int] = field(default_factory=list)
     folded: List[int] = field(default_factory=list)
@@ -154,12 +175,16 @@ class ServingRouter:
                  retry_after_per_request: float = 0.05,
                  clock: Optional[Callable[[], float]] = None,
                  sleep: Callable[[float], None] = time.sleep,
+                 slo_monitor=None,
                  seed: int = 0):
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got "
                              f"{num_replicas}")
         self._clock = clock if clock is not None else time.monotonic
         self._sleep = sleep
+        # read-only observability hook (observability.slo.SloMonitor):
+        # fed terminal outcomes + TTFT; never consulted for routing
+        self.slo_monitor = slo_monitor
         self.policy: DispatchPolicy = make_policy(policy,
                                                   page_size=page_size)
         self._retry_cost = float(retry_after_per_request)
@@ -212,8 +237,20 @@ class ServingRouter:
         rec = FleetRequest(
             request_id, toks, int(max_new_tokens),
             deadline_abs=None if deadline is None else now + deadline,
-            max_queue_time=max_queue_time)
-        self._dispatch(rec, forced=False)
+            max_queue_time=max_queue_time, submit_time=now)
+        # one distributed trace per request, keyed by the stable id:
+        # every span/event below that carries this request_id (dispatch
+        # attempts, engine prefill/first-token/terminal, failovers)
+        # joins it, across replicas and restarts
+        tracing.start_trace(request_id, name="router.submit",
+                            request_id=request_id,
+                            prompt_tokens=len(toks),
+                            max_new_tokens=int(max_new_tokens))
+        try:
+            self._dispatch(rec, forced=False)
+        except BaseException:
+            tracing.end_trace(request_id)   # refused: nothing to trace
+            raise
         self.requests[request_id] = rec
         self._live[request_id] = rec
         return request_id
@@ -289,11 +326,23 @@ class ServingRouter:
                             "pdt_router_affinity_hits_total") / lookups)
             tried.add(h.index)
             try:
-                rec.engine_req = h.dispatch(
-                    self._effective_prompt(rec),
-                    self._remaining_budget(rec), rec.request_id,
-                    deadline=self._remaining_deadline(rec),
-                    max_queue_time=rec.max_queue_time)
+                # one span per ATTEMPT: failed candidates stay in the
+                # trace with their error, so a failover's path across
+                # replicas reads straight off the request tree
+                # candidate = how many replicas THIS placement pass has
+                # tried (incl. this one) — truthful per-call ordering;
+                # use `seq` to order across passes
+                with telemetry.span("router.dispatch",
+                                    request_id=rec.request_id,
+                                    replica=h.index,
+                                    policy=self.policy.name,
+                                    forced=forced,
+                                    candidate=len(tried)):
+                    rec.engine_req = h.dispatch(
+                        self._effective_prompt(rec),
+                        self._remaining_budget(rec), rec.request_id,
+                        deadline=self._remaining_deadline(rec),
+                        max_queue_time=rec.max_queue_time)
             except EngineOverloaded:
                 # the engine's OWN admission bound refused (a factory
                 # that set max_waiting): not a health event — try the
@@ -318,6 +367,8 @@ class ServingRouter:
                                 status=rec.status, replica=None,
                                 tokens=len(rec.tokens),
                                 failovers=rec.failovers)
+                self._slo_feed(rec)
+                tracing.end_trace(rec.request_id)
                 return
             except Exception as e:          # router.dispatch fault etc.
                 if h.note_failure(self._clock(), e):
@@ -415,16 +466,22 @@ class ServingRouter:
 
     def _harvest(self, h: ReplicaHandle):
         """Mirror the token streams of this replica's live requests —
-        the 'already streamed to the client' state failover folds in."""
+        the 'already streamed to the client' state failover folds in.
+        The first harvest that sees tokens stamps the request's
+        fleet-level first-token time (router clock)."""
         for rec in self._live.values():
             if rec.replica == h.index and not rec.done \
                     and rec.generation == h.generation \
                     and rec.engine_req is not None:
                 rec.tokens = rec.folded + list(rec.engine_req.output)
+                if rec.tokens and rec.first_token_time is None:
+                    rec.first_token_time = self._clock()
 
     def _finalize(self, rec: FleetRequest, req: Request,
                   finished: List[FleetRequest]):
         rec.tokens = rec.folded + list(req.output)
+        if rec.tokens and rec.first_token_time is None:
+            rec.first_token_time = self._clock()
         rec.status = req.status
         rec.error = req.error
         rec.engine_req = None
@@ -435,6 +492,8 @@ class ServingRouter:
                         status=rec.status, replica=rec.replica,
                         tokens=len(rec.tokens),
                         failovers=rec.failovers)
+        self._slo_feed(rec)
+        tracing.end_trace(rec.request_id)
 
     def _failover_replica(self, h: ReplicaHandle):
         """Re-route everything mirrored onto `h` (which just died)."""
@@ -463,6 +522,8 @@ class ServingRouter:
                             status=rec.status, replica=from_replica,
                             tokens=len(rec.tokens),
                             failovers=rec.failovers)
+            self._slo_feed(rec)
+            tracing.end_trace(rec.request_id)
             return
         if from_replica is not None:
             # an orphan being retried (replica=None) already counted
@@ -480,6 +541,25 @@ class ServingRouter:
             telemetry.event("router.orphaned",
                             request_id=rec.request_id,
                             tokens_folded=len(rec.tokens))
+
+    def _slo_feed(self, rec: FleetRequest):
+        """Read-only SLO hook: one terminal outcome (+ the fleet-level
+        TTFT when a first token was streamed) per request, tagged with
+        the replica that held it last. TTFT is submit-to-first-
+        mirrored-token on the ROUTER clock, so time a request spent on
+        a replica that died before producing anything counts — the
+        client waited through it. Nothing here influences routing."""
+        mon = self.slo_monitor
+        if mon is None:
+            return
+        replica = None if rec.replica is None else str(rec.replica)
+        mon.observe_outcome("outcome",
+                            rec.status == RequestStatus.FINISHED,
+                            replica=replica)
+        if rec.first_token_time is not None:
+            mon.observe("ttft",
+                        rec.first_token_time - rec.submit_time,
+                        replica=replica)
 
     # -- operator surface ------------------------------------------------
     def kill_replica(self, index: int, reason: str = "killed"):
@@ -543,9 +623,13 @@ class ServingRouter:
     def fleet_info(self) -> Dict[str, object]:
         """Operator snapshot: per-replica state/queue/restarts plus
         fleet counters and the prefix-cache aggregate (hits survive
-        replica death — the handles fold in retired engine counters)."""
+        replica death — the handles fold in retired engine counters).
+        With an `slo_monitor` attached, each replica row also carries
+        its worst SLO state over its own traffic, and a fleet-level
+        `slo` section holds every objective's verdict — render with
+        `observability.render_fleet_status`."""
         pending = len(self._live)
-        return {
+        info = {
             "replicas": [
                 {"index": h.index, "state": h.state,
                  "outstanding": h.outstanding(),
@@ -561,3 +645,14 @@ class ServingRouter:
             "prefix_tokens_reused": sum(h.prefix_tokens_reused()
                                         for h in self.replicas),
         }
+        if self.slo_monitor is not None:
+            statuses = self.slo_monitor.evaluate()
+            info["slo"] = {
+                name: {"state": st.state, "value": st.value,
+                       "burn_rate": st.burn_rate,
+                       "samples": st.samples}
+                for name, st in statuses.items()}
+            for row in info["replicas"]:
+                row["slo"] = self.slo_monitor.replica_state(
+                    str(row["index"]))
+        return info

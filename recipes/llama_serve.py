@@ -15,6 +15,10 @@ then drive every decode surface the framework ships —
     over N engines plus a kill-a-replica failover drill — SIGKILL one
     replica mid-decode, prove zero loss (outputs identical to an
     unkilled fleet), and print the `pdt_router_*` Prometheus dump,
+  * the operator surface (docs/observability.md): an `SloMonitor`
+    grades the drill's TTFT/availability objectives (SLO report +
+    fleet status printed), and the failover timeline is written as a
+    Perfetto/Chrome trace (`--trace-out`) for visual inspection,
   * speculative decoding with a draft model (lossless vs greedy),
 
 and print per-path outputs + engine cache/occupancy stats.
@@ -41,6 +45,9 @@ def main(argv=None):
     p.add_argument("--draft-layers", type=int, default=1)
     p.add_argument("--replicas", type=int, default=3,
                    help="fleet size for the router failover drill")
+    p.add_argument("--trace-out", default=None,
+                   help="write the failover drill's Perfetto/Chrome "
+                        "trace here (default: a temp file)")
     args = p.parse_args(argv)
 
     import numpy as np
@@ -168,15 +175,17 @@ def main(argv=None):
     # engines, then the failover drill — SIGKILL a replica mid-decode
     # and prove zero loss against an unkilled fleet's outputs
     from paddle_tpu.serving import ServingRouter
+    from paddle_tpu.observability.slo import (SloMonitor,
+                                              default_serving_objectives)
 
-    def fleet():
+    def fleet(mon=None):
         return ServingRouter(
             lambda i: ContinuousBatchingEngine(
                 model, max_batch_size=2,
                 max_seq_len=min(256, cfg.max_position_embeddings),
                 enable_prefix_caching=True),
             num_replicas=args.replicas, policy="prefix_affinity",
-            page_size=16)
+            page_size=16, slo_monitor=mon)
 
     fleet_jobs = [system + rng.integers(
         1, cfg.vocab_size, int(rng.integers(4, 10))).tolist()
@@ -185,7 +194,15 @@ def main(argv=None):
     ref_ids = [ref_router.submit(pr, n) for pr in fleet_jobs]
     want_out = ref_router.run()                  # the unkilled oracle
 
-    router = fleet()
+    # the killed fleet runs with the operator surface attached: an SLO
+    # monitor grading the drill (generous bounds — tiny-model CPU
+    # prefills span compiles) and a cleared trace ring so the exported
+    # Perfetto timeline shows exactly the failover drill
+    telemetry.clear_events()
+    slo_mon = SloMonitor(default_serving_objectives(
+        ttft_p95=120.0, tpot_p95=30.0, max_error_rate=0.01,
+        min_availability=0.99, window_s=3600.0))
+    router = fleet(mon=slo_mon)
     ids_f = [router.submit(pr, n) for pr in fleet_jobs]
     router.step()
     router.step()                                # mid-decode everywhere
@@ -207,6 +224,24 @@ def main(argv=None):
     print("\n".join(line for line in telemetry.to_prometheus()
                     .splitlines() if "pdt_router" in line))
     print("--- end router telemetry ---")
+
+    # 3d) operator surface: SLO verdicts, the fleet status report, and
+    # the drill's failover timeline as a Perfetto/Chrome trace
+    slo_report = slo_mon.evaluate()
+    assert all(st.ok for st in slo_report.values()), slo_report
+    print(slo_mon.report())
+    print(telemetry.render_fleet_status(info))
+    killed_rid = ids_f[0]
+    tree = telemetry.request_tree(killed_rid)
+    assert tree is not None and tree["children"], \
+        "killed request left no span tree"
+    import tempfile
+    trace_out = args.trace_out or os.path.join(
+        tempfile.gettempdir(), "llama_serve_failover_trace.json")
+    telemetry.export_chrome_trace(path=trace_out)
+    print(f"failover drill trace -> {trace_out} "
+          "(load in chrome://tracing or https://ui.perfetto.dev; "
+          "pid=replica, tid=request)")
 
     # 4) speculative decoding (draft = shallow copy of the config)
     d_cfg = LlamaConfig(

@@ -62,7 +62,12 @@ def pytest_configure(config):
 # report carries a telemetry snapshot for post-mortem debugging
 _TELEMETRY_FILES = ("test_serving.py", "test_chaos.py",
                     "test_telemetry.py", "test_elastic_robustness.py",
-                    "test_router.py")
+                    "test_router.py", "test_observability_slo.py")
+
+# failing fleet-drill tests additionally attach a Chrome-trace export
+# of the telemetry ring: the failover timeline that produced the
+# failure is then directly loadable in chrome://tracing / Perfetto
+_CHROME_TRACE_FILES = ("test_chaos.py", "test_router.py")
 
 
 @pytest.fixture(autouse=True)
@@ -79,17 +84,29 @@ def _telemetry_enabled(request, monkeypatch):
 def pytest_runtest_makereport(item, call):
     outcome = yield
     rep = outcome.get_result()
-    if rep.when == "call" and rep.failed and os.path.basename(
-            str(item.fspath)) in _TELEMETRY_FILES:
-        try:
-            import json
-            import paddle_tpu.observability as telemetry
-            rep.sections.append(
-                ("telemetry snapshot",
-                 json.dumps(telemetry.snapshot(), indent=1,
-                            sort_keys=True, default=str)))
-        except Exception:
-            pass        # a broken dump must never mask the real failure
+    if rep.when == "call" and rep.failed:
+        base = os.path.basename(str(item.fspath))
+        if base in _TELEMETRY_FILES:
+            try:
+                import json
+                import paddle_tpu.observability as telemetry
+                rep.sections.append(
+                    ("telemetry snapshot",
+                     json.dumps(telemetry.snapshot(), indent=1,
+                                sort_keys=True, default=str)))
+            except Exception:
+                pass    # a broken dump must never mask the real failure
+        if base in _CHROME_TRACE_FILES:
+            try:
+                import json
+                import paddle_tpu.observability as telemetry
+                rep.sections.append(
+                    ("chrome trace (save as .json, load in "
+                     "chrome://tracing or ui.perfetto.dev)",
+                     json.dumps(telemetry.export_chrome_trace(),
+                                default=str)))
+            except Exception:
+                pass
 
 
 @pytest.fixture(autouse=True)

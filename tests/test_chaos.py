@@ -11,6 +11,7 @@ output, all four terminal fates reconcile exactly across the
 restarts with backoff and resumes taking traffic). conftest enables
 PDT_CHECK_INVARIANTS=1 for this file, so page accounting is re-proved
 after every engine step of every test."""
+import json
 import random
 
 import numpy as np
@@ -690,3 +691,180 @@ class TestRouterFleetChaos:
         clock.advance(2.5)
         router.step()
         assert router.replicas[0].state == ReplicaState.HEALTHY
+
+
+class TestObservabilityChaos:
+    """ISSUE-5 acceptance drills: one request traced end to end through
+    a 4-replica kill drill must yield a single CONNECTED span tree
+    whose Chrome export validates against the trace-event schema, and
+    an attached SloMonitor must flag a deliberately induced TTFT breach
+    while grading the unfaulted run pass."""
+
+    def _fleet(self, model, n=4, clock=None, engine_kw=None, **kw):
+        clock = clock if clock is not None else FakeClock()
+        ekw = dict(max_batch_size=2, max_seq_len=64, page_size=4)
+        ekw.update(engine_kw or {})
+        kw.setdefault("page_size", 4)
+        kw.setdefault("sleep", clock.advance)
+        router = ServingRouter(
+            lambda i: ContinuousBatchingEngine(model, clock=clock, **ekw),
+            num_replicas=n, policy="round_robin", clock=clock, **kw)
+        return router, clock
+
+    JOBS = [([5, 4, 3, 2, 6, 7], 8), ([9, 1, 2], 6),
+            ([7, 7, 1, 2], 5), ([1, 2, 3, 4], 6)]
+
+    def test_kill_drill_yields_one_connected_span_tree(self, model):
+        from paddle_tpu.observability import trace as trace_mod
+        router, clock = self._fleet(model, n=4, restart_backoff_base=9.0,
+                                    restart_backoff_max=9.0)
+        rids = [router.submit(p, m) for p, m in self.JOBS]
+        router.step()
+        router.step()                           # mid-decode everywhere
+        x = rids[0]
+        victim = router.requests[x].replica
+        assert not router.requests[x].done
+        router.kill_replica(victim)             # SIGKILL: x stranded
+        router.run()                            # survivors finish all
+        assert router.requests[x].failovers == 1
+        assert router.requests[x].status == RequestStatus.FINISHED
+
+        # ONE tree: router.submit root -> dispatch on the victim ->
+        # prefill -> decode steps -> failover -> re-dispatch on a
+        # survivor -> re-prefill -> terminal
+        evts = telemetry.events()
+        tree = trace_mod.request_tree(x, evts)
+        assert tree is not None
+        assert tree["event"]["name"] == "router.submit"
+
+        def flatten(node):
+            out = [node["event"]]
+            for c in node["children"]:
+                out += flatten(c)
+            return out
+
+        flat = flatten(tree)
+        names = [e["name"] for e in flat]
+        assert names.count("router.dispatch") == 2     # orig + failover
+        assert names.count("serving.prefill") == 2     # prefill twice
+        assert "router.failover" in names
+        assert "router.terminal" in names
+        assert "serving.first_token" in names
+        assert "serving.decode_step" in names          # batched fan-in
+        # CONNECTED: the tree contains every ring event of this trace
+        # plus every batched decode step that served the request
+        tid = tree["event"]["trace"]
+        in_trace = [e for e in evts if e.get("trace") == tid]
+        fanin = [e for e in evts if e.get("trace") != tid
+                 and x in (e["attrs"].get("rids") or ())]
+        assert len(flat) == len(in_trace) + len(fanin)
+        assert {e["seq"] for e in flat} \
+            == {e["seq"] for e in in_trace + fanin}
+        # the failover is visible as two distinct dispatch replicas
+        dispatch_replicas = [e["attrs"]["replica"] for e in flat
+                             if e["name"] == "router.dispatch"]
+        assert dispatch_replicas[0] == victim
+        assert dispatch_replicas[1] != victim
+        # timestamps all on one clock base: parents start no later
+        # than their children (duration reconstruction holds)
+        by_seq = {e["seq"]: e for e in flat}
+        for e in flat:
+            p = by_seq.get(e.get("parent"))
+            if p is not None:
+                assert p["ts_mono"] <= e["ts_mono"] + 1e-9
+
+        # the Chrome export validates against the trace-event schema
+        doc = telemetry.export_chrome_trace(evts)
+        assert set(doc) == {"traceEvents", "displayTimeUnit"}
+        for e in doc["traceEvents"]:
+            assert isinstance(e["name"], str)
+            assert isinstance(e["pid"], int)
+            assert isinstance(e["tid"], int)
+            assert e["ph"] in ("X", "i", "M"), e
+            if e["ph"] == "X":
+                assert e["dur"] >= 0.0 and e["ts"] >= 0.0
+            elif e["ph"] == "i":
+                assert e["s"] in ("t", "p", "g") and e["ts"] >= 0.0
+        procs = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        assert f"replica {victim}" in procs        # pid = replica
+        assert len(procs) >= 3                     # victim + survivor(s)
+        threads = {e["args"]["name"] for e in doc["traceEvents"]
+                   if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert set(rids) <= threads                # tid = request
+        json.dumps(doc)                            # serializable
+
+    def test_slo_monitor_flags_deliberate_ttft_breach(self, model):
+        from paddle_tpu.observability.slo import SloMonitor, SloObjective
+
+        def objectives():
+            return [SloObjective("ttft_p95", "ttft", "latency", 0.5,
+                                 quantile=0.95, window_s=1e6),
+                    SloObjective("availability", "outcome",
+                                 "availability", 0.99, window_s=1e6)]
+
+        # unfaulted run: on the fake clock TTFT is 0.0s -> pass
+        clock = FakeClock()
+        mon = SloMonitor(objectives(), clock=clock, warn_burn=0.5)
+        router, clock = self._fleet(model, n=2, clock=clock,
+                                    slo_monitor=mon)
+        for p, m in self.JOBS:
+            router.submit(p, m)
+        router.run()
+        rep = mon.evaluate()
+        assert rep["ttft_p95"].state == "pass"
+        assert rep["availability"].state == "pass"
+        assert rep["ttft_p95"].samples == len(self.JOBS)
+        info = router.fleet_info()
+        assert info["slo"]["ttft_p95"]["state"] == "pass"
+        # per-replica SLO rides fleet_info next to health
+        graded = [r["slo"] for r in info["replicas"]
+                  if r["slo"] is not None]
+        assert graded and all(s == "pass" for s in graded)
+        assert telemetry.value("pdt_slo_state",
+                               objective="ttft_p95") == 0
+
+        # deliberate breach: the fleet sits on its queue for 1.2s of
+        # fake time before the first step, so every first token lands
+        # 1.2s after arrival — p95 TTFT 1.2s >> the 0.5s objective
+        clock2 = FakeClock()
+        mon2 = SloMonitor(objectives(), clock=clock2, warn_burn=0.5)
+        router2, clock2 = self._fleet(model, n=2, clock=clock2,
+                                      slo_monitor=mon2)
+        for p, m in self.JOBS:
+            router2.submit(p, m)
+        clock2.advance(1.2)
+        router2.run()
+        st = mon2.evaluate()["ttft_p95"]
+        assert st.state == "breach"
+        assert st.value == pytest.approx(1.2)
+        assert st.burn_rate > 1.0
+        assert mon2.evaluate()["availability"].state == "pass"
+        assert telemetry.value("pdt_slo_state",
+                               objective="ttft_p95") == 2
+        info2 = router2.fleet_info()
+        assert info2["slo"]["ttft_p95"]["state"] == "breach"
+        assert "breach" in {r["slo"] for r in info2["replicas"]}
+
+    def test_slo_ttft_spans_failover_on_router_clock(self, model):
+        """Time a request spends on a replica that dies before
+        producing a token is time the CLIENT waited: the monitor's
+        TTFT sample must span submit -> first mirrored token on the
+        router clock, not restart from the failover re-dispatch (the
+        survivor engine's arrival_time resets there)."""
+        from paddle_tpu.observability.slo import SloMonitor, SloObjective
+        clock = FakeClock()
+        mon = SloMonitor([SloObjective("ttft_p95", "ttft", "latency",
+                                       0.5, quantile=0.95,
+                                       window_s=1e6)], clock=clock)
+        router, clock = self._fleet(model, n=2, clock=clock,
+                                    slo_monitor=mon)
+        rid = router.submit([5, 4, 3], 4)
+        router.kill_replica(router.requests[rid].replica)
+        clock.advance(2.0)              # dead time the client sat out
+        router.run()
+        assert router.requests[rid].status == RequestStatus.FINISHED
+        st = mon.evaluate()["ttft_p95"]
+        assert st.samples == 1
+        assert st.value == pytest.approx(2.0)   # not 0.0-from-survivor
+        assert st.state == "breach"
