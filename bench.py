@@ -55,6 +55,53 @@ PROBE_TIMEOUT_S = _env_int("BENCH_TPU_PROBE_TIMEOUT", "240")
 PROBE_ATTEMPTS = _env_int("BENCH_TPU_PROBE_ATTEMPTS", "5")
 PROBE_BUDGET_S = _env_int("BENCH_TPU_PROBE_BUDGET", "2400")
 SKIP_TPU = os.environ.get("PDT_BENCH_SKIP_TPU", "") not in ("", "0")
+# ISSUE 6 satellite (BENCH_r01-r05 each burned up to 5x240 s on doomed
+# probes before the CPU fallback): the verdict is CACHED in a TTL'd
+# file, and after a cached FAILURE the retry ladder drops to
+# PROBE_ATTEMPTS_RETRY attempts — a flaky tunnel gets re-checked
+# cheaply, not re-besieged.
+PROBE_CACHE_PATH = os.environ.get("PDT_BENCH_PROBE_CACHE",
+                                  "/tmp/pdt_tpu_probe.json")
+PROBE_CACHE_TTL_S = _env_int("BENCH_PROBE_TTL", "3600")
+PROBE_ATTEMPTS_RETRY = _env_int("BENCH_PROBE_ATTEMPTS_RETRY", "1")
+
+# which serving attention path the engine benches run (ISSUE 6):
+# default ragged; set PDT_BENCH_ATTENTION_IMPL=legacy to A/B
+ATTENTION_IMPL = os.environ.get("PDT_BENCH_ATTENTION_IMPL", "ragged")
+
+# what the last probe_tpu() call cost and decided — attached to the
+# bench JSON (detail.tpu_probe) so the BENCH_r*.json trajectory shows
+# what probing cost each round
+PROBE_INFO = {}
+
+
+def _probe_cache_read():
+    """The cached probe verdict, or None when absent/corrupt/expired
+    (an expired entry is still returned with "expired": True so a
+    re-probe after a failure can shrink its attempt ladder)."""
+    try:
+        with open(PROBE_CACHE_PATH) as f:
+            entry = json.load(f)
+        verdict = bool(entry["verdict"])
+        age = time.time() - float(entry["ts"])
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+    if age < 0:                            # clock went backwards
+        return None
+    return {"verdict": verdict, "age_s": age,
+            "expired": age >= PROBE_CACHE_TTL_S}
+
+
+def _probe_cache_write(verdict: bool, wall_s: float, attempts: int):
+    tmp = PROBE_CACHE_PATH + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"verdict": verdict, "ts": time.time(),
+                       "wall_s": round(wall_s, 3),
+                       "attempts": attempts}, f)
+        os.replace(tmp, PROBE_CACHE_PATH)
+    except OSError:
+        pass                               # cache is best-effort
 
 
 def probe_tpu() -> bool:
@@ -69,12 +116,41 @@ def probe_tpu() -> bool:
     can raise them (PDT_BENCH_TPU_PROBE_ATTEMPTS / _TIMEOUT / _BUDGET;
     unprefixed names accepted as fallback), and PDT_BENCH_SKIP_TPU=1
     bypasses the probe entirely.
-    """
+
+    The verdict is cached in PROBE_CACHE_PATH (PDT_BENCH_PROBE_CACHE)
+    for PROBE_CACHE_TTL_S seconds. A fresh FAILURE short-circuits the
+    probe outright — back-to-back bench/bench_decode runs stop paying
+    5x240 s each for the same dead tunnel — and a stale failure caps
+    the retry ladder at PROBE_ATTEMPTS_RETRY. A cached SUCCESS is
+    never trusted blindly: the tunnel is known to die between runs,
+    and skipping the probe would hand the round-1 wedge straight to
+    the parent's own backend init — instead it shrinks the ladder to
+    one cheap confirming attempt. PROBE_INFO records verdict, wall
+    time, attempts, and cache hits for the bench JSON."""
+    global PROBE_INFO
+    cached = _probe_cache_read()
+    if cached is not None and not cached["expired"] \
+            and not cached["verdict"]:
+        PROBE_INFO = {"verdict": False, "wall_s": 0.0,
+                      "attempts": 0, "cached": True,
+                      "cache_age_s": round(cached["age_s"], 1)}
+        sys.stderr.write(
+            f"bench: TPU probe verdict False from cache "
+            f"({PROBE_CACHE_PATH}, age {cached['age_s']:.0f}s)\n")
+        return False
+    attempts_cap = PROBE_ATTEMPTS
+    if cached is not None:
+        # cached success (fresh or stale) -> one confirming attempt;
+        # expired failure -> re-check the tunnel, but cheaply
+        attempts_cap = max(1, min(PROBE_ATTEMPTS, PROBE_ATTEMPTS_RETRY))
     code = ("import jax; d = jax.devices(); "
             "assert d and d[0].platform != 'cpu', d; print('ok')")
-    deadline = time.monotonic() + PROBE_BUDGET_S
+    t_start = time.monotonic()
+    deadline = t_start + PROBE_BUDGET_S
     backoff = 5.0
-    for attempt in range(PROBE_ATTEMPTS):
+    verdict = False
+    attempts = 0                      # COMPLETED probe subprocesses
+    for attempt in range(1, attempts_cap + 1):
         remaining = deadline - time.monotonic()
         if remaining <= 5:
             sys.stderr.write("bench: TPU probe budget exhausted\n")
@@ -83,18 +159,25 @@ def probe_tpu() -> bool:
             r = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True,
                 timeout=min(PROBE_TIMEOUT_S, remaining), text=True)
+            attempts += 1
             if r.returncode == 0 and "ok" in r.stdout:
-                return True
+                verdict = True
+                break
             sys.stderr.write(
-                f"bench: TPU probe attempt {attempt + 1} failed "
+                f"bench: TPU probe attempt {attempt} failed "
                 f"(rc={r.returncode}): {r.stderr.strip()[-500:]}\n")
         except subprocess.TimeoutExpired:
+            attempts += 1
             sys.stderr.write(
-                f"bench: TPU probe attempt {attempt + 1} timed out\n")
-        if attempt + 1 < PROBE_ATTEMPTS:
+                f"bench: TPU probe attempt {attempt} timed out\n")
+        if attempt < attempts_cap:
             time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
             backoff = min(backoff * 2, 120.0)
-    return False
+    wall = time.monotonic() - t_start
+    PROBE_INFO = {"verdict": verdict, "wall_s": round(wall, 3),
+                  "attempts": attempts, "cached": False}
+    _probe_cache_write(verdict, wall, attempts)
+    return verdict
 
 
 def emit(payload: dict) -> None:
@@ -137,6 +220,8 @@ REGRESSION_METRICS = (
     "detail.decode_tokens_per_sec",
     "detail.router.replicas_1_affinity.tokens_per_sec",
     "detail.router.replicas_4_affinity.tokens_per_sec",
+    "detail.paged_attention.decode_tokens_per_sec_ragged",
+    "detail.paged_attention.mixed_tokens_per_sec_ragged",
 )
 
 
@@ -184,7 +269,8 @@ def bench_decode(model, cfg, on_tpu: bool) -> dict:
     else:
         slots, p_len, warm, steps, max_seq = 2, 8, 2, 4, 64
     eng = ContinuousBatchingEngine(model, max_batch_size=slots,
-                                   max_seq_len=max_seq)
+                                   max_seq_len=max_seq,
+                                   attention_impl=ATTENTION_IMPL)
     rng = np.random.default_rng(0)
     # engine telemetry rides the same JSON (ISSUE 2): BENCH_r*.json
     # trajectories carry serving signals, not just matmul timings
@@ -222,6 +308,7 @@ def bench_decode(model, cfg, on_tpu: bool) -> dict:
         "decode_tokens_per_sec": round(slots * steps / dt, 1),
         "decode_batch_slots": slots,
         "decode_step_ms": round(dt / steps * 1e3, 3),
+        "attention_impl": eng.attn_impl,
         "engine_telemetry": {
             "ttft_cold_avg_s": round(ttft["sum"] / ttft["count"], 4)
             if ttft.get("count") else None,
@@ -287,7 +374,8 @@ def bench_router(model, cfg, on_tpu: bool) -> dict:
                 lambda i: ContinuousBatchingEngine(
                     model, max_batch_size=slots, page_size=page,
                     max_seq_len=sys_pages * page + 64,
-                    enable_prefix_caching=True),
+                    enable_prefix_caching=True,
+                    attention_impl=ATTENTION_IMPL),
                 num_replicas=n, policy=policy, page_size=page)
             for p in prompts:
                 router.submit(p, max_new_tokens=new_toks)
@@ -330,6 +418,125 @@ def bench_router(model, cfg, on_tpu: bool) -> dict:
         }}
     finally:
         model.train()
+
+
+def bench_paged_attention(on_tpu: bool) -> dict:
+    """Paged-attention microbench (ISSUE 6): the legacy q=1 kernel vs
+    the ragged kernel vs the unbounded XLA gather path, at a decode
+    shape and a mixed prefill+decode shape. On TPU the first two run
+    the Pallas kernels; on the CPU fallback they run their XLA oracles
+    (the ragged one gather-BOUNDED to the referenced block-table
+    prefix), so the CPU numbers measure the trim + one-dispatch
+    packing win and the TPU numbers the kernel itself. Returns a
+    detail sub-dict gated by --check-regression."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.ops.paged_attention import paged_attention_values
+    from paddle_tpu.ops import ragged_paged_attention as rpa
+
+    if on_tpu:
+        hk, g, d, ps = 8, 2, 64, 16
+        s_max, decode_b, decode_ctx = 2048, 32, 1024
+        prefill_len, n_prefill, n_decode = 512, 4, 28
+        reps = 10
+    else:
+        hk, g, d, ps = 2, 2, 32, 16
+        s_max, decode_b, decode_ctx = 256, 4, 64
+        prefill_len, n_prefill, n_decode = 32, 2, 4
+        reps = 3
+    h = hk * g
+    pps = s_max // ps
+    rng = np.random.default_rng(0)
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+
+    def _pool(n_seqs):
+        num_pages = n_seqs * pps + 1
+        kp = jnp.asarray(rng.standard_normal(
+            (hk, num_pages, ps, d)).astype(np.float32), dt)
+        vp = jnp.asarray(rng.standard_normal(
+            (hk, num_pages, ps, d)).astype(np.float32), dt)
+        bt = (np.arange(n_seqs * pps, dtype=np.int32)
+              .reshape(n_seqs, pps) + 1)
+        return kp, vp, bt
+
+    def _time(f, *a):
+        np.asarray(jax.device_get(f(*a)))          # compile + warm
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(jax.device_get(f(*a)))      # D2H sync discipline
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def _gather_full(q, kp, vp, qs, ql, cl, bt):
+        """The pre-trim baseline: gather the FULL block table, then the
+        shared masked core — what `_paged_xla` cost before ISSUE 6."""
+        t = q.shape[0]
+        kc, vc = rpa.gather_pages(kp, vp, jnp.asarray(bt),
+                                  pages_bound=bt.shape[1])
+        seq_t, pos_t = rpa.token_arrays(qs, ql, cl, t)
+        tok_seq = np.maximum(seq_t, 0)
+        ctx_t = np.where(seq_t >= 0, cl[tok_seq], 0)
+        qh = q.reshape(t, hk, g, d)
+        out = rpa.masked_page_attention(
+            qh, kc[tok_seq], vc[tok_seq],
+            jnp.asarray(np.where(seq_t >= 0, pos_t, -1)),
+            jnp.asarray(ctx_t), 1.0 / (d ** 0.5))
+        return out.reshape(t, h, d)
+
+    out = {}
+    # -- decode shape: B sequences x 1 query token ---------------------
+    kp, vp, bt = _pool(decode_b)
+    ctx = rng.integers(decode_ctx // 2, decode_ctx,
+                       decode_b).astype(np.int32)
+    q1 = jnp.asarray(rng.standard_normal(
+        (decode_b, h, d)).astype(np.float32), dt)
+    qs1 = np.arange(decode_b, dtype=np.int32)
+    ql1 = np.ones(decode_b, np.int32)
+    t_legacy = _time(jax.jit(lambda q, k, v: paged_attention_values(
+        q, k, v, jnp.asarray(ctx), jnp.asarray(bt))), q1, kp, vp)
+    t_ragged = _time(jax.jit(lambda q, k, v:
+                             rpa.ragged_paged_attention_values(
+                                 q, k, v, qs1, ql1, ctx, bt,
+                                 block_q=1)), q1, kp, vp)
+    t_gather = _time(jax.jit(lambda q, k, v: _gather_full(
+        q, k, v, qs1, ql1, ctx, bt)), q1, kp, vp)
+    out["decode"] = {
+        "batch": decode_b, "ctx": int(decode_ctx), "pages_per_seq": pps,
+        "legacy_kernel_ms": round(t_legacy * 1e3, 3),
+        "ragged_ms": round(t_ragged * 1e3, 3),
+        "xla_gather_ms": round(t_gather * 1e3, 3),
+        "ragged_vs_gather_speedup": round(t_gather / t_ragged, 3),
+    }
+    out["decode_tokens_per_sec_ragged"] = round(decode_b / t_ragged, 1)
+    # -- mixed prefill+decode shape: the ragged kernel's reason to
+    # exist; the legacy kernel cannot express it -----------------------
+    n_seqs = n_prefill + n_decode
+    kp, vp, bt = _pool(n_seqs)
+    ql = np.array([prefill_len] * n_prefill + [1] * n_decode, np.int32)
+    cl = np.array([prefill_len] * n_prefill
+                  + list(rng.integers(decode_ctx // 2, decode_ctx,
+                                      n_decode)), np.int32)
+    qs, total = rpa.pack_ragged_starts(ql, block_q=8)
+    qm = jnp.asarray(rng.standard_normal(
+        (total, h, d)).astype(np.float32), dt)
+    tokens = int(ql.sum())
+    t_ragged = _time(jax.jit(lambda q, k, v:
+                             rpa.ragged_paged_attention_values(
+                                 q, k, v, qs, ql, cl, bt,
+                                 block_q=8)), qm, kp, vp)
+    t_gather = _time(jax.jit(lambda q, k, v: _gather_full(
+        q, k, v, qs, ql, cl, bt)), qm, kp, vp)
+    out["mixed"] = {
+        "prefills": n_prefill, "prefill_len": prefill_len,
+        "decodes": n_decode, "query_tokens": tokens,
+        "ragged_ms": round(t_ragged * 1e3, 3),
+        "xla_gather_ms": round(t_gather * 1e3, 3),
+        "ragged_vs_gather_speedup": round(t_gather / t_ragged, 3),
+    }
+    out["mixed_tokens_per_sec_ragged"] = round(tokens / t_ragged, 1)
+    return {"paged_attention": out}
 
 
 def bench_int8(on_tpu: bool) -> dict:
@@ -476,6 +683,11 @@ def run_bench(on_tpu: bool) -> dict:
     except Exception:
         detail["router_error"] = traceback.format_exc(limit=3)[-400:]
     try:
+        detail.update(bench_paged_attention(on_tpu))
+    except Exception:
+        detail["paged_attention_error"] = \
+            traceback.format_exc(limit=3)[-400:]
+    try:
         detail.update(bench_int8(on_tpu))
     except Exception:
         detail["int8_error"] = traceback.format_exc(limit=3)[-400:]
@@ -564,6 +776,10 @@ def main(argv=None):
     def _alarm(signum, frame):
         raise TimeoutError("bench watchdog expired (backend hang?)")
 
+    # probe cost + verdict ride the JSON so the BENCH_r*.json trajectory
+    # shows what probing cost this round (ISSUE 6 satellite)
+    probe_detail = dict(PROBE_INFO) if PROBE_INFO else {"skipped": True}
+
     signal.signal(signal.SIGALRM, _alarm)
     signal.alarm(int(os.environ.get("BENCH_WATCHDOG_S", "1500")))
     try:
@@ -572,6 +788,7 @@ def main(argv=None):
         result = {
             "metric": metric, "value": 0.0,
             "unit": "fraction_of_peak", "vs_baseline": 0.0,
+            "detail": {"tpu_probe": probe_detail},
             "error": ((error + "; ") if error else "")
             + traceback.format_exc(limit=5)[-1500:],
         }
@@ -581,6 +798,7 @@ def main(argv=None):
                 if args.check_regression else 0)
     finally:
         signal.alarm(0)
+    result.setdefault("detail", {})["tpu_probe"] = probe_detail
     if error:
         result["error"] = error
     emit(result)

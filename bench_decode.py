@@ -63,10 +63,14 @@ def run(on_tpu: bool) -> dict:
     # warmup run compiles every program BEFORE the timed pass.
     from paddle_tpu.models.serving import ContinuousBatchingEngine
     rng = np.random.default_rng(1)
+    # which attention path the engine runs (ISSUE 6): default ragged,
+    # env-switchable so the legacy path stays one knob away in benches
+    attention_impl = os.environ.get("PDT_BENCH_ATTENTION_IMPL", "ragged")
     eng = ContinuousBatchingEngine(
         model, max_batch_size=batch,
         max_seq_len=min(cfg.max_position_embeddings, prompt + new),
-        prompt_pad=max(prompt // 2, 8))
+        prompt_pad=max(prompt // 2, 8),
+        attention_impl=attention_impl)
     n_req = batch * 2
 
     def submit():
@@ -99,6 +103,7 @@ def run(on_tpu: bool) -> dict:
             "ms_per_token_step": round(dt / new * 1000, 3),
             "continuous_batching_tokens_per_sec": round(cb_tps, 1),
             "continuous_batching_requests": n_req,
+            "attention_impl": eng.attn_impl,
         },
     }
 

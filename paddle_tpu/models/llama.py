@@ -197,6 +197,42 @@ class PagedKVCacheView:
         self.block_tables = jnp.asarray(bt, jnp.int32)
 
 
+class RaggedKVCacheView:
+    """`past_key_value` for the RAGGED serving path (≙ the ragged
+    paged-attention design, PAPERS.md arxiv 2604.15464): per-layer page
+    pools (HK, P, page_size, D), the shared per-sequence block table
+    (N, pps), and the descriptors of ONE packed mixed batch — decode
+    steps, full prefills, chunk continuations, and prefix-cache suffix
+    prefills all ride the same (1, T) token axis. `token_seq`/
+    `positions` are per packed token (T,) — -1 marks padding rows,
+    which scatter to the trash page; `query_start`/`query_len`/
+    `context_lens` are per sequence (N,); `block_q` is the static
+    q-block size the packer aligned `query_start` to (decode batches
+    pass 1); `pages_bound` is the static gather trim the XLA fallback
+    applies (None = full table)."""
+
+    def __init__(self, k_pages, v_pages, block_tables, token_seq,
+                 positions, query_start, query_len, context_lens,
+                 block_q=1, pages_bound=None):
+        self.k_pages = k_pages if isinstance(k_pages, Tensor) \
+            else Tensor(k_pages)
+        self.v_pages = v_pages if isinstance(v_pages, Tensor) \
+            else Tensor(v_pages)
+
+        def _i32(x):
+            return jnp.asarray(x._value if isinstance(x, Tensor) else x,
+                               jnp.int32)
+        self.block_tables = _i32(block_tables)
+        self.token_seq = _i32(token_seq)
+        self.positions = _i32(positions)
+        self.query_start = _i32(query_start)
+        self.query_len = _i32(query_len)
+        self.context_lens = _i32(context_lens)
+        self.block_q = int(block_q)
+        self.pages_bound = None if pages_bound is None \
+            else int(pages_bound)
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
@@ -224,6 +260,12 @@ class LlamaAttention(nn.Layer):
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
         v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        if isinstance(past_key_value, RaggedKVCacheView):
+            # rope happens inside (per-token angles from the view):
+            # the generic apply_rope offset conventions cannot express
+            # a packed ragged batch
+            return self._forward_ragged(q, k, v, cos, sin,
+                                        past_key_value, use_cache, b, s)
         q = apply_rope(q, cos, sin, position_offset)
         k = apply_rope(k, cos, sin, position_offset)
         if isinstance(past_key_value, PagedKVCacheView):
@@ -376,6 +418,55 @@ class LlamaAttention(nn.Layer):
                                              attn_mask=attention_mask,
                                              is_causal=True)
         return self.o_proj(out.reshape([b, s, -1]))
+
+    def _forward_ragged(self, q, k, v, cos, sin, view, use_cache, b, s):
+        """One packed mixed batch (decode + prefills) through the page
+        table: per-token rope, ONE scatter of every new KV row into the
+        pages (padding rows trash-route), then ragged paged attention
+        with per-sequence (query_start, query_len, context_len)
+        descriptors. q/k/v arrive pre-rope as (1, T, heads, D)."""
+        from paddle_tpu.core.tensor import apply as _apply
+        from paddle_tpu.ops.rope import rope_rotate_values
+        from paddle_tpu.ops.ragged_paged_attention import (
+            ragged_paged_attention_values, ragged_scatter_values)
+        if b != 1:
+            raise ValueError(
+                "ragged KV cache wants a packed (1, T, ...) batch")
+        pos = view.positions
+        seq = view.token_seq
+        bt = view.block_tables
+
+        def fn_rope(x, c, s_):
+            cv = c[pos].astype(jnp.float32)[None, :, None, :]
+            sv = s_[pos].astype(jnp.float32)[None, :, None, :]
+            return rope_rotate_values(x, cv, sv)
+        q = _apply("rope_ragged", fn_rope, (q, cos, sin))
+        k = _apply("rope_ragged", fn_rope, (k, cos, sin))
+
+        def fn_scatter(kp, vp, kk, vv):
+            return ragged_scatter_values(kp, vp, kk[0], vv[0], bt, seq,
+                                         pos)
+        kp_new, vp_new = _apply(
+            "ragged_kv_scatter", fn_scatter,
+            (view.k_pages, view.v_pages, k, v), multi_output=True)
+
+        win = self.sliding_window
+
+        def fn_attn(qq, kp, vp):
+            return ragged_paged_attention_values(
+                qq[0], kp, vp, view.query_start, view.query_len,
+                view.context_lens, bt, window=win,
+                block_q=view.block_q,
+                pages_bound=view.pages_bound)[None]
+        out = _apply("ragged_paged_attention", fn_attn,
+                     (q, kp_new, vp_new))
+        out = self.o_proj(out.reshape([1, s, -1]))
+        if use_cache:
+            return out, RaggedKVCacheView(
+                kp_new, vp_new, bt, seq, pos, view.query_start,
+                view.query_len, view.context_lens, view.block_q,
+                view.pages_bound)
+        return out
 
 
 class LlamaMLP(nn.Layer):

@@ -38,6 +38,19 @@ rows) — TPU-native:
   so resident KV is bounded by the window, not the sequence.
 * `kv_layout="dense"` keeps the previous per-slot contiguous caches
   (also the parity oracle for the paged path).
+* `attention_impl="ragged"` (the default on the paged layout) batches
+  EVERY admission through one ragged paged-attention dispatch
+  (`ops/ragged_paged_attention.py`): the admitted prompts — full
+  prefills, prefix-cache suffix prefills, and chunk continuations —
+  are PACKED along one token axis with per-sequence (query_start,
+  query_len, context_len) descriptors, so admitting N ragged prompts
+  costs ONE dispatch instead of N, and the only program key is the
+  padded token count (no per-bucket prefill LRU, no per-(shared_len,
+  bucket) suffix programs, no separate chunk program). Decode rides
+  the same builder at block_q=1. `attention_impl="legacy"` keeps the
+  per-bucket jnp-attention prefill paths and the q=1 decode kernel —
+  greedy outputs are bit-identical between the two, which makes the
+  chaos drills the regression harness for the kernel.
 * REQUEST LIFECYCLE HARDENING (≙ production TPU serving stacks, which
   treat KV-pool exhaustion and preemption as first-class events): a
   monotonic-clock tick per step expires requests past their deadline /
@@ -176,6 +189,7 @@ class ContinuousBatchingEngine:
                  eos_token_id: Optional[int] = None,
                  prompt_pad: int = 16,
                  kv_layout: str = "paged",
+                 attention_impl: str = "ragged",
                  page_size: int = 16,
                  num_pages: Optional[int] = None,
                  do_sample: bool = False,
@@ -209,6 +223,13 @@ class ContinuousBatchingEngine:
                 f"{cfg.max_position_embeddings})")
         if kv_layout not in ("paged", "dense"):
             raise ValueError(f"kv_layout {kv_layout!r}: paged|dense")
+        if attention_impl not in ("ragged", "legacy"):
+            raise ValueError(
+                f"attention_impl {attention_impl!r}: ragged|legacy")
+        # ragged attention walks the page table; the dense layout has
+        # no pages, so it always serves through the legacy paths
+        self.attn_impl = attention_impl if kv_layout == "paged" \
+            else "legacy"
         self._window = getattr(cfg, "sliding_window", None)
         if kv_layout == "paged" and self._window is not None \
                 and enable_prefix_caching:
@@ -344,6 +365,11 @@ class ContinuousBatchingEngine:
         self._decode_jit = None
         self._insert_jit = None
         self._prefill_jits: "OrderedDict[int, object]" = OrderedDict()
+        # ragged path: ONE program family keyed only on the padded
+        # token count of the admission batch (the decode program lives
+        # in _decode_jit at block_q=1)
+        self._ragged_jits: "OrderedDict[int, object]" = OrderedDict()
+        self._ragged_block_q = 8
 
     # -- public API ----------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32,
@@ -750,38 +776,81 @@ class ContinuousBatchingEngine:
 
         return jax.jit(run)
 
+    def _claim_candidate(self, free):
+        """The admission preamble shared by the legacy and ragged
+        loops: peek the FIFO head, match + PIN any cached prefix pages
+        (pin BEFORE reservation — under pool pressure _reserve_ok may
+        evict the matched entry itself, and unpinned pages would land
+        on the free list while still referenced), check the worst-case
+        page reservation, then claim a slot. Returns (slot, req,
+        prompt, shared) with the prefix pages still pinned, or None
+        when the head request must wait for pages (FIFO: stop
+        admitting)."""
+        req = self._queue[0]
+        prompt = self._effective_prompt(req)
+        shared = None
+        if self.layout == "paged" and self._prefix_enabled:
+            shared = self._match_prefix(prompt)
+            if shared is not None:
+                shared = list(shared)
+                for p in shared:
+                    self._incref(p)
+        if self.layout == "paged" and not self._reserve_ok(
+                req, len(shared) if shared else 0):
+            if shared:
+                for p in shared:
+                    self._decref(p)        # unpin before waiting
+            return None
+        slot = free.pop(0)
+        self._queue.pop(0)
+        # slot ownership is recorded BEFORE any dispatch so a failed
+        # prefill can release partially-built slot state uniformly
+        self._slot_req[slot] = req
+        req.status = RequestStatus.RUNNING
+        self._slot_seq[slot] = self._admit_seq
+        self._admit_seq += 1
+        return slot, req, prompt, shared
+
+    def _admission_pool_exhausted(self, slot, req, free, finished):
+        """Back out a claimed slot after an admission-time allocation
+        failure and requeue (or starve out) the request. Returns True
+        when the caller should try the NEXT queued request (the victim
+        starved out), False to stop admitting this step."""
+        self._release_slot(slot, register=False)
+        free.insert(0, slot)
+        self._requeue_or_starve(req, finished)
+        return req.done
+
+    def _admission_failed(self, slot, req, exc, free, finished):
+        """Isolate a failed prefill: finalize THIS request, free the
+        slot's partial state, keep admitting everything else."""
+        self.num_failures += 1
+        self._finalize(req, RequestStatus.FAILED,
+                       f"{type(exc).__name__}: {exc}", finished)
+        self._release_slot(slot, register=False)
+        free.insert(0, slot)
+
+    def _attach_shared(self, slot: int, shared: List[int]) -> int:
+        """Attach pinned prefix-cache pages read-only to `slot`'s block
+        table; returns the shared token length."""
+        self._slot_shared_pages[slot] = list(shared)
+        for j, p in enumerate(shared):
+            self._bt[slot, j] = p
+            self._incref(p)
+        self._slot_next_idx[slot] = len(shared)
+        return len(shared) * self.page_size
+
     def _admit(self):
+        if self.layout == "paged" and self.attn_impl == "ragged":
+            return self._admit_ragged()
         finished = []
         free = [i for i, r in enumerate(self._slot_req) if r is None]
         while free and self._queue:
-            req = self._queue[0]
-            prompt = self._effective_prompt(req)
-            p_len = len(prompt)
-            shared = None
-            if self.layout == "paged" and self._prefix_enabled:
-                shared = self._match_prefix(prompt)
-                if shared is not None:
-                    # PIN the matched pages before reservation: under
-                    # pool pressure _reserve_ok may evict the matched
-                    # entry itself, and unpinned pages would land on the
-                    # free list while still referenced by `shared`
-                    shared = list(shared)
-                    for p in shared:
-                        self._incref(p)
-            if self.layout == "paged" and not self._reserve_ok(
-                    req, len(shared) if shared else 0):
-                if shared:
-                    for p in shared:
-                        self._decref(p)    # unpin before waiting
+            claim = self._claim_candidate(free)
+            if claim is None:
                 break                      # FIFO: wait for pages to free
-            slot = free.pop(0)
-            self._queue.pop(0)
-            # slot ownership is recorded BEFORE dispatch so a failed
-            # prefill can release partially-built slot state uniformly
-            self._slot_req[slot] = req
-            req.status = RequestStatus.RUNNING
-            self._slot_seq[slot] = self._admit_seq
-            self._admit_seq += 1
+            slot, req, prompt, shared = claim
+            p_len = len(prompt)
             try:
                 # request_id joins the request's distributed trace when
                 # a fleet router opened one (trace.start_trace) — the
@@ -826,10 +895,8 @@ class ContinuousBatchingEngine:
                 # running requests complete — under the same starvation
                 # guard as decode-time preemption. register=False: the
                 # prefilled rows were never scattered into the pages.
-                self._release_slot(slot, register=False)
-                free.insert(0, slot)
-                self._requeue_or_starve(req, finished)
-                if req.done:
+                if self._admission_pool_exhausted(slot, req, free,
+                                                  finished):
                     continue       # starved out: try the next request
                 break              # pool exhausted: stop admitting
             except Exception as e:
@@ -842,13 +909,7 @@ class ContinuousBatchingEngine:
                        else self._caches)[0][0]
                 if getattr(arr, "is_deleted", lambda: False)():
                     raise
-                # isolate the failure: finalize THIS request, free the
-                # slot's partial state, keep serving everything else
-                self.num_failures += 1
-                self._finalize(req, RequestStatus.FAILED,
-                               f"{type(e).__name__}: {e}", finished)
-                self._release_slot(slot, register=False)
-                free.insert(0, slot)
+                self._admission_failed(slot, req, e, free, finished)
                 continue
             self._pos[slot] = p_len
             self._tok[slot] = int(tok)
@@ -878,12 +939,7 @@ class ContinuousBatchingEngine:
         the gathered prefix KV). `prompt` is the effective prompt
         (original + any tokens generated before a preemption)."""
         p_len = len(prompt)
-        shared_len = len(pages) * self.page_size
-        self._slot_shared_pages[slot] = list(pages)
-        for j, p in enumerate(pages):
-            self._bt[slot, j] = p
-            self._incref(p)
-        self._slot_next_idx[slot] = len(pages)
+        shared_len = self._attach_shared(slot, pages)
         self._reserve_and_alloc(slot, req, p_len)
         suffix = prompt[shared_len:]
         bucket = self._bucket(len(suffix))
@@ -906,6 +962,227 @@ class ContinuousBatchingEngine:
         self.prefix_hits += 1
         self.prefix_tokens_reused += shared_len
         return int(tok)
+
+    # -- ragged admission (attention_impl="ragged") ---------------------
+    def _admit_ragged(self):
+        """Batched admission through the ragged paged-attention path:
+        collect every admittable request (same FIFO + worst-case page
+        reservation as the legacy path), then prefill them ALL in one
+        packed dispatch — full prefills, prefix-cache suffix prefills,
+        and (when `prefill_chunk` bounds the dispatch) chunk
+        continuations ride one token axis. Loops while instant-finish
+        admissions free slots, mirroring the legacy admit loop."""
+        finished: List[Request] = []
+        while True:
+            entries = self._collect_ragged_entries(finished)
+            if not entries:
+                break
+            freed = False
+            for batch in self._ragged_batches(entries):
+                freed |= self._dispatch_ragged(batch, finished)
+            if not (freed and self._queue):
+                break
+        return finished
+
+    def _collect_ragged_entries(self, finished):
+        """The host-side half of admission: reservation, slot and page
+        allocation, prefix-cache attach — everything EXCEPT the model
+        dispatch, per request, so `serving.prefill` faults still
+        isolate a single request. Returns the admission entries to
+        pack."""
+        entries = []
+        free = [i for i, r in enumerate(self._slot_req) if r is None]
+        while free and self._queue:
+            claim = self._claim_candidate(free)
+            if claim is None:
+                break                  # FIFO: wait for pages to free
+            slot, req, prompt, shared = claim
+            p_len = len(prompt)
+            shared_len = 0
+            try:
+                with telemetry.span("serving.prefill", rid=req.rid,
+                                    request_id=req.request_id,
+                                    prompt_len=p_len,
+                                    shared_pages=len(shared)
+                                    if shared else 0):
+                    try:
+                        fault_point("serving.prefill")
+                        if shared:
+                            shared_len = self._attach_shared(slot,
+                                                             shared)
+                        self._reserve_and_alloc(slot, req, p_len)
+                    finally:
+                        if shared:
+                            for p in shared:
+                                self._decref(p)    # unpin: slot holds refs
+                if shared:
+                    self.prefix_hits += 1
+                    self.prefix_tokens_reused += shared_len
+                entries.append({"slot": slot, "req": req,
+                                "tokens": prompt[shared_len:],
+                                "offset": shared_len})
+            except PoolExhausted:
+                if self._admission_pool_exhausted(slot, req, free,
+                                                  finished):
+                    continue       # starved out: try the next request
+                break              # pool exhausted: stop admitting
+            except Exception as e:
+                # no dispatch happened yet, so the shared KV is intact:
+                # isolate the failure and keep admitting
+                self._admission_failed(slot, req, e, free, finished)
+                continue
+        return entries
+
+    def _ragged_batches(self, entries):
+        """Split admission entries into dispatch batches bounded by
+        `prefill_chunk` tokens (unbounded without it). A long prompt
+        spills into CHUNK CONTINUATION pieces in later batches — their
+        earlier rows are already scattered into the slot's pages, so
+        the continuation attends them through the page table at its
+        position offset. Only a request's final piece samples."""
+        budget = self._chunk
+        batches, cur, cur_tok = [], [], 0
+        for e in entries:
+            toks, off = e["tokens"], e["offset"]
+            while toks:
+                if budget is not None and cur_tok >= budget:
+                    batches.append(cur)
+                    cur, cur_tok = [], 0
+                take = len(toks) if budget is None \
+                    else min(len(toks), budget - cur_tok)
+                cur.append({"slot": e["slot"], "req": e["req"],
+                            "tokens": toks[:take], "offset": off,
+                            "sample": take == len(toks)})
+                toks = toks[take:]
+                off += take
+                cur_tok += take
+        if cur:
+            batches.append(cur)
+        return batches
+
+    def _dispatch_ragged(self, batch, finished):
+        """Pack one batch of admission pieces (each sequence's query
+        segment aligned to block_q) and run the ONE ragged program —
+        scatter + attention + sampling for every piece in a single
+        dispatch. Returns True when an instant-finish freed a slot."""
+        bq = self._ragged_block_q
+        grid = -(-self.pad // bq) * bq
+        cur = 0
+        for piece in batch:
+            piece["row0"] = cur
+            cur += -(-len(piece["tokens"]) // bq) * bq
+        t_pad = -(-max(cur, 1) // grid) * grid
+        ids = np.zeros(t_pad, np.int32)
+        tok_seq = np.full(t_pad, -1, np.int32)
+        qpos = np.zeros(t_pad, np.int32)
+        qstart = np.zeros(self.B, np.int32)
+        qlen = np.zeros(self.B, np.int32)
+        ctx = np.zeros(self.B, np.int32)
+        # OOB sentinel rows clamp inside the program; their samples are
+        # never read back
+        sample_rows = np.full(self.B, t_pad, np.int32)
+        for piece in batch:
+            s, n, r0 = piece["slot"], len(piece["tokens"]), piece["row0"]
+            ids[r0:r0 + n] = piece["tokens"]
+            tok_seq[r0:r0 + n] = s
+            qpos[r0:r0 + n] = piece["offset"] + np.arange(n)
+            qstart[s] = r0
+            qlen[s] = n
+            ctx[s] = piece["offset"] + n
+            if piece["sample"]:
+                sample_rows[s] = r0 + n - 1
+        # static gather trim for the XLA fallback: the batch's max page
+        # demand, power-of-two bucketed so the (t_pad, bound) program
+        # family stays log-bounded. Exact — trimmed columns lie past
+        # every context in this dispatch.
+        need = max(-(-int(ctx[p["slot"]]) // self.page_size)
+                   for p in batch)
+        bound = min(1 << max(need - 1, 0).bit_length(), self.pps)
+        rids = ([p["req"].request_id for p in batch]
+                if telemetry.enabled() else ())
+        with telemetry.span("serving.ragged_prefill", tokens=int(cur),
+                            t_pad=int(t_pad), rids=rids):
+            jit = self._get_ragged_prefill(t_pad, bound)
+            nxt, self._kv = jit(
+                [p._value for p in self._params],
+                [b._value for b in self._buffers],
+                self._kv, jnp.asarray(ids), jnp.asarray(tok_seq),
+                jnp.asarray(qpos), jnp.asarray(qstart),
+                jnp.asarray(qlen), jnp.asarray(ctx),
+                jnp.asarray(self._bt), jnp.asarray(sample_rows),
+                self._next_keys())
+            nxt = np.asarray(nxt)
+        freed = False
+        for piece in batch:
+            if not piece["sample"]:
+                continue
+            req, s = piece["req"], piece["slot"]
+            self._pos[s] = piece["offset"] + len(piece["tokens"])
+            tok = int(nxt[s])
+            self._tok[s] = tok
+            req.output.append(tok)
+            _M_ADMISSIONS.inc()
+            if telemetry.enabled() and req.first_token_time is None:
+                req.first_token_time = self._clock()
+                ttft = req.first_token_time - req.arrival_time
+                _M_TTFT.observe(ttft)
+                telemetry.event("serving.first_token", rid=req.rid,
+                                request_id=req.request_id, ttft_s=ttft)
+            if (self.eos is not None and tok == self.eos) \
+                    or len(req.output) >= req.max_new_tokens:
+                self._finalize(req, RequestStatus.FINISHED, None,
+                               finished)
+                self._release_slot(s)
+                freed = True
+        return freed
+
+    def _get_ragged_prefill(self, t_pad: int, pages_bound: int):
+        """One jit object per (padded token count, pow2 gather bound) —
+        the whole program key space on the ragged admission path
+        (compare the legacy per-bucket prefill + per-(shared_len,
+        bucket) suffix + chunk families)."""
+        key = (t_pad, pages_bound)
+        jit = self._ragged_jits.get(key)
+        if jit is None:
+            jit = self._build_ragged_step(self._ragged_block_q,
+                                          pages_bound)
+            self._ragged_jits[key] = jit
+            while len(self._ragged_jits) > self._max_prefill:
+                self._ragged_jits.popitem(last=False)      # LRU
+        else:
+            self._ragged_jits.move_to_end(key)
+        return jit
+
+    def _build_ragged_step(self, block_q: int, pages_bound=None):
+        """The one ragged program: packed ids -> per-token rope ->
+        ONE KV scatter into the pages -> ragged paged attention with
+        per-sequence descriptors -> sample each slot's designated row.
+        Serves admission batches (block_q=8) and, at block_q=1 with
+        t_pad == B, the decode step."""
+        model = self.model
+        params, buffers = self._params, self._buffers
+        strat, temp = self.strategy, self.temperature
+        tk, tp = self.top_k, self.top_p
+
+        def run(pv, bv, kv, ids, tok_seq, qpos, qstart, qlen, ctx, bt,
+                sample_rows, key):
+            from .generation import bind_state, _sample_token
+            from .llama import RaggedKVCacheView
+            with bind_state(params, buffers, pv, bv), no_grad():
+                views = [RaggedKVCacheView(kp, vp, bt, tok_seq, qpos,
+                                           qstart, qlen, ctx, block_q,
+                                           pages_bound)
+                         for kp, vp in kv]
+                logits, new = model.forward(
+                    Tensor(ids[None]), past_key_values=views,
+                    use_cache=True)
+                rows = logits._value[0]
+                sel = rows[jnp.clip(sample_rows, 0, rows.shape[0] - 1)]
+                nxt, _ = _sample_token(sel, key, strat, temp, tk, tp)
+                return nxt, [(v.k_pages._value, v.v_pages._value)
+                             for v in new]
+
+        return jax.jit(run, donate_argnums=(2,))
 
     # -- dense layout --------------------------------------------------
     def _dense_insert(self, slot: int, rows):
@@ -1313,7 +1590,18 @@ class ContinuousBatchingEngine:
         before the dispatch, so they survive an injected dispatch
         fault."""
         if self._decode_jit is None:
-            self._decode_jit = self._build_decode()
+            # ragged mode: decode is the SAME ragged program at
+            # block_q=1 — B sequences of one query token each. The
+            # constant descriptor arrays (slot indices, unit query
+            # lens) are built once: B never changes for the engine's
+            # lifetime and re-uploading them every step would tax the
+            # exact hot loop this path exists to speed up.
+            if self.layout == "paged" and self.attn_impl == "ragged":
+                self._decode_jit = self._build_ragged_step(1)
+                self._decode_idx = jnp.arange(self.B, dtype=jnp.int32)
+                self._decode_ones = jnp.ones(self.B, jnp.int32)
+            else:
+                self._decode_jit = self._build_decode()
         # inactive slots decode garbage at a clamped position; their
         # outputs are never read. Paged: their block-table rows are all
         # trash-page, so their KV writes land in page 0 (never read);
@@ -1357,11 +1645,22 @@ class ContinuousBatchingEngine:
         with telemetry.span("serving.decode_step", slots=n_active,
                             rids=rids):
             t0 = time.perf_counter()
-            nxt, new_kv = self._decode_jit(
-                [p._value for p in self._params],
-                [b._value for b in self._buffers],
-                kv, jnp.asarray(self._tok), jnp.asarray(pos), bt,
-                self._next_keys())
+            if self.layout == "paged" and self.attn_impl == "ragged":
+                bidx = self._decode_idx
+                nxt, new_kv = self._decode_jit(
+                    [p._value for p in self._params],
+                    [b._value for b in self._buffers],
+                    kv, jnp.asarray(self._tok), bidx,
+                    jnp.asarray(pos.astype(np.int32)), bidx,
+                    self._decode_ones,
+                    jnp.asarray((pos + 1).astype(np.int32)), bt, bidx,
+                    self._next_keys())
+            else:
+                nxt, new_kv = self._decode_jit(
+                    [p._value for p in self._params],
+                    [b._value for b in self._buffers],
+                    kv, jnp.asarray(self._tok), jnp.asarray(pos), bt,
+                    self._next_keys())
             if self.layout == "paged":
                 self._kv = new_kv
             else:

@@ -16,6 +16,13 @@ masked (their DMA still runs — grid shapes are static — but a cheaper
 Decode only (q = 1 token/sequence); no VJP — serving has no backward.
 Forward-parity is tested against a NumPy oracle and the contiguous-cache
 `masked_multihead_attention` functional.
+
+The ragged sibling (`ragged_paged_attention.py`) generalizes this grid
+to mixed prefill+decode batches AND fixes the "DMA still runs" cost
+above: dead pages route their index_map to the resident trash page, so
+the pipeline skips the copy. This kernel remains the minimal q = 1 form
+(and the `attention_impl="legacy"` engine path); the XLA fallback below
+is the decode special case of the ragged masked-attention core.
 """
 from __future__ import annotations
 
@@ -98,18 +105,21 @@ def _paged_kernel(ctx_ref, bt_ref,          # scalar-prefetched
 
 
 def paged_attention_values(q, k_pages, v_pages, context_lens, block_tables,
-                           scale=None, window=None):
+                           scale=None, window=None, use_kernel=None):
     """q: (B, H, D); k_pages/v_pages: (HK, P, page_size, D);
     context_lens: (B,) int32; block_tables: (B, pages_per_seq) int32.
     `window`: static sliding-window size — the decode query sees only
-    keys in [ctx - window, ctx). Returns (B, H, D)."""
+    keys in [ctx - window, ctx). `use_kernel`: None routes by platform;
+    True forces the Pallas kernel (interpret mode off-TPU — the CI
+    kernel/oracle parity path). Returns (B, H, D)."""
     b, h, d = q.shape
     hk, _, page_size, _ = k_pages.shape
     g = h // hk
     pps = block_tables.shape[1]
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
 
-    if _interpret():
+    kernel = use_kernel if use_kernel is not None else on_tpu()
+    if not kernel:
         return _paged_xla(q, k_pages, v_pages, context_lens, block_tables,
                           sc, window)
 
@@ -138,34 +148,27 @@ def paged_attention_values(q, k_pages, v_pages, context_lens, block_tables,
                           window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, hk, g, d), q.dtype),
+        interpret=_interpret(),
     )(context_lens, block_tables, qh, k_pages, v_pages)
     return out.reshape(b, h, d)
 
 
 def _paged_xla(q, k_pages, v_pages, context_lens, block_tables, scale,
                window=None):
-    """Reference/CI path: gather the block table back to a contiguous
-    cache, then masked attention. Semantically identical to the kernel."""
+    """Reference/CI path: the decode (q = 1) special case of the ragged
+    masked-attention core — the gather is BOUNDED to the block-table
+    prefix actually referenced (static trim on pps when the context
+    lengths are concrete), and the masking math is the ONE shared copy
+    in `ragged_paged_attention.masked_page_attention`."""
+    from .ragged_paged_attention import gather_pages, masked_page_attention
     b, h, d = q.shape
-    hk, _, page_size, _ = k_pages.shape
+    hk = k_pages.shape[0]
     g = h // hk
-    pps = block_tables.shape[1]
-    s_max = pps * page_size
-    # gather: (HK, B, pps, page, D) -> (B, pps, page, HK, D) -> contiguous
-    kg = jnp.transpose(k_pages[:, block_tables], (1, 2, 3, 0, 4))
-    vg = jnp.transpose(v_pages[:, block_tables], (1, 2, 3, 0, 4))
-    kc = kg.reshape(b, s_max, hk, d)
-    vc = vg.reshape(b, s_max, hk, d)
-    qh = q.reshape(b, hk, g, d)
-    logits = jnp.einsum("bkgd,btkd->bkgt", qh, kc,
-                        preferred_element_type=jnp.float32) * scale
-    pos = jnp.arange(s_max)
-    mask = pos[None, :] < context_lens[:, None]       # (B, S_max)
-    if window is not None:
-        mask = mask & (pos[None, :] >= context_lens[:, None] - window)
-    logits = jnp.where(mask[:, None, None, :], logits, NEG_INF)
-    p = jax.nn.softmax(logits, axis=-1).astype(vc.dtype)
-    out = jnp.einsum("bkgt,btkd->bkgd", p, vc)
+    kc, vc = gather_pages(k_pages, v_pages, block_tables,
+                          context_lens=context_lens)
+    ctx = jnp.asarray(context_lens, jnp.int32)
+    out = masked_page_attention(q.reshape(b, hk, g, d), kc, vc,
+                                ctx - 1, ctx, scale, window)
     return out.reshape(b, h, d)
 
 

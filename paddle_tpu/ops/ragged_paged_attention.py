@@ -1,0 +1,431 @@
+"""Ragged paged attention — ONE fused kernel for mixed prefill+decode
+over the page table.
+
+≙ the ragged paged-attention design of the TPU serving study (PAPERS.md,
+arxiv 2604.15464) and the reference engine's unified attention dispatch:
+a batch that mixes decode steps (q = 1), full prefills, chunked-prefill
+continuations, and prefix-cache suffix prefills runs through ONE Pallas
+grid — no per-request padding to a bucket, no per-shape program family.
+
+Layout. Queries of all sequences are PACKED along one token axis:
+``q`` is (T, H, D) and sequence ``s`` owns rows
+``[query_start[s], query_start[s] + query_len[s])``.  Row ``j`` of a
+sequence carries the GLOBAL position ``context_len[s] - query_len[s] +
+j`` — so ``query_len == context_len`` is a full prefill, ``query_len ==
+1`` a decode step, and anything in between a chunk continuation or a
+prefix-cache suffix prefill whose queries attend causally at
+``position_offset = context_len - query_len`` into prefix-shared pages.
+Rows owned by no sequence are padding: their output is zero and their
+KV (see `ragged_scatter_values`) routes to the trash page.
+
+Kernel. The grid is (q-blocks, kv-heads, pages-per-seq); the block
+tables and the per-sequence descriptors are SCALAR-PREFETCHED so the
+page index feeds the BlockSpec index_map and Mosaic double-buffers page
+fetches (the `paged_attention.py` pattern, generalized from q = 1 to
+ragged q).  Each q block belongs to exactly one sequence (the packer
+aligns ``query_start`` to ``block_q``; decode batches use block_q = 1).
+Dead pages — beyond a sequence's causal frontier, wholly below its
+sliding window, or under a padding q block — skip both the FLOPs *and*
+the DMA: their index_map routes to the RESIDENT trash page 0, and since
+consecutive grid steps then fetch the same block, the Pallas pipeline
+elides the copy entirely.  This fixes the "DMA still runs" cost
+documented in `paged_attention.py`.
+
+The XLA path (`_ragged_xla`) is the CI oracle: a page gather BOUNDED to
+the block-table prefix actually referenced (static trim when the
+context lengths are concrete) followed by the shared masked-attention
+core — `paged_attention._paged_xla` is its q = 1 special case, so the
+two fallbacks are one copy of the math.  Serving has no backward; no
+VJP is defined.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+from . import mxu_dot, on_tpu
+from ..core.tensor import Tensor, apply
+
+NEG_INF = -1e30
+LANES = 128
+DEFAULT_BLOCK_Q = 8
+TRASH_PAGE = 0
+
+
+def _interpret() -> bool:
+    return not on_tpu()
+
+
+# ---------------------------------------------------------------------------
+# packing helpers (host-side; engine + tests build batches with these)
+# ---------------------------------------------------------------------------
+def pack_ragged_starts(query_lens, block_q=DEFAULT_BLOCK_Q):
+    """Aligned packed layout for a ragged batch: each sequence's query
+    segment starts on a ``block_q`` boundary so every q block belongs to
+    exactly one sequence. Returns (query_start (N,) int32, total_rows)
+    where total_rows is the aligned length of the packed token axis
+    (before any further bucket padding)."""
+    starts, cur = [], 0
+    for n in query_lens:
+        starts.append(cur)
+        cur += -(-int(n) // block_q) * block_q
+    return np.asarray(starts, np.int32), cur
+
+
+def token_arrays(query_start, query_len, context_len, total_rows):
+    """Per-token (token_seq, positions) int32 arrays for a packed ragged
+    batch: ``token_seq[t]`` is the owning sequence (-1 for padding rows)
+    and ``positions[t]`` the token's global position in that sequence —
+    what rope rotation and the page scatter consume."""
+    seq = np.full(int(total_rows), -1, np.int32)
+    pos = np.zeros(int(total_rows), np.int32)
+    for s, (st, ql, cl) in enumerate(zip(query_start, query_len,
+                                         context_len)):
+        st, ql, cl = int(st), int(ql), int(cl)
+        seq[st:st + ql] = s
+        pos[st:st + ql] = np.arange(cl - ql, cl, dtype=np.int32)
+    return seq, pos
+
+
+# ---------------------------------------------------------------------------
+# shared masked-attention core (also backs paged_attention._paged_xla)
+# ---------------------------------------------------------------------------
+def gather_pages(k_pages, v_pages, block_tables, context_lens=None,
+                 pages_bound=None):
+    """Gather block-table pages to per-sequence contiguous caches
+    (N, S, HK, D), bounding the gather to the block-table prefix
+    actually referenced: when ``context_lens`` is CONCRETE (host-side
+    numpy / eager call) the trim is static — ``S = ceil(max(ctx) /
+    page_size) * page_size`` — instead of materializing the full
+    ``pps * page_size`` worst case.  ``pages_bound`` overrides the trim
+    explicitly (traced callers that know a static bound)."""
+    page_size = k_pages.shape[2]
+    pps = block_tables.shape[1]
+    bound = pps
+    if pages_bound is not None:
+        bound = max(1, min(int(pages_bound), pps))
+    elif context_lens is not None:
+        try:
+            # concrete (host/eager) context lengths: trim statically;
+            # traced ones raise TracerArrayConversionError and keep the
+            # full table (the compiled-engine case, where the bound is
+            # the slot reservation anyway)
+            ctx_np = np.asarray(context_lens)
+        except Exception:
+            ctx_np = None
+        if ctx_np is not None and ctx_np.size:
+            max_ctx = int(np.max(ctx_np))
+            bound = max(1, min(-(-max_ctx // page_size), pps))
+    bt = block_tables[:, :bound]
+    n = bt.shape[0]
+    kg = jnp.transpose(k_pages[:, bt], (1, 2, 3, 0, 4))
+    vg = jnp.transpose(v_pages[:, bt], (1, 2, 3, 0, 4))
+    s_max = bound * page_size
+    hk, d = k_pages.shape[0], k_pages.shape[3]
+    return (kg.reshape(n, s_max, hk, d), vg.reshape(n, s_max, hk, d))
+
+
+def masked_page_attention(q, kc, vc, q_positions, context_lens, scale,
+                          window=None):
+    """The ONE masked-attention core behind every paged XLA fallback.
+
+    q: (T, HK, G, D) packed query tokens; kc/vc: (T, S, HK, D) — the
+    gathered cache rows of each token's OWN sequence (callers gather
+    per sequence and index by token); q_positions: (T,) global position
+    of each query token; context_lens: (T,) context length of the
+    token's sequence. Token t attends keys ``k <= q_positions[t]``
+    (and ``> q_positions[t] - window``), keys past the context are
+    masked, and tokens with no valid key output zero."""
+    s_max = kc.shape[1]
+    logits = jnp.einsum("tkgd,tskd->tkgs", q, kc,
+                        preferred_element_type=jnp.float32) * scale
+    kpos = jnp.arange(s_max)
+    valid = (kpos[None, :] <= q_positions[:, None]) \
+        & (kpos[None, :] < context_lens[:, None])
+    if window is not None:
+        valid = valid & (kpos[None, :] > q_positions[:, None] - window)
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    any_valid = jnp.any(valid, axis=-1)[:, None, None, None]
+    p = jax.nn.softmax(logits, axis=-1)
+    p = jnp.where(any_valid, p, 0.0).astype(vc.dtype)
+    return jnp.einsum("tkgs,tskd->tkgd", p, vc)
+
+
+def _ragged_xla(q, k_pages, v_pages, query_start, query_len, context_len,
+                block_tables, scale, window=None, pages_bound=None):
+    """Reference/CI path: bounded page gather + the shared masked core.
+    Semantically identical to the kernel; padding rows output zero.
+    ``pages_bound`` is the TRACED caller's static trim (the engine
+    passes its batch's max reserved page count — context lengths are
+    tracers there, so the concrete-trim path cannot fire)."""
+    t, h, d = q.shape
+    hk = k_pages.shape[0]
+    g = h // hk
+    n = block_tables.shape[0]
+    kc, vc = gather_pages(k_pages, v_pages, block_tables,
+                          context_lens=context_len,
+                          pages_bound=pages_bound)
+    # post-trim: normalize descriptors to device arrays (a numpy base
+    # indexed by a traced index array would not convert)
+    query_start = jnp.asarray(query_start, jnp.int32)
+    query_len = jnp.asarray(query_len, jnp.int32)
+    context_len = jnp.asarray(context_len, jnp.int32)
+    # token -> owning sequence via segment membership (works for any
+    # descriptor order; padding rows match no sequence)
+    rows = jnp.arange(t)
+    in_seq = (rows[:, None] >= query_start[None, :]) \
+        & (rows[:, None] < (query_start + query_len)[None, :])
+    tok_seq = jnp.where(jnp.any(in_seq, 1), jnp.argmax(in_seq, 1), 0)
+    live = jnp.any(in_seq, 1)
+    tok_pos = context_len[tok_seq] - query_len[tok_seq] \
+        + (rows - query_start[tok_seq])
+    tok_ctx = jnp.where(live, context_len[tok_seq], 0)
+    qh = q.reshape(t, hk, g, d)
+    out = masked_page_attention(qh, kc[tok_seq], vc[tok_seq],
+                                jnp.where(live, tok_pos, -1), tok_ctx,
+                                scale, window)
+    return out.reshape(t, h, d)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel
+# ---------------------------------------------------------------------------
+def _ragged_kernel(qb_seq_ref, qstart_ref, qlen_ref, ctx_ref, bt_ref,
+                   q_ref, k_ref, v_ref, o_ref,
+                   acc_ref, m_ref, l_ref, *, scale, page_size, block_q,
+                   group, window):
+    qb = pl.program_id(0)
+    i = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    s = qb_seq_ref[qb]
+    sc = jnp.maximum(s, 0)
+    ctx = ctx_ref[sc]
+    qlen = qlen_ref[sc]
+    qb_off = qb * block_q - qstart_ref[sc]
+    first_q = ctx - qlen + qb_off                  # global pos of row 0
+    last_q = ctx - qlen + jnp.minimum(qb_off + block_q, qlen) - 1
+    live = (s >= 0) & (qb_off < qlen) & (i * page_size <= last_q)
+    if window is not None:
+        live = live & ((i + 1) * page_size > first_q - window + 1)
+
+    @pl.when(live)
+    def _page():
+        q = q_ref[0, 0].astype(jnp.float32)          # (block_q*G, D)
+        k = k_ref[0, 0].astype(jnp.float32)          # (page_size, D)
+        v = v_ref[0, 0].astype(jnp.float32)
+        sim = mxu_dot(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        kpos = i * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, sim.shape, 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, sim.shape, 0) // group
+        qpos = first_q + row
+        valid = (kpos <= qpos) & (qb_off + row < qlen)
+        if window is not None:
+            valid = valid & (kpos > qpos - window)
+        sim = jnp.where(valid, sim, NEG_INF)
+        m_prev = m_ref[:, :1]
+        m_cur = jnp.max(sim, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(sim > NEG_INF * 0.5, jnp.exp(sim - m_new), 0.0)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, -1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + mxu_dot(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == n_pages - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0, 0] = jnp.where(m_ref[:, :1] > NEG_INF * 0.5,
+                                acc_ref[:] / l, 0.0).astype(o_ref.dtype)
+
+
+def _page_index_map(qb, hh, ii, qb_seq, qstart, qlen, ctx, bt, *,
+                    page_size, block_q, window):
+    """BlockSpec index_map for k/v: live pages read their block-table
+    entry; DEAD pages (causally past the frontier, below the window, or
+    under a padding q block) route to the resident trash page 0 — the
+    pipeline then skips the DMA because the block index is unchanged."""
+    s = qb_seq[qb]
+    sc = jnp.maximum(s, 0)
+    c = ctx[sc]
+    ql = qlen[sc]
+    qb_off = qb * block_q - qstart[sc]
+    first_q = c - ql + qb_off
+    last_q = c - ql + jnp.minimum(qb_off + block_q, ql) - 1
+    live = (s >= 0) & (qb_off < ql) & (ii * page_size <= last_q)
+    if window is not None:
+        live = live & ((ii + 1) * page_size > first_q - window + 1)
+    return (hh, jnp.where(live, bt[sc, ii], TRASH_PAGE), 0, 0)
+
+
+def _ragged_pallas(q, k_pages, v_pages, query_start, query_len,
+                   context_len, block_tables, scale, window, block_q,
+                   interpret):
+    t, h, d = q.shape
+    hk, _, page_size, _ = k_pages.shape
+    g = h // hk
+    n = block_tables.shape[0]
+    pps = block_tables.shape[1]
+    nqb = t // block_q
+    # q block qb -> owning sequence (padding blocks: -1); every block
+    # belongs to at most one sequence because starts are block-aligned
+    qb_rows = jnp.arange(nqb, dtype=jnp.int32) * block_q
+    in_seq = (qb_rows[:, None] >= query_start[None, :]) \
+        & (qb_rows[:, None] < (query_start + query_len)[None, :])
+    qb_seq = jnp.where(jnp.any(in_seq, 1),
+                       jnp.argmax(in_seq, 1), -1).astype(jnp.int32)
+    # (T, H, D) -> (HK, nqb, block_q*G, D): one MXU-ready q tile per
+    # (kv head, q block); all reshapes live outside the kernel
+    qk = jnp.transpose(q.reshape(t, hk, g, d), (1, 0, 2, 3))
+    qk = qk.reshape(hk, nqb, block_q * g, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(nqb, hk, pps),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q * g, d),
+                         lambda qb, hh, ii, *refs: (hh, qb, 0, 0)),
+            pl.BlockSpec((1, 1, page_size, d), functools.partial(
+                _page_index_map, page_size=page_size, block_q=block_q,
+                window=window)),
+            pl.BlockSpec((1, 1, page_size, d), functools.partial(
+                _page_index_map, page_size=page_size, block_q=block_q,
+                window=window)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q * g, d),
+                               lambda qb, hh, ii, *refs: (hh, qb, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q * g, d), jnp.float32),
+            pltpu.VMEM((block_q * g, LANES), jnp.float32),
+            pltpu.VMEM((block_q * g, LANES), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_ragged_kernel, scale=scale,
+                          page_size=page_size, block_q=block_q, group=g,
+                          window=window),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((hk, nqb, block_q * g, d),
+                                       q.dtype),
+        interpret=interpret,
+    )(qb_seq, query_start.astype(jnp.int32),
+      query_len.astype(jnp.int32), context_len.astype(jnp.int32),
+      block_tables.astype(jnp.int32), qk, k_pages, v_pages)
+    out = out.reshape(hk, nqb, block_q, g, d)
+    return jnp.transpose(out, (1, 2, 0, 3, 4)).reshape(t, h, d)
+
+
+def ragged_paged_attention_values(q, k_pages, v_pages, query_start,
+                                  query_len, context_len, block_tables,
+                                  scale=None, window=None,
+                                  block_q=DEFAULT_BLOCK_Q,
+                                  use_kernel=None, pages_bound=None):
+    """q: (T, H, D) packed ragged queries; k_pages/v_pages:
+    (HK, P, page_size, D); query_start/query_len/context_len: (N,)
+    int32 per-sequence descriptors; block_tables: (N, pages_per_seq)
+    int32.  Row j of sequence s sits at global position
+    ``context_len[s] - query_len[s] + j`` and attends its sequence's
+    pages causally (band-limited by ``window`` when set).  Returns
+    (T, H, D); padding rows (owned by no sequence) return zero.
+
+    ``use_kernel``: None routes by platform (Pallas on TPU, the bounded
+    XLA gather oracle elsewhere); True forces the Pallas kernel — in
+    interpret mode off-TPU, which is how CI proves kernel/oracle parity.
+    The Pallas path requires ``query_start`` aligned to ``block_q``
+    (build batches with `pack_ragged_starts`; decode batches pass
+    block_q=1).  ``pages_bound``: STATIC cap on block-table columns the
+    XLA fallback gathers — traced callers (context lengths are tracers,
+    so the automatic concrete trim cannot fire) pass their known max
+    page demand to keep the gather O(max context), not O(pps). Columns
+    past every context are fully masked, so trimming them is exact."""
+    t, h, d = q.shape
+    sc = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    def _i32(x):
+        # keep CONCRETE descriptors as host arrays: jnp.asarray inside
+        # a trace would lift them to tracers and defeat the static
+        # gather trim / any host-side shape decisions
+        if isinstance(x, jax.core.Tracer):
+            return x
+        try:
+            return np.asarray(x, np.int32)
+        except Exception:
+            return x
+    query_start = _i32(query_start)
+    query_len = _i32(query_len)
+    context_len = _i32(context_len)
+    block_tables = _i32(block_tables)
+    kernel = use_kernel if use_kernel is not None else on_tpu()
+    if not kernel:
+        return _ragged_xla(q, k_pages, v_pages, query_start, query_len,
+                           context_len, block_tables, sc, window,
+                           pages_bound=pages_bound)
+    if t % block_q:
+        raise ValueError(f"packed length {t} not a multiple of "
+                         f"block_q {block_q}")
+    return _ragged_pallas(q, k_pages, v_pages, query_start, query_len,
+                          context_len, block_tables, sc, window,
+                          block_q, _interpret())
+
+
+def ragged_scatter_values(k_pages, v_pages, k_rows, v_rows, block_tables,
+                          token_seq, positions):
+    """Scatter packed ragged KV rows into the page pools.
+
+    k_rows/v_rows: (T, HK, D) rows for the packed token axis;
+    block_tables: (N, pps); token_seq: (T,) owning sequence per row
+    (-1 = padding); positions: (T,) global position per row. Padding
+    rows route to the trash page (never read). Returns the updated
+    (k_pages, v_pages) — one scatter for the whole mixed batch."""
+    page_size = k_pages.shape[2]
+    live = token_seq >= 0
+    sc = jnp.maximum(token_seq, 0)
+    page_idx = jnp.where(
+        live, block_tables[sc, positions // page_size], TRASH_PAGE)
+    slot = jnp.where(live, positions % page_size, 0)
+    kp = k_pages.at[:, page_idx, slot].set(
+        jnp.swapaxes(k_rows, 0, 1).astype(k_pages.dtype))
+    vp = v_pages.at[:, page_idx, slot].set(
+        jnp.swapaxes(v_rows, 0, 1).astype(v_pages.dtype))
+    return kp, vp
+
+
+def ragged_paged_attention(q: Tensor, k_pages: Tensor, v_pages: Tensor,
+                           query_start, query_len, context_len,
+                           block_tables, scale=None, window=None,
+                           block_q=DEFAULT_BLOCK_Q) -> Tensor:
+    """Eager/tape entry. Serving-only: no grad path."""
+    qs = query_start._value if isinstance(query_start, Tensor) \
+        else jnp.asarray(query_start, jnp.int32)
+    ql = query_len._value if isinstance(query_len, Tensor) \
+        else jnp.asarray(query_len, jnp.int32)
+    cl = context_len._value if isinstance(context_len, Tensor) \
+        else jnp.asarray(context_len, jnp.int32)
+    bt = block_tables._value if isinstance(block_tables, Tensor) \
+        else jnp.asarray(block_tables, jnp.int32)
+
+    def fn(qq, kk, vv):
+        return ragged_paged_attention_values(qq, kk, vv, qs, ql, cl, bt,
+                                             scale, window, block_q)
+    return apply("ragged_paged_attention", fn, (q, k_pages, v_pages))

@@ -43,6 +43,12 @@ def main(argv=None):
     p.add_argument("--max-new-tokens", type=int, default=24)
     p.add_argument("--num-beams", type=int, default=4)
     p.add_argument("--draft-layers", type=int, default=1)
+    p.add_argument("--attention-impl", default="ragged",
+                   choices=("ragged", "legacy"),
+                   help="serving attention path: the fused ragged "
+                        "paged-attention kernel (default) or the "
+                        "legacy per-bucket prefill + q=1 decode paths "
+                        "(greedy outputs are bit-identical)")
     p.add_argument("--replicas", type=int, default=3,
                    help="fleet size for the router failover drill")
     p.add_argument("--trace-out", default=None,
@@ -110,7 +116,9 @@ def main(argv=None):
     eng = ContinuousBatchingEngine(model, max_batch_size=4,
                                    max_seq_len=min(
                                        256, cfg.max_position_embeddings),
-                                   enable_prefix_caching=True)
+                                   enable_prefix_caching=True,
+                                   attention_impl=args.attention_impl)
+    print(f"engine attention_impl: {eng.attn_impl}")
     rids = [eng.add_request(
         system + rng.integers(1, cfg.vocab_size,
                               int(rng.integers(4, 10))).tolist(), n)
@@ -132,7 +140,7 @@ def main(argv=None):
     eng = ContinuousBatchingEngine(
         model, max_batch_size=2,
         max_seq_len=min(256, cfg.max_position_embeddings),
-        max_waiting=3)
+        max_waiting=3, attention_impl=args.attention_impl)
     for _ in range(3):
         eng.add_request(rng.integers(1, cfg.vocab_size, 6).tolist(), 8)
     try:
@@ -183,7 +191,8 @@ def main(argv=None):
             lambda i: ContinuousBatchingEngine(
                 model, max_batch_size=2,
                 max_seq_len=min(256, cfg.max_position_embeddings),
-                enable_prefix_caching=True),
+                enable_prefix_caching=True,
+                attention_impl=args.attention_impl),
             num_replicas=args.replicas, policy="prefix_affinity",
             page_size=16, slo_monitor=mon)
 

@@ -62,7 +62,8 @@ def pytest_configure(config):
 # report carries a telemetry snapshot for post-mortem debugging
 _TELEMETRY_FILES = ("test_serving.py", "test_chaos.py",
                     "test_telemetry.py", "test_elastic_robustness.py",
-                    "test_router.py", "test_observability_slo.py")
+                    "test_router.py", "test_observability_slo.py",
+                    "test_ragged_attention.py")
 
 # failing fleet-drill tests additionally attach a Chrome-trace export
 # of the telemetry ring: the failover timeline that produced the
@@ -114,9 +115,9 @@ def _serving_invariant_checks(request, monkeypatch):
     """Every serving/chaos test runs with the engine invariant checker
     on: page-accounting violations surface as EngineInvariantError in
     whatever test created them, for free."""
-    if os.path.basename(str(request.fspath)) in ("test_serving.py",
-                                                 "test_chaos.py",
-                                                 "test_router.py"):
+    if os.path.basename(str(request.fspath)) in (
+            "test_serving.py", "test_chaos.py", "test_router.py",
+            "test_ragged_attention.py"):
         monkeypatch.setenv("PDT_CHECK_INVARIANTS", "1")
     yield
 

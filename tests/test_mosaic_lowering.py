@@ -16,6 +16,9 @@ Shapes mirror tests/test_tpu_compile.py (bench.py's Llama config).
 import os
 
 import jax
+import jax.export  # noqa: F401  (registers jax.export for _lower —
+#                   standalone runs must not depend on another test
+#                   file having imported it first)
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -130,6 +133,45 @@ class TestPagedAttentionLowering:
         bt = jnp.zeros((b, 8), jnp.int32)
         _lower(lambda q, kp, vp: paged_attention_values(
             q, kp, vp, ctx, bt, window=window), q, kp, kp)
+
+
+class TestRaggedPagedAttentionLowering:
+    """ISSUE 6: the mixed prefill+decode grid — (block_q*G, D) q tiles,
+    scalar-prefetched descriptors, trash-page index_map routing — must
+    survive the Mosaic pass at bench shapes, windowed and not, and at
+    the decode form (block_q=1)."""
+
+    @pytest.mark.parametrize("window", [None, 256])
+    def test_mixed_batch(self, window):
+        from paddle_tpu.ops.ragged_paged_attention import (
+            pack_ragged_starts, ragged_paged_attention_values)
+
+        pages, page_size = 512, 16
+        ql = np.array([512, 512, 1, 1, 1, 1], np.int32)
+        cl = np.array([512, 512, 900, 800, 700, 600], np.int32)
+        qs, total = pack_ragged_starts(ql, block_q=8)
+        q = jnp.zeros((total, BENCH_H, BENCH_D), jnp.bfloat16)
+        kp = jnp.zeros((BENCH_HK, pages, page_size, BENCH_D),
+                       jnp.bfloat16)
+        bt = jnp.zeros((len(ql), 64), jnp.int32)
+        _lower(lambda q, kp, vp: ragged_paged_attention_values(
+            q, kp, vp, qs, ql, cl, bt, window=window, block_q=8),
+            q, kp, kp)
+
+    def test_decode_block_q1(self):
+        from paddle_tpu.ops.ragged_paged_attention import \
+            ragged_paged_attention_values
+
+        b, pages, page_size = 8, 64, 16
+        qs = np.arange(b, dtype=np.int32)
+        ql = np.ones(b, np.int32)
+        cl = np.full(b, 100, np.int32)
+        q = jnp.zeros((b, BENCH_H, BENCH_D), jnp.bfloat16)
+        kp = jnp.zeros((BENCH_HK, pages, page_size, BENCH_D),
+                       jnp.bfloat16)
+        bt = jnp.zeros((b, 8), jnp.int32)
+        _lower(lambda q, kp, vp: ragged_paged_attention_values(
+            q, kp, vp, qs, ql, cl, bt, block_q=1), q, kp, kp)
 
 
 class TestGroupedMatmulLowering:

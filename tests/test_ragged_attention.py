@@ -1,0 +1,460 @@
+"""Ragged paged attention (ISSUE 6): the fused mixed prefill+decode
+kernel (`ops/ragged_paged_attention.py`) proved in INTERPRET mode
+against an independent NumPy oracle — mixed batches, prefix-shared
+pages at nonzero position offsets, sliding windows, GQA group sizes,
+and empty/degenerate sequences — plus the scatter/packing helpers, the
+bounded-gather static trim, and the ENGINE-level contract: greedy
+streams bit-identical between `attention_impl="ragged"` and `"legacy"`
+through a forced preemption and a SIGKILL replica failover.
+
+conftest runs this file with PDT_TELEMETRY=1 and
+PDT_CHECK_INVARIANTS=1, so every engine step here re-proves page
+accounting on the ragged path."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as telemetry
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import (ContinuousBatchingEngine,
+                                       PoolExhausted, RequestStatus)
+from paddle_tpu.ops.paged_attention import paged_attention_values
+from paddle_tpu.ops.ragged_paged_attention import (
+    gather_pages, pack_ragged_starts, ragged_paged_attention_values,
+    ragged_scatter_values, token_arrays)
+from paddle_tpu.utils.faults import FaultInjector
+
+
+def np_ragged_oracle(q, kp, vp, qs, ql, cl, bt, window=None):
+    """Independent NumPy reference: per-token loop over the page table,
+    full-precision softmax. Padding rows output zero."""
+    hk, _, ps, d = kp.shape
+    h = q.shape[1]
+    g = h // hk
+    out = np.zeros_like(q, dtype=np.float32)
+    scale = 1.0 / np.sqrt(d)
+    for s in range(len(ql)):
+        for j in range(int(ql[s])):
+            row = int(qs[s]) + j
+            pos = int(cl[s]) - int(ql[s]) + j
+            lo = 0 if window is None else max(0, pos - window + 1)
+            keys, vals = [], []
+            for kpos in range(lo, pos + 1):
+                pg = bt[s, kpos // ps]
+                keys.append(kp[:, pg, kpos % ps])
+                vals.append(vp[:, pg, kpos % ps])
+            if not keys:
+                continue
+            K = np.stack(keys, 0)                    # (L, HK, D)
+            V = np.stack(vals, 0)
+            for head in range(h):
+                kh = head // g
+                logits = (K[:, kh] @ q[row, head]) * scale
+                p = np.exp(logits - logits.max())
+                p /= p.sum()
+                out[row, head] = p @ V[:, kh]
+    return out
+
+
+def _case(rng, hk=2, g=2, d=16, ps=4, n_pages=12, pps=4,
+          ql=(1, 7, 5), cl=(9, 7, 13), block_q=4, tail_pad=4,
+          bt=None):
+    """Build one ragged batch: packed q, page pools, block tables,
+    descriptors. Defaults mix a decode step, a full prefill, and a
+    suffix continuation (context > query: nonzero position offset)."""
+    h = hk * g
+    ql = np.asarray(ql, np.int32)
+    cl = np.asarray(cl, np.int32)
+    qs, total = pack_ragged_starts(ql, block_q=block_q)
+    t = total + tail_pad
+    q = rng.standard_normal((t, h, d)).astype(np.float32)
+    kp = rng.standard_normal((hk, n_pages, ps, d)).astype(np.float32)
+    vp = rng.standard_normal((hk, n_pages, ps, d)).astype(np.float32)
+    if bt is None:
+        bt = np.zeros((len(ql), pps), np.int32)
+        nxt = 1
+        for s in range(len(ql)):
+            need = -(-int(cl[s]) // ps) if cl[s] else 0
+            for j in range(need):
+                bt[s, j] = nxt
+                nxt += 1
+            assert nxt <= n_pages
+    return q, kp, vp, qs, ql, cl, np.asarray(bt, np.int32)
+
+
+def _both_paths(q, kp, vp, qs, ql, cl, bt, window=None, block_q=4):
+    """(interpret-mode Pallas kernel, XLA gather oracle) outputs."""
+    args = (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            qs, ql, cl, bt)
+    kern = np.asarray(ragged_paged_attention_values(
+        *args, window=window, block_q=block_q, use_kernel=True))
+    xla = np.asarray(ragged_paged_attention_values(
+        *args, window=window, block_q=block_q, use_kernel=False))
+    return kern, xla
+
+
+class TestRaggedKernelParity:
+    """Interpret-mode kernel AND the XLA oracle vs NumPy — the parity
+    ladder every ops/ kernel carries."""
+
+    def test_mixed_decode_prefill_batch(self):
+        rng = np.random.default_rng(0)
+        q, kp, vp, qs, ql, cl, bt = _case(rng)
+        ref = np_ragged_oracle(q, kp, vp, qs, ql, cl, bt)
+        kern, xla = _both_paths(q, kp, vp, qs, ql, cl, bt)
+        np.testing.assert_allclose(kern, ref, atol=2e-5)
+        np.testing.assert_allclose(xla, ref, atol=2e-5)
+        # padding rows (owned by no sequence) are exactly zero
+        seq_t, _ = token_arrays(qs, ql, cl, q.shape[0])
+        assert np.all(kern[seq_t < 0] == 0)
+        assert np.all(xla[seq_t < 0] == 0)
+
+    def test_prefix_shared_pages_nonzero_offset(self):
+        """Two sequences attach the SAME physical pages for their first
+        two page slots (a prefix-cache hit); the second prefills only a
+        suffix at position_offset = 8."""
+        rng = np.random.default_rng(1)
+        bt = np.zeros((2, 4), np.int32)
+        bt[0] = [1, 2, 3, 0]       # full owner: ctx 12, decode q=1
+        bt[1] = [1, 2, 4, 5]       # shares pages 1-2, suffix q=5 @ off 8
+        q, kp, vp, qs, ql, cl, bt = _case(
+            rng, ql=(1, 5), cl=(12, 13), bt=bt)
+        ref = np_ragged_oracle(q, kp, vp, qs, ql, cl, bt)
+        kern, xla = _both_paths(q, kp, vp, qs, ql, cl, bt)
+        np.testing.assert_allclose(kern, ref, atol=2e-5)
+        np.testing.assert_allclose(xla, ref, atol=2e-5)
+
+    @pytest.mark.parametrize("window", [3, 6, 64])
+    def test_sliding_window(self, window):
+        rng = np.random.default_rng(2)
+        q, kp, vp, qs, ql, cl, bt = _case(rng)
+        ref = np_ragged_oracle(q, kp, vp, qs, ql, cl, bt, window=window)
+        kern, xla = _both_paths(q, kp, vp, qs, ql, cl, bt, window=window)
+        np.testing.assert_allclose(kern, ref, atol=2e-5)
+        np.testing.assert_allclose(xla, ref, atol=2e-5)
+
+    @pytest.mark.parametrize("g", [1, 2, 4])
+    def test_gqa_group_sizes(self, g):
+        rng = np.random.default_rng(3)
+        q, kp, vp, qs, ql, cl, bt = _case(rng, g=g)
+        ref = np_ragged_oracle(q, kp, vp, qs, ql, cl, bt)
+        kern, xla = _both_paths(q, kp, vp, qs, ql, cl, bt)
+        np.testing.assert_allclose(kern, ref, atol=2e-5)
+        np.testing.assert_allclose(xla, ref, atol=2e-5)
+
+    def test_empty_and_degenerate_sequences(self):
+        """query_len 0 (nothing to do) and context_len == query_len == 1
+        (a sequence's very first token) are both well-defined; outputs
+        stay finite and match NumPy."""
+        rng = np.random.default_rng(4)
+        q, kp, vp, qs, ql, cl, bt = _case(
+            rng, ql=(0, 1, 3), cl=(0, 1, 3), block_q=1, tail_pad=0)
+        ref = np_ragged_oracle(q, kp, vp, qs, ql, cl, bt)
+        kern, xla = _both_paths(q, kp, vp, qs, ql, cl, bt, block_q=1)
+        assert np.isfinite(kern).all() and np.isfinite(xla).all()
+        np.testing.assert_allclose(kern, ref, atol=2e-5)
+        np.testing.assert_allclose(xla, ref, atol=2e-5)
+
+    def test_decode_batch_matches_legacy_kernel(self):
+        """A pure decode batch (block_q=1, one query per sequence) is
+        exactly the legacy kernel's domain: both kernels, both in
+        interpret mode, must agree — the ragged kernel subsumes the
+        q=1 one."""
+        rng = np.random.default_rng(5)
+        b = 3
+        q, kp, vp, qs, ql, cl, bt = _case(
+            rng, ql=(1,) * b, cl=(9, 6, 2), block_q=1, tail_pad=0)
+        ragged = np.asarray(ragged_paged_attention_values(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            qs, ql, cl, bt, block_q=1, use_kernel=True))
+        legacy = np.asarray(paged_attention_values(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(cl), jnp.asarray(bt), use_kernel=True))
+        np.testing.assert_allclose(ragged, legacy, atol=2e-5)
+        # and the legacy interpret kernel agrees with ITS oracle
+        legacy_xla = np.asarray(paged_attention_values(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(cl), jnp.asarray(bt)))
+        np.testing.assert_allclose(legacy, legacy_xla, atol=2e-5)
+
+    def test_unaligned_packed_length_rejected(self):
+        rng = np.random.default_rng(6)
+        q, kp, vp, qs, ql, cl, bt = _case(rng, tail_pad=3)  # t % 4 != 0
+        with pytest.raises(ValueError, match="block_q"):
+            ragged_paged_attention_values(
+                jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                qs, ql, cl, bt, block_q=4, use_kernel=True)
+
+
+class TestScatterAndPacking:
+    def test_scatter_roundtrip_and_trash_routing(self):
+        rng = np.random.default_rng(7)
+        hk, d, ps, n_pages = 2, 8, 4, 6
+        ql = np.array([3, 2], np.int32)
+        cl = np.array([7, 2], np.int32)
+        qs, total = pack_ragged_starts(ql, block_q=4)
+        t = total
+        seq_t, pos_t = token_arrays(qs, ql, cl, t)
+        k_rows = rng.standard_normal((t, hk, d)).astype(np.float32)
+        v_rows = rng.standard_normal((t, hk, d)).astype(np.float32)
+        bt = np.array([[1, 2, 0], [3, 0, 0]], np.int32)
+        kp0 = np.zeros((hk, n_pages, ps, d), np.float32)
+        kp, vp = ragged_scatter_values(
+            jnp.asarray(kp0), jnp.asarray(kp0.copy()),
+            jnp.asarray(k_rows), jnp.asarray(v_rows),
+            jnp.asarray(bt), jnp.asarray(seq_t), jnp.asarray(pos_t))
+        kp = np.asarray(kp)
+        for row in range(t):
+            s, pos = int(seq_t[row]), int(pos_t[row])
+            if s < 0:
+                continue
+            pg = bt[s, pos // ps]
+            np.testing.assert_array_equal(kp[:, pg, pos % ps],
+                                          k_rows[row])
+        # live pages hold ONLY live rows; everything else (incl. every
+        # padding row) landed in trash page 0
+        live = {(int(bt[int(seq_t[r])][int(pos_t[r]) // ps]),
+                 int(pos_t[r]) % ps)
+                for r in range(t) if seq_t[r] >= 0}
+        for pg in range(1, n_pages):
+            for sl in range(ps):
+                if (pg, sl) not in live:
+                    assert np.all(kp[:, pg, sl] == 0), (pg, sl)
+
+    def test_pack_starts_aligned_and_token_arrays(self):
+        ql = [1, 7, 5, 0]
+        qs, total = pack_ragged_starts(ql, block_q=8)
+        assert list(qs) == [0, 8, 16, 24]
+        assert total == 24
+        seq_t, pos_t = token_arrays(qs, np.asarray(ql),
+                                    np.asarray([4, 7, 9, 0]), 24)
+        assert seq_t[0] == 0 and pos_t[0] == 3          # decode @ ctx-1
+        assert list(seq_t[8:15]) == [1] * 7
+        assert list(pos_t[16:21]) == [4, 5, 6, 7, 8]    # offset 4 suffix
+        assert np.all(seq_t[np.r_[1:8, 15:16, 21:24]] == -1)
+
+
+class TestGatherTrim:
+    """The `_paged_xla` satellite: the gather is bounded to the
+    block-table prefix actually referenced when context lengths are
+    concrete, and the trim never changes results."""
+
+    def test_gather_bounded_to_referenced_prefix(self):
+        kp = jnp.zeros((2, 33, 4, 8))
+        bt = jnp.asarray(np.zeros((3, 8), np.int32))
+        ctx = np.array([5, 9, 2], np.int32)               # 3 pages max
+        kc, _ = gather_pages(kp, kp, bt, context_lens=ctx)
+        assert kc.shape[1] == 3 * 4                       # trimmed
+        kc_full, _ = gather_pages(kp, kp, bt, pages_bound=8)
+        assert kc_full.shape[1] == 8 * 4                  # full on demand
+        # traced context lengths cannot trim (shape must be static)
+        shape = jax.eval_shape(
+            lambda c: gather_pages(kp, kp, bt, context_lens=c)[0],
+            jax.ShapeDtypeStruct((3,), jnp.int32)).shape
+        assert shape[1] == 8 * 4
+
+    def test_trim_matches_full_gather_attention(self):
+        rng = np.random.default_rng(8)
+        q, kp, vp, qs, ql, cl, bt = _case(rng, pps=8, n_pages=40)
+        trimmed = np.asarray(ragged_paged_attention_values(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            qs, ql, cl, bt, use_kernel=False))
+        ref = np_ragged_oracle(q, kp, vp, qs, ql, cl, bt)
+        np.testing.assert_allclose(trimmed, ref, atol=2e-5)
+
+
+# -- engine integration ------------------------------------------------
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      num_key_value_heads=1, max_position_embeddings=64)
+    paddle.seed(7)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", 4)
+    return ContinuousBatchingEngine(model, **kw)
+
+
+JOBS = [([5, 4, 3, 2, 6, 7], 8), ([9, 1, 2], 6), ([7, 7, 1, 2], 5)]
+
+
+def _drain(eng):
+    reqs = {}
+    while eng._queue or any(r is not None for r in eng._slot_req):
+        for r in eng.step():
+            reqs[r.rid] = r
+    return reqs
+
+
+class TestRaggedEngineParity:
+    """The ISSUE 6 acceptance contract: `attention_impl="ragged"` and
+    `"legacy"` produce IDENTICAL greedy streams — in the clean run,
+    through a forced preemption, and through a SIGKILL replica
+    failover (the PR-4/5 chaos drills as the kernel's regression
+    harness)."""
+
+    def _run(self, model, impl, jobs=JOBS, fault=None, **kw):
+        eng = _engine(model, attention_impl=impl, **kw)
+        rids = [eng.add_request(p, n) for p, n in jobs]
+        if fault is None:
+            reqs = _drain(eng)
+        else:
+            with FaultInjector() as fi:
+                fi.arm(*fault[:1], **fault[1])
+                reqs = _drain(eng)
+        return eng, rids, reqs
+
+    def test_streams_identical_clean(self, model):
+        outs = {}
+        for impl in ("legacy", "ragged"):
+            _, rids, reqs = self._run(model, impl)
+            outs[impl] = [reqs[r].output for r in rids]
+            assert all(reqs[r].status == RequestStatus.FINISHED
+                       for r in rids)
+        assert outs["ragged"] == outs["legacy"]
+
+    def test_streams_identical_through_preemption(self, model):
+        """Forced pool exhaustion mid-decode: the victim requeues and
+        re-prefills through the ragged path — final streams equal the
+        legacy run under the SAME fault."""
+        outs = {}
+        for impl in ("legacy", "ragged"):
+            eng, rids, reqs = self._run(
+                model, impl, jobs=JOBS[:2],
+                fault=("serving.alloc_page",
+                       dict(nth=4, exc=PoolExhausted)))
+            assert eng.num_preemptions == 1, impl
+            assert all(reqs[r].status == RequestStatus.FINISHED
+                       for r in rids), impl
+            outs[impl] = [reqs[r].output for r in rids]
+        assert outs["ragged"] == outs["legacy"]
+
+    def test_streams_identical_through_sigkill_failover(self, model):
+        """A replica SIGKILL mid-decode with zero-loss failover: fleet
+        outputs are identical between the two impls (and equal the
+        single-engine reference)."""
+        from paddle_tpu.serving import ServingRouter
+
+        class Clock:
+            def __init__(self):
+                self.t = 0.0
+
+            def advance(self, dt):
+                self.t += dt
+
+            def __call__(self):
+                return self.t
+
+        outs = {}
+        for impl in ("legacy", "ragged"):
+            clock = Clock()
+            router = ServingRouter(
+                lambda i: _engine(model, attention_impl=impl,
+                                  clock=clock),
+                num_replicas=3, policy="round_robin", clock=clock,
+                sleep=clock.advance, page_size=4)
+            ids = [router.submit(p, n) for p, n in JOBS]
+            router.step()
+            router.step()                            # mid-decode
+            router.kill_replica(1)
+            out = router.run()
+            assert router.num_failovers == 1, impl
+            outs[impl] = [out[i] for i in ids]
+        assert outs["ragged"] == outs["legacy"]
+        _, rids, reqs = self._run(model, "ragged")
+        assert outs["ragged"] == [reqs[r].output for r in rids]
+
+    def test_one_dispatch_per_admission_round(self, model):
+        """Admitting N ragged prompts costs ONE dispatch: the first
+        step's admission produces a single serving.ragged_prefill span
+        carrying every admitted request_id, and no legacy per-bucket
+        prefill/suffix/chunk programs are ever minted."""
+        eng = _engine(model, max_batch_size=3)
+        rids = [eng.add_request(p, n) for p, n in JOBS]
+        eng.step()
+        spans = [e for e in telemetry.events()
+                 if e["name"] == "serving.ragged_prefill"]
+        assert len(spans) == 1            # N admissions, ONE dispatch
+        batch_rids = set(spans[0]["attrs"]["rids"])
+        assert batch_rids == {str(r) for r in rids}
+        eng.run()
+        assert len(eng._prefill_jits) == 0
+        assert len(eng._suffix_jits) == 0
+        assert len(eng._ragged_jits) >= 1
+
+    def test_prefix_cache_rides_ragged_admission(self, model):
+        """A prefix-cache hit admits through the packed suffix path:
+        hits are counted and outputs equal the cache-off engine."""
+        sys_prompt = [3, 9, 2, 7, 5, 1, 4, 8]          # 2 full pages
+        jobs = [(sys_prompt + [11], 6), (sys_prompt + [13, 14], 6)]
+        outs = {}
+        for caching in (False, True):
+            eng = _engine(model, max_batch_size=1,
+                          enable_prefix_caching=caching)
+            rids = [eng.add_request(p, n) for p, n in jobs]
+            reqs = _drain(eng)
+            outs[caching] = [reqs[r].output for r in rids]
+        assert outs[True] == outs[False]
+        assert eng.prefix_hits >= 1
+        assert eng.prefix_tokens_reused >= 4
+
+    def test_chunked_prefill_spills_across_dispatches(self, model):
+        """prefill_chunk bounds the ragged dispatch: a long prompt
+        spills into chunk-continuation pieces, and the stream equals
+        the unchunked engine's."""
+        prompt = list(np.arange(1, 30) % 60 + 1)
+        ref_eng, _, ref_reqs = self._run(model, "ragged",
+                                         jobs=[(prompt, 6)])
+        eng = _engine(model, attention_impl="ragged", prefill_chunk=8)
+        rid = eng.add_request(prompt, 6)
+        reqs = _drain(eng)
+        assert reqs[rid].output == list(ref_reqs.values())[0].output
+        # the admission really split: > 1 ragged_prefill span for one
+        # admitted request
+        spans = {e["seq"] for e in telemetry.events()
+                 if e["name"] == "serving.ragged_prefill"}
+        assert len(spans) >= 2
+
+    def test_admission_program_gather_is_bounded(self, model):
+        """The traced admission program cannot use the concrete trim
+        (context lengths are tracers), so the engine threads a STATIC
+        pages_bound — short prompts must compile a program whose
+        gather is O(their pages), not O(pps)."""
+        eng = _engine(model, max_batch_size=2)      # pps = 64/4 = 16
+        eng.add_request([1, 2, 3], 2)
+        eng.step()
+        keys = list(eng._ragged_jits)
+        assert keys, "no ragged admission program was built"
+        t_pad, bound = keys[0]
+        assert bound == 1                            # ceil(3/4) -> pow2
+        assert bound < eng.pps
+
+    def test_attention_impl_validation_and_dense_fallback(self, model):
+        with pytest.raises(ValueError, match="attention_impl"):
+            _engine(model, attention_impl="fused")
+        eng = _engine(model, kv_layout="dense", attention_impl="ragged")
+        assert eng.attn_impl == "legacy"   # dense has no page table
+        eng2 = _engine(model)
+        assert eng2.attn_impl == "ragged"  # the default
+
+    def test_sampling_seeded_reproducible_on_ragged(self, model):
+        def run(seed, **kw):
+            eng = _engine(model, seed=seed, **kw)
+            rid = eng.add_request([5, 42, 7, 11], 8)
+            return _drain(eng)[rid].output
+
+        s1 = run(3, do_sample=True, temperature=0.8, top_k=20)
+        s2 = run(3, do_sample=True, temperature=0.8, top_k=20)
+        assert s1 == s2 and len(s1) == 8
+        tiny_p = run(9, do_sample=True, top_p=1e-9)
+        greedy = run(0)
+        assert tiny_p == greedy

@@ -172,6 +172,45 @@ class TestPagedAttentionCompile:
             q, kp, vp, cl, bt, window=512), q, kp, kp)
 
 
+class TestRaggedPagedAttentionCompile:
+    """ISSUE 6: the mixed prefill+decode ragged kernel must compile AND
+    execute on the chip — q tiles are (block_q*G, D), descriptors ride
+    scalar prefetch, dead pages route their index_map to the trash
+    page. Numerics vs the XLA oracle stay the interpret tier's job
+    (tests/test_ragged_attention.py); this is the Mosaic gate."""
+
+    def test_mixed_batch_and_decode_shapes(self):
+        from paddle_tpu.ops.ragged_paged_attention import (
+            pack_ragged_starts, ragged_paged_attention_values)
+
+        pages_per_seq, page = 128, 16
+        ql = np.array([512, 512, 1, 1, 1, 1], np.int32)
+        cl = np.array([512, 512, 1800, 1500, 900, 600], np.int32)
+        qs, total = pack_ragged_starts(ql, block_q=8)
+        q = jnp.zeros((total, BENCH_H, BENCH_D), jnp.bfloat16)
+        kp = jnp.zeros((BENCH_HK, len(ql) * pages_per_seq, page,
+                        BENCH_D), jnp.bfloat16)
+        bt = jnp.arange(len(ql) * pages_per_seq,
+                        dtype=jnp.int32).reshape(len(ql), pages_per_seq)
+        _compile(lambda q, kp, vp: ragged_paged_attention_values(
+            q, kp, vp, qs, ql, cl, bt, block_q=8), q, kp, kp)
+        _compile(lambda q, kp, vp: ragged_paged_attention_values(
+            q, kp, vp, qs, ql, cl, bt, window=512, block_q=8),
+            q, kp, kp)
+        # decode form: block_q=1, one query per sequence
+        b = 8
+        qs1 = np.arange(b, dtype=np.int32)
+        ql1 = np.ones(b, np.int32)
+        cl1 = np.full(b, 2000, np.int32)
+        q1 = jnp.zeros((b, BENCH_H, BENCH_D), jnp.bfloat16)
+        kp1 = jnp.zeros((BENCH_HK, b * pages_per_seq, page, BENCH_D),
+                        jnp.bfloat16)
+        bt1 = jnp.arange(b * pages_per_seq, dtype=jnp.int32).reshape(
+            b, pages_per_seq)
+        _compile(lambda q, kp, vp: ragged_paged_attention_values(
+            q, kp, vp, qs1, ql1, cl1, bt1, block_q=1), q1, kp1, kp1)
+
+
 class TestGroupedMatmulCompile:
     def test_gmm_bench_shape(self):
         from paddle_tpu.ops.grouped_matmul import gmm_pallas
