@@ -8,7 +8,10 @@ vs_baseline is MFU / 0.40 — the BASELINE.json north-star target MFU
 Serving-latency detail now carries TTFT/TPOT p50/p95/p99 (the SLO axes,
 interpolated from the telemetry histograms via
 `observability.slo.quantile_from_buckets`) under
-`detail.engine_telemetry` and each `detail.router` fleet run.
+`detail.engine_telemetry` and each `detail.router` fleet run, plus a
+`detail.disagg` disaggregated-vs-colocated A/B (TTFT/TPOT p50/p95 per
+mode, migration latency histogram, outputs-identical cross-check —
+ISSUE 8) whose tokens/sec both gate regressions.
 
 Regression gate: `bench.py --check-regression PREV.json
 [--regression-threshold PCT]` runs the bench, emits the JSON line as
@@ -222,6 +225,8 @@ REGRESSION_METRICS = (
     "detail.router.replicas_4_affinity.tokens_per_sec",
     "detail.paged_attention.decode_tokens_per_sec_ragged",
     "detail.paged_attention.mixed_tokens_per_sec_ragged",
+    "detail.disagg.colocated.tokens_per_sec",
+    "detail.disagg.disaggregated.tokens_per_sec",
 )
 
 
@@ -415,6 +420,91 @@ def bench_router(model, cfg, on_tpu: bool) -> dict:
             "affinity_vs_round_robin_prefix_reuse": round(
                 four["prefix_tokens_reused"]
                 / max(1, four_rr["prefix_tokens_reused"]), 3),
+        }}
+    finally:
+        model.train()
+
+
+def bench_disagg(model, cfg, on_tpu: bool) -> dict:
+    """Disaggregated-vs-colocated A/B (ISSUE 8): the SAME shared-prefix
+    workload through a colocated fleet and a prefill:N,decode:N fleet —
+    TTFT and TPOT p50/p95 per mode, aggregate tokens/sec (both gated by
+    --check-regression), the migration latency histogram
+    (pdt_transfer_seconds), and an outputs-identical cross-check of the
+    acceptance property. CPU-mesh proxy numbers like bench_router:
+    replicas are engines stepped in one process, so the A/B measures
+    scheduling + transfer overhead, not parallel speedup."""
+    import numpy as np
+    import paddle_tpu.observability as telemetry
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+    from paddle_tpu.serving import ServingRouter
+
+    model.eval()
+    page = 16
+    if on_tpu:
+        groups, per_group, sys_pages, new_toks, slots = 6, 6, 8, 32, 4
+        roles = "prefill:2,decode:2"
+    else:
+        groups, per_group, sys_pages, new_toks, slots = 2, 4, 2, 6, 2
+        roles = "prefill:1,decode:1"
+    n_replicas = sum(int(p.split(":")[1]) for p in roles.split(","))
+    rng = np.random.default_rng(0)
+    prompts = []
+    for g in range(groups):
+        system = rng.integers(1, cfg.vocab_size, sys_pages * page).tolist()
+        for _ in range(per_group):
+            prompts.append(system + rng.integers(
+                1, cfg.vocab_size, int(rng.integers(3, 7))).tolist())
+
+    def fleet_run(mode_roles):
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            router = ServingRouter(
+                lambda i: ContinuousBatchingEngine(
+                    model, max_batch_size=slots, page_size=page,
+                    max_seq_len=sys_pages * page + 64,
+                    enable_prefix_caching=True,
+                    attention_impl=ATTENTION_IMPL),
+                num_replicas=n_replicas, policy="prefix_affinity",
+                page_size=page, roles=mode_roles)
+            ids = [router.submit(p, max_new_tokens=new_toks)
+                   for p in prompts]
+            t0 = time.perf_counter()
+            out = router.run()
+            dt = time.perf_counter() - t0
+            info = router.fleet_info()
+            hists = telemetry.snapshot()["histograms"]
+        finally:
+            telemetry.disable(clear_override=True)
+        toks = sum(len(v) for v in out.values())
+        stats = {
+            "tokens_per_sec": round(toks / dt, 1),
+            "ttft_quantiles_s": _hist_quantiles(
+                hists.get("pdt_serving_ttft_seconds", {}).get(""),
+                qs=(0.5, 0.95)),
+            "tpot_quantiles_s": _hist_quantiles(
+                hists.get("pdt_serving_tpot_seconds", {}).get(""),
+                qs=(0.5, 0.95)),
+            "migrations": info.get("migrations", 0),
+            "prefix_tokens_reused": int(info["prefix_tokens_reused"]),
+        }
+        if mode_roles is not None:
+            stats["migration_latency_s"] = _hist_quantiles(
+                hists.get("pdt_transfer_seconds", {}).get(""),
+                qs=(0.5, 0.95))
+            stats["prefix_store"] = info.get("prefix_store")
+        return stats, [out[i] for i in ids]
+
+    try:
+        colo, out_c = fleet_run(None)
+        disagg, out_d = fleet_run(roles)
+        return {"disagg": {
+            "roles": roles,
+            "colocated": colo,
+            "disaggregated": disagg,
+            # the acceptance property, re-proved on the bench workload
+            "outputs_identical": out_c == out_d,
         }}
     finally:
         model.train()
@@ -682,6 +772,10 @@ def run_bench(on_tpu: bool) -> dict:
         detail.update(bench_router(model, cfg, on_tpu))
     except Exception:
         detail["router_error"] = traceback.format_exc(limit=3)[-400:]
+    try:
+        detail.update(bench_disagg(model, cfg, on_tpu))
+    except Exception:
+        detail["disagg_error"] = traceback.format_exc(limit=3)[-400:]
     try:
         detail.update(bench_paged_attention(on_tpu))
     except Exception:

@@ -317,6 +317,8 @@ class ContinuousBatchingEngine:
             self._slot_shared_pages: List[List[int]] = \
                 [[] for _ in range(self.B)]
             self._suffix_jits: "OrderedDict[tuple, object]" = OrderedDict()
+            # migration/prefix-store page-content installs, by count
+            self._install_jits: "OrderedDict[int, object]" = OrderedDict()
             self.prefix_hits = 0
             self.prefix_tokens_reused = 0
             # chunked prefill (vLLM-style): prompts longer than the
@@ -535,6 +537,266 @@ class ContinuousBatchingEngine:
             if req is not None and req.rid == rid:
                 return req
         return None
+
+    # -- migration hooks (serving/transfer.py, disaggregated fleets) ----
+    def _resident_slot(self, rid: int) -> int:
+        for i, r in enumerate(self._slot_req):
+            if r is not None and r.rid == rid:
+                return i
+        raise ValueError(f"no resident request with rid {rid} (queued "
+                         "or terminal requests hold no pages)")
+
+    def export_pages(self, rid: int) -> dict:
+        """Serialize a RUNNING request's resident KV pages + request
+        state for migration into another engine (the disaggregated
+        prefill/decode transfer plane, serving/transfer.py).
+        READ-ONLY: the request keeps running here until
+        `evict_request`, so a failure anywhere downstream leaves this
+        engine untouched. The payload's `kv` entries are host numpy,
+        per layer, shaped (hk, n_pages, page_size, hd) over the slot's
+        live block-table window — the D2H gather is the transfer
+        plane's serialize cost."""
+        if self.layout != "paged":
+            raise ValueError("export_pages requires the paged layout")
+        slot = self._resident_slot(rid)
+        req = self._slot_req[slot]
+        freed = int(self._slot_freed[slot])
+        n_idx = int(self._slot_next_idx[slot])
+        pages = np.asarray(self._bt[slot, freed:n_idx], np.int32)
+        L, hk, hd, dt = self._kv_shape
+        now = self._clock()
+        return {
+            "request_id": req.request_id,
+            "prompt": list(req.prompt),
+            "output": list(req.output),
+            "max_new_tokens": req.max_new_tokens,
+            "deadline_remaining": None if req.deadline is None
+            else req.deadline - now,
+            # ages, not absolutes: the target rebases them on ITS clock
+            # so TPOT keeps dividing by the full first-token-to-finish
+            # interval across the move
+            "first_token_age": None if req.first_token_time is None
+            else now - req.first_token_time,
+            "preemptions": req.preemptions,
+            "ctx": int(self._pos[slot]),
+            "last_token": int(self._tok[slot]),
+            "freed": freed,
+            "n_pages": int(n_idx - freed),
+            "page_size": self.page_size,
+            "max_seq_len": self.S,
+            "kv_spec": (L, hk, hd, str(jnp.dtype(dt))),
+            "kv": [(np.asarray(kp[:, pages]), np.asarray(vp[:, pages]))
+                   for kp, vp in self._kv],
+        }
+
+    def import_pages(self, payload: dict,
+                     deadline: Optional[float] = None) -> Request:
+        """Install a serialized request (`export_pages` payload) into
+        this engine: claim a free slot, attach any prompt prefix this
+        engine's own trie already holds READ-ONLY (a migrated system
+        prompt costs no page copies the second time), allocate the
+        remaining pages and write their contents in one donated
+        program, then re-register the installed chain in the prefix
+        structures so it is warm for the NEXT migration. `deadline`
+        (seconds from now on this engine's clock) overrides the
+        payload's remaining budget. Transactional: any failure backs
+        the slot out, so `check_invariants()` holds on both sides of
+        every outcome. Raises EngineOverloaded (no free slot) /
+        PoolExhausted (no pages) when the engine cannot take it NOW —
+        capacity deferrals, distinct from transfer failures."""
+        if self.layout != "paged":
+            raise ValueError("import_pages requires the paged layout")
+        L, hk, hd, dt = self._kv_shape
+        spec = tuple(payload["kv_spec"])
+        mine = (L, hk, hd, str(jnp.dtype(dt)))
+        if spec != mine:
+            raise ValueError(f"kv geometry mismatch: payload {spec} vs "
+                             f"engine {mine}")
+        if payload["page_size"] != self.page_size:
+            raise ValueError(
+                f"page_size mismatch: payload {payload['page_size']} "
+                f"vs engine {self.page_size}")
+        ctx = int(payload["ctx"])
+        if ctx >= self.S:
+            raise ValueError(f"context {ctx} does not fit max_seq_len "
+                             f"{self.S}")
+        free = [i for i, r in enumerate(self._slot_req) if r is None]
+        if not free:
+            raise EngineOverloaded("no free slot for a migration "
+                                   "import — retry after a step")
+        now = self._clock()
+        budget = payload["deadline_remaining"] if deadline is None \
+            else deadline
+        req = Request(self._next_rid, list(payload["prompt"]),
+                      int(payload["max_new_tokens"]),
+                      output=list(payload["output"]),
+                      status=RequestStatus.RUNNING,
+                      deadline=None if budget is None else now + budget,
+                      enqueue_time=now, arrival_time=now,
+                      preemptions=int(payload.get("preemptions", 0)),
+                      first_token_time=None
+                      if payload.get("first_token_age") is None
+                      else now - payload["first_token_age"],
+                      request_id=payload["request_id"])
+        freed = int(payload["freed"])
+        shared = None
+        if self._prefix_enabled and not freed:
+            shared = self._match_prefix(req.prompt)
+            if shared is not None:
+                shared = list(shared)
+                for p in shared:
+                    self._incref(p)        # pin across _reserve_ok
+        if not self._reserve_ok(req, len(shared) if shared else 0):
+            if shared:
+                for p in shared:
+                    self._decref(p)
+            raise PoolExhausted(
+                "migration import cannot reserve worst-case pages — "
+                "retry after running requests release")
+        slot = free[0]
+        self._slot_req[slot] = req
+        self._slot_seq[slot] = self._admit_seq
+        self._admit_seq += 1
+        self._next_rid += 1
+        try:
+            m = 0
+            try:
+                if shared:
+                    self._attach_shared(slot, shared)
+                    m = len(shared)
+            finally:
+                if shared:
+                    for p in shared:
+                        self._decref(p)    # unpin: the slot holds refs
+            if freed:
+                # window engines: the slid-out leading pages stay
+                # trash-routed on the target too
+                self._slot_next_idx[slot] = freed
+                self._slot_freed[slot] = freed
+            self._slot_reserved[slot] = self._worst_pages(req)
+            n_total = freed + int(payload["n_pages"])
+            while int(self._slot_next_idx[slot]) < n_total:
+                self._alloc_page(slot)
+            start = m if m else freed
+            ids = [int(self._bt[slot, j]) for j in range(start, n_total)]
+            off = start - freed
+            self._install_kv(ids, [(kp[:, off:], vp[:, off:])
+                                   for kp, vp in payload["kv"]])
+            if self._prefix_enabled and not freed:
+                self._register_prefix(slot, req)
+            if shared:
+                self.prefix_hits += 1
+                self.prefix_tokens_reused += m * self.page_size
+        except BaseException:
+            self._release_slot(slot, register=False)
+            raise
+        self._pos[slot] = ctx
+        self._tok[slot] = int(payload["last_token"])
+        if self._invariants_enabled():
+            self.check_invariants()
+        return req
+
+    def evict_request(self, rid: int) -> Request:
+        """Detach a live request WITHOUT a terminal transition — the
+        migration hand-off (its pages now live in another engine). A
+        running slot is released exactly like a finished request's
+        (prompt full pages register into the prefix trie, so the chain
+        stays warm HERE for future prefills); a queued request just
+        leaves the queue. Terminal counters are untouched: the request
+        finishes, exactly once, wherever it lands."""
+        for i, r in enumerate(self._slot_req):
+            if r is not None and r.rid == rid:
+                self._release_slot(i)
+                return r
+        for i, r in enumerate(self._queue):
+            if r.rid == rid:               # pre-admission hand-off
+                self._queue.pop(i)
+                return r
+        raise ValueError(f"no live request with rid {rid}")
+
+    def import_prefix(self, pages_tokens: List[List[int]],
+                      kv_rows) -> int:
+        """Install an externally-held prefix chain (the fleet prefix
+        store's host-RAM spill, serving/prefix_store.py) into this
+        engine's prefix cache: `pages_tokens` is a list of FULL-page
+        token lists forming one chain from position 0, `kv_rows` the
+        per-layer (k, v) page contents shaped (hk, n, page_size, hd).
+        Pages already in the trie are skipped (trie keys are exact
+        tokens, so contents are identical by construction); missing
+        ones — always a chain SUFFIX, existence is prefix-closed —
+        allocate, install, and register with their refcount held by
+        the trie node, evictable under pressure like any cached chain.
+        Installs draw ONLY on genuinely free pages — restoring a cold
+        chain never evicts resident (warmer-by-definition) cached
+        chains, and, critically, never mutates the trie mid-build
+        (an eviction between registrations could delete a node the
+        chain under construction already linked through). Returns the
+        pages newly installed (0 when prefix caching is off, the
+        chain is already resident, or the pool has nothing free)."""
+        if self.layout != "paged" or not self._prefix_enabled:
+            return 0
+        parent, missing_from = None, None
+        for f, ptoks in enumerate(pages_tokens):
+            if len(ptoks) != self.page_size:
+                raise ValueError("import_prefix needs FULL pages "
+                                 f"(page {f} has {len(ptoks)} tokens)")
+            key = (parent, tuple(int(t) for t in ptoks))
+            if missing_from is None and key not in self._prefix_nodes:
+                missing_from = f
+            parent = key
+        if missing_from is None:
+            return 0                       # chain already resident
+        page_ids, parent = [], None
+        for f, ptoks in enumerate(pages_tokens):
+            key = (parent, tuple(int(t) for t in ptoks))
+            if f < missing_from:
+                self._prefix_nodes.move_to_end(key)
+                parent = key
+                continue
+            if not self._free:
+                break                      # install what fits for free
+            page = self._free.pop()
+            self._page_rc[page] = 1        # held by the trie node
+            self._prefix_nodes[key] = {"page": page, "parent": parent,
+                                       "children": 0}
+            if parent is not None:
+                self._prefix_nodes[parent]["children"] += 1
+            page_ids.append(page)
+            parent = key
+        if page_ids:
+            end = missing_from + len(page_ids)
+            self._install_kv(
+                page_ids, [(kp[:, missing_from:end],
+                            vp[:, missing_from:end])
+                           for kp, vp in kv_rows])
+        # entry-budget cap AFTER content lands: an eviction here can
+        # only take a fully-installed, consistent node
+        while len(self._prefix_nodes) > self._max_prefix_entries:
+            if not self._evict_one():
+                break
+        return len(page_ids)
+
+    def _install_kv(self, page_ids: List[int], rows):
+        """Write transferred page contents into the pool — one donated
+        program per page count, LRU-capped like the scatter programs
+        (migration imports + prefix-store spill restores land here)."""
+        n = len(page_ids)
+        jit = self._install_jits.get(n)
+        if jit is None:
+            def _ins(kv, ids_, rows_):
+                return [(kp.at[:, ids_].set(rk.astype(kp.dtype)),
+                         vp.at[:, ids_].set(rv.astype(vp.dtype)))
+                        for (kp, vp), (rk, rv) in zip(kv, rows_)]
+            jit = jax.jit(_ins, donate_argnums=(0,))
+            self._install_jits[n] = jit
+            while len(self._install_jits) > self._max_prefill:
+                self._install_jits.popitem(last=False)      # LRU
+        else:
+            self._install_jits.move_to_end(n)
+        self._kv = jit(self._kv,
+                       jnp.asarray(np.asarray(page_ids, np.int32)),
+                       [(jnp.asarray(rk), jnp.asarray(rv))
+                        for rk, rv in rows])
 
     def _expire(self) -> List[Request]:
         """Monotonic-clock tick: finalize queued/running requests whose

@@ -8,7 +8,11 @@
         --chrome OUT.json
     python -m paddle_tpu.observability trace tree IN.jsonl
         --request REQUEST_ID
+    python -m paddle_tpu.observability status --from FLEET.json
 
+`status` renders a saved `ServingRouter.fleet_info()` snapshot as the
+operator report (per-replica role + health, role aggregates,
+prefix-store stats, SLO verdicts — status.render_fleet_status).
 `snapshot` converts between the two export forms: load a saved JSON
 snapshot (`telemetry.write_json`) or a Prometheus text dump
 (`.prom` / `.txt`, parsed with `parse_prometheus`) and render it as
@@ -84,6 +88,18 @@ def _cmd_slo(args) -> int:
     return 1 if any(not st.ok for st in statuses.values()) else 0
 
 
+def _cmd_status(args) -> int:
+    from .status import render_fleet_status
+    with open(args.src) as f:
+        info = json.load(f)
+    if not isinstance(info, dict) or "replicas" not in info:
+        raise SystemExit(f"{args.src}: not a fleet_info() snapshot "
+                         "(JSON object with a 'replicas' list "
+                         "expected)")
+    print(render_fleet_status(info))
+    return 0
+
+
 def _cmd_trace_export(args) -> int:
     evts = _trace.load_trace_jsonl(args.jsonl)
     doc = _trace.export_chrome_trace(evts, path=args.chrome)
@@ -130,6 +146,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "objectives)")
     s.add_argument("--warn-burn", type=float, default=0.5)
     s.set_defaults(fn=_cmd_slo)
+
+    s = sub.add_parser("status", help="render a saved fleet_info() "
+                                      "snapshot (roles, SLO, prefix "
+                                      "store)")
+    s.add_argument("--from", dest="src", metavar="FLEET.json",
+                   required=True)
+    s.set_defaults(fn=_cmd_status)
 
     t = sub.add_parser("trace", help="trace tooling")
     tsub = t.add_subparsers(dest="trace_cmd", required=True)

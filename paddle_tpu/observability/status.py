@@ -1,10 +1,12 @@
 """Human-readable fleet status: the operator's one-glance surface.
 
 `render_fleet_status` turns `ServingRouter.fleet_info()` (per-replica
-health, queue depths, restart counts, the prefix-cache aggregate, and —
-when an `SloMonitor` is attached — per-replica and fleet-level SLO
-verdicts) into the fixed-width report `recipes/llama_serve.py` prints
-after its drills. Pure formatting: no registry reads, no side effects,
+role + health, queue depths, restart counts, the prefix-cache
+aggregate, role aggregates + prefix-store stats for disaggregated
+fleets, and — when an `SloMonitor` is attached — per-replica and
+fleet-level SLO verdicts) into the fixed-width report
+`recipes/llama_serve.py` prints after its drills; `paddle-tpu-obs
+status --from fleet.json` renders a saved snapshot. Pure formatting: no registry reads, no side effects,
 so it can render a `fleet_info()` dict captured anywhere (a log line, a
 post-mortem dump, a test)."""
 from __future__ import annotations
@@ -17,8 +19,8 @@ __all__ = ["render_fleet_status"]
 def render_fleet_status(info: Dict[str, object]) -> str:
     """Format one `ServingRouter.fleet_info()` snapshot."""
     lines: List[str] = ["fleet status"]
-    lines.append(f"  {'replica':<8} {'state':<9} {'outstanding':>11} "
-                 f"{'restarts':>8} {'slo':<7} note")
+    lines.append(f"  {'replica':<8} {'role':<10} {'state':<9} "
+                 f"{'outstanding':>11} {'restarts':>8} {'slo':<7} note")
     for r in info.get("replicas", []):
         slo = r.get("slo")
         note = r.get("death_reason") or ""
@@ -26,7 +28,8 @@ def render_fleet_status(info: Dict[str, object]) -> str:
             note = (note + " " if note else "") \
                 + f"{r['consecutive_failures']} consecutive failures"
         lines.append(
-            f"  {r['index']:<8} {r['state']:<9} "
+            f"  {r['index']:<8} {r.get('role', 'colocated'):<10} "
+            f"{r['state']:<9} "
             f"{r['outstanding']:>11} {r['restarts']:>8} "
             f"{(slo.upper() if slo else '-'):<7} {note}".rstrip())
     lines.append(
@@ -34,9 +37,31 @@ def render_fleet_status(info: Dict[str, object]) -> str:
         f"{info.get('pending', 0)} pending; "
         f"failovers {info.get('failovers', 0)}, "
         f"restarts {info.get('restarts', 0)}")
+    roles: Optional[Dict[str, dict]] = info.get("roles")  # type: ignore
+    if roles:
+        parts = [
+            f"{name}={d.get('replicas', 0)} "
+            f"(queue {d.get('queue_depth', 0)}, "
+            f"{d.get('migrations', 0)} migrated)"
+            for name, d in roles.items()]
+        lines.append(
+            "  roles: " + " ".join(parts)
+            + f"; migrations {info.get('migrations', 0)}")
     lines.append(
         f"  prefix cache: {info.get('prefix_hits', 0)} hits, "
         f"{info.get('prefix_tokens_reused', 0)} tokens reused")
+    store: Optional[Dict[str, object]] = \
+        info.get("prefix_store")  # type: ignore
+    if store:
+        hr = store.get("hit_rate")
+        lines.append(
+            f"  prefix store: {store.get('chains', 0)} chains "
+            f"({store.get('spilled_chains', 0)} spilled, "
+            f"{store.get('spilled_bytes', 0)} B), "
+            f"hits {store.get('hits', 0)} replica / "
+            f"{store.get('spill_hits', 0)} spill, "
+            f"{store.get('misses', 0)} miss"
+            + (f"; hit rate {hr:.2f}" if hr is not None else ""))
     slo: Optional[Dict[str, dict]] = info.get("slo")  # type: ignore
     if slo:
         parts = []

@@ -18,29 +18,46 @@ arXiv:2605.25645):
   replica supervision via the `router.*` fault sites, and ZERO-LOSS
   failover (streamed tokens fold into a survivor's re-prefill — the
   engine-preemption recovery shape, one level up).
+* `transfer.py` — the KV page transfer plane (ISSUE 8): serialize a
+  finished prefill's pages + request state out of one engine and
+  install them into another's paged cache, the disaggregated
+  prefill/decode hand-off (`transfer.serialize`/`transfer.install`
+  fault sites, `pdt_transfer_*` telemetry).
+* `prefix_store.py` — the fleet-wide prefix store: page-aligned chain
+  hashes shared across replicas (replacing per-replica warmth sets for
+  role-aware fleets) with host-RAM spill for cold chains, so a warm
+  prefix outlives the replicas that computed it.
 
-Telemetry rides `pdt_router_*` (docs/serving.md "Fleet"); every future
-scale layer (disaggregated prefill, autoscaling, multi-host replicas)
-builds on this one.
+Telemetry rides `pdt_router_*` / `pdt_transfer_*` /
+`pdt_prefix_store_*` (docs/serving.md "Fleet" + "Disaggregation");
+every future scale layer (autoscaling, multi-host replicas) builds on
+this one.
 
     from paddle_tpu.serving import ServingRouter
 
     router = ServingRouter(lambda i: ContinuousBatchingEngine(model),
-                           num_replicas=4, policy="prefix_affinity",
-                           page_size=16)
+                           roles="prefill:2,decode:2",
+                           policy="prefix_affinity", page_size=16)
     rid = router.submit(prompt, max_new_tokens=64)
     outputs = router.run()          # {request_id: tokens}
 """
 from .policy import (DispatchPolicy, LeastOutstandingPolicy,  # noqa: F401
                      POLICIES, PrefixAffinityPolicy, RoundRobinPolicy,
                      make_policy)
-from .replica import ReplicaHandle, ReplicaState  # noqa: F401
+from .prefix_store import FleetPrefixStore, chain_hashes  # noqa: F401
+from .replica import (ReplicaHandle, ReplicaRole,  # noqa: F401
+                      ReplicaState)
 from .router import (FleetOverloaded, FleetRequest,  # noqa: F401
-                     ServingRouter)
+                     ServingRouter, parse_roles)
+from .transfer import (install_request, migrate_request,  # noqa: F401
+                       payload_nbytes, serialize_request)
 
 __all__ = [
-    "ServingRouter", "FleetRequest", "FleetOverloaded",
-    "ReplicaHandle", "ReplicaState",
+    "ServingRouter", "FleetRequest", "FleetOverloaded", "parse_roles",
+    "ReplicaHandle", "ReplicaState", "ReplicaRole",
     "DispatchPolicy", "RoundRobinPolicy", "LeastOutstandingPolicy",
     "PrefixAffinityPolicy", "POLICIES", "make_policy",
+    "FleetPrefixStore", "chain_hashes",
+    "serialize_request", "install_request", "migrate_request",
+    "payload_nbytes",
 ]

@@ -28,6 +28,7 @@ from __future__ import annotations
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence
 
+from .prefix_store import FleetPrefixStore, chain_hashes
 from .replica import ReplicaHandle
 
 __all__ = ["DispatchPolicy", "RoundRobinPolicy", "LeastOutstandingPolicy",
@@ -85,30 +86,31 @@ class PrefixAffinityPolicy(DispatchPolicy):
 
     name = "prefix_affinity"
 
-    def __init__(self, page_size: int = 16, max_tracked: int = 4096):
+    def __init__(self, page_size: int = 16, max_tracked: int = 4096,
+                 store: Optional[FleetPrefixStore] = None):
         self.page_size = int(page_size)
         self.max_tracked = int(max_tracked)
-        # replica index -> LRU set of warm chain hashes
+        # replica index -> LRU set of warm chain hashes; superseded by
+        # the FLEET prefix store when one is attached (role-aware
+        # fleets): warmth then lives in one shared structure that also
+        # spills cold chains to host RAM (prefix_store.py)
         self._warm: Dict[int, "OrderedDict[int, None]"] = {}
+        self.store = store
         # select() diagnostics the router reads for the hit-rate metric
         self.last_match_pages = 0
 
     def _chain_hashes(self, prompt: List[int]) -> List[int]:
-        """Rolling hash per FULL page of the prompt, capped one page
-        short of the whole prompt (the engine can never share the final
-        token — its logits seed decoding), mirroring
-        `ContinuousBatchingEngine._match_prefix`. Tuple-of-int hashing
-        is stable within a process and unsalted across runs."""
-        ps = self.page_size
-        n = (len(prompt) - 1) // ps
-        hashes, h = [], 0
-        for f in range(n):
-            h = hash((h, tuple(prompt[f * ps:(f + 1) * ps])))
-            hashes.append(h)
-        return hashes
+        """Rolling hash per FULL page of the prompt (the shared
+        definition in prefix_store.py — one scheme for the policy, the
+        fleet store, and the engine-trie shape they both mirror).
+        Tuple-of-int hashing is stable within a process and unsalted
+        across runs."""
+        return chain_hashes(prompt, self.page_size)
 
     def _longest_warm(self, replica_index: int,
                       hashes: List[int]) -> int:
+        if self.store is not None:
+            return self.store.longest_warm(replica_index, hashes)
         warm = self._warm.get(replica_index)
         if not warm:
             return 0
@@ -134,6 +136,9 @@ class PrefixAffinityPolicy(DispatchPolicy):
         return min(candidates, key=lambda h: (h.outstanding(), h.index))
 
     def on_dispatch(self, replica, prompt):
+        if self.store is not None:
+            self.store.record(replica.index, prompt)
+            return
         warm = self._warm.setdefault(replica.index, OrderedDict())
         for h in self._chain_hashes(prompt):
             if h in warm:
@@ -144,6 +149,8 @@ class PrefixAffinityPolicy(DispatchPolicy):
             warm.popitem(last=False)
 
     def forget(self, replica_index: int):
+        if self.store is not None:
+            self.store.forget_replica(replica_index)
         self._warm.pop(replica_index, None)
 
 
@@ -154,15 +161,24 @@ POLICIES = {
 }
 
 
-def make_policy(policy, page_size: int = 16) -> DispatchPolicy:
+def make_policy(policy, page_size: int = 16,
+                store: Optional[FleetPrefixStore] = None
+                ) -> DispatchPolicy:
     """Accepts a policy NAME (see `POLICIES`) or an instance.
     `page_size` seeds prefix-affinity hashing and must match the
-    engines' page size for warmth tracking to mirror their tries."""
+    engines' page size for warmth tracking to mirror their tries.
+    `store` (role-aware fleets) attaches the fleet-wide prefix store
+    to prefix-affinity warmth tracking."""
     if isinstance(policy, DispatchPolicy):
+        if store is not None and isinstance(policy,
+                                            PrefixAffinityPolicy) \
+                and policy.store is None:
+            policy.store = store
         return policy
     if policy in POLICIES:
         if policy == PrefixAffinityPolicy.name:
-            return PrefixAffinityPolicy(page_size=page_size)
+            return PrefixAffinityPolicy(page_size=page_size,
+                                        store=store)
         return POLICIES[policy]()
     raise ValueError(f"unknown dispatch policy {policy!r}: "
                      f"{sorted(POLICIES)} or a DispatchPolicy instance")

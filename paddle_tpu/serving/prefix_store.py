@@ -1,0 +1,246 @@
+"""Fleet-wide prefix store: shared warmth + host-RAM spill for KV chains.
+
+The engines run vLLM-style automatic prefix caching keyed on
+PAGE-ALIGNED token prefixes (models/serving.py), and PR 4's
+`prefix_affinity` policy mirrored that structure as *per-replica* LRU
+sets — a prefix was an asset of exactly one replica, and died with it.
+Disaggregated fleets (serving/transfer.py, ISSUE 8) need the fleet view:
+
+* **Warmth tracking** — one `FleetPrefixStore` replaces the policy's
+  per-replica sets: every chain hash (rolling hash per FULL page,
+  h_f = hash((h_{f-1}, page_f tokens)) — the engine-trie shape) maps to
+  the set of replicas believed to hold it warm, so a prefix warm on ANY
+  prefill replica is reachable by all (the router routes to it).
+* **Host-RAM spill** — cold chains keep their actual KV page CONTENT in
+  host RAM under a byte budget: the transfer plane already serializes a
+  migrating request's prompt pages to host memory, so spilling them is
+  free, and when every replica holding a chain dies (or evicts it), the
+  next request with that prefix re-installs the spilled pages into its
+  prefill replica (`engine.import_prefix`) instead of recomputing the
+  prefill. Spill entries are LRU-bounded by `spill_budget_bytes`
+  (content dropped, warmth records kept).
+
+The store is process-local host state (≙ a serving cell's prefix
+directory + host-RAM cache tier): no device memory, no threads,
+deterministic given the call sequence — the router drives it from its
+step loop. Telemetry rides `pdt_prefix_store_*` (docs/observability.md).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import observability as telemetry
+
+__all__ = ["FleetPrefixStore", "chain_hashes"]
+
+
+_M_CHAINS = telemetry.gauge(
+    "pdt_prefix_store_chains",
+    "Chains tracked by the fleet prefix store.")
+_M_SPILL_BYTES = telemetry.gauge(
+    "pdt_prefix_store_spilled_bytes",
+    "Host-RAM bytes held by spilled chain KV content.")
+_M_HITS = telemetry.counter(
+    "pdt_prefix_store_hits_total",
+    "Store lookups that found the prefix warm, by source "
+    "(replica = routed to a warm replica, spill = re-installed from "
+    "host RAM).", ("source",))
+_M_MISSES = telemetry.counter(
+    "pdt_prefix_store_misses_total",
+    "Store lookups that found the prefix nowhere in the fleet.")
+_M_EVICTIONS = telemetry.counter(
+    "pdt_prefix_store_evictions_total",
+    "Chain records or spill payloads evicted under the store bounds.")
+
+
+def chain_hashes(prompt: Sequence[int], page_size: int) -> List[int]:
+    """Rolling hash per FULL page of `prompt`, capped one page short of
+    the whole prompt (the engine can never share the final token — its
+    logits seed decoding), mirroring the engine trie and
+    `ContinuousBatchingEngine._match_prefix`'s match cap. The shared
+    definition for `PrefixAffinityPolicy` and the fleet store — the two
+    must agree or warmth tracking silently diverges from routing."""
+    ps = int(page_size)
+    n = (len(prompt) - 1) // ps
+    hashes, h = [], 0
+    for f in range(n):
+        h = hash((h, tuple(prompt[f * ps:(f + 1) * ps])))
+        hashes.append(h)
+    return hashes
+
+
+class FleetPrefixStore:
+    """Fleet-wide chain warmth + host-RAM spill (module docstring).
+
+    One entry per chain hash, LRU-ordered: ``replicas`` is the set of
+    replica indices believed warm; spilled entries additionally carry
+    the page's token tuple and per-layer KV content (numpy, host RAM).
+    `max_chains` bounds the entry count; `spill_budget_bytes` bounds
+    the CONTENT bytes (evicting content keeps the warmth record)."""
+
+    def __init__(self, page_size: int, max_chains: int = 4096,
+                 spill_budget_bytes: int = 32 << 20):
+        self.page_size = int(page_size)
+        self.max_chains = int(max_chains)
+        self.spill_budget_bytes = int(spill_budget_bytes)
+        # hash -> {"parent": hash|None, "replicas": set,
+        #          "tokens": tuple|None, "kv": [(k, v)]|None, "bytes": int}
+        self._chains: "OrderedDict[int, dict]" = OrderedDict()
+        self.spilled_bytes = 0
+        # python-side counters so fleet_info works without telemetry
+        self.hits = 0
+        self.spill_hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- warmth ----------------------------------------------------------
+    def _touch(self, h: int, parent: Optional[int]) -> dict:
+        entry = self._chains.get(h)
+        if entry is None:
+            entry = {"parent": parent, "replicas": set(),
+                     "tokens": None, "kv": None, "bytes": 0}
+            self._chains[h] = entry
+            self._cap_chains()
+        else:
+            self._chains.move_to_end(h)
+        return entry
+
+    def record(self, replica_index: int, prompt: Sequence[int]):
+        """Replica `replica_index` now holds this prompt's chain warm
+        (a dispatch placed it there, or a migration installed it)."""
+        parent = None
+        for h in chain_hashes(prompt, self.page_size):
+            self._touch(h, parent)["replicas"].add(int(replica_index))
+            parent = h
+        _M_CHAINS.set(len(self._chains))
+
+    def longest_warm(self, replica_index: int,
+                     hashes: Sequence[int]) -> int:
+        """Pages of `hashes` warm on `replica_index`, from the front."""
+        depth = 0
+        for h in hashes:
+            entry = self._chains.get(h)
+            if entry is None or replica_index not in entry["replicas"]:
+                break
+            depth += 1
+        return depth
+
+    def forget_replica(self, replica_index: int):
+        """The replica died: its warmth is gone (its KV pool died with
+        it) — but spilled content lives in HOST RAM and survives."""
+        for entry in self._chains.values():
+            entry["replicas"].discard(int(replica_index))
+
+    # -- host-RAM spill --------------------------------------------------
+    def spill_payload(self, payload: dict) -> int:
+        """Spill the prompt chain of one transfer payload
+        (`serving.transfer.serialize_request` dict contract: `prompt`,
+        `page_size`, `freed`, and per-layer `kv` page arrays shaped
+        (hk, n_pages, page_size, hd)). The content is already host-side
+        numpy, so this is bookkeeping, not a device read. Returns the
+        number of pages spilled (0 for window engines — slid-out pages
+        make prompt KV non-stable — or a page-size mismatch)."""
+        if payload.get("freed") or payload["page_size"] != self.page_size:
+            return 0
+        prompt = payload["prompt"]
+        ps = self.page_size
+        hashes = chain_hashes(prompt, ps)
+        spilled, parent = 0, None
+        for f, h in enumerate(hashes):
+            entry = self._touch(h, parent)
+            parent = h
+            if entry["kv"] is not None:
+                continue                       # already spilled
+            kv = [(np.asarray(kp[:, f]), np.asarray(vp[:, f]))
+                  for kp, vp in payload["kv"]]
+            nbytes = sum(a.nbytes + b.nbytes for a, b in kv)
+            entry["tokens"] = tuple(prompt[f * ps:(f + 1) * ps])
+            entry["kv"] = kv
+            entry["bytes"] = nbytes
+            self.spilled_bytes += nbytes
+            spilled += 1
+        self._cap_spill()
+        _M_CHAINS.set(len(self._chains))
+        _M_SPILL_BYTES.set(self.spilled_bytes)
+        return spilled
+
+    def fetch(self, prompt: Sequence[int]):
+        """Longest spilled chain prefix of `prompt`, ready for
+        `engine.import_prefix`: (page token tuples, per-layer (k, v)
+        arrays shaped (hk, n, page_size, hd)), or None when nothing is
+        spilled for this prefix."""
+        chain = []
+        for h in chain_hashes(prompt, self.page_size):
+            entry = self._chains.get(h)
+            if entry is None or entry["kv"] is None:
+                break
+            self._chains.move_to_end(h)
+            chain.append(entry)
+        if not chain:
+            return None
+        tokens = [list(e["tokens"]) for e in chain]
+        layers = len(chain[0]["kv"])
+        kv_rows = [(np.stack([e["kv"][li][0] for e in chain], axis=1),
+                    np.stack([e["kv"][li][1] for e in chain], axis=1))
+                   for li in range(layers)]
+        return tokens, kv_rows
+
+    # -- accounting ------------------------------------------------------
+    def note_lookup(self, source: str):
+        """One routing decision's outcome: `replica` (warm replica
+        found), `spill` (restored from host RAM), or `miss`."""
+        if source == "replica":
+            self.hits += 1
+            _M_HITS.inc(source="replica")
+        elif source == "spill":
+            self.spill_hits += 1
+            _M_HITS.inc(source="spill")
+        else:
+            self.misses += 1
+            _M_MISSES.inc()
+
+    def stats(self) -> Dict[str, object]:
+        lookups = self.hits + self.spill_hits + self.misses
+        return {
+            "chains": len(self._chains),
+            "spilled_chains": sum(1 for e in self._chains.values()
+                                  if e["kv"] is not None),
+            "spilled_bytes": self.spilled_bytes,
+            "hits": self.hits,
+            "spill_hits": self.spill_hits,
+            "misses": self.misses,
+            "hit_rate": round((self.hits + self.spill_hits) / lookups, 4)
+            if lookups else None,
+        }
+
+    # -- bounds ----------------------------------------------------------
+    def _drop_content(self, entry: dict):
+        if entry["kv"] is not None:
+            self.spilled_bytes -= entry["bytes"]
+            entry["kv"] = None
+            entry["tokens"] = None
+            entry["bytes"] = 0
+            self.evictions += 1
+            _M_EVICTIONS.inc()
+
+    def _cap_chains(self):
+        while len(self._chains) > self.max_chains:
+            _, entry = self._chains.popitem(last=False)     # LRU
+            if entry["kv"] is not None:
+                self._drop_content(entry)   # counts the eviction
+            else:
+                self.evictions += 1
+                _M_EVICTIONS.inc()
+        _M_SPILL_BYTES.set(self.spilled_bytes)
+
+    def _cap_spill(self):
+        if self.spilled_bytes <= self.spill_budget_bytes:
+            return
+        for entry in self._chains.values():                 # LRU order
+            if self.spilled_bytes <= self.spill_budget_bytes:
+                break
+            self._drop_content(entry)
+        _M_SPILL_BYTES.set(self.spilled_bytes)

@@ -41,7 +41,24 @@ from ..distributed.launch import restart_backoff
 from ..models.serving import ContinuousBatchingEngine, Request
 from ..utils.faults import fault_point
 
-__all__ = ["ReplicaHandle", "ReplicaState"]
+__all__ = ["ReplicaHandle", "ReplicaState", "ReplicaRole"]
+
+
+class ReplicaRole:
+    """Disaggregated serving roles (ISSUE 8, router.py `roles=`):
+    `prefill` replicas take fresh admissions and hand finished
+    prefills to the KV transfer plane, `decode` replicas receive
+    migrated pages and run the decode loop, `colocated` does both (the
+    PR-4 default). Roles steer SCHEDULING only — every engine keeps
+    both capabilities, which is what lets failover re-prefill stranded
+    work on ANY survivor, role notwithstanding."""
+
+    PREFILL = "prefill"
+    DECODE = "decode"
+    COLOCATED = "colocated"
+    ALL = frozenset({PREFILL, DECODE, COLOCATED})
+    # fresh submits may land here; decode replicas only take migrations
+    PREFILL_CAPABLE = frozenset({PREFILL, COLOCATED})
 
 
 class ReplicaState:
@@ -92,7 +109,16 @@ class ReplicaHandle:
                  restart_backoff_base: float = 1.0,
                  restart_backoff_max: float = 60.0,
                  max_restarts: Optional[int] = 5,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 role: str = ReplicaRole.COLOCATED):
+        if role not in ReplicaRole.ALL:
+            raise ValueError(f"unknown replica role {role!r}: "
+                             f"{sorted(ReplicaRole.ALL)}")
+        self.role = role
+        # transfer-plane traffic (survives restarts — the counters
+        # describe the SLOT in the fleet, not one engine incarnation)
+        self.migrations_in = 0
+        self.migrations_out = 0
         self.index = int(index)
         self._factory = engine_factory
         self._clock = clock

@@ -40,6 +40,19 @@ on the CPU test mesh, no threads, no sleeps inside `step()`):
   idempotent per `request_id`; with no survivor the request parks
   orphaned and retries after the next restart.
 
+* **Disaggregation** — with `roles="prefill:N,decode:M"` the fleet
+  splits the engine's two phases (docs/serving.md "Disaggregation"):
+  fresh submits land only on PREFILL-CAPABLE replicas (prefix-affine
+  dispatch as before), and every finished prefill migrates — KV pages
+  + request state through the transfer plane (`transfer.py`,
+  `router.migrate` span, `pdt_transfer_*`) — to the decode replica
+  with the fewest outstanding slots. The fleet-wide prefix store
+  (`prefix_store.py`) replaces per-replica warmth sets and spills cold
+  chains to host RAM, so a prefix outlives the replicas that computed
+  it. A SIGKILL of either transfer endpoint degrades to the ordinary
+  failover path: re-prefill on a survivor, greedy outputs
+  bit-identical to a colocated fleet.
+
 Telemetry (`pdt_router_*`, docs/serving.md "Fleet"): dispatch counters
 by {policy, replica}, failover/restart counters, per-replica state and
 queue-depth gauges, affinity hit-rate, fleet terminal counters that
@@ -68,11 +81,51 @@ from typing import Callable, Dict, List, Optional
 from .. import observability as telemetry
 from ..observability import trace as tracing
 from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
-                              Request, RequestStatus)
+                              PoolExhausted, Request, RequestStatus)
+from . import transfer
 from .policy import DispatchPolicy, PrefixAffinityPolicy, make_policy
-from .replica import ReplicaHandle, ReplicaState
+from .prefix_store import FleetPrefixStore
+from .replica import ReplicaHandle, ReplicaRole, ReplicaState
 
-__all__ = ["ServingRouter", "FleetRequest", "FleetOverloaded"]
+__all__ = ["ServingRouter", "FleetRequest", "FleetOverloaded",
+           "parse_roles"]
+
+
+def parse_roles(roles):
+    """Normalize a role spec into a per-replica role list: None (all
+    colocated), a ``"prefill:2,decode:1"`` string, a ``{role: count}``
+    dict, or an explicit per-index list. String/dict forms order
+    replicas prefill, then decode, then colocated — so
+    ``"prefill:2,decode:2"`` puts prefill on indices 0-1."""
+    if roles is None:
+        return None
+    if isinstance(roles, str):
+        spec = {}
+        for part in roles.split(","):
+            if not part.strip():
+                continue
+            name, _, count = part.partition(":")
+            spec[name.strip()] = int(count) if count.strip() else 1
+        roles = spec
+    if isinstance(roles, dict):
+        out = []
+        for name, count in roles.items():
+            if name not in ReplicaRole.ALL:
+                raise ValueError(f"unknown replica role {name!r}: "
+                                 f"{sorted(ReplicaRole.ALL)}")
+            if int(count) < 1:
+                raise ValueError(
+                    f"role count must be >= 1, got {name}:{count}")
+        for name in (ReplicaRole.PREFILL, ReplicaRole.DECODE,
+                     ReplicaRole.COLOCATED):
+            out.extend([name] * int(roles.get(name, 0)))
+        return out
+    out = [str(r) for r in roles]
+    for name in out:
+        if name not in ReplicaRole.ALL:
+            raise ValueError(f"unknown replica role {name!r}: "
+                             f"{sorted(ReplicaRole.ALL)}")
+    return out
 
 
 _M_DISPATCH = telemetry.counter(
@@ -165,6 +218,8 @@ class ServingRouter:
                  num_replicas: int = 2,
                  policy="least_outstanding",
                  *, page_size: int = 16,
+                 roles=None,
+                 prefix_store: Optional[FleetPrefixStore] = None,
                  max_replica_outstanding: Optional[int] = None,
                  degraded_after: int = 1,
                  dead_after: int = 3,
@@ -177,16 +232,38 @@ class ServingRouter:
                  sleep: Callable[[float], None] = time.sleep,
                  slo_monitor=None,
                  seed: int = 0):
+        # roles (disaggregated prefill/decode, docs/serving.md
+        # "Disaggregation"): a spec — see `parse_roles` — defines both
+        # the fleet SIZE and each replica's role; without one every
+        # replica is colocated and num_replicas rules
+        role_list = parse_roles(roles)
+        if role_list is not None:
+            num_replicas = len(role_list)
+        else:
+            role_list = [ReplicaRole.COLOCATED] * num_replicas
         if num_replicas < 1:
             raise ValueError(f"num_replicas must be >= 1, got "
                              f"{num_replicas}")
+        if not any(r in ReplicaRole.PREFILL_CAPABLE for r in role_list):
+            raise ValueError(
+                "a fleet needs at least one prefill-capable replica "
+                "(prefill or colocated) — decode-only fleets can "
+                "never admit")
+        self.roles_enabled = any(r != ReplicaRole.COLOCATED
+                                 for r in role_list)
         self._clock = clock if clock is not None else time.monotonic
         self._sleep = sleep
         # read-only observability hook (observability.slo.SloMonitor):
         # fed terminal outcomes + TTFT; never consulted for routing
         self.slo_monitor = slo_monitor
-        self.policy: DispatchPolicy = make_policy(policy,
-                                                  page_size=page_size)
+        # the fleet-wide prefix store rides along whenever roles are on
+        # (its spill is what makes a prefix outlive its replica); pass
+        # `prefix_store=` to share one across routers or tune bounds
+        if prefix_store is None and self.roles_enabled:
+            prefix_store = FleetPrefixStore(page_size=page_size)
+        self.prefix_store = prefix_store
+        self.policy: DispatchPolicy = make_policy(
+            policy, page_size=page_size, store=prefix_store)
         self._retry_cost = float(retry_after_per_request)
         rng = random.Random(seed)
         self.replicas: List[ReplicaHandle] = [
@@ -198,8 +275,10 @@ class ServingRouter:
                           restart_backoff_base=restart_backoff_base,
                           restart_backoff_max=restart_backoff_max,
                           max_restarts=max_restarts,
-                          rng=random.Random(rng.random()))
+                          rng=random.Random(rng.random()),
+                          role=role_list[i])
             for i in range(num_replicas)]
+        self.num_migrations = 0
         self.requests: Dict[str, FleetRequest] = {}
         # non-terminal requests only: the per-step harvest/failover
         # scans iterate THIS index, not every request ever submitted
@@ -257,13 +336,18 @@ class ServingRouter:
 
     def _accepting(self) -> List[ReplicaHandle]:
         """Replicas eligible for new work, HEALTHY before DEGRADED (a
-        degraded replica takes traffic only when no healthy one can)."""
-        healthy = [h for h in self.replicas
-                   if h.state == ReplicaState.HEALTHY and h.can_accept()]
+        degraded replica takes traffic only when no healthy one can).
+        Fresh submits are PREFILL-CAPABLE only: decode-role replicas
+        receive work exclusively through the transfer plane."""
+        capable = [h for h in self.replicas
+                   if h.role in ReplicaRole.PREFILL_CAPABLE
+                   and h.can_accept()]
+        healthy = [h for h in capable
+                   if h.state == ReplicaState.HEALTHY]
         if healthy:
             return healthy
-        return [h for h in self.replicas
-                if h.state == ReplicaState.DEGRADED and h.can_accept()]
+        return [h for h in capable
+                if h.state == ReplicaState.DEGRADED]
 
     def _overloaded(self) -> FleetOverloaded:
         now = self._clock()
@@ -273,7 +357,8 @@ class ServingRouter:
         alive = [h for h in self.replicas
                  if h.state in (ReplicaState.HEALTHY,
                                 ReplicaState.DEGRADED)
-                 and h.engine is not None]
+                 and h.engine is not None
+                 and h.role in ReplicaRole.PREFILL_CAPABLE]
         if alive:
             _M_REJECTIONS.inc(reason="fleet_full")
             depth = min(h.outstanding() for h in alive)
@@ -300,10 +385,22 @@ class ServingRouter:
         tried = set()
         while True:
             if forced:
-                cands = ([h for h in self.replicas
-                          if h.state == ReplicaState.HEALTHY]
-                         or [h for h in self.replicas
-                             if h.state == ReplicaState.DEGRADED])
+                # zero-loss beats role purity: stranded work prefers
+                # prefill-capable survivors but re-prefills on a decode
+                # replica when nothing else is left standing
+                tiers = (
+                    [h for h in self.replicas
+                     if h.state == ReplicaState.HEALTHY
+                     and h.role in ReplicaRole.PREFILL_CAPABLE],
+                    [h for h in self.replicas
+                     if h.state == ReplicaState.DEGRADED
+                     and h.role in ReplicaRole.PREFILL_CAPABLE],
+                    [h for h in self.replicas
+                     if h.state == ReplicaState.HEALTHY],
+                    [h for h in self.replicas
+                     if h.state == ReplicaState.DEGRADED],
+                )
+                cands = next((t for t in tiers if t), [])
             else:
                 cands = self._accepting()
             cands = [h for h in cands if h.index not in tried]
@@ -324,6 +421,20 @@ class ServingRouter:
                     if lookups:
                         _M_AFF_RATE.set(telemetry.value(
                             "pdt_router_affinity_hits_total") / lookups)
+            if not tried:
+                # once per PLACEMENT, not per retried candidate: the
+                # store's hit/miss accounting describes routing
+                # decisions, and the spill restore warms the
+                # first-choice replica only (a retry's replica gets
+                # warmed by its own next placement)
+                spilled = self._restore_spill(
+                    h, self._effective_prompt(rec))
+                if self.prefix_store is not None \
+                        and isinstance(self.policy,
+                                       PrefixAffinityPolicy):
+                    self.prefix_store.note_lookup(
+                        "replica" if self.policy.last_match_pages > 0
+                        else "spill" if spilled else "miss")
             tried.add(h.index)
             try:
                 # one span per ATTEMPT: failed candidates stay in the
@@ -440,12 +551,18 @@ class ServingRouter:
                     self._finalize(rec, req, finished)
             self._harvest(h)
             h.finish_drain_if_empty(self._clock())
+        # disaggregation hand-off: finished prefills on prefill-role
+        # replicas migrate to decode replicas through the transfer
+        # plane, BEFORE the failover scan (a migrated request must not
+        # read as stranded on its source)
+        if self.roles_enabled:
+            self._migrate_ready()
         # failover pass: anything mirrored onto a replica that is no
         # longer alive (died in the health or step pass, or was killed
         # between ticks), plus orphans parked by an earlier all-dead tick
         for h in self.replicas:
             if not h.alive():
-                self.policy.forget(h.index)    # its warm cache is gone
+                self._forget_caches(h.index)   # its warm cache is gone
         for rec in list(self._live.values()):
             if rec.done:
                 continue
@@ -463,6 +580,111 @@ class ServingRouter:
         for h in self.replicas:
             h.update_gauges()
         return finished
+
+    def _forget_caches(self, index: int):
+        """A replica's warm state died with it: the dispatch policy
+        AND the fleet prefix store both forget (the store's host-RAM
+        spill survives — that is the point of it)."""
+        self.policy.forget(index)
+        if self.prefix_store is not None:
+            self.prefix_store.forget_replica(index)
+
+    def _restore_spill(self, h: ReplicaHandle, prompt) -> int:
+        """Re-install a host-RAM-spilled prefix chain into the chosen
+        replica BEFORE dispatch, so a chain that outlived every warm
+        replica (prefix_store.py) still saves the prefill — admission
+        then matches the engine's trie as if the chain had always
+        lived there. Best-effort: cache warming never fails a
+        dispatch. Returns the pages installed."""
+        store = self.prefix_store
+        if store is None or h.engine is None:
+            return 0
+        if isinstance(self.policy, PrefixAffinityPolicy) \
+                and self.policy.last_match_pages > 0:
+            return 0               # a warm replica was found: no need
+        entry = store.fetch(prompt)
+        if entry is None:
+            return 0
+        try:
+            installed = h.engine.import_prefix(*entry)
+        except Exception:
+            return 0
+        if installed:
+            telemetry.event("router.prefix_restore", replica=h.index,
+                            pages=installed)
+        return installed
+
+    def _migrate_ready(self):
+        """The disaggregation hand-off (one pass per step tick): every
+        request whose PREFILL has finished on a prefill-role replica
+        migrates — pages + state, serving/transfer.py — to the decode
+        replica with the fewest outstanding slots (decode dispatch
+        balances decode slots, where prefill dispatch stays
+        prefix-affine). Capacity refusals defer to the next tick with
+        the request decoding where it is: migration is an
+        optimization, never a dependency. Transfer FAILURES leave both
+        engines consistent (serialize is read-only, install backs its
+        slot out), so the request simply stays on its source — if the
+        source then dies mid-transfer, the ordinary failover pass
+        re-prefills it on a survivor with its streamed tokens folded
+        in, bit-identical to a colocated fleet."""
+        targets = [h for h in self.replicas
+                   if h.role == ReplicaRole.DECODE and h.alive()]
+        for rec in list(self._live.values()):
+            if rec.done or rec.replica is None \
+                    or rec.engine_req is None:
+                continue
+            src = self.replicas[rec.replica]
+            if src.role != ReplicaRole.PREFILL or not src.alive() \
+                    or rec.generation != src.generation:
+                continue
+            req = rec.engine_req
+            if req.status != RequestStatus.RUNNING or not req.output:
+                continue           # not prefilled yet (or requeued)
+            # re-check can_accept PER migration: each install raises a
+            # target's outstanding count, and the bounded per-replica
+            # queue (max_replica_outstanding) must hold for migrated
+            # work exactly as it does for fresh dispatches
+            avail = [t for t in targets if t.can_accept()]
+            if not avail:
+                return             # no decode capacity this tick
+            dst = min(avail, key=lambda t: (t.outstanding(), t.index))
+            try:
+                # the span joins the request's distributed trace via
+                # request_id — migration shows up between the source's
+                # prefill and the target's decode steps
+                with telemetry.span("router.migrate",
+                                    request_id=rec.request_id,
+                                    from_replica=src.index,
+                                    to_replica=dst.index,
+                                    tokens=len(rec.tokens)):
+                    new_req, payload = transfer.migrate_request(
+                        src.engine, dst.engine, req.rid,
+                        deadline=self._remaining_deadline(rec))
+            except (EngineOverloaded, PoolExhausted):
+                # target full RIGHT NOW: try other targets for later
+                # requests, retry this one next tick
+                targets = [t for t in targets if t is not dst]
+                continue
+            except Exception:
+                # transfer.py counted the failure; both engines are
+                # consistent and a dead endpoint is the health/failover
+                # machinery's job — leave the request where it is
+                continue
+            rec.replica, rec.generation = dst.index, dst.generation
+            rec.engine_req = new_req    # rec.folded is unchanged: the
+            #                             target holds the same output
+            #                             stream the source did
+            rec.dispatches += 1
+            self.num_migrations += 1
+            src.migrations_out += 1
+            dst.migrations_in += 1
+            if self.prefix_store is not None:
+                # the serialized prompt pages are host-side already —
+                # spilling them is free, and makes the chain outlive
+                # every replica that ever computed it
+                self.prefix_store.spill_payload(payload)
+                self.prefix_store.record(dst.index, payload["prompt"])
 
     def _harvest(self, h: ReplicaHandle):
         """Mirror the token streams of this replica's live requests —
@@ -497,7 +719,7 @@ class ServingRouter:
 
     def _failover_replica(self, h: ReplicaHandle):
         """Re-route everything mirrored onto `h` (which just died)."""
-        self.policy.forget(h.index)
+        self._forget_caches(h.index)
         for rec in list(self._live.values()):
             if rec.replica == h.index and not rec.done:
                 self._failover_one(rec)
@@ -570,7 +792,7 @@ class ServingRouter:
         kills."""
         h = self.replicas[index]
         h.die(reason, self._clock())
-        self.policy.forget(index)
+        self._forget_caches(index)
 
     def drain_replica(self, index: int):
         """Graceful decommission: no new traffic, in-flight completes,
@@ -631,20 +853,39 @@ class ServingRouter:
         pending = len(self._live)
         info = {
             "replicas": [
-                {"index": h.index, "state": h.state,
+                {"index": h.index, "role": h.role, "state": h.state,
                  "outstanding": h.outstanding(),
                  "consecutive_failures": h.consecutive_failures,
                  "restarts": h.restarts,
+                 "migrations_in": h.migrations_in,
+                 "migrations_out": h.migrations_out,
                  "death_reason": h.death_reason}
                 for h in self.replicas],
             "pending": pending,
             "submitted": len(self.requests),
             "failovers": self.num_failovers,
             "restarts": self.num_restarts,
+            "migrations": self.num_migrations,
             "prefix_hits": sum(h.prefix_hits() for h in self.replicas),
             "prefix_tokens_reused": sum(h.prefix_tokens_reused()
                                         for h in self.replicas),
         }
+        if self.roles_enabled:
+            # per-role aggregates: migrations count OUT of prefill and
+            # INTO decode (the same transfers seen from each end)
+            agg: Dict[str, dict] = {}
+            for h in self.replicas:
+                row = agg.setdefault(h.role, {"replicas": 0,
+                                              "queue_depth": 0,
+                                              "migrations": 0})
+                row["replicas"] += 1
+                row["queue_depth"] += h.outstanding()
+                row["migrations"] += (h.migrations_out
+                                      if h.role == ReplicaRole.PREFILL
+                                      else h.migrations_in)
+            info["roles"] = agg
+        if self.prefix_store is not None:
+            info["prefix_store"] = self.prefix_store.stats()
         if self.slo_monitor is not None:
             statuses = self.slo_monitor.evaluate()
             info["slo"] = {

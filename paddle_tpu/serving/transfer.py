@@ -1,0 +1,134 @@
+"""KV page transfer plane: migrate a request between replica engines.
+
+Disaggregated prefill/decode serving (ISSUE 8, ≙ the TPU serving
+split every production stack runs) moves a request from the replica
+that PREFILLED it to the replica that will DECODE it. What moves is
+exactly what the engine holds for the request:
+
+* its resident KV pages (`engine.export_pages` gathers the block-table
+  window to host numpy — the serialize side is READ-ONLY, the source
+  stays consistent no matter what happens next);
+* its request state — original prompt, tokens streamed so far, token
+  budget, remaining deadline, preemption count, stable `request_id`.
+
+`install_request` re-materializes that state inside the target engine
+(`engine.import_pages`): a free slot is claimed, any prompt prefix the
+target's own trie already holds attaches READ-ONLY (a migrated system
+prompt costs no page copies the second time), the remaining pages are
+allocated and their contents written by one donated device program,
+and the installed chain re-registers in the target's prefix structures
+so it is warm for the NEXT migration. Page-accounting invariants
+(`check_invariants`) hold on both engines at every boundary.
+
+Failure semantics (the failover contract, docs/serving.md
+"Disaggregation"): a fault or SIGKILL at EITHER endpoint mid-transfer
+leaves both engines consistent — serialize never mutates, install
+backs its slot out — so the router simply falls back to the PR-4
+failover machinery: re-prefill on a survivor with the streamed tokens
+folded in, greedy outputs bit-identical to a colocated fleet. Fault
+sites `transfer.serialize` / `transfer.install` (utils/faults.py)
+force both halves deterministically.
+
+Telemetry: `pdt_transfer_*` counters/histogram plus `transfer.serialize`
+/ `transfer.install` spans that join the request's distributed trace
+via its `request_id` (docs/observability.md).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional, Tuple
+
+from .. import observability as telemetry
+from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
+                              PoolExhausted, Request)
+from ..utils.faults import fault_point
+
+__all__ = ["serialize_request", "install_request", "migrate_request",
+           "payload_nbytes"]
+
+
+_M_MIGRATIONS = telemetry.counter(
+    "pdt_transfer_migrations_total",
+    "Requests migrated between engines through the KV transfer plane.")
+_M_FAILURES = telemetry.counter(
+    "pdt_transfer_failures_total",
+    "Transfer-plane failures by stage (capacity deferrals — no free "
+    "slot / no pages on the target — are not failures and retry next "
+    "step).", ("stage",))
+_M_BYTES = telemetry.counter(
+    "pdt_transfer_bytes_total",
+    "KV page bytes serialized out of source engines.")
+_M_SECONDS = telemetry.histogram(
+    "pdt_transfer_seconds",
+    "Wall time of one complete migration (serialize + install + "
+    "source evict).")
+
+
+def payload_nbytes(payload: dict) -> int:
+    """Host bytes of the payload's KV page content."""
+    return sum(k.nbytes + v.nbytes for k, v in payload["kv"])
+
+
+def serialize_request(engine: ContinuousBatchingEngine,
+                      rid: int) -> dict:
+    """Serialize one RUNNING request's pages + state out of `engine`.
+    Read-only: the source still owns the request until
+    `engine.evict_request`. Fault site: ``transfer.serialize``."""
+    req = engine.get_request(rid)
+    request_id = req.request_id if req is not None else str(rid)
+    with telemetry.span("transfer.serialize", rid=rid,
+                        request_id=request_id):
+        fault_point("transfer.serialize")
+        return engine.export_pages(rid)
+
+
+def install_request(engine: ContinuousBatchingEngine, payload: dict,
+                    *, deadline: Optional[float] = None) -> Request:
+    """Install a serialized request into `engine`'s paged cache;
+    returns the live target-engine Request (the router mirrors its
+    stream exactly like a dispatched one). `deadline` is the remaining
+    budget in seconds on the target engine's clock (the router
+    re-derives it so fleet deadlines stay exact across the move).
+    Raises `EngineOverloaded` / `PoolExhausted` when the target lacks a
+    slot / pages RIGHT NOW — deferrals, not failures. Fault site:
+    ``transfer.install`` (fires before any target mutation)."""
+    with telemetry.span("transfer.install",
+                        request_id=payload["request_id"],
+                        tokens=len(payload["output"]),
+                        pages=payload["n_pages"]):
+        fault_point("transfer.install")
+        return engine.import_pages(payload, deadline=deadline)
+
+
+def migrate_request(src: ContinuousBatchingEngine,
+                    dst: ContinuousBatchingEngine, rid: int,
+                    *, deadline: Optional[float] = None,
+                    ) -> Tuple[Request, dict]:
+    """One complete migration: serialize from `src`, install into
+    `dst`, then evict the source copy (ordered so a failure at any
+    point leaves the request live on exactly one engine — never zero).
+    Returns (target Request, payload). Capacity refusals
+    (`EngineOverloaded`/`PoolExhausted`) propagate untouched for the
+    router to defer on; anything else counts a
+    `pdt_transfer_failures_total{stage=...}` before re-raising."""
+    t0 = time.perf_counter()
+    stage = "serialize"
+    try:
+        payload = serialize_request(src, rid)
+        stage = "install"
+        req = install_request(dst, payload, deadline=deadline)
+    except (EngineOverloaded, PoolExhausted):
+        raise                       # target capacity: defer, not a fault
+    except BaseException as e:
+        _M_FAILURES.inc(stage=stage)
+        telemetry.event("transfer.failed", stage=stage, rid=rid,
+                        error=f"{type(e).__name__}: {e}")
+        raise
+    # both engines hold the request for this instant; evicting second
+    # means a crash window can only DUPLICATE (idempotent per
+    # request_id), never lose
+    src.evict_request(rid)
+    _M_MIGRATIONS.inc()
+    _M_BYTES.inc(payload_nbytes(payload))
+    _M_SECONDS.observe(time.perf_counter() - t0)
+    return req, payload

@@ -44,6 +44,12 @@ Instrumented sites (grep ``fault_point(`` for the live list):
   specific busy replica of a fleet); ``router.health`` — inside every
   replica health probe (serving/replica.py — failures drive the
   HEALTHY -> DEGRADED -> DEAD machine and zero-loss failover);
+* ``transfer.serialize`` — before a migration serializes a request's
+  KV pages out of its source engine; ``transfer.install`` — before the
+  payload installs into the target engine's paged cache
+  (serving/transfer.py, the disaggregated prefill/decode page transfer
+  plane — either fault leaves BOTH engines consistent, and the router
+  degrades to failover re-prefill);
 * ``checkpoint.save`` — before any byte of a state-dict write;
   ``checkpoint.write`` — after one group's bytes land (fires between
   groups of a multi-group save: forces torn ``step_N.tmp`` dirs; for

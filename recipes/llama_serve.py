@@ -15,6 +15,12 @@ then drive every decode surface the framework ships —
     over N engines plus a kill-a-replica failover drill — SIGKILL one
     replica mid-decode, prove zero loss (outputs identical to an
     unkilled fleet), and print the `pdt_router_*` Prometheus dump,
+  * disaggregated prefill/decode (`--roles prefill:N,decode:M`): the
+    role-split fleet vs a colocated oracle on the same jobs, with a
+    kill-a-prefill-replica-mid-migration drill — the transfer dies at
+    the `transfer.serialize` fault site, the source is SIGKILLed, and
+    outputs are still identical (KV page transfer plane + fleet-wide
+    prefix store stats printed),
   * the operator surface (docs/observability.md): an `SloMonitor`
     grades the drill's TTFT/availability objectives (SLO report +
     fleet status printed), and the failover timeline is written as a
@@ -51,6 +57,12 @@ def main(argv=None):
                         "(greedy outputs are bit-identical)")
     p.add_argument("--replicas", type=int, default=3,
                    help="fleet size for the router failover drill")
+    p.add_argument("--roles", default="prefill:2,decode:2",
+                   help="role split for the disaggregation drill "
+                        "(prefill:N,decode:M[,colocated:K]); the "
+                        "drill proves outputs identical to a "
+                        "colocated fleet through a SIGKILL of a "
+                        "prefill replica mid-migration")
     p.add_argument("--trace-out", default=None,
                    help="write the failover drill's Perfetto/Chrome "
                         "trace here (default: a temp file)")
@@ -251,6 +263,62 @@ def main(argv=None):
     print(f"failover drill trace -> {trace_out} "
           "(load in chrome://tracing or https://ui.perfetto.dev; "
           "pid=replica, tid=request)")
+
+    # 3e) disaggregated prefill/decode (docs/serving.md
+    # "Disaggregation"): the same jobs through a colocated fleet (the
+    # oracle) and a role-split fleet, with a kill-a-prefill-replica-
+    # mid-migration drill — the first migration attempt dies at the
+    # transfer.serialize fault site, the source replica is SIGKILLed
+    # with the transfer un-done, and failover re-prefills on survivors:
+    # outputs must still be identical to the unkilled colocated fleet
+    from paddle_tpu.serving import parse_roles
+    role_list = parse_roles(args.roles)
+    n_roles = len(role_list)
+    disagg_jobs = [system + rng.integers(
+        1, cfg.vocab_size, int(rng.integers(4, 10))).tolist()
+        for _ in range(2 * n_roles)]
+
+    def role_fleet(roles):
+        return ServingRouter(
+            lambda i: ContinuousBatchingEngine(
+                model, max_batch_size=2,
+                max_seq_len=min(256, cfg.max_position_embeddings),
+                enable_prefix_caching=True,
+                attention_impl=args.attention_impl),
+            num_replicas=n_roles, policy="prefix_affinity",
+            page_size=16, roles=roles)
+
+    colo = role_fleet(None)
+    colo_ids = [colo.submit(pr, n) for pr in disagg_jobs]
+    colo_out = colo.run()                        # the colocated oracle
+
+    disagg = role_fleet(args.roles)
+    d_ids = [disagg.submit(pr, n) for pr in disagg_jobs]
+    victim = next(i for i, h in enumerate(disagg.replicas)
+                  if h.role == "prefill")
+    with FaultInjector(seed=0) as fi:
+        fi.arm("transfer.serialize", nth=1)      # first migration dies
+        disagg.step()                            # ... mid-transfer
+    disagg.kill_replica(victim)                  # SIGKILL the source
+    d_out = disagg.run()
+    assert [d_out[i] for i in d_ids] == [colo_out[i] for i in colo_ids], \
+        "disaggregation changed outputs"
+    info = disagg.fleet_info()
+    assert info["migrations"] >= 1 and info["pending"] == 0
+    store = info["prefix_store"]
+    print(f"disaggregation: roles {args.roles}, killed prefill replica "
+          f"{victim} mid-migration -> {info['failovers']} failover(s), "
+          f"{info['migrations']} migration(s), outputs identical to the "
+          f"colocated fleet; prefix store {store['chains']} chains "
+          f"({store['spilled_chains']} spilled), hit rate "
+          f"{store['hit_rate']}")
+    print(telemetry.render_fleet_status(info))
+    print("--- transfer telemetry (Prometheus text exposition) ---")
+    print("\n".join(line for line in telemetry.to_prometheus()
+                    .splitlines()
+                    if "pdt_transfer" in line or "pdt_prefix_store"
+                    in line))
+    print("--- end transfer telemetry ---")
 
     # 4) speculative decoding (draft = shallow copy of the config)
     d_cfg = LlamaConfig(

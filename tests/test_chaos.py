@@ -868,3 +868,135 @@ class TestObservabilityChaos:
         assert st.samples == 1
         assert st.value == pytest.approx(2.0)   # not 0.0-from-survivor
         assert st.state == "breach"
+
+class TestDisaggChaos:
+    """Four-fates drill under DISAGGREGATED roles (ISSUE 8): a
+    prefill:1,decode:1 fleet lands every terminal fate — PREEMPTED
+    (forced pool exhaustion on the DECODE engine, post-migration),
+    FAILED (injected prefill fault on the prefill replica), TIMEOUT (a
+    deadline that dies with its replica), FINISHED (including requests
+    whose migration was killed mid-transfer by a SIGKILL of the
+    prefill endpoint) — with greedy outputs bit-identical to a
+    colocated engine and exact fleet-vs-engine counter reconciliation.
+    Same FakeClock discipline as every fleet drill."""
+
+    def _fleet(self, model, clock=None, engine_kw=None, **kw):
+        clock = clock if clock is not None else FakeClock()
+        ekw = dict(max_batch_size=2, max_seq_len=64, page_size=4)
+        ekw.update(engine_kw or {})
+        kw.setdefault("page_size", 4)
+        kw.setdefault("sleep", clock.advance)
+        router = ServingRouter(
+            lambda i: ContinuousBatchingEngine(model, clock=clock,
+                                               **ekw),
+            roles="prefill:1,decode:1", policy="round_robin",
+            clock=clock, **kw)
+        return router, clock
+
+    def test_disagg_four_fates_reconcile(self, model):
+        jobs = [([5, 4, 3, 2, 6, 7], 8), ([9, 1, 2], 6),
+                ([7, 7, 1, 2], 5)]
+        eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                       max_seq_len=64, page_size=4)
+        rids = [eng.add_request(p, m) for p, m in jobs]
+        res = eng.run()
+        ref = [res[r] for r in rids]
+        statuses = (RequestStatus.FINISHED, RequestStatus.FAILED,
+                    RequestStatus.TIMEOUT, RequestStatus.PREEMPTED)
+        eng_base = {s: telemetry.value(
+            "pdt_serving_requests_terminal_total", status=s)
+            for s in statuses}
+        adm_base = telemetry.value("pdt_serving_admissions_total")
+        router, clock = self._fleet(
+            model, restart_backoff_base=3.0, restart_backoff_max=3.0,
+            engine_kw=dict(max_preemptions=0))
+
+        # fate 1 — PREEMPTED, on the DECODE engine after migration.
+        # alloc-page visits are deterministic: admission on the prefill
+        # engine takes 1-2 (6-token prompt, page 4), the migration
+        # install on the decode engine takes 3-4 (ctx 7 -> 2 pages),
+        # and visit 5 is the decode engine's first lazy growth — so
+        # nth=5 forces pool exhaustion exactly there; max_preemptions=0
+        # turns the preempt into the starvation-guard terminal
+        d = router.submit([5, 4, 3, 2, 6, 7], 8)
+        with FaultInjector() as fi:
+            fi.arm("serving.alloc_page", nth=5, exc=PoolExhausted)
+            while not router.requests[d].done:
+                router.step()
+        rec_d = router.requests[d]
+        assert rec_d.status == RequestStatus.PREEMPTED
+        assert len(rec_d.tokens) > 0            # partial output retained
+        assert router.requests[d].replica == 1  # it died a decode-side
+        assert router.num_migrations == 1       # resident, post-transfer
+        assert all(h.state == ReplicaState.HEALTHY
+                   for h in router.replicas)
+
+        # fate 2 — FAILED: an injected prefill fault on the prefill
+        # replica is a REQUEST failure, isolated by the engine
+        c = router.submit([9, 1, 2], 6)
+        with FaultInjector() as fi:
+            fi.arm("serving.prefill", nth=1)
+            while not router.requests[c].done:
+                router.step()
+        assert router.requests[c].status == RequestStatus.FAILED
+        assert router.replicas[0].state == ReplicaState.HEALTHY
+
+        # fates 3+4 — TIMEOUT and FINISHED-after-SIGKILL-mid-migration:
+        # three normal requests and one doomed deadline all land on the
+        # only prefill replica; every migration attempt this step dies
+        # mid-transfer (the serialize fault), then the prefill endpoint
+        # is SIGKILLed with the transfers un-done
+        a1, a2, a3 = [router.submit(p, m) for p, m in jobs]
+        b = router.submit([1, 2, 3], 40, deadline=5.0)
+        with FaultInjector() as fi:
+            fi.arm("transfer.serialize", always=True)
+            router.step()                       # prefills; transfers die
+            assert fi.trips("transfer.serialize") == 2
+        router.kill_replica(0)                  # SIGKILL the source
+        clock.advance(6.0)                      # past b's deadline AND
+        out = router.run()                      # past r0's backoff
+        assert [out[i] for i in (a1, a2, a3)] == ref   # zero loss
+        assert router.requests[b].status == RequestStatus.TIMEOUT
+        assert "failover" in (router.requests[b].error or "")
+
+        # the restarted prefill replica takes fresh traffic again and
+        # hands it to the decode replica through the transfer plane
+        assert router.replicas[0].state == ReplicaState.HEALTHY
+        assert router.replicas[0].restarts == 1
+        extra = [router.submit(p, m) for p, m in jobs[:2]]
+        assert all(router.requests[i].replica == 0 for i in extra)
+        out = router.run()
+        assert [out[i] for i in extra] == ref[:2]
+
+        # exact reconciliation, fleet vs engines, per status. The one
+        # asymmetry is STRUCTURAL: b timed out while dead-stranded, so
+        # the router finalized it honestly and no engine ever saw it —
+        # fleet timeout=1, engine timeout=0.
+        fates = {RequestStatus.FINISHED: 5, RequestStatus.FAILED: 1,
+                 RequestStatus.TIMEOUT: 1, RequestStatus.PREEMPTED: 1}
+        for status, want in fates.items():
+            assert telemetry.value("pdt_router_requests_terminal_total",
+                                   status=status) == want, status
+        for status, want in ((RequestStatus.FINISHED, 5),
+                             (RequestStatus.FAILED, 1),
+                             (RequestStatus.TIMEOUT, 0),
+                             (RequestStatus.PREEMPTED, 1)):
+            assert telemetry.value("pdt_serving_requests_terminal_total",
+                                   status=status) \
+                - eng_base[status] == want, status
+        assert sum(fates.values()) == len(router.requests)
+        # admissions = successful PREFILLS only: d (1), a1+a2 before
+        # the kill (2), a1+a2+a3 re-prefilled after it (3), extras (2).
+        # c's prefill faulted and b never left the queue; migration
+        # installs deliberately do NOT count as admissions.
+        assert telemetry.value("pdt_serving_admissions_total") \
+            - adm_base == 8
+        # migrations: d, the three re-prefilled a's, both extras — the
+        # two killed-mid-transfer attempts retried after failover
+        assert router.num_migrations == 6
+        assert telemetry.value("pdt_transfer_migrations_total") == 6
+        assert telemetry.value("pdt_transfer_failures_total",
+                               stage="serialize") == 2
+        info = router.fleet_info()
+        assert info["roles"]["prefill"]["migrations"] == 6
+        assert info["roles"]["decode"]["migrations"] == 6
