@@ -1,0 +1,45 @@
+"""pdt-lint: the AST-based invariant analyzer for the serving stack.
+
+Public API (stdlib-only; see core.py for the framework and
+docs/static_analysis.md for the checker catalog)::
+
+    from paddle_tpu.analysis import lint_repo
+
+    result = lint_repo("/path/to/repo")
+    assert not result.failed, [f.render() for f in result.new]
+
+The CLI is ``paddle-tpu-lint`` / ``python -m paddle_tpu.analysis``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from .checkers import (ALL_CHECKER_CLASSES, by_code,     # noqa: F401
+                       default_checkers)
+from .core import (Baseline, Checker, Finding, LintResult,  # noqa: F401
+                   Project, SourceFile, Suppression, run_checkers)
+
+__all__ = ["Finding", "Checker", "Project", "SourceFile", "Baseline",
+           "Suppression", "LintResult", "run_checkers",
+           "default_checkers", "by_code", "ALL_CHECKER_CLASSES",
+           "lint_repo"]
+
+
+def lint_repo(root: str, codes: Optional[Sequence[str]] = None,
+              baseline: Optional[str] = None,
+              respect_suppressions: bool = True,
+              use_baseline: bool = True) -> LintResult:
+    """Run the default checker set over `root`'s ``paddle_tpu``
+    package, against the committed baseline when present — the
+    programmatic equivalent of ``paddle-tpu-lint paddle_tpu/`` (the
+    tier-1 gate in tests/test_lint.py calls this)."""
+    from .__main__ import BASELINE_NAME
+    bl = None
+    if use_baseline:
+        bpath = baseline or os.path.join(root, BASELINE_NAME)
+        if os.path.isfile(bpath):
+            bl = Baseline.load(bpath)
+    project = Project(root, [os.path.join(root, "paddle_tpu")])
+    return run_checkers(project, default_checkers(codes), baseline=bl,
+                        respect_suppressions=respect_suppressions)

@@ -1,0 +1,162 @@
+"""The ``paddle-tpu-lint`` CLI (also ``python -m paddle_tpu.analysis``).
+
+Exit codes: 0 = clean against the committed baseline; 1 = findings
+(new findings, suppression-hygiene violations, or stale baseline
+entries); 2 = usage error.
+
+::
+
+    paddle-tpu-lint paddle_tpu/                 # human output
+    paddle-tpu-lint --format json paddle_tpu/   # machine output
+    paddle-tpu-lint --no-baseline paddle_tpu/   # raw view, no policy
+    paddle-tpu-lint --update-baseline           # SHRINK the baseline
+    paddle-tpu-lint --list-checkers
+
+``--update-baseline`` only ever removes entries whose finding is gone
+— it never adds one. New findings must be fixed, or suppressed inline
+with a reason that survives review (docs/static_analysis.md).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .checkers import ALL_CHECKER_CLASSES, default_checkers
+from .core import Baseline, Project, run_checkers
+
+BASELINE_NAME = ".pdt-lint-baseline.json"
+
+
+def find_root(start: str) -> str:
+    """Walk up from `start` to the repo root (pyproject.toml)."""
+    cur = os.path.abspath(start)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    while True:
+        if os.path.isfile(os.path.join(cur, "pyproject.toml")):
+            return cur
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return os.path.abspath(start)
+        cur = parent
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paddle-tpu-lint",
+        description="AST-based invariant analyzer for the paddle_tpu "
+                    "serving stack (checker catalog: "
+                    "docs/static_analysis.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to scan (default: the "
+                        "paddle_tpu package under the repo root)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: walk up to pyproject.toml)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline file (default: <root>/{BASELINE_NAME} "
+                        "when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--no-suppressions", action="store_true",
+                   help="ignore inline suppressions (stale-opt-out "
+                        "audit mode)")
+    p.add_argument("--format", choices=("human", "json"),
+                   default="human")
+    p.add_argument("--checker", action="append", default=None,
+                   metavar="PDT0xx",
+                   help="run only these checkers (repeatable)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="drop baseline entries whose finding is gone "
+                        "(shrink-only; never adds)")
+    p.add_argument("--list-checkers", action="store_true")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_checkers:
+        for cls in ALL_CHECKER_CLASSES:
+            print(f"{cls.code}  {cls.name:28s} {cls.rationale}")
+        return 0
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"paddle-tpu-lint: no such path: {p}",
+                  file=sys.stderr)
+            return 2
+    root = args.root or find_root(
+        args.paths[0] if args.paths else os.getcwd())
+    paths = args.paths or [os.path.join(root, "paddle_tpu")]
+    if not args.paths and not os.path.isdir(paths[0]):
+        print(f"paddle-tpu-lint: default scan target {paths[0]} "
+              "missing; pass paths explicitly", file=sys.stderr)
+        return 2
+    try:
+        checkers = default_checkers(args.checker)
+    except ValueError as e:
+        print(f"paddle-tpu-lint: {e}", file=sys.stderr)
+        return 2
+    baseline = None
+    if not args.no_baseline:
+        bpath = args.baseline or os.path.join(root, BASELINE_NAME)
+        if os.path.isfile(bpath):
+            try:
+                baseline = Baseline.load(bpath)
+            except (ValueError, json.JSONDecodeError) as e:
+                print(f"paddle-tpu-lint: bad baseline: {e}",
+                      file=sys.stderr)
+                return 2
+        elif args.baseline:
+            print(f"paddle-tpu-lint: baseline not found: {bpath}",
+                  file=sys.stderr)
+            return 2
+
+    project = Project(root, paths)
+    result = run_checkers(project, checkers, baseline=baseline,
+                          respect_suppressions=not args.no_suppressions)
+
+    if args.update_baseline:
+        if baseline is None:
+            print("paddle-tpu-lint: no baseline to update",
+                  file=sys.stderr)
+            return 2
+        for fp in result.stale_baseline:
+            # count KEPT findings (suppressed ones must not prop up a
+            # baseline entry, or the entry would read stale forever)
+            have = sum(1 for f in result.new + result.baselined
+                       if f.fingerprint == fp)
+            if have == 0:
+                del baseline.entries[fp]
+            else:
+                baseline.entries[fp]["count"] = have
+        baseline.save()
+        # stderr: --format json owns stdout (machine output contract)
+        print(f"baseline: {len(result.stale_baseline)} stale "
+              f"entr{'y' if len(result.stale_baseline) == 1 else 'ies'}"
+              " removed" if result.stale_baseline
+              else "baseline: already minimal", file=sys.stderr)
+        # fall through: new findings still fail the run
+
+    if args.format == "json":
+        print(json.dumps(result.to_json(), indent=2))
+    else:
+        for f in result.new + result.meta:
+            print(f.render())
+        for fp in ([] if args.update_baseline
+                   else result.stale_baseline):
+            print(f"stale baseline entry: {fp} — the finding is gone; "
+                  f"run --update-baseline (the baseline only shrinks)")
+        s = result.to_json()["summary"]
+        print(f"pdt-lint: {s['new']} new, {s['meta']} hygiene, "
+              f"{s['baselined']} baselined, {s['suppressed']} "
+              f"suppressed, {s['stale_baseline']} stale-baseline")
+    failed = bool(result.new or result.meta
+                  or (result.stale_baseline
+                      and not args.update_baseline))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

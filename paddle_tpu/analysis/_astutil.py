@@ -1,0 +1,81 @@
+"""Shared AST helpers for the pdt-lint checkers: import-alias
+resolution (so ``np.asarray``, ``numpy.asarray`` and ``from numpy
+import asarray`` all resolve to the same dotted name) and
+function-scope walks."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["import_aliases", "call_name", "dotted", "literal_str",
+           "walk_functions", "body_calls"]
+
+
+def import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """Map local names to the dotted thing they import.
+
+    ``import numpy as np``                      -> {"np": "numpy"}
+    ``from time import monotonic as mono``      -> {"mono": "time.monotonic"}
+    ``from .. import observability as telemetry``
+                                        -> {"telemetry": "observability"}
+    ``from paddle_tpu.observability import span as telemetry_span``
+                                -> {"telemetry_span": "observability.span"}
+
+    Relative imports keep only the tail module path — checkers match on
+    suffixes, so ``..observability`` and ``paddle_tpu.observability``
+    resolve identically.
+    """
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if base.startswith("paddle_tpu."):
+                base = base[len("paddle_tpu."):]
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                full = f"{base}.{a.name}" if base else a.name
+                out[a.asname or a.name] = full
+    return out
+
+
+def dotted(node: ast.AST, aliases: Dict[str, str]) -> Optional[str]:
+    """Resolve an expression to a dotted name, mapping the root through
+    the file's import aliases. Returns None for non-name expressions."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    root = aliases.get(node.id, node.id)
+    parts.append(root)
+    return ".".join(reversed(parts))
+
+
+def call_name(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    return dotted(call.func, aliases)
+
+
+def literal_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def walk_functions(tree: ast.AST) -> Iterator[ast.FunctionDef]:
+    """Every (async) function definition in the module, any nesting."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def body_calls(fn: ast.AST) -> Iterator[ast.Call]:
+    """Every call inside `fn`, including nested defs (a nested def of a
+    traced function traces too)."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            yield node

@@ -1,0 +1,48 @@
+"""The pdt-lint checker registry. Each checker encodes one piece of
+repo law; docs/static_analysis.md is the human-facing catalog with the
+motivating PR for every rule."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..core import Checker
+from .catalog import CatalogDriftChecker
+from .clocks import InjectableClockChecker
+from .faultsites import FaultSiteDriftChecker
+from .pins import PinPairingChecker
+from .supervision import SwallowedErrorChecker
+from .tracedsync import TracedHostSyncChecker
+
+__all__ = ["ALL_CHECKER_CLASSES", "default_checkers", "by_code",
+           "CatalogDriftChecker", "InjectableClockChecker",
+           "FaultSiteDriftChecker", "PinPairingChecker",
+           "SwallowedErrorChecker", "TracedHostSyncChecker"]
+
+ALL_CHECKER_CLASSES = (
+    InjectableClockChecker,      # PDT001
+    TracedHostSyncChecker,       # PDT002
+    FaultSiteDriftChecker,       # PDT003
+    CatalogDriftChecker,         # PDT004
+    PinPairingChecker,           # PDT005
+    SwallowedErrorChecker,       # PDT006
+)
+
+
+def default_checkers(codes: Optional[Sequence[str]] = None,
+                     ) -> List[Checker]:
+    """Instantiate the default checker set, optionally filtered to
+    specific ``PDT0xx`` codes."""
+    out = [cls() for cls in ALL_CHECKER_CLASSES]
+    if codes is not None:
+        want = set(codes)
+        unknown = want - {c.code for c in out}
+        if unknown:
+            raise ValueError(f"unknown checker code(s): "
+                             f"{sorted(unknown)} (have "
+                             f"{[c.code for c in out]})")
+        out = [c for c in out if c.code in want]
+    return out
+
+
+def by_code() -> Dict[str, type]:
+    return {cls.code: cls for cls in ALL_CHECKER_CLASSES}

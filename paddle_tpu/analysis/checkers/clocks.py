@@ -1,0 +1,71 @@
+"""PDT001 — injectable-clock discipline.
+
+Repo law (PR 4/5): the serving, fleet, and checkpoint layers are
+step-driven and clock-injectable — deterministic on the CPU test mesh,
+no wall-clock reads inside the machinery. A direct ``time.time()`` /
+``time.monotonic()`` / ``time.perf_counter()`` call on those paths
+cannot be driven by the tests' fake clocks (the PR-8 live hit:
+``serving/transfer.py`` timed migrations on ``time.perf_counter()``,
+so the bench's migration-latency quantiles were fake-clock-blind).
+
+References to the clock functions (``clock=time.monotonic`` defaults)
+are fine — the law bans the *call*, not the injectable default.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Tuple
+
+from .._astutil import call_name, import_aliases
+from ..core import Checker, Finding, Project
+
+__all__ = ["InjectableClockChecker"]
+
+_CLOCK_CALLS = ("time.time", "time.monotonic", "time.perf_counter")
+
+
+class InjectableClockChecker(Checker):
+    code = "PDT001"
+    name = "injectable-clock"
+    rationale = ("serving/fleet/checkpoint code must read time through "
+                 "an injected clock (PR 4 router, PR 5 SLO engine, "
+                 "PR 8 transfer-plane fix)")
+
+    DEFAULT_SCOPE = (
+        "paddle_tpu/serving/*.py",
+        "paddle_tpu/models/serving.py",
+        "paddle_tpu/distributed/checkpoint/*.py",
+        "paddle_tpu/hapi/callbacks.py",
+        "paddle_tpu/distributed/fleet/elastic.py",
+    )
+    # clock OWNERS: the observability substrate is the one place the
+    # process-wide monotonic/wall base pair may be read directly
+    DEFAULT_ALLOW = (
+        "paddle_tpu/observability/registry.py",
+        "paddle_tpu/observability/trace.py",
+    )
+
+    def __init__(self, scope: Tuple[str, ...] = DEFAULT_SCOPE,
+                 allow: Tuple[str, ...] = DEFAULT_ALLOW,
+                 clock_calls: Tuple[str, ...] = _CLOCK_CALLS):
+        self.scope = scope
+        self.allow = allow
+        self.clock_calls = clock_calls
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.match(self.scope, exclude=self.allow):
+            if sf.tree is None:
+                continue
+            aliases = import_aliases(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node, aliases)
+                if name in self.clock_calls:
+                    yield self.finding(
+                        sf, node,
+                        f"direct {name}() call on a clock-injectable "
+                        f"path — thread the owning component's "
+                        f"injected clock instead (fake clocks must be "
+                        f"able to drive this timing)",
+                        detail=name, project=project)

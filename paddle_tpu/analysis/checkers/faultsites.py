@@ -1,0 +1,111 @@
+"""PDT003 — fault-site drift.
+
+Repo law (PR 1 fault injection, PR 5 drift guard): the module
+docstring of ``utils/faults.py`` is the catalog of record for fault
+sites — chaos tests arm sites by name, and the
+``pdt_faults_fired_total{site=...}`` series uses the same names. A
+``fault_point()`` call the docstring does not list (or a documented
+site no code declares) silently breaks both.
+
+Formerly a word-boundary regex scan in
+tests/test_observability_slo.py; now an AST pass, which also catches
+what the regex could not: a ``fault_point(non_literal)`` call that no
+text scan can account for.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from .._astutil import call_name, import_aliases, literal_str
+from ..core import Checker, Finding, Project
+
+__all__ = ["FaultSiteDriftChecker", "collect_code_sites",
+           "collect_doc_sites"]
+
+_DOC_SITE_RE = re.compile(r"``([a-z_]+\.[a-z_]+)``")
+
+
+def collect_code_sites(project: Project, scope, faults_file,
+                       ) -> Dict[str, List[Tuple[str, ast.Call]]]:
+    """``fault_point("...")`` literal sites across `scope` (excluding
+    the declaring module itself): {site: [(relpath, call node)]}."""
+    sites: Dict[str, List[Tuple[str, ast.Call]]] = {}
+    for sf in project.match(scope, exclude=(faults_file,)):
+        if sf.tree is None:
+            continue
+        aliases = import_aliases(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node, aliases)
+            if name is None or name.split(".")[-1] != "fault_point":
+                continue
+            lit = literal_str(node.args[0]) if node.args else None
+            key = lit if lit is not None else ""
+            sites.setdefault(key, []).append((sf.relpath, node))
+    return sites
+
+
+def collect_doc_sites(project: Project, faults_file) -> Set[str]:
+    """The ``site`` tokens of the faults.py module docstring."""
+    sf = project.file(faults_file)
+    if sf is None or sf.tree is None:
+        return set()
+    doc = ast.get_docstring(sf.tree) or ""
+    return set(_DOC_SITE_RE.findall(doc))
+
+
+class FaultSiteDriftChecker(Checker):
+    code = "PDT003"
+    name = "fault-site-drift"
+    rationale = ("the faults.py docstring, the fault_point() call "
+                 "sites, and the pdt_faults_fired_total site labels "
+                 "are one catalog (PR 1/5)")
+
+    DEFAULT_SCOPE = ("paddle_tpu/*.py", "paddle_tpu/**/*.py")
+    DEFAULT_FAULTS_FILE = "paddle_tpu/utils/faults.py"
+
+    def __init__(self, scope=DEFAULT_SCOPE,
+                 faults_file=DEFAULT_FAULTS_FILE):
+        self.scope = scope
+        self.faults_file = faults_file
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        faults_sf = project.file(self.faults_file)
+        if faults_sf is None:
+            return
+        code_sites = collect_code_sites(project, self.scope,
+                                        self.faults_file)
+        doc_sites = collect_doc_sites(project, self.faults_file)
+        for path, node in code_sites.pop("", []):
+            sf = project.file(path)
+            yield self.finding(
+                sf, node,
+                "fault_point() with a non-literal site name — chaos "
+                "tests and the docstring catalog can only track "
+                "literal sites",
+                detail="non-literal", project=project)
+        for site in sorted(set(code_sites) - doc_sites):
+            path, node = code_sites[site][0]
+            sf = project.file(path)
+            yield self.finding(
+                sf, node,
+                f"fault site \"{site}\" is not listed in the "
+                f"{self.faults_file} docstring — add it (the "
+                "docstring is the chaos-site catalog of record)",
+                detail=site, project=project)
+        for site in sorted(doc_sites - set(code_sites)):
+            line = 0
+            for i, ln in enumerate(faults_sf.lines, start=1):
+                if f"``{site}``" in ln:
+                    line = i
+                    break
+            yield Finding(
+                self.code, faults_sf.relpath, line,
+                f"documented fault site \"{site}\" has no "
+                "fault_point() call in the tree — remove the "
+                "docstring entry or restore the site",
+                symbol="<module docstring>", detail=site,
+                checker=self.name)

@@ -1,0 +1,139 @@
+"""PDT005 — prefix-page pin/decref pairing.
+
+Repo law (PR 1 paged KV + prefix trie; `_claim_candidate` docstring):
+admission pins matched prefix pages (`_incref`) BEFORE the worst-case
+reservation — under pool pressure `_reserve_ok` may evict the matched
+chain itself — and ownership then travels with the claim until the
+slot holds its own references. Two structural obligations follow:
+
+* a **caller of `_claim_candidate`** receives pinned pages and must
+  release them on every path, success or raise — i.e. a `_decref`
+  inside a `finally`;
+* a **pin held across the reservation** (`_incref` before
+  `_reserve_ok` in the same function) must be exception-guarded: if
+  the reservation raises, an unguarded pin leaks the page refcount
+  and the next `check_invariants()` sweep dies far from the cause.
+
+Both rules are purely structural, so the AST can enforce what the
+docstrings could only describe. This checker found two live hits at
+introduction (`_claim_candidate` and `import_pages` pinned across an
+unguarded `_reserve_ok`), fixed in the same PR.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Tuple
+
+from .._astutil import walk_functions
+from ..core import Checker, Finding, Project
+
+__all__ = ["PinPairingChecker"]
+
+
+def _method_tail(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    return None
+
+
+def _calls_in(node: ast.AST, names) -> List[ast.Call]:
+    return [n for n in ast.walk(node)
+            if isinstance(n, ast.Call) and _method_tail(n) in names]
+
+
+class PinPairingChecker(Checker):
+    code = "PDT005"
+    name = "pin-decref-pairing"
+    rationale = ("prefix-page pins must be released on every path "
+                 "(PR 1 paged admission; _claim_candidate contract)")
+
+    DEFAULT_SCOPE = ("paddle_tpu/models/serving.py",
+                     "paddle_tpu/serving/*.py")
+
+    def __init__(self, scope=DEFAULT_SCOPE,
+                 incref_names=("_incref",), decref_names=("_decref",),
+                 claim_names=("_claim_candidate",),
+                 reserve_names=("_reserve_ok",)):
+        self.scope = scope
+        self.incref_names = incref_names
+        self.decref_names = decref_names
+        self.claim_names = claim_names
+        self.reserve_names = reserve_names
+
+    # -- rule helpers ----------------------------------------------------
+    def _guarded_tries(self, fn: ast.FunctionDef) -> List[ast.Try]:
+        """Try statements whose finally or except bodies release pins."""
+        out = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Try):
+                continue
+            release = list(node.finalbody)
+            for h in node.handlers:
+                release.extend(h.body)
+            if any(_calls_in(stmt, self.decref_names)
+                   for stmt in release):
+                out.append(node)
+        return out
+
+    @staticmethod
+    def _encloses(outer: ast.AST, inner: ast.AST) -> bool:
+        return any(n is inner for n in ast.walk(outer))
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.match(self.scope):
+            if sf.tree is None:
+                continue
+            for fn in walk_functions(sf.tree):
+                yield from self._check_fn(project, sf, fn)
+
+    def _check_fn(self, project: Project, sf, fn: ast.FunctionDef,
+                  ) -> Iterable[Finding]:
+        if fn.name in self.claim_names:
+            claims = []          # the claim owner is checked by rule 2
+        else:
+            claims = _calls_in(fn, self.claim_names)
+        guarded = self._guarded_tries(fn)
+        # rule 1: claim callers release in a finally — and the guarded
+        # try must ENCLOSE or FOLLOW the claim (an unrelated earlier
+        # try/finally in the same function covers nothing)
+        release_tries = [
+            t for t in self._tries_with_finally(fn)
+            if any(_calls_in(stmt, self.decref_names)
+                   for stmt in t.finalbody)]
+        for call in claims:
+            if not any(t.lineno >= call.lineno
+                       or self._encloses(t, call)
+                       for t in release_tries):
+                yield self.finding(
+                    sf, call,
+                    f"`{fn.name}` takes pinned prefix pages from "
+                    f"{_method_tail(call)}() but has no "
+                    "finally-guarded decref — a raise between claim "
+                    "and release leaks the pins",
+                    detail=f"claim:{_method_tail(call)}",
+                    project=project)
+        # rule 2: pin held across the reservation is exception-guarded
+        increfs = _calls_in(fn, self.incref_names)
+        reserves = _calls_in(fn, self.reserve_names)
+        for res in reserves:
+            before = [i for i in increfs if i.lineno < res.lineno]
+            if not before:
+                continue
+            if any(self._encloses(t, res) for t in guarded):
+                continue
+            yield self.finding(
+                sf, res,
+                f"`{fn.name}` pins pages (line "
+                f"{before[0].lineno}) and then calls "
+                f"{_method_tail(res)}() unguarded — if the "
+                "reservation raises, the pinned pages leak their "
+                "refcount; wrap it so the pins release on the error "
+                "path",
+                detail=f"pin-across:{_method_tail(res)}",
+                project=project)
+
+    def _tries_with_finally(self, fn: ast.FunctionDef) -> List[ast.Try]:
+        return [n for n in ast.walk(fn)
+                if isinstance(n, ast.Try) and n.finalbody]

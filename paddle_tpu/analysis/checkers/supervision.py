@@ -1,0 +1,86 @@
+"""PDT006 — swallowed supervision errors.
+
+Repo law (PR 4 replica supervision, PR 5 operability): on the router
+and replica step paths an exception IS the signal — every broad
+handler must either re-raise, charge the failure to a replica's
+health (`note_failure`), or leave a trace (a metric increment or a
+telemetry event). A broad handler that silently drops the error
+(`except Exception: return 0`) turns a failing subsystem into an
+invisible one: the fleet keeps stepping and the operator surface
+shows green.
+
+The rule is deliberately narrow to stay precise: a *bare* ``except:``
+is always a finding (it eats ``KeyboardInterrupt``), and an
+``except Exception`` / ``except BaseException`` handler is a finding
+only when its body contains **no call at all and no raise** — pure
+``pass`` / ``continue`` / ``return <constant>`` swallows. A handler
+that calls anything is assumed to be handling (the fixed live hit:
+`_restore_spill` returned 0 on any engine import error, so failed
+cache warm-ups were indistinguishable from cold misses).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..core import Checker, Finding, Project
+
+__all__ = ["SwallowedErrorChecker"]
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _handler_types(handler: ast.ExceptHandler):
+    t = handler.type
+    if t is None:
+        return None                      # bare except
+    if isinstance(t, ast.Tuple):
+        return [e.id if isinstance(e, ast.Name) else None
+                for e in t.elts]
+    return [t.id if isinstance(t, ast.Name) else None]
+
+
+class SwallowedErrorChecker(Checker):
+    code = "PDT006"
+    name = "swallowed-supervision-error"
+    rationale = ("router/replica step paths must re-raise, charge "
+                 "health, or count a metric/event for every broad "
+                 "exception (PR 4/5)")
+
+    DEFAULT_SCOPE = ("paddle_tpu/serving/*.py",
+                     "paddle_tpu/models/serving.py")
+
+    def __init__(self, scope=DEFAULT_SCOPE):
+        self.scope = scope
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.match(self.scope):
+            if sf.tree is None:
+                continue
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                types = _handler_types(node)
+                if types is None:
+                    yield self.finding(
+                        sf, node,
+                        "bare `except:` on a supervision path — it "
+                        "eats KeyboardInterrupt/SystemExit; catch "
+                        "Exception at the broadest",
+                        detail="bare-except", project=project)
+                    continue
+                if not any(t in _BROAD for t in types if t):
+                    continue
+                has_raise = any(isinstance(n, ast.Raise)
+                                for n in ast.walk(node))
+                has_call = any(isinstance(n, ast.Call)
+                               for n in ast.walk(node))
+                if has_raise or has_call:
+                    continue
+                yield self.finding(
+                    sf, node,
+                    "broad except swallows the error with no "
+                    "re-raise, health charge, metric, or event — a "
+                    "failing subsystem becomes invisible to the "
+                    "operator surface",
+                    detail="swallow", project=project)

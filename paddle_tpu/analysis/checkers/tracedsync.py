@@ -1,0 +1,149 @@
+"""PDT002 — traced host/device boundary.
+
+Repo law (PR 6, the ragged-kernel integration pitfall): code inside a
+``jax.jit``- or ``pallas_call``-traced function runs under tracing —
+a host sync there (``np.asarray`` on a tracer, ``.item()``,
+``jax.device_get``, ``float()`` of an operand) either crashes with a
+`TracerArrayConversionError` at first dispatch or, worse, silently
+constant-folds a value that should be data-dependent.
+
+The checker marks a function TRACED when it is decorated with
+``jax.jit`` (bare or via ``partial``), passed to a ``jax.jit(...)``
+call, or is the kernel argument of a ``pallas_call``; every call in
+its body (nested defs included) is then checked against the forbidden
+set. ``float()``/``int()`` are flagged only when applied directly to a
+parameter of the traced function — shape arithmetic on static Python
+ints stays legal.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set, Tuple
+
+from .._astutil import (body_calls, call_name, dotted, import_aliases,
+                        walk_functions)
+from ..core import Checker, Finding, Project
+
+__all__ = ["TracedHostSyncChecker"]
+
+
+class TracedHostSyncChecker(Checker):
+    code = "PDT002"
+    name = "traced-host-sync"
+    rationale = ("no host synchronization inside jit/pallas-traced "
+                 "functions (PR 6 jnp-inside-trace pitfall)")
+
+    DEFAULT_SCOPE = ("paddle_tpu/ops/*.py", "paddle_tpu/models/*.py")
+
+    def __init__(self, scope: Tuple[str, ...] = DEFAULT_SCOPE):
+        self.scope = scope
+
+    # -- traced-function discovery --------------------------------------
+    def _is_jit_expr(self, node: ast.AST, aliases) -> bool:
+        """`jax.jit` / `jit`, possibly wrapped in functools.partial."""
+        name = dotted(node, aliases)
+        if name is not None and (name == "jax.jit"
+                                 or name.endswith(".jit")
+                                 or name == "jit"):
+            return True
+        if isinstance(node, ast.Call):
+            inner = call_name(node, aliases)
+            if inner is not None and inner.split(".")[-1] == "partial":
+                return any(self._is_jit_expr(a, aliases)
+                           for a in node.args)
+        return False
+
+    def _traced_names(self, tree: ast.AST, aliases) -> Set[str]:
+        traced: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node, aliases)
+            if name is None:
+                continue
+            tail = name.split(".")[-1]
+            if name == "jax.jit" or name == "jit" \
+                    or name.endswith(".jit"):
+                for a in node.args[:1]:
+                    if isinstance(a, ast.Name):
+                        traced.add(a.id)
+            elif tail == "pallas_call":
+                for a in node.args[:1]:
+                    if isinstance(a, ast.Name):
+                        traced.add(a.id)
+                kern = next((kw.value for kw in node.keywords
+                             if kw.arg == "kernel"), None)
+                if isinstance(kern, ast.Name):
+                    traced.add(kern.id)
+            elif tail == "partial":
+                # partial(kernel_fn, static...) handed to pallas_call /
+                # jit: the wrapped Name traces
+                if any(self._is_jit_expr(a, aliases) for a in node.args):
+                    for a in node.args:
+                        if isinstance(a, ast.Name):
+                            traced.add(a.id)
+        return traced
+
+    def _traced_functions(self, tree: ast.AST,
+                          aliases) -> List[ast.FunctionDef]:
+        names = self._traced_names(tree, aliases)
+        out = []
+        for fn in walk_functions(tree):
+            if fn.name in names:
+                out.append(fn)
+                continue
+            for dec in fn.decorator_list:
+                if self._is_jit_expr(dec, aliases):
+                    out.append(fn)
+                    break
+        return out
+
+    # -- forbidden-call scan --------------------------------------------
+    def _forbidden(self, call: ast.Call, aliases,
+                   params: Set[str]):
+        name = call_name(call, aliases)
+        if name is not None:
+            tail = name.split(".")
+            if len(tail) >= 2 and tail[-2] in ("numpy", "np") \
+                    and tail[-1] in ("asarray", "array"):
+                return (f"{tail[-2]}.{tail[-1]}",
+                        "materializes a host array from a tracer")
+            if name == "jax.device_get" or name.endswith(
+                    ".device_get"):
+                return ("jax.device_get", "explicit device->host sync")
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "item" and not call.args:
+            return (".item()", "scalar host sync")
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in ("float", "int") and call.args:
+            a = call.args[0]
+            if isinstance(a, ast.Name) and a.id in params:
+                return (f"{call.func.id}()",
+                        "concretizes a traced operand")
+        return None
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.match(self.scope):
+            if sf.tree is None:
+                continue
+            aliases = import_aliases(sf.tree)
+            seen: Set[int] = set()
+            for fn in self._traced_functions(sf.tree, aliases):
+                params = {a.arg for a in (fn.args.args
+                                          + fn.args.posonlyargs
+                                          + fn.args.kwonlyargs)}
+                for call in body_calls(fn):
+                    key = id(call)
+                    if key in seen:
+                        continue
+                    hit = self._forbidden(call, aliases, params)
+                    if hit is None:
+                        continue
+                    seen.add(key)
+                    what, why = hit
+                    yield self.finding(
+                        sf, call,
+                        f"{what} inside traced function "
+                        f"`{fn.name}` — {why}; move it outside the "
+                        f"trace or keep the value on-device",
+                        detail=f"{fn.name}:{what}", project=project)

@@ -1,0 +1,428 @@
+"""pdt-lint core: the AST-based invariant-analysis framework.
+
+Seven PRs of hardening produced disciplines that lived in reviewer
+memory plus two regex scans: injectable clocks, trace-safe host/device
+boundaries, fault-site/metric-catalog sync, the `_claim_candidate`
+pin/decref pairing, never-swallow supervision errors. This package
+encodes them as *checkers* — small AST passes over a parsed view of
+the repo — so every future PR is checked mechanically in tier-1 for
+the bug classes the repo has already paid to learn
+(docs/static_analysis.md is the catalog of record).
+
+Three layers, all stdlib-only:
+
+* **Project** — the parsed repo: every ``*.py`` under the scanned
+  roots as a :class:`SourceFile` (source text + ``ast`` tree + the
+  inline-suppression table), plus raw access to non-Python files
+  (docs, for the drift checkers). Parsing happens once; checkers
+  share it.
+* **Checker** — a pluggable pass: ``check(project)`` yields
+  :class:`Finding`s. Each has a stable ``code`` (``PDT0xx``), a scope
+  (fnmatch globs over repo-relative paths), and a rationale naming
+  the PR that motivated the rule. The registry lives in
+  ``analysis.checkers``.
+* **Policy** — what separates a *finding* from a *failure*:
+
+  - inline suppressions: ``# pdt-lint: disable=PDT0xx <reason>`` on
+    the offending line (or alone on the line above). The reason is
+    MANDATORY — a reasonless disable suppresses nothing and is itself
+    reported (PDT000), and an unused suppression is reported too, so
+    stale opt-outs cannot accumulate;
+  - the committed baseline (``.pdt-lint-baseline.json``) grandfathers
+    pre-existing findings by line-number-free fingerprint. It is only
+    allowed to SHRINK: a baseline entry no longer matched by the tree
+    is a failure ("remove it"), and ``--update-baseline`` can drop
+    entries but never add one — new findings must be fixed or
+    suppressed inline, with a reason, in review.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["Finding", "SourceFile", "Project", "Checker", "Suppression",
+           "Baseline", "LintResult", "run_checkers", "SUPPRESS_RE",
+           "META_CODE"]
+
+# the meta code: malformed / unused suppressions (not a pluggable
+# checker — the framework itself enforces suppression hygiene)
+META_CODE = "PDT000"
+
+SUPPRESS_RE = re.compile(
+    r"#\s*pdt-lint:\s*disable=([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)"
+    r"[ \t]*(.*?)\s*$")
+
+
+@dataclass
+class Finding:
+    """One rule violation at one source location."""
+
+    code: str                 # "PDT001"
+    path: str                 # repo-relative posix path
+    line: int                 # 1-based; 0 = whole-file/doc finding
+    message: str
+    symbol: str = ""          # enclosing Class.func dotted name
+    detail: str = ""          # stable slug (callee, site, metric name)
+    checker: str = ""
+    col: int = 0
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by the baseline: findings
+        survive unrelated edits shifting line numbers, but a second
+        occurrence of the same defect in the same symbol is a NEW
+        finding (fingerprints carry a count in the baseline)."""
+        return f"{self.code}:{self.path}:{self.symbol}:{self.detail}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{loc}: {self.code}{sym}: {self.message}"
+
+    def to_json(self) -> dict:
+        return {"code": self.code, "path": self.path, "line": self.line,
+                "col": self.col, "symbol": self.symbol,
+                "message": self.message, "detail": self.detail,
+                "checker": self.checker,
+                "fingerprint": self.fingerprint}
+
+
+@dataclass
+class Suppression:
+    """One parsed ``# pdt-lint: disable=`` comment."""
+
+    path: str
+    line: int                  # line the comment sits on
+    target_line: int           # line the suppression covers
+    codes: Tuple[str, ...]
+    reason: str
+    used: bool = False
+
+
+class SourceFile:
+    """One parsed Python file: text, AST, and its suppression table."""
+
+    def __init__(self, path: str, relpath: str, text: str):
+        self.path = path
+        self.relpath = relpath
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text, filename=relpath)
+        except SyntaxError as e:            # surfaced as a finding
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        self.suppressions: List[Suppression] = []
+        self.malformed: List[int] = []      # disable comments w/o reason
+        self._scan_suppressions()
+
+    def _scan_suppressions(self):
+        # suppressions live in COMMENT tokens only — a docstring that
+        # *mentions* the directive (this framework's own docs do) can
+        # neither suppress nor be reported as malformed
+        try:
+            comments = [
+                (tok.start[0], tok.string, tok.line)
+                for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline)
+                if tok.type == tokenize.COMMENT]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return          # unparseable: already a PDT000 finding
+        for i, comment, srcline in comments:
+            if "pdt-lint" not in comment:
+                continue
+            m = SUPPRESS_RE.search(comment)
+            if m is None:
+                # a disable ATTEMPT that does not parse (typo'd code,
+                # lowercase, missing '=') must not rot silently
+                if re.search(r"pdt-lint:\s*disable", comment):
+                    self.malformed.append(i)
+                continue
+            codes = tuple(c.strip() for c in m.group(1).split(","))
+            reason = m.group(2).strip()
+            if not reason:
+                # a reasonless disable suppresses NOTHING — the why is
+                # the reviewable part (docs/static_analysis.md)
+                self.malformed.append(i)
+                continue
+            # a comment-only line covers the next non-comment line;
+            # a trailing comment covers its own line
+            target = i
+            if srcline.strip().startswith("#"):
+                target = i + 1
+                for j in range(i, len(self.lines)):
+                    if self.lines[j].strip() \
+                            and not self.lines[j].strip().startswith("#"):
+                        target = j + 1
+                        break
+            self.suppressions.append(
+                Suppression(self.relpath, i, target, codes, reason))
+
+    def suppression_for(self, code: str,
+                        line: int) -> Optional[Suppression]:
+        for s in self.suppressions:
+            if s.target_line == line and (code in s.codes):
+                return s
+        return None
+
+
+def _enclosing_symbols(tree: ast.AST) -> Dict[int, str]:
+    """Map each function/class body line to its dotted symbol name —
+    the symbol half of the baseline fingerprint."""
+    out: Dict[int, str] = {}
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                name = f"{prefix}.{child.name}" if prefix else child.name
+                end = getattr(child, "end_lineno", child.lineno)
+                for ln in range(child.lineno, end + 1):
+                    out[ln] = name
+                walk(child, name)
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+class Project:
+    """The parsed repo view shared by every checker."""
+
+    def __init__(self, root: str, paths: Optional[List[str]] = None):
+        self.root = os.path.abspath(root)
+        self.files: Dict[str, SourceFile] = {}
+        roots = paths or [self.root]
+        for p in roots:
+            p = os.path.abspath(p)
+            if os.path.isfile(p):
+                self._add(p)
+                continue
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d not in ("__pycache__", ".git",
+                                            ".claude")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        self._add(os.path.join(dirpath, fn))
+        self._symbol_maps: Dict[str, Dict[int, str]] = {}
+
+    def _add(self, path: str):
+        rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+        if rel in self.files:
+            return
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        self.files[rel] = SourceFile(path, rel, text)
+
+    # -- checker helpers -------------------------------------------------
+    def match(self, globs: Iterable[str],
+              exclude: Iterable[str] = ()) -> List[SourceFile]:
+        out = []
+        for rel, sf in self.files.items():
+            if any(fnmatch.fnmatch(rel, g) for g in globs) \
+                    and not any(fnmatch.fnmatch(rel, g) for g in exclude):
+                out.append(sf)
+        return out
+
+    def file(self, relpath: str) -> Optional[SourceFile]:
+        return self.files.get(relpath)
+
+    def read_text(self, relpath: str) -> Optional[str]:
+        """Raw access to non-Python repo files (docs, for drift
+        checkers). Returns None when absent."""
+        path = os.path.join(self.root, relpath.replace("/", os.sep))
+        if not os.path.isfile(path):
+            return None
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+
+    def symbol_at(self, sf: SourceFile, line: int) -> str:
+        if sf.relpath not in self._symbol_maps:
+            self._symbol_maps[sf.relpath] = (
+                _enclosing_symbols(sf.tree) if sf.tree else {})
+        return self._symbol_maps[sf.relpath].get(line, "")
+
+
+class Checker:
+    """Base class for pluggable checkers. Subclasses set ``code``,
+    ``name``, ``rationale`` (the repo law + motivating PR), and
+    implement :meth:`check`. Scope lives in overridable constructor
+    args so the fixture tests exercise checkers on synthetic trees."""
+
+    code: str = "PDT999"
+    name: str = "base"
+    rationale: str = ""
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, sf: SourceFile, node, message: str,
+                detail: str = "", project: Optional[Project] = None,
+                ) -> Finding:
+        line = getattr(node, "lineno", 0) if node is not None else 0
+        col = getattr(node, "col_offset", 0) if node is not None else 0
+        symbol = project.symbol_at(sf, line) if project and line else ""
+        return Finding(self.code, sf.relpath, line, message,
+                       symbol=symbol, detail=detail, checker=self.name,
+                       col=col)
+
+
+class Baseline:
+    """The committed grandfather file: ``{fingerprint: {count,
+    reason}}``. Shrink-only — see the module docstring."""
+
+    def __init__(self, entries: Optional[Dict[str, dict]] = None,
+                 path: Optional[str] = None):
+        self.entries: Dict[str, dict] = dict(entries or {})
+        self.path = path
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        if not isinstance(doc, dict) or doc.get("version") != 1 \
+                or not isinstance(doc.get("findings"), dict):
+            raise ValueError(
+                f"{path}: not a pdt-lint baseline (need "
+                '{"version": 1, "findings": {...}})')
+        entries = {}
+        for fp, ent in doc["findings"].items():
+            if isinstance(ent, int):        # shorthand: bare count
+                ent = {"count": ent}
+            if not isinstance(ent, dict) or "count" not in ent:
+                raise ValueError(f"{path}: malformed entry {fp!r}")
+            entries[fp] = {"count": int(ent["count"]),
+                           "reason": str(ent.get("reason", ""))}
+        return cls(entries, path=path)
+
+    def save(self, path: Optional[str] = None):
+        path = path or self.path
+        doc = {"version": 1,
+               "findings": {fp: self.entries[fp]
+                            for fp in sorted(self.entries)}}
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=False)
+            f.write("\n")
+
+    def count(self, fingerprint: str) -> int:
+        ent = self.entries.get(fingerprint)
+        return int(ent["count"]) if ent else 0
+
+
+@dataclass
+class LintResult:
+    """Outcome of one lint run, after suppression + baseline policy."""
+
+    findings: List[Finding]            # every raw (unsuppressed) finding
+    new: List[Finding]                 # over the baseline: FAILURES
+    baselined: List[Finding]           # grandfathered
+    suppressed: List[Tuple[Finding, Suppression]]
+    meta: List[Finding] = field(default_factory=list)   # PDT000
+    stale_baseline: List[str] = field(default_factory=list)
+
+    @property
+    def failed(self) -> bool:
+        return bool(self.new or self.meta or self.stale_baseline)
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "findings": [f.to_json() for f in self.new + self.meta],
+            "baselined": [f.to_json() for f in self.baselined],
+            "suppressed": [
+                {**f.to_json(), "reason": s.reason,
+                 "suppressed_at": s.line}
+                for f, s in self.suppressed],
+            "stale_baseline": list(self.stale_baseline),
+            "summary": {
+                "total": len(self.findings) + len(self.meta),
+                "new": len(self.new), "meta": len(self.meta),
+                "baselined": len(self.baselined),
+                "suppressed": len(self.suppressed),
+                "stale_baseline": len(self.stale_baseline),
+                "failed": self.failed,
+            },
+        }
+
+
+def run_checkers(project: Project, checkers: Iterable[Checker],
+                 baseline: Optional[Baseline] = None,
+                 respect_suppressions: bool = True) -> LintResult:
+    """Run `checkers` over `project` and apply policy. With
+    ``respect_suppressions=False`` every raw finding lands in
+    ``new`` — the no-stale-suppressions gate in tests/test_lint.py
+    uses this to prove each committed opt-out still masks a live
+    finding."""
+    raw: List[Finding] = []
+    meta: List[Finding] = []
+    for sf in project.files.values():
+        if sf.parse_error is not None:
+            meta.append(Finding(META_CODE, sf.relpath, 0,
+                                f"unparseable: {sf.parse_error}",
+                                checker="framework",
+                                detail="parse-error"))
+    for checker in checkers:
+        raw.extend(checker.check(project))
+    raw.sort(key=lambda f: (f.path, f.line, f.code, f.detail))
+
+    suppressed: List[Tuple[Finding, Suppression]] = []
+    kept: List[Finding] = []
+    for f in raw:
+        sf = project.file(f.path)
+        sup = (sf.suppression_for(f.code, f.line)
+               if (sf is not None and respect_suppressions) else None)
+        if sup is not None:
+            sup.used = True
+            suppressed.append((f, sup))
+        else:
+            kept.append(f)
+
+    if respect_suppressions:
+        for sf in project.files.values():
+            for ln in sf.malformed:
+                meta.append(Finding(
+                    META_CODE, sf.relpath, ln,
+                    "malformed pdt-lint suppression (unparseable code "
+                    "list or missing reason) — write: "
+                    "# pdt-lint: disable=PDTxxx <why the rule does "
+                    "not apply>",
+                    checker="framework", detail="malformed-suppression"))
+            for s in sf.suppressions:
+                if not s.used:
+                    meta.append(Finding(
+                        META_CODE, sf.relpath, s.line,
+                        f"unused suppression for {','.join(s.codes)} — "
+                        "the finding it masked is gone; remove the "
+                        "comment",
+                        checker="framework", detail="unused-suppression"))
+
+    new: List[Finding] = []
+    baselined: List[Finding] = []
+    stale: List[str] = []
+    if baseline is None:
+        new = kept
+    else:
+        seen: Dict[str, int] = {}
+        for f in kept:
+            seen[f.fingerprint] = seen.get(f.fingerprint, 0) + 1
+            if seen[f.fingerprint] <= baseline.count(f.fingerprint):
+                baselined.append(f)
+            else:
+                new.append(f)
+        for fp, ent in sorted(baseline.entries.items()):
+            have = seen.get(fp, 0)
+            if have < int(ent["count"]):
+                stale.append(fp)
+    return LintResult(findings=raw, new=new, baselined=baselined,
+                      suppressed=suppressed, meta=meta,
+                      stale_baseline=stale)

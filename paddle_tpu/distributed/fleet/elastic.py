@@ -525,11 +525,15 @@ class HeartbeatMembership:
     def heartbeat(self):
         """One manual beat (for loops that prefer explicit control).
         Atomic write (tmp + rename): a reader must never observe a
-        truncated/empty file and misclassify the worker as dead."""
+        truncated/empty file and misclassify the worker as dead. The
+        payload comes from the injectable clock — freshness uses the
+        file's mtime, so the content only needs to parse as a
+        timestamp (`_beat_valid`), and a fake-clock test writes
+        fake-clock beats."""
         assert self.rank is not None
         tmp = self._beat_path(self.rank) + ".tmp"
         with open(tmp, "w") as f:
-            f.write(str(time.time()))
+            f.write(str(self._clock()))
         os.replace(tmp, self._beat_path(self.rank))
 
     def stop(self):

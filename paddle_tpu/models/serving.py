@@ -646,10 +646,19 @@ class ContinuousBatchingEngine:
                 shared = list(shared)
                 for p in shared:
                     self._incref(p)        # pin across _reserve_ok
-        if not self._reserve_ok(req, len(shared) if shared else 0):
-            if shared:
+        # the pin is held across the reservation; any exit without a
+        # reservation — refusal OR raise — must unpin (PDT005 found
+        # the raise path unguarded)
+        try:
+            ok = self._reserve_ok(req, len(shared) if shared else 0)
+        except BaseException:
+            ok = False
+            raise
+        finally:
+            if not ok and shared:
                 for p in shared:
                     self._decref(p)
+        if not ok:
             raise PoolExhausted(
                 "migration import cannot reserve worst-case pages — "
                 "retry after running requests release")
@@ -1057,12 +1066,25 @@ class ContinuousBatchingEngine:
                 shared = list(shared)
                 for p in shared:
                     self._incref(p)
-        if self.layout == "paged" and not self._reserve_ok(
-                req, len(shared) if shared else 0):
-            if shared:
-                for p in shared:
-                    self._decref(p)        # unpin before waiting
-            return None
+        if self.layout == "paged":
+            # the pin is held ACROSS the reservation (it may evict the
+            # matched chain), so the reservation's own error path must
+            # unpin — an unguarded raise here would leak the refcounts
+            # and fail a later check_invariants() far from the cause
+            # (PDT005 found this unguarded)
+            try:
+                ok = self._reserve_ok(req,
+                                      len(shared) if shared else 0)
+            except BaseException:
+                if shared:
+                    for p in shared:
+                        self._decref(p)
+                raise
+            if not ok:
+                if shared:
+                    for p in shared:
+                        self._decref(p)    # unpin before waiting
+                return None
         slot = free.pop(0)
         self._queue.pop(0)
         # slot ownership is recorded BEFORE any dispatch so a failed
@@ -1906,6 +1928,10 @@ class ContinuousBatchingEngine:
                 if telemetry.enabled() else ())
         with telemetry.span("serving.decode_step", slots=n_active,
                             rids=rids):
+            # pdt-lint: disable=PDT001 decode_step_seconds measures the
+            # REAL wall time of one device dispatch incl. its D2H sync
+            # (tokens/sec derives from it) — a fake clock here would
+            # fabricate hardware throughput, not make tests exact
             t0 = time.perf_counter()
             if self.layout == "paged" and self.attn_impl == "ragged":
                 bidx = self._decode_idx
@@ -1930,6 +1956,7 @@ class ContinuousBatchingEngine:
             # the D2H copy is the step's sync point — dispatch alone
             # returns before the device finishes, so time through it
             nxt = np.asarray(nxt)
+            # pdt-lint: disable=PDT001 same real-wall measurement as t0
             dt = time.perf_counter() - t0
         if telemetry.enabled():
             _M_DECODE_STEP.observe(dt)

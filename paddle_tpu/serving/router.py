@@ -607,7 +607,13 @@ class ServingRouter:
             return 0
         try:
             installed = h.engine.import_prefix(*entry)
-        except Exception:
+        except Exception as e:
+            # best-effort still means VISIBLE: a failing spill restore
+            # must not read as an ordinary cold miss (PDT006 — this
+            # handler swallowed errors silently before pdt-lint)
+            telemetry.event("router.prefix_restore_failed",
+                            replica=h.index,
+                            error=f"{type(e).__name__}: {e}")
             return 0
         if installed:
             telemetry.event("router.prefix_restore", replica=h.index,
@@ -660,16 +666,21 @@ class ServingRouter:
                                     tokens=len(rec.tokens)):
                     new_req, payload = transfer.migrate_request(
                         src.engine, dst.engine, req.rid,
-                        deadline=self._remaining_deadline(rec))
+                        deadline=self._remaining_deadline(rec),
+                        clock=self._clock)
             except (EngineOverloaded, PoolExhausted):
                 # target full RIGHT NOW: try other targets for later
                 # requests, retry this one next tick
                 targets = [t for t in targets if t is not dst]
                 continue
+            # pdt-lint: disable=PDT006 transfer.migrate_request already
+            # counted pdt_transfer_failures_total{stage=} and emitted
+            # transfer.failed before re-raising — a second count here
+            # would double-book the same fault
             except Exception:
-                # transfer.py counted the failure; both engines are
-                # consistent and a dead endpoint is the health/failover
-                # machinery's job — leave the request where it is
+                # both engines are consistent and a dead endpoint is
+                # the health/failover machinery's job — leave the
+                # request where it is
                 continue
             rec.replica, rec.generation = dst.index, dst.generation
             rec.engine_req = new_req    # rec.folded is unchanged: the

@@ -66,7 +66,28 @@ def main(argv=None):
     p.add_argument("--trace-out", default=None,
                    help="write the failover drill's Perfetto/Chrome "
                         "trace here (default: a temp file)")
+    p.add_argument("--lint-gate", action="store_true",
+                   help="run paddle-tpu-lint against the committed "
+                        "baseline FIRST and refuse to serve a dirty "
+                        "tree (the serving invariants the lint "
+                        "encodes are the ones this recipe's drills "
+                        "rely on — docs/static_analysis.md)")
     args = p.parse_args(argv)
+
+    if args.lint_gate:
+        # fail fast, before any model build: a tree that violates the
+        # serving invariants (or drifted from the baseline) must not
+        # demo green
+        from paddle_tpu.analysis.__main__ import main as lint_main
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        rc = lint_main([os.path.join(root, "paddle_tpu"),
+                        "--root", root])
+        if rc != 0:
+            print("lint gate: tree is dirty vs the pdt-lint baseline "
+                  "— fix or suppress (with a reason) before serving")
+            return rc
+        print("lint gate: clean vs baseline")
 
     import numpy as np
     import paddle_tpu as paddle

@@ -483,3 +483,37 @@ class TestDisaggFleet:
         assert "roles" in rendered and "prefill" in rendered \
             and "decode" in rendered
         assert "prefix store" in rendered
+
+
+class TestMigrationTiming:
+    """ISSUE 9 (pdt-lint PDT001, the live hit that motivated the
+    rule): `migrate_request` timed migrations on
+    `time.perf_counter()`, so the `pdt_transfer_seconds` quantiles the
+    bench reports could never be driven by the tests' fake clocks.
+    The clock is now injectable and the router threads ITS clock
+    through every hand-off."""
+
+    def test_fake_clock_drives_transfer_histogram(self, model):
+        src, dst = _engine(model), _engine(model)
+        rid = src.add_request([5, 4, 3, 2, 6, 7], 6)
+        src.step()                      # prefill -> RUNNING w/ output
+        ticks = iter([10.0, 11.5])
+        transfer.migrate_request(src, dst, rid,
+                                 clock=lambda: next(ticks))
+        h = telemetry.snapshot()["histograms"]["pdt_transfer_seconds"]
+        assert h[""]["count"] == 1
+        assert h[""]["sum"] == pytest.approx(1.5)
+
+    def test_router_migrations_run_on_the_router_clock(self, model):
+        router, clock = _fleet(model, "prefill:1,decode:1")
+        rids = [router.submit(p, n) for p, n in
+                [([5, 4, 3, 2, 6, 7], 8), ([9, 1, 2], 6)]]
+        out = router.run()
+        assert router.num_migrations >= 1
+        assert all(len(out[r]) > 0 for r in rids)
+        h = telemetry.snapshot()["histograms"]["pdt_transfer_seconds"]
+        # the fake clock does not advance inside one step tick, so a
+        # migration timed on the ROUTER clock observes exactly 0.0 —
+        # any perf_counter leak would observe real (nonzero) wall time
+        assert h[""]["count"] == router.num_migrations
+        assert h[""]["sum"] == 0.0

@@ -512,29 +512,34 @@ class TestCLI:
 
 
 # -- drift guards ------------------------------------------------------
+# Since ISSUE 9 these are thin wrappers over the pdt-lint checkers
+# (paddle_tpu.analysis, PDT003/PDT004) — ONE source of truth for what
+# counts as drift; the word-boundary regex scans that used to live
+# here are now AST passes shared with the `paddle-tpu-lint` CLI. The
+# wrappers run with suppressions ignored: catalog drift cannot be
+# opted out of inline.
 class TestDocsAndSiteConsistency:
+    def _project(self):
+        from paddle_tpu.analysis import Project
+        return Project(REPO, [os.path.join(REPO, "paddle_tpu")])
+
     def _documented_sites(self):
-        import paddle_tpu.utils.faults as faults
-        return set(re.findall(r"``([a-z_]+\.[a-z_]+)``",
-                              faults.__doc__))
+        from paddle_tpu.analysis.checkers.faultsites import (
+            FaultSiteDriftChecker, collect_doc_sites)
+        return collect_doc_sites(
+            self._project(), FaultSiteDriftChecker.DEFAULT_FAULTS_FILE)
 
     def test_fault_site_docstring_matches_source(self):
         """Every site in the faults.py docstring exists as a
-        fault_point() call in the source, and vice versa."""
-        in_code = set()
-        pkg = os.path.join(REPO, "paddle_tpu")
-        for dirpath, _, files in os.walk(pkg):
-            for fn in files:
-                if not fn.endswith(".py") or fn == "faults.py":
-                    continue
-                with open(os.path.join(dirpath, fn)) as f:
-                    in_code |= set(re.findall(
-                        r'fault_point\(\s*"([a-z_.]+)"\s*\)', f.read()))
-        documented = self._documented_sites()
-        assert documented == in_code, (
-            "fault-site drift: docstring-only "
-            f"{sorted(documented - in_code)}, code-only "
-            f"{sorted(in_code - documented)}")
+        fault_point() call in the source, and vice versa — the PDT003
+        checker, which also rejects non-literal fault_point() sites
+        the old regex could not see."""
+        from paddle_tpu.analysis import run_checkers
+        from paddle_tpu.analysis.checkers import FaultSiteDriftChecker
+        res = run_checkers(self._project(), [FaultSiteDriftChecker()],
+                           respect_suppressions=False)
+        assert res.new == [], ("fault-site drift: "
+                               + "; ".join(f.render() for f in res.new))
 
     def test_every_documented_site_fires_with_site_label(self):
         """Arming + visiting each documented site must produce the
@@ -555,8 +560,21 @@ class TestDocsAndSiteConsistency:
 
     def test_metric_catalog_matches_registered_instruments(self):
         """docs/observability.md's catalog rows must equal the pdt_*
-        instruments the instrumented modules actually register —
-        catches doc/metric drift in BOTH directions."""
+        instruments the code registers — drift fails in BOTH
+        directions (the PDT004 checker; being AST-based it needs no
+        import list, so modules the old test forgot to import are
+        covered too, and span/event names are checked alongside the
+        metric table)."""
+        from paddle_tpu.analysis import run_checkers
+        from paddle_tpu.analysis.checkers import CatalogDriftChecker
+        res = run_checkers(self._project(), [CatalogDriftChecker()],
+                           respect_suppressions=False)
+        assert res.new == [], ("catalog drift: "
+                               + "; ".join(f.render() for f in res.new))
+        # the static view must agree with the live registry: every
+        # dynamically registered pdt_* instrument is one the AST
+        # collector sees (guards against registration forms the
+        # checker cannot parse creeping in)
         import paddle_tpu.distributed.checkpoint      # noqa: F401
         import paddle_tpu.distributed.fleet.elastic   # noqa: F401
         import paddle_tpu.distributed.launch          # noqa: F401
@@ -564,18 +582,18 @@ class TestDocsAndSiteConsistency:
         import paddle_tpu.observability.slo           # noqa: F401
         import paddle_tpu.serving                     # noqa: F401
         import paddle_tpu.utils.faults                # noqa: F401
+        from paddle_tpu.analysis.checkers.catalog import (
+            collect_instruments)
+        static = set(collect_instruments(
+            self._project(), CatalogDriftChecker.DEFAULT_SCOPE,
+            CatalogDriftChecker.DEFAULT_EXCLUDE))
         registered = {n for n in telemetry.REGISTRY.instruments()
                       if n.startswith("pdt_")}
-        doc = os.path.join(REPO, "docs", "observability.md")
-        with open(doc) as f:
-            rows = [ln for ln in f if ln.lstrip().startswith("|")]
-        documented = set()
-        for ln in rows:
-            documented |= set(re.findall(r"`(pdt_[a-z_]*[a-z])`", ln))
-        assert documented == registered, (
-            "metric-catalog drift: docs-only "
-            f"{sorted(documented - registered)}, registered-only "
-            f"{sorted(registered - documented)}")
+        assert registered == static, (
+            "static/live registry drift: AST-collector-only (a "
+            "registration the runtime never executes) "
+            f"{sorted(static - registered)}, live-only (a form the "
+            f"collector cannot parse) {sorted(registered - static)}")
 
     def test_every_pallas_kernel_has_interpret_oracle_test(self):
         """Every `ops/` module containing a Pallas kernel must be

@@ -531,3 +531,32 @@ class TestRouterSurface:
         router.step()
         assert telemetry.value("pdt_router_replica_queue_depth",
                                replica="1") >= 0
+
+
+class TestSpillRestoreVisibility:
+    """ISSUE 9 (pdt-lint PDT006): `_restore_spill` is best-effort, but
+    a FAILING restore must be visible — before the fix it swallowed
+    every exception, so a broken spill path read as an ordinary cold
+    miss forever. The fix emits `router.prefix_restore_failed`."""
+
+    def test_failed_restore_emits_event_and_dispatch_survives(
+            self, model):
+        router, clock = _router(model, policy="prefix_affinity",
+                                roles="prefill:1,decode:1")
+        # a spilled chain exists for the prompt...
+        router.prefix_store.fetch = lambda prompt: ([[1, 2, 3, 4]],
+                                                    "bogus-kv-rows")
+        # ...but installing it into the chosen replica blows up
+        for h in router.replicas:
+            def broken(*a, _h=h, **k):
+                raise RuntimeError("spill install exploded")
+            h.engine.import_prefix = broken
+        rid = router.submit([5, 4, 3, 2, 6, 7], 6)
+        fails = [e for e in telemetry.events()
+                 if e["name"] == "router.prefix_restore_failed"]
+        assert len(fails) == 1
+        assert "RuntimeError" in fails[0]["attrs"]["error"]
+        assert fails[0]["attrs"]["replica"] == 0
+        # cache warming never fails a dispatch: the request completes
+        out = router.run()
+        assert len(out[rid]) == 6

@@ -1172,3 +1172,82 @@ class TestPageAccounting:
         res = eng.run()
         assert [len(res[r]) for r in rids] == [16, 12]
         self._assert_pool_restored(eng, baseline)
+
+
+class TestPinSafety:
+    """ISSUE 9 (pdt-lint PDT005): admission pins matched prefix pages
+    BEFORE the worst-case reservation — so the reservation's ERROR
+    path must unpin, or the refcounts leak and a later
+    `check_invariants()` dies far from the cause. Both pin-across-
+    reserve sites (`_claim_candidate`, `import_pages`) were unguarded
+    until the checker flagged them; these tests pin the guard."""
+
+    def _tiny(self):
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                          intermediate_size=64, num_hidden_layers=2,
+                          num_attention_heads=2, num_key_value_heads=1,
+                          max_position_embeddings=64)
+        paddle.seed(3)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        return m
+
+    @staticmethod
+    def _raising_reserve():
+        def boom(req, shared_pages=0):
+            raise RuntimeError("reservation accounting exploded")
+        return boom
+
+    def test_claim_candidate_unpins_when_reserve_raises(self):
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        eng = ContinuousBatchingEngine(self._tiny(), max_batch_size=1,
+                                       max_seq_len=64, page_size=4,
+                                       enable_prefix_caching=True)
+        base = list(range(1, 13))
+        rid0 = eng.add_request(base, 4)
+        res = eng.run()
+        assert len(res[rid0]) == 4          # chain now registered
+        rc_before = eng._page_rc.copy()
+        orig = eng._reserve_ok
+        eng._reserve_ok = self._raising_reserve()
+        try:
+            eng.add_request(base + [40, 41], 4)   # prefix match pins
+            with pytest.raises(RuntimeError, match="accounting"):
+                eng.step()
+        finally:
+            eng._reserve_ok = orig
+        # the pins taken for the matched prefix were released on the
+        # error path: refcounts identical, invariants hold
+        assert (eng._page_rc == rc_before).all()
+        eng.check_invariants()
+        res = eng.run()                     # and the engine still serves
+        assert len(res[rid0 + 1]) == 4
+
+    def test_import_pages_unpins_when_reserve_raises(self):
+        from paddle_tpu.models.serving import ContinuousBatchingEngine
+        m = self._tiny()
+        prompt = list(range(1, 11))
+        src = ContinuousBatchingEngine(m, max_batch_size=1,
+                                       max_seq_len=64, page_size=4)
+        rid = src.add_request(prompt, 6)
+        src.step()                          # prefilled + first token
+        payload = src.export_pages(rid)
+        dst = ContinuousBatchingEngine(m, max_batch_size=2,
+                                       max_seq_len=64, page_size=4,
+                                       enable_prefix_caching=True)
+        warm = dst.add_request(prompt, 3)
+        dst.run()                           # dst trie holds the chain
+        rc_before = dst._page_rc.copy()
+        orig = dst._reserve_ok
+        dst._reserve_ok = self._raising_reserve()
+        try:
+            with pytest.raises(RuntimeError, match="accounting"):
+                dst.import_pages(payload)
+        finally:
+            dst._reserve_ok = orig
+        assert (dst._page_rc == rc_before).all()
+        dst.check_invariants()
+        req = dst.import_pages(payload)     # and the import still works
+        assert req.request_id == payload["request_id"]
+        dst.check_invariants()
+        assert warm is not None
