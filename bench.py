@@ -227,6 +227,7 @@ REGRESSION_METRICS = (
     "detail.paged_attention.mixed_tokens_per_sec_ragged",
     "detail.disagg.colocated.tokens_per_sec",
     "detail.disagg.disaggregated.tokens_per_sec",
+    "detail.speculative.spec_decode_tokens_per_sec",
 )
 
 
@@ -510,6 +511,97 @@ def bench_disagg(model, cfg, on_tpu: bool) -> dict:
         model.train()
 
 
+def bench_speculative(model, cfg, on_tpu: bool) -> dict:
+    """Speculative-decoding A/B (ISSUE 10): the SAME shared-prefix
+    workload through a plain engine and SELF-DRAFT (target==draft,
+    acceptance ≈ 1) speculative engines at k ∈ {2, 4, 8}. Self-draft
+    isolates the MECHANISM's win — k draft steps fused into one scan
+    dispatch + one batched verify replace k+1 per-token decode
+    dispatches — from draft-model quality; a real deployment's
+    smaller draft only widens the gap. Reports effective tokens/sec
+    (full run, admission included, measured identically across
+    configs), acceptance rate, and the draft pass's share of decode
+    wall time; `spec_decode_tokens_per_sec` (the k=4 run) gates
+    regressions."""
+    import numpy as np
+    import paddle_tpu.observability as telemetry
+    from paddle_tpu.models.serving import (ContinuousBatchingEngine,
+                                           SpecConfig)
+
+    model.eval()
+    if on_tpu:
+        slots, jobs, sys_len, tail, new_toks = 8, 16, 64, 6, 64
+    else:
+        slots, jobs, sys_len, tail, new_toks = 2, 4, 8, 4, 24
+    rng = np.random.default_rng(0)
+    system = rng.integers(1, cfg.vocab_size, sys_len).tolist()
+    prompts = [system + rng.integers(1, cfg.vocab_size, tail).tolist()
+               for _ in range(jobs)]
+    max_seq = sys_len + tail + new_toks + 16
+
+    def engine_run(spec):
+        eng = ContinuousBatchingEngine(
+            model, max_batch_size=slots, max_seq_len=max_seq,
+            spec_decode=spec)
+
+        def one_pass():
+            for p in prompts:
+                eng.add_request(p, max_new_tokens=new_toks)
+            t0 = time.perf_counter()
+            out = eng.run()
+            return (sum(len(v) for v in out.values()),
+                    time.perf_counter() - t0)
+
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            # TWO warm-up passes: slot-finish desync in later passes
+            # reaches admission/verify shapes the all-fresh first pass
+            # never minted, and a compile inside a timed pass would
+            # swamp the measurement. Then best-of-3 timed passes (the
+            # `_time` discipline elsewhere in this file) so a
+            # scheduler hiccup cannot flip the A/B verdict.
+            one_pass()
+            one_pass()
+            telemetry.reset()
+            best = (0, 1.0)
+            for _ in range(3):
+                toks, dt = one_pass()
+                if toks / dt > best[0] / best[1]:
+                    best = (toks, dt)
+            toks, dt = best
+            hists = telemetry.snapshot()["histograms"]
+        finally:
+            telemetry.disable(clear_override=True)
+        stats = {"tokens_per_sec": round(toks / dt, 1)}
+        if spec is not None:
+            info = eng.spec_info()
+            draft_s = hists.get("pdt_spec_draft_seconds",
+                                {}).get("", {})
+            step_s = hists.get("pdt_serving_decode_step_seconds",
+                               {}).get("", {})
+            stats["acceptance_rate"] = round(info["acceptance_rate"], 4)
+            stats["rounds"] = info["rounds"]
+            if step_s.get("count"):
+                stats["draft_overhead_frac"] = round(
+                    draft_s.get("sum", 0.0)
+                    / max(step_s.get("sum", 0.0), 1e-9), 4)
+        return stats
+
+    try:
+        out = {"plain": engine_run(None)}
+        for k in (2, 4, 8):
+            out[f"k{k}"] = engine_run(SpecConfig(model, k=k))
+        out["spec_decode_tokens_per_sec"] = \
+            out["k4"]["tokens_per_sec"]
+        out["speedup_vs_plain_at_k4"] = round(
+            out["k4"]["tokens_per_sec"]
+            / max(out["plain"]["tokens_per_sec"], 1e-9), 3)
+        return {"speculative": out}
+    finally:
+        model.train()
+
+
 def bench_paged_attention(on_tpu: bool) -> dict:
     """Paged-attention microbench (ISSUE 6): the legacy q=1 kernel vs
     the ragged kernel vs the unbounded XLA gather path, at a decode
@@ -776,6 +868,11 @@ def run_bench(on_tpu: bool) -> dict:
         detail.update(bench_disagg(model, cfg, on_tpu))
     except Exception:
         detail["disagg_error"] = traceback.format_exc(limit=3)[-400:]
+    try:
+        detail.update(bench_speculative(model, cfg, on_tpu))
+    except Exception:
+        detail["speculative_error"] = \
+            traceback.format_exc(limit=3)[-400:]
     try:
         detail.update(bench_paged_attention(on_tpu))
     except Exception:

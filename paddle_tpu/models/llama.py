@@ -64,6 +64,18 @@ class LlamaConfig:
                            max_position_embeddings=256)
 
     @staticmethod
+    def tiny_draft():
+        """A draft-sized sibling of `tiny()` sharing its vocabulary
+        and rope coverage — the ready-made target/draft pair for
+        speculative decoding (`models.speculative`, the serving
+        engine's `spec_decode=SpecConfig(...)`), so a demo or test
+        does not have to hand-derive a compatible draft config."""
+        return LlamaConfig(vocab_size=512, hidden_size=64,
+                           intermediate_size=128, num_hidden_layers=1,
+                           num_attention_heads=2, num_key_value_heads=1,
+                           max_position_embeddings=256)
+
+    @staticmethod
     def small():
         """~110M for single-chip smoke benchmarking."""
         return LlamaConfig(vocab_size=32000, hidden_size=768,
@@ -209,7 +221,15 @@ class RaggedKVCacheView:
     `context_lens` are per sequence (N,); `block_q` is the static
     q-block size the packer aligned `query_start` to (decode batches
     pass 1); `pages_bound` is the static gather trim the XLA fallback
-    applies (None = full table)."""
+    applies (None = full table).
+
+    The speculative engine mode (`serving.SpecConfig`) rides this
+    view twice over: the VERIFY pass packs each slot as a multi-token
+    decode row (`query_len = k+1` at `context_len = pos+k+1` — the
+    chunk-continuation descriptor shape, so no new attention math),
+    and the draft scan drives the decode shape with `query_len = 0`
+    rows for masked-out slots (no ownership -> zero output, KV
+    trash-routed) — both exercised by tests/test_spec_decode.py."""
 
     def __init__(self, k_pages, v_pages, block_tables, token_seq,
                  positions, query_start, query_len, context_lens,

@@ -35,6 +35,41 @@ from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.autograd import no_grad
 from .generation import bind_state
 
+__all__ = ["speculative_generate", "spec_accept_greedy", "_spec_accept"]
+
+
+def spec_accept_greedy(greedy, props):
+    """Greedy acceptance core — the ONE copy of the prefix-match math,
+    shared by the standalone `speculative_generate` loop and the
+    serving engine's spec-decode verify pass
+    (`models/serving.py` `spec_decode=`).
+
+    `greedy` (R, K+1) int32: the target's greedy choice at each of the
+    K+1 verify rows (row i scores the token AFTER position i);
+    `props` (R, K) int32: the draft's proposals. Proposal i is
+    accepted iff it equals the target's greedy choice at the previous
+    row AND every earlier proposal was accepted. Returns
+    (j (R,) accepted count, bonus (R,) the target token emitted after
+    the accepted prefix — `greedy[r, j]`, which is the mismatch
+    correction on a rejection and the free extra token on a full
+    accept). Emitting ``greedy[r, :j+1]`` therefore reproduces the
+    target-only greedy stream EXACTLY, for any draft.
+
+    Callers may pad ragged rows: a sentinel proposal that can never
+    match (e.g. -1) caps `j` at the real proposal count. Works traced
+    (inside the compiled speculative loop) and eager; plain-numpy
+    inputs run through numpy directly — the engine calls this on the
+    host EVERY decode round, and eager jnp dispatch overhead there
+    would tax the exact hot loop speculation exists to speed up."""
+    import numpy as np
+    xp = np if isinstance(greedy, np.ndarray) \
+        and isinstance(props, np.ndarray) else jnp
+    K = props.shape[1]
+    match = props == greedy[:, :K]
+    j = xp.sum(xp.cumprod(match.astype(xp.int32), 1), 1)        # (R,)
+    bonus = xp.take_along_axis(greedy, j[:, None], 1)[:, 0]
+    return j, bonus
+
 
 def _spec_accept(p_logp, q_logp, props, key):
     """Rejection-sampling acceptance core (Leviathan et al.): given the
@@ -250,10 +285,7 @@ def _build_spec(target, draft, sig):
                 else:
                     g = jnp.argmax(v_logits._value, -1).astype(
                         jnp.int32)                  # (B, K+1)
-                    match = props == g[:, :K]       # (B, K)
-                    j = jnp.sum(jnp.cumprod(match.astype(jnp.int32), 1),
-                                1)                  # (B,) accepted count
-                    bonus = jnp.take_along_axis(g, j[:, None], 1)[:, 0]
+                    j, bonus = spec_accept_greedy(g, props)
                 i_ar = jnp.arange(K + 1)[None, :]
                 tokmat = jnp.where(
                     i_ar < j[:, None],

@@ -82,6 +82,60 @@ def pack_ragged_starts(query_lens, block_q=DEFAULT_BLOCK_Q):
     return np.asarray(starts, np.int32), cur
 
 
+def pack_ragged_batch(pieces, n_seqs, block_q=DEFAULT_BLOCK_Q,
+                      pad_to=None):
+    """Pack a batch of admission/verify pieces into the descriptor +
+    per-token arrays one ragged dispatch consumes. Each piece is a dict
+    ``{"seq": owning sequence index, "tokens": [ids...], "offset":
+    global position of the first token, "sample": bool}``; `n_seqs`
+    sizes the per-sequence descriptor arrays (the engine passes its
+    slot count). Segment starts are aligned to `block_q` and the token
+    axis is padded to a multiple of ``pad_to`` (default `block_q`) so
+    the padded length — the only program-cache key on the ragged path —
+    stays coarse. Returns a dict of int32 numpy arrays: per-token
+    ``ids`` / ``token_seq`` (-1 on padding rows, which trash-route) /
+    ``positions``; per-sequence ``query_start`` / ``query_len`` /
+    ``context_len`` / ``sample_rows`` (an out-of-range sentinel row for
+    sequences that do not sample — callers clamp in-program and never
+    read those back); plus ``t_pad`` and ``tokens``, the block_q-ALIGNED
+    row total before the final pad (a 3-token piece at block_q=8
+    contributes 8 — the historical meaning of the span ``tokens``
+    attrs fed from it, NOT the raw token count).
+
+    This is the ONE packer behind the engine's admission dispatch, the
+    speculative-verify dispatch (each slot a ``query_len = k+1``
+    multi-token row), and the draft-cache backfill prefills — the
+    descriptor format cannot drift between them."""
+    grid = int(pad_to) if pad_to else int(block_q)
+    cur = 0
+    row0 = []
+    for p in pieces:
+        row0.append(cur)
+        cur += -(-len(p["tokens"]) // block_q) * block_q
+    t_pad = -(-max(cur, 1) // grid) * grid
+    ids = np.zeros(t_pad, np.int32)
+    token_seq = np.full(t_pad, -1, np.int32)
+    positions = np.zeros(t_pad, np.int32)
+    query_start = np.zeros(n_seqs, np.int32)
+    query_len = np.zeros(n_seqs, np.int32)
+    context_len = np.zeros(n_seqs, np.int32)
+    sample_rows = np.full(n_seqs, t_pad, np.int32)
+    for p, r0 in zip(pieces, row0):
+        s, n = int(p["seq"]), len(p["tokens"])
+        ids[r0:r0 + n] = p["tokens"]
+        token_seq[r0:r0 + n] = s
+        positions[r0:r0 + n] = p["offset"] + np.arange(n)
+        query_start[s] = r0
+        query_len[s] = n
+        context_len[s] = p["offset"] + n
+        if p.get("sample"):
+            sample_rows[s] = r0 + n - 1
+    return {"ids": ids, "token_seq": token_seq, "positions": positions,
+            "query_start": query_start, "query_len": query_len,
+            "context_len": context_len, "sample_rows": sample_rows,
+            "t_pad": t_pad, "tokens": cur}
+
+
 def token_arrays(query_start, query_len, context_len, total_rows):
     """Per-token (token_seq, positions) int32 arrays for a packed ragged
     batch: ``token_seq[t]`` is the owning sequence (-1 for padding rows)

@@ -146,10 +146,13 @@ class ReplicaHandle:
         self.next_restart_time: Optional[float] = None
         self.auto_restart = True           # False for drained replicas
         self.last_progress = clock()
-        # prefix-cache counters folded in from engines this handle has
-        # already discarded, so fleet aggregates survive replica death
+        # prefix-cache + speculation counters folded in from engines
+        # this handle has already discarded, so fleet aggregates
+        # survive replica death
         self.retired_prefix_hits = 0
         self.retired_prefix_tokens_reused = 0
+        self.retired_spec = {"rounds": 0, "proposed": 0, "accepted": 0,
+                             "degraded": 0}
         _M_STATE.set(ReplicaState.CODE[self.state], replica=str(index))
 
     # -- introspection ---------------------------------------------------
@@ -180,6 +183,19 @@ class ReplicaHandle:
         live = (self.engine.prefix_tokens_reused
                 if self.engine is not None else 0)
         return self.retired_prefix_tokens_reused + live
+
+    def spec_info(self) -> dict:
+        """Speculative-decoding counters for this replica SLOT (live
+        engine + retired incarnations): a killed spec replica's
+        acceptance history must survive into the fleet aggregate."""
+        out = dict(self.retired_spec)
+        if self.engine is not None:
+            live = self.engine.spec_info()
+            for k in out:
+                out[k] += live[k]
+        out["acceptance_rate"] = out["accepted"] / max(out["proposed"],
+                                                       1)
+        return out
 
     # -- traffic ---------------------------------------------------------
     def dispatch(self, prompt: List[int], max_new_tokens: int,
@@ -291,6 +307,9 @@ class ReplicaHandle:
             self.retired_prefix_hits += self.engine.prefix_hits
             self.retired_prefix_tokens_reused += \
                 self.engine.prefix_tokens_reused
+            live_spec = self.engine.spec_info()
+            for k in self.retired_spec:
+                self.retired_spec[k] += live_spec[k]
         self.engine = None
         self.death_reason = reason
         self._transition(ReplicaState.DEAD, reason)

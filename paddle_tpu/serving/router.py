@@ -897,6 +897,21 @@ class ServingRouter:
             info["roles"] = agg
         if self.prefix_store is not None:
             info["prefix_store"] = self.prefix_store.stats()
+        # speculative decoding (engine spec_decode=): fleet-wide
+        # acceptance aggregate, retired incarnations folded in by the
+        # handles — the operator's one look at whether speculation is
+        # actually paying (a sagging acceptance rate means the draft
+        # has drifted from the traffic)
+        spec_rows = [h.spec_info() for h in self.replicas]
+        if any(r["rounds"] or r["degraded"] for r in spec_rows) \
+                or any(h.engine is not None and h.engine.spec_enabled
+                       for h in self.replicas):
+            agg = {k: sum(r[k] for r in spec_rows)
+                   for k in ("rounds", "proposed", "accepted",
+                             "degraded")}
+            agg["acceptance_rate"] = (agg["accepted"]
+                                      / max(agg["proposed"], 1))
+            info["speculation"] = agg
         if self.slo_monitor is not None:
             statuses = self.slo_monitor.evaluate()
             info["slo"] = {

@@ -29,6 +29,18 @@ folded in, greedy outputs bit-identical to a colocated fleet. Fault
 sites `transfer.serialize` / `transfer.install` (utils/faults.py)
 force both halves deterministically.
 
+Speculative decoding (engine ``spec_decode=``, ISSUE 10): the payload
+carries TARGET pages only — a source engine's DRAFT-model cache is
+deliberately DROPPED at the hand-off (`evict_request` releases the
+slot's draft pages with the slot), and the target rebuilds it lazily
+from the migrated stream on its first spec round, exactly as it does
+after a preemption's token-folding re-prefill. Serializing draft
+pages would buy one backfill prefill at the cost of coupling the
+transfer format to the draft model's geometry (and failover — whose
+payload is just the token mirror — could never honor it anyway), so
+the one rebuild is the contract: draft caches are rebuilt or dropped,
+never torn, on every path that moves a request between engines.
+
 Telemetry: `pdt_transfer_*` counters/histogram plus `transfer.serialize`
 / `transfer.install` spans that join the request's distributed trace
 via its `request_id` (docs/observability.md).

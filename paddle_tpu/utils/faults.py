@@ -38,6 +38,12 @@ Instrumented sites (grep ``fault_point(`` for the live list):
 
 * ``serving.alloc_page``, ``serving.prefill``, ``serving.decode`` —
   continuous-batching engine (models/serving.py);
+* ``speculative.draft`` — before a speculative round's draft pass
+  (backfill prefills + the k-step draft scan); ``speculative.verify``
+  — before the batched target verify dispatch (models/serving.py
+  ``spec_decode=``). Either fault DEGRADES that round to plain decode
+  — the request never fails, it just stops speculating for one step —
+  and drops draft-cache validity so the next round rebuilds it;
 * ``router.dispatch`` — before a request is handed to a replica's
   engine; ``router.step`` — before a replica with outstanding work
   steps (idle replicas do not consume visits, so ``nth=`` targets a

@@ -49,6 +49,15 @@ def main(argv=None):
     p.add_argument("--max-new-tokens", type=int, default=24)
     p.add_argument("--num-beams", type=int, default=4)
     p.add_argument("--draft-layers", type=int, default=1)
+    p.add_argument("--speculate", type=int, default=0, metavar="K",
+                   help="run the kill-a-replica fleet drill with "
+                        "ENGINE speculative decoding (spec_decode="
+                        "SpecConfig(draft, K)): the killed fleet "
+                        "drafts K tokens per slot per round while the "
+                        "unkilled reference fleet stays plain, so the "
+                        "outputs-identical assert proves losslessness "
+                        "through SIGKILL failover; prints acceptance "
+                        "rate + effective tokens/sec (0 = off)")
     p.add_argument("--attention-impl", default="ragged",
                    choices=("ragged", "legacy"),
                    help="serving attention path: the fused ragged "
@@ -219,13 +228,30 @@ def main(argv=None):
     from paddle_tpu.observability.slo import (SloMonitor,
                                               default_serving_objectives)
 
-    def fleet(mon=None):
+    # the draft model (shared by the --speculate fleet drill and the
+    # standalone speculative_generate demo below)
+    from paddle_tpu.models.serving import SpecConfig
+    d_cfg = LlamaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size // 2,
+        intermediate_size=cfg.intermediate_size // 2,
+        num_hidden_layers=args.draft_layers,
+        num_attention_heads=max(1, cfg.num_attention_heads // 2),
+        num_key_value_heads=max(1, cfg.num_key_value_heads // 2),
+        max_position_embeddings=cfg.max_position_embeddings)
+    paddle.seed(1)
+    draft = LlamaForCausalLM(d_cfg)
+    draft.eval()
+
+    def fleet(mon=None, speculate=0):
         return ServingRouter(
             lambda i: ContinuousBatchingEngine(
                 model, max_batch_size=2,
                 max_seq_len=min(256, cfg.max_position_embeddings),
                 enable_prefix_caching=True,
-                attention_impl=args.attention_impl),
+                attention_impl=args.attention_impl,
+                spec_decode=SpecConfig(draft, k=speculate)
+                if speculate else None),
             num_replicas=args.replicas, policy="prefix_affinity",
             page_size=16, slo_monitor=mon)
 
@@ -244,13 +270,15 @@ def main(argv=None):
     slo_mon = SloMonitor(default_serving_objectives(
         ttft_p95=120.0, tpot_p95=30.0, max_error_rate=0.01,
         min_availability=0.99, window_s=3600.0))
-    router = fleet(mon=slo_mon)
+    router = fleet(mon=slo_mon, speculate=args.speculate)
     ids_f = [router.submit(pr, n) for pr in fleet_jobs]
     router.step()
     router.step()                                # mid-decode everywhere
     victim = router.requests[ids_f[0]].replica
     router.kill_replica(victim)                  # SIGKILL-shaped
+    t0 = time.perf_counter()
     got_out = router.run()
+    drill_wall = time.perf_counter() - t0
     assert [got_out[i] for i in ids_f] \
         == [want_out[i] for i in ref_ids], "failover changed outputs"
     info = router.fleet_info()
@@ -262,6 +290,19 @@ def main(argv=None):
           f"affinity hit rate "
           f"{telemetry.value('pdt_router_affinity_hit_rate'):.2f}")
     assert info["failovers"] >= 1 and info["pending"] == 0
+    if args.speculate:
+        # the killed fleet ran ENGINE speculation against a PLAIN
+        # reference fleet — the assert above just proved losslessness
+        # through the SIGKILL (the survivor's rebuilt draft cache
+        # included)
+        sp = info["speculation"]
+        toks = sum(len(v) for v in got_out.values())
+        print(f"speculation: k={args.speculate}, acceptance "
+              f"{sp['acceptance_rate']:.2f} ({sp['accepted']}/"
+              f"{sp['proposed']} over {sp['rounds']} rounds, "
+              f"{sp['degraded']} degraded), effective "
+              f"{toks / drill_wall:.0f} tok/s through the kill drill")
+        assert sp["rounds"] >= 1
     print("--- router telemetry (Prometheus text exposition) ---")
     print("\n".join(line for line in telemetry.to_prometheus()
                     .splitlines() if "pdt_router" in line))
@@ -341,18 +382,8 @@ def main(argv=None):
                     in line))
     print("--- end transfer telemetry ---")
 
-    # 4) speculative decoding (draft = shallow copy of the config)
-    d_cfg = LlamaConfig(
-        vocab_size=cfg.vocab_size,
-        hidden_size=cfg.hidden_size // 2,
-        intermediate_size=cfg.intermediate_size // 2,
-        num_hidden_layers=args.draft_layers,
-        num_attention_heads=max(1, cfg.num_attention_heads // 2),
-        num_key_value_heads=max(1, cfg.num_key_value_heads // 2),
-        max_position_embeddings=cfg.max_position_embeddings)
-    paddle.seed(1)
-    draft = LlamaForCausalLM(d_cfg)
-    draft.eval()
+    # 4) standalone speculative decoding (same draft as the fleet
+    # drill's engine-mode speculation)
     want, _ = model.generate(ids, max_new_tokens=n)
     got, acc = speculative_generate(model, draft, ids, max_new_tokens=n,
                                     num_draft_tokens=4)

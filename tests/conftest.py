@@ -63,7 +63,8 @@ def pytest_configure(config):
 _TELEMETRY_FILES = ("test_serving.py", "test_chaos.py",
                     "test_telemetry.py", "test_elastic_robustness.py",
                     "test_router.py", "test_observability_slo.py",
-                    "test_ragged_attention.py", "test_disagg.py")
+                    "test_ragged_attention.py", "test_disagg.py",
+                    "test_spec_decode.py")
 
 # failing fleet-drill tests additionally attach a Chrome-trace export
 # of the telemetry ring: the failover timeline that produced the
@@ -117,7 +118,8 @@ def _serving_invariant_checks(request, monkeypatch):
     whatever test created them, for free."""
     if os.path.basename(str(request.fspath)) in (
             "test_serving.py", "test_chaos.py", "test_router.py",
-            "test_ragged_attention.py", "test_disagg.py"):
+            "test_ragged_attention.py", "test_disagg.py",
+            "test_spec_decode.py"):
         monkeypatch.setenv("PDT_CHECK_INVARIANTS", "1")
     yield
 

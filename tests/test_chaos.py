@@ -357,6 +357,85 @@ class TestEngineChaos:
             eng.check_invariants()
 
 
+class TestSpeculativeChaos:
+    """ISSUE 10 fault sites: an armed `speculative.draft` /
+    `speculative.verify` site degrades THAT round to plain decode —
+    the request never fails, the stream stays bit-identical, and the
+    degradation is visible (pdt_spec_degraded_total{site=} +
+    serving.spec_degraded event + the fault counter chaos runs
+    reconcile against)."""
+
+    JOBS = [([5, 4, 3, 2, 6, 7], 8), ([9, 1, 2], 6)]
+
+    @pytest.fixture(scope="class")
+    def draft(self):
+        cfg = LlamaConfig(vocab_size=64, hidden_size=16,
+                          intermediate_size=32, num_hidden_layers=1,
+                          num_attention_heads=2, num_key_value_heads=1,
+                          max_position_embeddings=64)
+        paddle.seed(8)
+        d = LlamaForCausalLM(cfg)
+        d.eval()
+        return d
+
+    def _run(self, model, draft=None, fault=None, k=4):
+        from paddle_tpu.models.serving import SpecConfig
+        eng = _engine(model, spec_decode=None if draft is None
+                      else SpecConfig(draft, k=k))
+        rids = [eng.add_request(p, n) for p, n in self.JOBS]
+        if fault is None:
+            reqs = _drain(eng)
+        else:
+            with FaultInjector() as fi:
+                fi.arm(fault[0], **fault[1])
+                reqs = _drain(eng)
+        return eng, [reqs[r].output for r in rids], \
+            [reqs[r].status for r in rids]
+
+    def test_draft_fault_degrades_round_not_request(self, model, draft):
+        _, want, _ = self._run(model)               # plain reference
+        telemetry.reset()
+        telemetry.clear_events()
+        eng, got, statuses = self._run(
+            model, draft, fault=("speculative.draft", dict(nth=2)))
+        assert got == want                          # still lossless
+        assert all(s == RequestStatus.FINISHED for s in statuses)
+        assert eng.num_spec_degraded == 1
+        assert eng.num_spec_rounds >= 1             # other rounds spec'd
+        snap = telemetry.snapshot()["counters"]
+        assert snap["pdt_spec_degraded_total"]['site="draft"'] == 1
+        assert snap["pdt_faults_fired_total"][
+            'site="speculative.draft"'] == 1
+        ev = [e for e in telemetry.events()
+              if e["name"] == "serving.spec_degraded"]
+        assert len(ev) == 1 and ev[0]["attrs"]["site"] == "draft"
+        # the degraded round served through the PLAIN decode dispatch
+        assert any(e["name"] == "serving.decode_step"
+                   for e in telemetry.events())
+
+    def test_verify_fault_storm_never_fails_requests(self, model,
+                                                     draft):
+        """speculative.verify armed ALWAYS: every round degrades (the
+        draft pass runs, then verify dies pre-dispatch), the engine
+        serves every request to completion through plain decode, and
+        zero spec rounds commit."""
+        _, want, _ = self._run(model)
+        telemetry.reset()
+        eng, got, statuses = self._run(
+            model, draft, fault=("speculative.verify",
+                                 dict(always=True)))
+        assert got == want
+        assert all(s == RequestStatus.FINISHED for s in statuses)
+        assert eng.num_spec_rounds == 0
+        assert eng.num_spec_degraded >= 1
+        assert telemetry.value("pdt_spec_degraded_total",
+                               site="verify") \
+            == eng.num_spec_degraded
+        assert telemetry.value("pdt_faults_fired_total",
+                               site="speculative.verify") \
+            == eng.num_spec_degraded
+
+
 class TestCheckpointChaos:
     def test_injected_save_failure_leaves_no_partial_checkpoint(
             self, tmp_path):
