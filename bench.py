@@ -228,6 +228,16 @@ REGRESSION_METRICS = (
     "detail.disagg.colocated.tokens_per_sec",
     "detail.disagg.disaggregated.tokens_per_sec",
     "detail.speculative.spec_decode_tokens_per_sec",
+    # soak (ISSUE 11): the open-loop capacity headline — virtual-time
+    # deterministic, so the threshold catches real scheduling drift
+    "detail.soak.max_sustainable_qps",
+)
+
+# latency-family regression gates: LOWER is better, a rise past the
+# threshold is the regression (ISSUE 11: the interactive lane's p95
+# TTFT under 2x overload must stay guarded like tokens/sec)
+REGRESSION_METRICS_LOWER = (
+    "detail.soak.overload.interactive_p95_ttft_s",
 )
 
 
@@ -247,14 +257,22 @@ def check_regression(prev: dict, cur: dict,
     were comparable at all (0 = nothing to compare, itself a red
     flag)."""
     regressions, compared = [], 0
-    for path in REGRESSION_METRICS:
+    for path, lower_better in \
+            [(p, False) for p in REGRESSION_METRICS] \
+            + [(p, True) for p in REGRESSION_METRICS_LOWER]:
         p, c = _dig(prev, path), _dig(cur, path)
         if not isinstance(p, (int, float)) or isinstance(p, bool) \
                 or not isinstance(c, (int, float)) \
                 or isinstance(c, bool) or p <= 0:
             continue
         compared += 1
-        if c < p * (1.0 - threshold_pct / 100.0):
+        if lower_better:
+            if c > p * (1.0 + threshold_pct / 100.0):
+                regressions.append(
+                    f"{path}: {p:g} -> {c:g} "
+                    f"({(c / p - 1) * 100:+.1f}%, threshold "
+                    f"+{threshold_pct:g}% — lower is better)")
+        elif c < p * (1.0 - threshold_pct / 100.0):
             regressions.append(
                 f"{path}: {p:g} -> {c:g} ({(c / p - 1) * 100:+.1f}%, "
                 f"threshold -{threshold_pct:g}%)")
@@ -602,6 +620,110 @@ def bench_speculative(model, cfg, on_tpu: bool) -> dict:
         model.train()
 
 
+def bench_soak(model, cfg, on_tpu: bool) -> dict:
+    """Open-loop soak capacity (ISSUE 11): max-sustainable-QPS by
+    binary search over the arrival rate of a seeded trace driven
+    through a 2-replica fleet in VIRTUAL time, then a 2x-overload run
+    with the QoS admission controller on. Virtual-time determinism
+    makes both headline numbers exact replay quantities, so the
+    regression gate catches scheduling drift, not timer noise.
+    Returns a detail sub-dict (`detail.soak`)."""
+    import paddle_tpu.observability as telemetry
+    from paddle_tpu.loadgen import (SoakDriver, TraceConfig,
+                                    VirtualClock, binary_search_qps,
+                                    generate_trace)
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+    from paddle_tpu.observability.slo import SloMonitor, SloObjective
+    from paddle_tpu.serving import QosAdmission, ServingRouter
+
+    page = 16
+    step_dt = 0.05
+    objective_s = 0.5              # interactive p95 TTFT bound
+    if on_tpu:
+        slots, duration, out_max, prompt_max = 8, 30.0, 24, 64
+    else:
+        slots, duration, out_max, prompt_max = 2, 12.0, 10, 24
+
+    def soak(qps, with_qos):
+        clock = VirtualClock()
+        mon = qos = None
+        if with_qos:
+            mon = SloMonitor(
+                [SloObjective("interactive_ttft_p95",
+                              "ttft.interactive", "latency",
+                              objective_s, quantile=0.95,
+                              window_s=duration)],
+                clock=clock)
+            qos = QosAdmission(slo_monitor=mon,
+                               shed_objective="interactive_ttft_p95",
+                               shed_burn=0.5, clock=clock)
+        router = ServingRouter(
+            lambda i: ContinuousBatchingEngine(
+                model, max_batch_size=slots, page_size=page,
+                max_seq_len=prompt_max + out_max + 2 * page,
+                attention_impl=ATTENTION_IMPL, clock=clock),
+            num_replicas=2, policy="least_outstanding", page_size=page,
+            max_replica_outstanding=4 * slots, clock=clock,
+            sleep=clock.advance, slo_monitor=mon, admission=qos)
+        trace = generate_trace(TraceConfig(
+            seed=0, duration_s=duration, base_qps=qps,
+            diurnal_amplitude=0.2, diurnal_period_s=duration,
+            burst_start_prob=0.02, burst_mean_s=1.0,
+            burst_multiplier=2.0,
+            prompt_len_median=8.0, prompt_len_max=prompt_max,
+            output_len_median=6.0, output_len_max=out_max,
+            # the 2x-overload phase must be winnable for QoS:
+            # interactive_share x 2 < 1 (docs/serving.md)
+            interactive_fraction=0.4,
+            vocab_size=cfg.vocab_size))
+        return SoakDriver(router, trace, clock=clock, step_dt=step_dt,
+                          max_wall_s=240).run().summary()
+
+    probes = {}                    # qps -> summary (soaks replay
+    #                                deterministically: probe once)
+
+    def sustainable(qps):
+        if qps not in probes:
+            probes[qps] = soak(qps, with_qos=False)
+        s = probes[qps]
+        inter = s["lanes"].get("interactive", {})
+        p95 = inter.get("ttft_p95_s")
+        # sustainable = nothing refused AND nothing admitted-then-lost
+        # (preempted/timeout sessions produce no TTFT sample, so the
+        # p95 alone would grade a lossy rate as fine)
+        served_all = s["outcomes"].get("finished", 0) == s["sessions"]
+        return served_all and (p95 is None or p95 <= objective_s)
+
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        model.eval()
+        max_qps = binary_search_qps(sustainable, 0.5, 4.0, iters=5)
+        at_max = probes.get(max_qps) or soak(max_qps, with_qos=False)
+        over = soak(max_qps * 2.0, with_qos=True)
+    finally:
+        model.train()
+        telemetry.disable(clear_override=True)
+    inter_over = over["lanes"].get("interactive", {})
+    batch_over = over["lanes"].get("batch", {})
+    return {"soak": {
+        "step_dt_s": step_dt,
+        "ttft_objective_s": objective_s,
+        "max_sustainable_qps": round(max_qps, 3),
+        "interactive_p95_ttft_s": (at_max["lanes"]
+                                   .get("interactive", {})
+                                   .get("ttft_p95_s")),
+        "overload": {
+            "arrival_qps": over["arrival_qps"],
+            "interactive_p95_ttft_s": inter_over.get("ttft_p95_s"),
+            "interactive_shed": inter_over.get("shed", 0),
+            "batch_shed": batch_over.get("shed", 0),
+            "outcomes": over["outcomes"],
+            "sheds_by_reason": over["sheds_by_reason"],
+        },
+    }}
+
+
 def bench_paged_attention(on_tpu: bool) -> dict:
     """Paged-attention microbench (ISSUE 6): the legacy q=1 kernel vs
     the ragged kernel vs the unbounded XLA gather path, at a decode
@@ -873,6 +995,10 @@ def run_bench(on_tpu: bool) -> dict:
     except Exception:
         detail["speculative_error"] = \
             traceback.format_exc(limit=3)[-400:]
+    try:
+        detail.update(bench_soak(model, cfg, on_tpu))
+    except Exception:
+        detail["soak_error"] = traceback.format_exc(limit=3)[-400:]
     try:
         detail.update(bench_paged_attention(on_tpu))
     except Exception:

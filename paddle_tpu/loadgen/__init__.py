@@ -1,0 +1,32 @@
+"""Trace-driven fleet load generation (ISSUE 11).
+
+The traffic plane for the million-user north star: `trace.py` builds
+seeded, REPLAYABLE open-loop arrival traces (diurnal + burst rate
+modulation, heavy-tailed prompt/output lengths, tenant/lane mix) and
+`driver.py` fires them at the real `ServingRouter` on a shared
+virtual clock — arrivals never wait for completions, so overload is
+real and the QoS admission controller (serving/admission.py) has
+something true to arbitrate. `recipes/fleet_soak.py` is the graded
+drill; `bench.py detail.soak` reports max-sustainable-QPS by binary
+search over the arrival rate.
+
+    from paddle_tpu.loadgen import (TraceConfig, generate_trace,
+                                    SoakDriver, VirtualClock)
+
+    clock = VirtualClock()
+    router = ServingRouter(factory, clock=clock, sleep=clock.advance,
+                           admission=QosAdmission(...))
+    result = SoakDriver(router, generate_trace(TraceConfig(seed=0)),
+                        clock=clock, step_dt=0.05).run()
+    print(result.summary())
+"""
+from .driver import (SessionRecord, SoakDriver,  # noqa: F401
+                     SoakResult, VirtualClock, binary_search_qps)
+from .trace import (ArrivalEvent, TraceConfig,  # noqa: F401
+                    generate_trace, iter_trace)
+
+__all__ = [
+    "TraceConfig", "ArrivalEvent", "iter_trace", "generate_trace",
+    "VirtualClock", "SessionRecord", "SoakResult", "SoakDriver",
+    "binary_search_qps",
+]

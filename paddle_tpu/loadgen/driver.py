@@ -1,0 +1,308 @@
+"""Open-loop soak driver: replayable traces against the REAL fleet
+router, in virtual time (ISSUE 11).
+
+The driver owns a `VirtualClock` and steps a `ServingRouter` built on
+that same clock: each tick advances virtual time by `step_dt` (the
+simulated wall cost of one fleet step), submits every trace arrival
+whose time has come — arrivals NEVER wait for completions, so
+overload is real — and harvests terminal requests into a per-session
+result table. Hundreds of thousands of sessions run in minutes of
+real time because only the model math is real; every latency (TTFT,
+queue wait, retry_after) is measured in virtual seconds on the shared
+injectable clock, which also makes a soak DETERMINISTIC: same trace
+seed + same fleet ⇒ identical metrics, bit for bit
+(tests/test_loadgen.py pins this).
+
+Outcomes per session: the router's terminal statuses (`finished` /
+`timeout` / `failed` / `preempted`) plus the two refusal surfaces —
+`shed` (a `QosShed` from the admission controller, with lane / tenant
+/ reason / retry_after recorded) and `overloaded` (hard
+`FleetOverloaded` backpressure). `SoakResult.summary()` aggregates
+per lane (exact p50/p95 TTFT via the SLO engine's quantile math),
+per tenant, and per shed reason — the numbers
+`recipes/fleet_soak.py` grades and `bench.py` regresses on.
+
+Telemetry: `pdt_loadgen_*` (docs/observability.md) + one
+`loadgen.soak` span around the drive, so a soak's scrape carries the
+workload side (arrivals, outcomes, virtual time) next to the fleet's
+own counters — the reconciliation the recipe asserts reads entirely
+off one snapshot.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional
+
+from .. import observability as telemetry
+from ..observability.slo import exact_quantile
+from ..serving.router import FleetOverloaded, QosShed, ServingRouter
+from .trace import ArrivalEvent
+
+__all__ = ["VirtualClock", "SessionRecord", "SoakResult",
+           "SoakDriver", "binary_search_qps"]
+
+_M_ARRIVALS = telemetry.counter(
+    "pdt_loadgen_arrivals_total",
+    "Trace arrivals submitted to the fleet, by lane.", ("lane",))
+_M_OUTCOMES = telemetry.counter(
+    "pdt_loadgen_outcomes_total",
+    "Soak session outcomes (terminal statuses + shed/overloaded "
+    "refusals).", ("outcome",))
+_M_OPEN = telemetry.gauge(
+    "pdt_loadgen_open_sessions",
+    "Sessions submitted but not yet terminal.")
+_M_VTIME = telemetry.gauge(
+    "pdt_loadgen_virtual_seconds",
+    "The soak's virtual clock (seconds since soak start).")
+
+
+class VirtualClock:
+    """The injectable-clock discipline's loadgen face: a monotonic
+    virtual clock shared by the trace driver, the router, its engines,
+    the SLO monitor, and the admission controller. `advance` doubles
+    as the router's `sleep` (a whole-fleet restart wait just jumps
+    virtual time)."""
+
+    def __init__(self, start: float = 0.0):
+        self.t = float(start)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float):
+        if dt < 0:
+            raise ValueError(f"cannot rewind a monotonic clock ({dt})")
+        self.t += dt
+
+
+@dataclass
+class SessionRecord:
+    """One trace session's fate."""
+
+    request_id: str
+    tenant: str
+    lane: str
+    arrival_s: float
+    outcome: str                    # finished|timeout|failed|preempted
+    #                                 |shed|overloaded|invalid
+    ttft_s: Optional[float] = None  # virtual seconds, router clock
+    tokens: int = 0
+    retry_after: Optional[float] = None
+    shed_reason: Optional[str] = None
+
+
+# refusal outcomes never entered the fleet; everything else is a
+# router terminal status
+REFUSAL_OUTCOMES = ("shed", "overloaded", "invalid")
+
+
+@dataclass
+class SoakResult:
+    """The harvest of one soak: the per-session table plus the fleet
+    snapshot taken after drain. `wall_s` is real seconds (excluded
+    from `summary()` so the summary is replay-deterministic)."""
+
+    duration_s: float          # full drive incl. the post-trace drain
+    trace_span_s: float        # first..last arrival (the open window)
+    steps: int
+    wall_s: float
+    sessions: List[SessionRecord] = field(default_factory=list)
+    fleet_info: Dict[str, object] = field(default_factory=dict)
+
+    def lane_sessions(self, lane: str) -> List[SessionRecord]:
+        return [s for s in self.sessions if s.lane == lane]
+
+    def summary(self) -> Dict[str, object]:
+        """Deterministic aggregate (virtual-time quantities only)."""
+        by_outcome: Dict[str, int] = {}
+        for s in self.sessions:
+            by_outcome[s.outcome] = by_outcome.get(s.outcome, 0) + 1
+        lanes: Dict[str, dict] = {}
+        for lane in sorted({s.lane for s in self.sessions}):
+            rows = self.lane_sessions(lane)
+            ttfts = [s.ttft_s for s in rows if s.ttft_s is not None]
+            lanes[lane] = {
+                "sessions": len(rows),
+                "finished": sum(1 for s in rows
+                                if s.outcome == "finished"),
+                "shed": sum(1 for s in rows if s.outcome == "shed"),
+                "overloaded": sum(1 for s in rows
+                                  if s.outcome == "overloaded"),
+                "tokens": sum(s.tokens for s in rows),
+                "ttft_p50_s": exact_quantile(ttfts, 0.50),
+                "ttft_p95_s": exact_quantile(ttfts, 0.95),
+            }
+        sheds_by_tenant: Dict[str, int] = {}
+        sheds_by_reason: Dict[str, int] = {}
+        for s in self.sessions:
+            if s.outcome == "shed":
+                sheds_by_tenant[s.tenant] = \
+                    sheds_by_tenant.get(s.tenant, 0) + 1
+                reason = s.shed_reason or "?"
+                sheds_by_reason[reason] = \
+                    sheds_by_reason.get(reason, 0) + 1
+        finished = by_outcome.get("finished", 0)
+        return {
+            "sessions": len(self.sessions),
+            "duration_s": self.duration_s,
+            "trace_span_s": self.trace_span_s,
+            "steps": self.steps,
+            "outcomes": dict(sorted(by_outcome.items())),
+            # arrival rate over the ARRIVAL window — duration_s also
+            # spans the drain, which would understate the offered load
+            "arrival_qps": round(len(self.sessions)
+                                 / max(self.trace_span_s, 1e-9), 4),
+            # service rate over the whole busy period, drain included
+            "completed_qps": round(finished
+                                   / max(self.duration_s, 1e-9), 4),
+            "tokens_total": sum(s.tokens for s in self.sessions),
+            "lanes": lanes,
+            "sheds_by_tenant": dict(sorted(sheds_by_tenant.items())),
+            "sheds_by_reason": dict(sorted(sheds_by_reason.items())),
+        }
+
+
+class SoakDriver:
+    """Drive one trace through one router (module docstring).
+
+    The router (and everything it feeds: engines, SLO monitor,
+    admission controller) MUST be built on `clock` — the driver
+    advances that clock and the whole stack moves together; a
+    wall-clock component would see frozen time. `step_dt` is the
+    virtual wall cost charged per router step. `max_wall_s` is a REAL
+    wall-time safety valve for runaway soaks (raises RuntimeError);
+    `release_terminal` drops router records as sessions finish so a
+    100k-session soak holds memory proportional to in-flight work.
+    """
+
+    def __init__(self, router: ServingRouter,
+                 arrivals: Iterable[ArrivalEvent], *,
+                 clock: VirtualClock, step_dt: float = 0.05,
+                 release_terminal: bool = True,
+                 max_wall_s: Optional[float] = None):
+        if step_dt <= 0:
+            raise ValueError(f"step_dt must be > 0, got {step_dt}")
+        self.router = router
+        self.arrivals = arrivals
+        self.clock = clock
+        self.step_dt = float(step_dt)
+        self.release_terminal = release_terminal
+        self.max_wall_s = max_wall_s
+        self._live: Dict[str, SessionRecord] = {}
+
+    # -- submit / harvest ------------------------------------------------
+    def _submit(self, evt: ArrivalEvent) -> SessionRecord:
+        _M_ARRIVALS.inc(lane=evt.lane)
+        rec = SessionRecord(evt.request_id, evt.tenant, evt.lane,
+                            evt.t, outcome="open")
+        try:
+            self.router.submit(list(evt.prompt),
+                               max_new_tokens=evt.max_new_tokens,
+                               request_id=evt.request_id,
+                               lane=evt.lane, tenant=evt.tenant)
+        except QosShed as e:
+            rec.outcome = "shed"
+            rec.retry_after = e.retry_after
+            rec.shed_reason = e.reason
+        except FleetOverloaded as e:
+            rec.outcome = "overloaded"
+            rec.retry_after = e.retry_after
+        except ValueError as e:
+            # a request the fleet could NEVER serve (prompt past
+            # max_seq_len): record it, keep soaking — visible in the
+            # outcome table, never a wedge
+            rec.outcome = "invalid"
+            telemetry.event("loadgen.invalid",
+                            request_id=evt.request_id,
+                            error=f"{type(e).__name__}: {e}")
+        if rec.outcome in REFUSAL_OUTCOMES:
+            _M_OUTCOMES.inc(outcome=rec.outcome)
+        else:
+            self._live[evt.request_id] = rec
+        return rec
+
+    def _harvest(self, done) -> None:
+        for fr in done:
+            rec = self._live.pop(fr.request_id, None)
+            if rec is None:
+                continue               # not one of this soak's sessions
+            rec.outcome = fr.status
+            rec.tokens = len(fr.tokens)
+            if fr.first_token_time is not None:
+                rec.ttft_s = fr.first_token_time - fr.submit_time
+            _M_OUTCOMES.inc(outcome=rec.outcome)
+            if self.release_terminal:
+                self.router.release_request(fr.request_id)
+
+    # -- the drive -------------------------------------------------------
+    def run(self) -> SoakResult:
+        t_start = self.clock()
+        wall0 = time.perf_counter()
+        sessions: List[SessionRecord] = []
+        steps = 0
+        last_arrival = 0.0
+        it = iter(self.arrivals)
+        nxt = next(it, None)
+        with telemetry.span("loadgen.soak", step_dt=self.step_dt):
+            while nxt is not None or self._live:
+                if self.max_wall_s is not None \
+                        and time.perf_counter() - wall0 \
+                        > self.max_wall_s:
+                    raise RuntimeError(
+                        f"soak exceeded max_wall_s={self.max_wall_s} "
+                        f"({len(sessions)} sessions, "
+                        f"{len(self._live)} still open)")
+                now = self.clock() - t_start
+                # open loop: submit EVERYTHING due by now, completions
+                # notwithstanding
+                while nxt is not None and nxt.t <= now:
+                    last_arrival = nxt.t
+                    sessions.append(self._submit(nxt))
+                    nxt = next(it, None)
+                if nxt is None and not self._live:
+                    break
+                if not any(h.alive() for h in self.router.replicas):
+                    # whole fleet down: jump to the next restart the
+                    # way router.run()'s sleep does, rather than
+                    # crawling there step_dt at a time
+                    waits = [h.next_restart_time - self.clock()
+                             for h in self.router.replicas
+                             if h.next_restart_time is not None]
+                    if waits and min(waits) > self.step_dt:
+                        self.clock.advance(min(waits))
+                self.clock.advance(self.step_dt)
+                self._harvest(self.router.step())
+                steps += 1
+                _M_OPEN.set(len(self._live))
+                _M_VTIME.set(self.clock() - t_start)
+        return SoakResult(
+            duration_s=self.clock() - t_start,
+            trace_span_s=last_arrival, steps=steps,
+            wall_s=time.perf_counter() - wall0, sessions=sessions,
+            fleet_info=self.router.fleet_info())
+
+
+def binary_search_qps(sustainable, lo: float, hi: float, *,
+                      iters: int = 6, grow: float = 2.0,
+                      max_grow_steps: int = 6) -> float:
+    """Max-sustainable-QPS search: `sustainable(qps) -> bool` runs one
+    (fresh-fleet) soak probe. `hi` doubles until unsustainable (capped
+    at `max_grow_steps` doublings — then HI itself is sustainable and
+    returned), then `iters` bisection rounds tighten the bracket.
+    Returns the highest known-sustainable rate. `lo` is trusted
+    sustainable (pick it trivially small)."""
+    if sustainable(hi):
+        for _ in range(max_grow_steps):
+            lo, hi = hi, hi * grow
+            if not sustainable(hi):
+                break
+        else:
+            return hi                  # never found a ceiling
+    for _ in range(iters):
+        mid = (lo + hi) / 2.0
+        if sustainable(mid):
+            lo = mid
+        else:
+            hi = mid
+    return lo

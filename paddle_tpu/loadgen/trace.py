@@ -1,0 +1,172 @@
+"""Seeded, replayable open-loop workload traces (ISSUE 11).
+
+Production serving systems are graded under OPEN-LOOP arrival
+processes: arrivals do not wait for completions, so overload is real
+and admission control is load-bearing (the closed-loop drills the
+fleet has seen so far can never overload it — every completed request
+gates the next submit). This module generates the traffic side of
+that grading, deterministically:
+
+* **Arrival process.** A rate-modulated Poisson process sampled by
+  stepwise inversion: inter-arrival gaps are exponential at the rate
+  in force at the previous arrival. The rate is `base_qps` modulated
+  by a **diurnal** sinusoid (amplitude/period) and by **burst
+  episodes** (a Markov-modulated on/off state: each off-state arrival
+  starts an episode with `burst_start_prob`, episodes last
+  exp(`burst_mean_s`) and multiply the rate by `burst_multiplier`) —
+  the two overload shapes a fleet actually sees.
+* **Heavy-tailed lengths.** Prompt and output lengths draw from
+  clamped lognormals (`*_median`, `*_sigma`, `*_max`) — a few huge
+  requests among many small ones, the tail that actually exercises
+  preemption and page pressure.
+* **Tenant / lane mix.** Each arrival carries a tenant (weighted
+  choice) and a QoS lane (`interactive` with `interactive_fraction`,
+  else `batch`) — the axes the admission controller arbitrates on.
+* **Shared prefixes.** With `num_system_prompts` > 0, a fraction of
+  prompts (`shared_prefix_prob`) prepend one of a fixed pool of
+  system prompts, giving the fleet prefix store something real to do.
+
+Everything is driven by one `random.Random(seed)`: the same config
+yields the IDENTICAL event sequence, so a soak is replayable
+bit-for-bit (tests/test_loadgen.py pins this). Times are VIRTUAL
+seconds — the driver (driver.py) maps them onto the fleet's
+injectable clock, never wall time. Stdlib-only by design: a trace can
+be generated (and inspected) without importing the serving stack.
+"""
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass
+from typing import Iterator, List, Tuple
+
+__all__ = ["TraceConfig", "ArrivalEvent", "iter_trace",
+           "generate_trace"]
+
+# lane literals mirror serving.admission.Lane (stdlib-only module:
+# the constants are duplicated, the TESTS assert they match)
+LANE_INTERACTIVE = "interactive"
+LANE_BATCH = "batch"
+
+
+@dataclass(frozen=True)
+class TraceConfig:
+    """One replayable workload (module docstring). All times/rates are
+    virtual seconds / events-per-virtual-second."""
+
+    seed: int = 0
+    duration_s: float = 60.0
+    base_qps: float = 10.0
+    # diurnal modulation: rate = base * (1 + A * sin(2*pi*t/P))
+    diurnal_amplitude: float = 0.0        # 0..1
+    diurnal_period_s: float = 240.0
+    # burst episodes (Markov-modulated): see module docstring
+    burst_start_prob: float = 0.0
+    burst_mean_s: float = 5.0
+    burst_multiplier: float = 4.0
+    # heavy-tailed lognormal lengths, clamped to [min, max]
+    prompt_len_median: float = 16.0
+    prompt_len_sigma: float = 0.6
+    prompt_len_min: int = 2
+    prompt_len_max: int = 48
+    output_len_median: float = 8.0
+    output_len_sigma: float = 0.8
+    output_len_min: int = 1
+    output_len_max: int = 32
+    # tenant mix: (name, weight) pairs; lane mix
+    tenants: Tuple[Tuple[str, float], ...] = (("acme", 3.0),
+                                              ("bidco", 1.0))
+    interactive_fraction: float = 0.7
+    # shared system prompts (fleet prefix-store realism)
+    num_system_prompts: int = 0
+    system_prompt_len: int = 16
+    shared_prefix_prob: float = 0.5
+    vocab_size: int = 64
+    request_id_prefix: str = "soak"
+
+    def __post_init__(self):
+        if self.base_qps <= 0 or self.duration_s <= 0:
+            raise ValueError("base_qps and duration_s must be > 0")
+        if not 0.0 <= self.diurnal_amplitude <= 1.0:
+            raise ValueError("diurnal_amplitude must be in [0, 1], "
+                             f"got {self.diurnal_amplitude}")
+        if not self.tenants:
+            raise ValueError("tenants must be non-empty")
+        if self.prompt_len_min < 1 or self.output_len_min < 1:
+            raise ValueError("length minima must be >= 1")
+
+
+@dataclass(frozen=True)
+class ArrivalEvent:
+    """One session arrival: submit `prompt` for `max_new_tokens` at
+    virtual time `t` on lane `lane` for `tenant`."""
+
+    t: float
+    request_id: str
+    tenant: str
+    lane: str
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+
+
+def _rate(cfg: TraceConfig, t: float, bursting: bool) -> float:
+    r = cfg.base_qps * (1.0 + cfg.diurnal_amplitude
+                        * math.sin(2.0 * math.pi * t
+                                   / cfg.diurnal_period_s))
+    if bursting:
+        r *= cfg.burst_multiplier
+    return max(r, 1e-9)
+
+
+def _length(rng: random.Random, median: float, sigma: float,
+            lo: int, hi: int) -> int:
+    # lognormal parameterized by its median: exp(mu) = median
+    n = int(round(rng.lognormvariate(math.log(max(median, 1.0)),
+                                     sigma)))
+    return max(lo, min(n, hi))
+
+
+def iter_trace(cfg: TraceConfig) -> Iterator[ArrivalEvent]:
+    """Yield the trace's arrivals in time order. Pure function of the
+    config (one seeded RNG): the same config replays identically."""
+    rng = random.Random(cfg.seed)
+    sys_prompts = [
+        tuple(rng.randrange(1, cfg.vocab_size)
+              for _ in range(cfg.system_prompt_len))
+        for _ in range(cfg.num_system_prompts)]
+    names = [n for n, _ in cfg.tenants]
+    weights = [w for _, w in cfg.tenants]
+    t = 0.0
+    burst_until = -1.0
+    i = 0
+    while True:
+        bursting = t < burst_until
+        t += rng.expovariate(_rate(cfg, t, bursting))
+        if t >= cfg.duration_s:
+            return
+        if not bursting and cfg.burst_start_prob > 0 \
+                and rng.random() < cfg.burst_start_prob:
+            burst_until = t + rng.expovariate(1.0 / cfg.burst_mean_s)
+        tenant = rng.choices(names, weights)[0]
+        lane = LANE_INTERACTIVE \
+            if rng.random() < cfg.interactive_fraction else LANE_BATCH
+        p_len = _length(rng, cfg.prompt_len_median,
+                        cfg.prompt_len_sigma, cfg.prompt_len_min,
+                        cfg.prompt_len_max)
+        o_len = _length(rng, cfg.output_len_median,
+                        cfg.output_len_sigma, cfg.output_len_min,
+                        cfg.output_len_max)
+        prefix: Tuple[int, ...] = ()
+        if sys_prompts and rng.random() < cfg.shared_prefix_prob:
+            prefix = rng.choice(sys_prompts)
+        tail = tuple(rng.randrange(1, cfg.vocab_size)
+                     for _ in range(p_len))
+        yield ArrivalEvent(t, f"{cfg.request_id_prefix}-{i}", tenant,
+                           lane, prefix + tail, o_len)
+        i += 1
+
+
+def generate_trace(cfg: TraceConfig) -> List[ArrivalEvent]:
+    """The whole trace as a list (hundreds of thousands of events are
+    fine — an event is a few dozen ints); `iter_trace` streams."""
+    return list(iter_trace(cfg))

@@ -231,6 +231,10 @@ class Request:
     # across engines. Surfaced in telemetry events and failover logs;
     # defaults to str(rid) for single-engine callers.
     request_id: str = ""
+    # QoS lane ordering (serving/admission.py Lane.PRIORITY): lower
+    # admits first; FIFO within a priority class. 0 = interactive,
+    # 1 = batch for router-submitted work
+    priority: int = 0
 
 
 class ContinuousBatchingEngine:
@@ -511,7 +515,8 @@ class ContinuousBatchingEngine:
     def add_request(self, prompt, max_new_tokens: int = 32,
                     deadline: Optional[float] = None,
                     max_queue_time: Optional[float] = None,
-                    request_id: Optional[str] = None) -> int:
+                    request_id: Optional[str] = None,
+                    priority: int = 0) -> int:
         """Queue a request. `deadline` is a completion budget in seconds
         from now on the engine's monotonic clock (overrides the engine
         `request_timeout` default); `max_queue_time` bounds time spent
@@ -519,10 +524,13 @@ class ContinuousBatchingEngine:
         identity carried through telemetry and failover logs (defaults
         to the engine-local rid) — a fleet router passes the same id on
         every re-dispatch so the request stays traceable across
-        replicas. Expired requests finalize with status `timeout` at
-        the next step tick. Raises EngineOverloaded when the bounded
-        queue is full (`max_waiting`) or the admission policy rejects
-        the request."""
+        replicas. `priority` is the QoS lane's queue class (lower
+        admits first, FIFO within a class — serving/admission.py maps
+        interactive=0, batch=1), so queued batch work can never starve
+        interactive admissions. Expired requests finalize with status
+        `timeout` at the next step tick. Raises EngineOverloaded when
+        the bounded queue is full (`max_waiting`) or the admission
+        policy rejects the request."""
         toks = [int(t) for t in np.asarray(prompt).ravel()]
         if not toks:
             raise ValueError("empty prompt")
@@ -547,7 +555,8 @@ class ContinuousBatchingEngine:
                     max_queue_time=max_queue_time
                     if max_queue_time is not None else self.max_queue_time,
                     request_id=request_id if request_id is not None
-                    else str(self._next_rid))
+                    else str(self._next_rid),
+                    priority=int(priority))
         if self.layout == "paged":
             usable = self.num_pages - 1
             need = self._worst_pages(r)
@@ -565,7 +574,14 @@ class ContinuousBatchingEngine:
                 f"admission policy rejected request (prompt {len(toks)} "
                 f"tokens, max_new_tokens {max_new_tokens})")
         self._next_rid += 1
-        self._queue.append(r)
+        # lane-aware ordering: insert behind every request of the same
+        # or more urgent class (stable — FIFO within a class). The
+        # admit loop still only ever peeks the HEAD, so the priority
+        # discipline composes with the page-reservation wait unchanged
+        idx = len(self._queue)
+        while idx > 0 and self._queue[idx - 1].priority > r.priority:
+            idx -= 1
+        self._queue.insert(idx, r)
         _M_QUEUE_DEPTH.set(len(self._queue))
         return r.rid
 
@@ -714,6 +730,7 @@ class ContinuousBatchingEngine:
             "first_token_age": None if req.first_token_time is None
             else now - req.first_token_time,
             "preemptions": req.preemptions,
+            "priority": req.priority,
             "ctx": int(self._pos[slot]),
             "last_token": int(self._tok[slot]),
             "freed": freed,
@@ -773,7 +790,8 @@ class ContinuousBatchingEngine:
                       first_token_time=None
                       if payload.get("first_token_age") is None
                       else now - payload["first_token_age"],
-                      request_id=payload["request_id"])
+                      request_id=payload["request_id"],
+                      priority=int(payload.get("priority", 0)))
         freed = int(payload["freed"])
         shared = None
         if self._prefix_enabled and not freed:
@@ -2027,7 +2045,14 @@ class ContinuousBatchingEngine:
         else:
             req.status = RequestStatus.QUEUED
             req.enqueue_time = self._clock()
-            self._queue.insert(0, req)
+            # head of its own PRIORITY CLASS: a preempted batch
+            # request resumes ahead of other batch work but never
+            # jumps queued interactive admissions
+            idx = 0
+            while idx < len(self._queue) \
+                    and self._queue[idx].priority < req.priority:
+                idx += 1
+            self._queue.insert(idx, req)
 
     def _preempt_youngest(self,
                           finished: List[Request]) -> Optional[int]:

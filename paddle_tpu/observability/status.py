@@ -3,7 +3,9 @@
 `render_fleet_status` turns `ServingRouter.fleet_info()` (per-replica
 role + health, queue depths, restart counts, the prefix-cache
 aggregate, role aggregates + prefix-store stats for disaggregated
-fleets, and — when an `SloMonitor` is attached — per-replica and
+fleets, QoS admission state — lane admit/shed counts, tenant budget
+occupancy, the arbitration burn — when a `QosAdmission` is attached,
+and — when an `SloMonitor` is attached — per-replica and
 fleet-level SLO verdicts) into the fixed-width report
 `recipes/llama_serve.py` prints after its drills; `paddle-tpu-obs
 status --from fleet.json` renders a saved snapshot. Pure formatting: no registry reads, no side effects,
@@ -62,6 +64,32 @@ def render_fleet_status(info: Dict[str, object]) -> str:
             f"{store.get('spill_hits', 0)} spill, "
             f"{store.get('misses', 0)} miss"
             + (f"; hit rate {hr:.2f}" if hr is not None else ""))
+    adm: Optional[Dict[str, object]] = \
+        info.get("admission")  # type: ignore
+    if adm:
+        lane_parts = []
+        for lane, d in sorted(adm.get("lanes", {}).items()):
+            reasons = d.get("shed_reasons") or {}
+            why = ", ".join(f"{r}={n}"
+                            for r, n in sorted(reasons.items()))
+            lane_parts.append(
+                f"{lane}={d.get('admitted', 0)} admitted"
+                f"/{d.get('shed', 0)} shed"
+                + (f" ({why})" if why else ""))
+        burn = adm.get("burn_rate", 0.0)
+        lines.append(
+            "  admission: "
+            + ("SHEDDING" if adm.get("shedding") else "open")
+            + f" (burn {burn:.2f} on {adm.get('objective', '?')}); "
+            + " ".join(lane_parts))
+        tenants = adm.get("tenants") or {}
+        if tenants:
+            t_parts = [
+                f"{name}={d.get('used_tokens', 0)}"
+                f"/{d.get('budget_tokens', 0)}"
+                + (" OVER" if d.get("over") else "")
+                for name, d in sorted(tenants.items())]
+            lines.append("  tenant budgets: " + " ".join(t_parts))
     slo: Optional[Dict[str, dict]] = info.get("slo")  # type: ignore
     if slo:
         parts = []

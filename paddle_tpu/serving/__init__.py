@@ -27,6 +27,12 @@ arXiv:2605.25645):
   hashes shared across replicas (replacing per-replica warmth sets for
   role-aware fleets) with host-RAM spill for cold chains, so a warm
   prefix outlives the replicas that computed it.
+* `admission.py` — the QoS admission brain (ISSUE 11): interactive vs
+  batch priority lanes, sliding-window per-tenant token budgets, and
+  SLO-arbitrated load shedding (the PR-5 burn-rate engine decides
+  WHEN to shed, lane/tenant ordering decides WHO) with one
+  `derive_retry_after` semantics across every refusal surface; fails
+  OPEN to plain FIFO when the controller itself breaks.
 
 Telemetry rides `pdt_router_*` / `pdt_transfer_*` /
 `pdt_prefix_store_*` (docs/serving.md "Fleet" + "Disaggregation");
@@ -41,6 +47,8 @@ this one.
     rid = router.submit(prompt, max_new_tokens=64)
     outputs = router.run()          # {request_id: tokens}
 """
+from .admission import (AdmissionDecision, Lane,  # noqa: F401
+                        QosAdmission, TenantBudget, derive_retry_after)
 from .policy import (DispatchPolicy, LeastOutstandingPolicy,  # noqa: F401
                      POLICIES, PrefixAffinityPolicy, RoundRobinPolicy,
                      make_policy)
@@ -48,12 +56,15 @@ from .prefix_store import FleetPrefixStore, chain_hashes  # noqa: F401
 from .replica import (ReplicaHandle, ReplicaRole,  # noqa: F401
                       ReplicaState)
 from .router import (FleetOverloaded, FleetRequest,  # noqa: F401
-                     ServingRouter, parse_roles)
+                     QosShed, ServingRouter, parse_roles)
 from .transfer import (install_request, migrate_request,  # noqa: F401
                        payload_nbytes, serialize_request)
 
 __all__ = [
-    "ServingRouter", "FleetRequest", "FleetOverloaded", "parse_roles",
+    "ServingRouter", "FleetRequest", "FleetOverloaded", "QosShed",
+    "parse_roles",
+    "Lane", "QosAdmission", "TenantBudget", "AdmissionDecision",
+    "derive_retry_after",
     "ReplicaHandle", "ReplicaState", "ReplicaRole",
     "DispatchPolicy", "RoundRobinPolicy", "LeastOutstandingPolicy",
     "PrefixAffinityPolicy", "POLICIES", "make_policy",

@@ -1,0 +1,454 @@
+"""QoS-tiered admission: priority lanes, tenant budgets, SLO-arbitrated
+load shedding (ISSUE 11 — docs/serving.md "Admission & QoS").
+
+Production fleets are not graded on whether they survive overload —
+open-loop traffic guarantees they will be overloaded — but on WHO they
+fail when they do. This module is the admission brain the router (and,
+one level down, the engine's `admission_policy` hook) consults before
+accepting work:
+
+* **Priority lanes.** Every request rides a lane — `interactive`
+  (latency-sensitive, protected) or `batch` (throughput work, shed
+  first). The lane maps to the engine's queue priority
+  (`Lane.PRIORITY`), so an admitted interactive request also *admits
+  into a slot* ahead of queued batch work (models/serving.py
+  lane-aware queue ordering) — batch can never starve interactive at
+  either layer.
+* **Tenant budgets.** `TenantBudget` meters admitted tokens
+  (prompt + worst-case output, the same reservation currency as the
+  engine's page admission) over a sliding window: charges expire
+  `window_s` after their admit tick, which IS the refill — no
+  separate refill clock. Over-budget tenants are the first shed.
+* **SLO-arbitrated shedding.** The PR-5 burn-rate engine decides WHEN
+  to shed: while `shed_objective`'s burn rate (fraction of the error
+  budget being consumed) is >= `shed_burn`, the controller sheds —
+  and the lane/tenant ordering decides WHO: over-budget tenants
+  first (any lane), then the whole batch lane. In-budget interactive
+  traffic is never QoS-shed; only hard backpressure
+  (`FleetOverloaded`) can refuse it.
+* **One retry_after.** `derive_retry_after` is the single semantics
+  for every refusal surface — router backpressure AND QoS shed — the
+  strongest of the queue-drain estimate, the burn-proportional
+  backoff, and any pending-restart wait, floored at `base` and capped.
+* **Fail OPEN.** The `admission.decide` fault site makes the
+  controller killable in chaos tests; every caller (router submit,
+  engine hook) degrades a controller failure to plain FIFO admission
+  — QoS is an optimization, and a broken brain must never wedge
+  submits (`pdt_admission_failopen_total` + `admission.failopen`
+  keep the degradation visible).
+
+Deterministic: clock-injectable throughout (PDT001), the burn
+evaluation is cached on the same clock (`reeval_interval_s`), and
+nothing here reads wall time — the loadgen soak drives it in virtual
+time.
+
+Telemetry: `pdt_admission_*` (docs/observability.md). Admissions are
+counted at COMMIT (after the fleet accepted the request), so the
+ledger reconciles exactly with the router's terminal counters:
+``admit decisions == fleet terminal requests`` once the fleet drains
+(recipes/fleet_soak.py asserts this) — refusals between the admit
+verdict and dispatch (`fleet_full`, request-shaped rejections) book
+nothing, and fail-OPEN admissions are deliberately outside the
+ledger (visible via `pdt_admission_failopen_total` instead).
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, Optional, Tuple
+
+from .. import observability as telemetry
+from ..utils.faults import fault_point
+
+__all__ = ["Lane", "TenantBudget", "AdmissionDecision", "QosAdmission",
+           "derive_retry_after", "note_failopen"]
+
+
+class Lane:
+    """QoS lanes and their engine queue priorities (lower admits
+    first). `interactive` is the protected latency lane; `batch` is
+    throughput work that sheds first under SLO burn."""
+
+    INTERACTIVE = "interactive"
+    BATCH = "batch"
+    ALL = frozenset({INTERACTIVE, BATCH})
+    PRIORITY = {INTERACTIVE: 0, BATCH: 1}
+
+    @classmethod
+    def of_priority(cls, priority: int) -> str:
+        return cls.INTERACTIVE if priority <= 0 else cls.BATCH
+
+
+_M_DECISIONS = telemetry.counter(
+    "pdt_admission_decisions_total",
+    "QoS admission decisions, by lane and verdict.",
+    ("lane", "decision"))
+_M_SHED = telemetry.counter(
+    "pdt_admission_shed_total",
+    "QoS sheds by lane and arbitration reason.", ("lane", "reason"))
+_M_RETRY_AFTER = telemetry.histogram(
+    "pdt_admission_retry_after_seconds",
+    "retry_after hints attached to QoS sheds.",
+    buckets=(0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0,
+             60.0))
+_M_BURN = telemetry.gauge(
+    "pdt_admission_burn_rate",
+    "The controller's cached arbitration burn rate (shed_objective).")
+_M_OVER_BUDGET = telemetry.gauge(
+    "pdt_admission_tenants_over_budget",
+    "Tenants currently over their sliding-window token budget.")
+_M_FAILOPEN = telemetry.counter(
+    "pdt_admission_failopen_total",
+    "Admission-controller failures degraded to plain FIFO admission.")
+
+
+def derive_retry_after(base: float, *, queue_depth: int = 0,
+                       burn_rate: float = 0.0,
+                       restart_wait: Optional[float] = None,
+                       cap: float = 60.0) -> float:
+    """ONE retry_after semantics for every refusal surface (router
+    backpressure and QoS shed — docs/serving.md "Admission & QoS"):
+    the strongest of
+
+    * the queue-drain estimate (``queue_depth * base``),
+    * the burn backoff (``base * burn_rate`` — clients back off
+      proportionally to how fast the SLO budget is burning),
+    * the restart wait (seconds until the next replica returns),
+
+    floored at ``base`` and capped at ``cap`` (an infinite burn must
+    not tell clients to go away forever)."""
+    hint = max(float(base), queue_depth * float(base),
+               float(base) * max(float(burn_rate), 0.0))
+    if restart_wait is not None:
+        hint = max(hint, float(restart_wait))
+    return min(hint, float(cap))
+
+
+def note_failopen(error: BaseException, where: str) -> None:
+    """Record one fail-open degradation (a broken/faulted admission
+    controller answered by plain FIFO admission). Shared by the router
+    submit path and the engine-hook wrapper so the counter means the
+    same thing everywhere."""
+    _M_FAILOPEN.inc()
+    telemetry.event("admission.failopen", where=where,
+                    error=f"{type(error).__name__}: {error}")
+
+
+class TenantBudget:
+    """Sliding-window token meter for one tenant: `charge()` records
+    admitted tokens at a clock tick, charges expire `window_s` later
+    (expiry IS the refill), `used()`/`over()` answer against the
+    bound. O(1) amortized; deterministic on the injected clock."""
+
+    def __init__(self, budget_tokens: int, window_s: float,
+                 clock: Callable[[], float]):
+        if budget_tokens < 1 or window_s <= 0:
+            raise ValueError("budget_tokens must be >= 1 and window_s "
+                             f"> 0, got {budget_tokens}/{window_s}")
+        self.budget_tokens = int(budget_tokens)
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._charges: Deque[Tuple[float, int]] = deque()
+        self._used = 0
+
+    def _expire(self, now: float):
+        cutoff = now - self.window_s
+        while self._charges and self._charges[0][0] <= cutoff:
+            self._used -= self._charges.popleft()[1]
+
+    def charge(self, tokens: int, now: Optional[float] = None):
+        now = self._clock() if now is None else now
+        self._expire(now)
+        self._charges.append((now, int(tokens)))
+        self._used += int(tokens)
+
+    def used(self, now: Optional[float] = None) -> int:
+        self._expire(self._clock() if now is None else now)
+        return self._used
+
+    def over(self, now: Optional[float] = None) -> bool:
+        return self.used(now) > self.budget_tokens
+
+
+@dataclass
+class AdmissionDecision:
+    """One `QosAdmission.decide` verdict. `cost_tokens` is the
+    reservation the caller commits against the tenant budget once the
+    fleet actually accepted the request (`QosAdmission.commit`)."""
+
+    admit: bool
+    lane: str
+    tenant: str
+    reason: str = "ok"             # ok | burn | tenant_budget
+    retry_after: float = 0.0
+    burn_rate: float = 0.0
+    cost_tokens: int = 0
+
+
+class QosAdmission:
+    """The admission brain (module docstring). Decide/commit is
+    two-phase on the router path: `decide()` arbitrates and counts the
+    decision, the router calls `commit()` only after `_dispatch`
+    succeeded — so a fleet_full refusal right after an admit verdict
+    never charges the tenant for work the fleet refused.
+
+    `slo_monitor` is the PR-5 `observability.slo.SloMonitor` the
+    router already feeds; `shed_objective` names the objective whose
+    BURN RATE arbitrates shedding (use a lane-scoped objective such as
+    ``SloObjective("interactive_ttft_p95", "ttft.interactive", ...)``
+    — the router feeds per-lane TTFT signals ``ttft.<lane>`` alongside
+    the stock ``ttft``). Without a monitor the burn is 0 and nothing
+    is ever QoS-shed (budgets may still shed with
+    ``enforce_budgets="always"``).
+
+    Budgets: `tenant_budget_tokens` is the default per-tenant bound
+    (None = unlimited); `budgets` overrides per tenant. Unknown
+    tenants inherit the default lazily.
+    """
+
+    def __init__(self, *, slo_monitor=None,
+                 shed_objective: str = "ttft_p95",
+                 shed_burn: float = 1.0,
+                 tenant_budget_tokens: Optional[int] = None,
+                 tenant_window_s: float = 60.0,
+                 budgets: Optional[Dict[str, int]] = None,
+                 enforce_budgets: str = "under_burn",
+                 default_tenant: str = "anon",
+                 retry_after_base: float = 0.05,
+                 retry_after_cap: float = 60.0,
+                 reeval_interval_s: float = 0.25,
+                 clock: Optional[Callable[[], float]] = None):
+        if enforce_budgets not in ("under_burn", "always"):
+            raise ValueError("enforce_budgets must be 'under_burn' or "
+                             f"'always', got {enforce_budgets!r}")
+        if shed_burn <= 0:
+            raise ValueError(f"shed_burn must be > 0, got {shed_burn}")
+        if tenant_budget_tokens is not None \
+                and int(tenant_budget_tokens) < 1:
+            # fail HERE, not in the first lazy budget_for() — a commit
+            # after dispatch must never be the place this surfaces
+            raise ValueError("tenant_budget_tokens must be >= 1, got "
+                             f"{tenant_budget_tokens}")
+        self.slo_monitor = slo_monitor
+        self.shed_objective = shed_objective
+        self.shed_burn = float(shed_burn)
+        self.default_budget_tokens = tenant_budget_tokens
+        self.tenant_window_s = float(tenant_window_s)
+        self._budget_overrides = dict(budgets or {})
+        self.enforce_budgets = enforce_budgets
+        self.default_tenant = default_tenant
+        self.retry_after_base = float(retry_after_base)
+        self.retry_after_cap = float(retry_after_cap)
+        self.reeval_interval_s = float(reeval_interval_s)
+        self._clock = clock if clock is not None else time.monotonic
+        self._budgets: Dict[str, TenantBudget] = {}
+        for name, tokens in self._budget_overrides.items():
+            self._budgets[name] = TenantBudget(
+                tokens, self.tenant_window_s, self._clock)
+        # stats mirror of the pdt_admission_* counters, kept locally so
+        # fleet_info/stats() work with telemetry disabled
+        self.admitted: Dict[str, int] = {}
+        self.shed: Dict[Tuple[str, str], int] = {}
+        self._burn: float = 0.0
+        self._burn_ts: Optional[float] = None
+        self._over_gauge_ts: Optional[float] = None
+
+    # -- burn arbitration ------------------------------------------------
+    def current_burn(self, now: Optional[float] = None) -> float:
+        """The shed objective's burn rate, re-evaluated at most every
+        `reeval_interval_s` on the injected clock (an `evaluate()` per
+        submit would make admission O(window) at soak rates)."""
+        if self.slo_monitor is None:
+            return 0.0
+        now = self._clock() if now is None else now
+        if self._burn_ts is None \
+                or not 0 <= now - self._burn_ts < self.reeval_interval_s:
+            st = self.slo_monitor.evaluate().get(self.shed_objective)
+            self._burn = float(st.burn_rate) if st is not None else 0.0
+            self._burn_ts = now
+            _M_BURN.set(min(self._burn, 1e9))
+        return self._burn
+
+    def shedding(self, now: Optional[float] = None) -> bool:
+        return self.current_burn(now) >= self.shed_burn
+
+    def _over_count(self, now: float) -> int:
+        out = 0
+        for tenant, b in list(self._budgets.items()):
+            if b.over(now):
+                out += 1
+            else:
+                self._maybe_prune(tenant, b, now)
+        return out
+
+    def _refresh_over_gauge(self, now: float):
+        """Keep `pdt_admission_tenants_over_budget` fresh from the
+        DECISION path (a scrape must not depend on someone polling
+        fleet_info), rate-limited on `reeval_interval_s` like the burn
+        — the count is O(tenants with live charges)."""
+        if self._over_gauge_ts is not None \
+                and 0 <= now - self._over_gauge_ts \
+                < self.reeval_interval_s:
+            return
+        self._over_gauge_ts = now
+        _M_OVER_BUDGET.set(self._over_count(now))
+
+    # -- tenant budgets --------------------------------------------------
+    def budget_for(self, tenant: str) -> Optional[TenantBudget]:
+        """The tenant's budget, creating one lazily from the default
+        bound. Only COMMIT creates entries (an admitted request is
+        about to charge); read paths use `_budgets.get` so shed
+        verdicts and adversarial tenant strings never grow the map."""
+        b = self._budgets.get(tenant)
+        if b is None and self.default_budget_tokens is not None:
+            b = TenantBudget(self.default_budget_tokens,
+                             self.tenant_window_s, self._clock)
+            self._budgets[tenant] = b
+        return b
+
+    def over_budget(self, tenant: str,
+                    now: Optional[float] = None) -> bool:
+        b = self._budgets.get(tenant)
+        if b is None:
+            return False               # no charges yet: cannot be over
+        if not b.over(now):
+            self._maybe_prune(tenant, b, now)
+            return False
+        return True
+
+    def _maybe_prune(self, tenant: str, b: TenantBudget,
+                     now: Optional[float]):
+        """Drop a default-budget tenant whose window has fully
+        drained — the map stays proportional to tenants with LIVE
+        charges, not tenants ever seen (per-user tenant ids at
+        million-user scale must not leak)."""
+        if tenant not in self._budget_overrides and b.used(now) == 0:
+            self._budgets.pop(tenant, None)
+
+    # -- the decision ----------------------------------------------------
+    def decide(self, *, prompt_tokens: int, max_new_tokens: int,
+               lane: str = Lane.INTERACTIVE,
+               tenant: Optional[str] = None,
+               queue_depth: int = 0) -> AdmissionDecision:
+        """Arbitrate one submission. Never raises on the healthy path
+        (shed is a RETURNED verdict, not an exception — the caller
+        owns the refusal surface); the `admission.decide` fault site
+        makes the controller itself killable, and every caller fails
+        OPEN to plain FIFO admission (module docstring)."""
+        fault_point("admission.decide")
+        if lane not in Lane.ALL:
+            raise ValueError(f"unknown lane {lane!r}: "
+                             f"{sorted(Lane.ALL)}")
+        tenant = tenant if tenant is not None else self.default_tenant
+        now = self._clock()
+        cost = int(prompt_tokens) + int(max_new_tokens)
+        burn = self.current_burn(now)
+        over = self.over_budget(tenant, now)
+        self._refresh_over_gauge(now)
+        reason = None
+        if burn >= self.shed_burn:
+            if over:
+                reason = "tenant_budget"
+            elif lane == Lane.BATCH:
+                reason = "burn"
+        elif over and self.enforce_budgets == "always":
+            reason = "tenant_budget"
+        if reason is None:
+            # the admit DECISION is not yet an admission: counters and
+            # stats move in commit(), once the fleet actually accepted
+            # — that is what keeps the admit ledger reconciling
+            # EXACTLY with the router's terminal counters
+            return AdmissionDecision(True, lane, tenant,
+                                     burn_rate=burn, cost_tokens=cost)
+        retry_after = derive_retry_after(
+            self.retry_after_base, queue_depth=queue_depth,
+            burn_rate=burn, cap=self.retry_after_cap)
+        _M_DECISIONS.inc(lane=lane, decision="shed")
+        _M_SHED.inc(lane=lane, reason=reason)
+        _M_RETRY_AFTER.observe(retry_after)
+        self.shed[(lane, reason)] = self.shed.get((lane, reason), 0) + 1
+        telemetry.event("admission.shed", lane=lane, tenant=tenant,
+                        reason=reason, burn_rate=round(burn, 4),
+                        retry_after=retry_after)
+        return AdmissionDecision(False, lane, tenant, reason=reason,
+                                 retry_after=retry_after,
+                                 burn_rate=burn, cost_tokens=cost)
+
+    def commit(self, decision: AdmissionDecision,
+               now: Optional[float] = None):
+        """Book an ADMITTED decision the fleet actually accepted:
+        count the admission (`pdt_admission_decisions_total{admit}` is
+        a ledger of COMMITTED admissions, which is what makes it equal
+        the router's terminal count once the fleet drains) and charge
+        the tenant budget (reservation currency: prompt + worst-case
+        output tokens, expiring with the sliding window). A dispatch
+        refusal or request-shaped rejection between decide() and here
+        books nothing anywhere in this ledger."""
+        if not decision.admit:
+            return
+        _M_DECISIONS.inc(lane=decision.lane, decision="admit")
+        self.admitted[decision.lane] = \
+            self.admitted.get(decision.lane, 0) + 1
+        b = self.budget_for(decision.tenant)
+        if b is not None:
+            b.charge(decision.cost_tokens, now)
+
+    # -- the engine hook -------------------------------------------------
+    def engine_policy(self):
+        """An `admission_policy` callable for
+        `ContinuousBatchingEngine(admission_policy=...)` — the same
+        brain one layer down for direct-engine callers: lane inferred
+        from the request's queue priority, tenant untracked (the
+        engine has no tenant concept), decide+commit single-phase
+        (nothing can refuse after the hook), and controller failures
+        fail OPEN to plain FIFO exactly like the router path."""
+        def policy(engine, req) -> bool:
+            try:
+                d = self.decide(
+                    prompt_tokens=len(req.prompt),
+                    max_new_tokens=req.max_new_tokens,
+                    lane=Lane.of_priority(req.priority),
+                    queue_depth=len(engine._queue))
+            except Exception as e:
+                note_failopen(e, where="engine.admission_policy")
+                return True
+            if d.admit:
+                try:
+                    self.commit(d)
+                except Exception as e:
+                    note_failopen(e, where="engine.admission_policy")
+            return d.admit
+        return policy
+
+    # -- operator surface ------------------------------------------------
+    def stats(self) -> Dict[str, object]:
+        """The `fleet_info()["admission"]` section
+        (observability/status.py renders it)."""
+        now = self._clock()
+        tenants = {}
+        for name, b in list(self._budgets.items()):
+            used = b.used(now)
+            if used == 0 and name not in self._budget_overrides:
+                self._budgets.pop(name, None)    # drained: prune
+                continue
+            tenants[name] = {"used_tokens": used,
+                             "budget_tokens": b.budget_tokens,
+                             "over": b.over(now)}
+        over_now = sum(1 for t in tenants.values() if t["over"])
+        _M_OVER_BUDGET.set(over_now)
+        self._over_gauge_ts = now
+        lanes = {}
+        for lane in sorted(Lane.ALL):
+            sheds = {r: n for (ln, r), n in sorted(self.shed.items())
+                     if ln == lane}
+            lanes[lane] = {"admitted": self.admitted.get(lane, 0),
+                           "shed": sum(sheds.values()),
+                           "shed_reasons": sheds}
+        return {"objective": self.shed_objective,
+                "burn_rate": self._burn,
+                "shedding": self._burn >= self.shed_burn,
+                "shed_burn": self.shed_burn,
+                "lanes": lanes,
+                "tenants": tenants,
+                "tenants_over_budget": over_now}

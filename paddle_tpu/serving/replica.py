@@ -201,16 +201,20 @@ class ReplicaHandle:
     def dispatch(self, prompt: List[int], max_new_tokens: int,
                  request_id: str,
                  deadline: Optional[float] = None,
-                 max_queue_time: Optional[float] = None) -> Request:
+                 max_queue_time: Optional[float] = None,
+                 priority: int = 0) -> Request:
         """Hand one request to this replica's engine; returns the live
-        engine Request so the router can mirror its token stream."""
+        engine Request so the router can mirror its token stream.
+        `priority` is the QoS lane's engine queue priority (lane-aware
+        ordering, models/serving.py)."""
         fault_point("router.dispatch")
         assert self.engine is not None, f"dispatch to dead replica " \
                                         f"{self.index}"
         rid = self.engine.add_request(prompt, max_new_tokens,
                                       deadline=deadline,
                                       max_queue_time=max_queue_time,
-                                      request_id=request_id)
+                                      request_id=request_id,
+                                      priority=priority)
         req = self.engine.get_request(rid)
         assert req is not None
         return req

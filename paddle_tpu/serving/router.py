@@ -17,7 +17,19 @@ on the CPU test mesh, no threads, no sleeps inside `step()`):
   (a subclass of the engine's `EngineOverloaded`, so front ends treat
   both as a 429) carrying a `retry_after` hint — queue-depth-derived
   when replicas are merely full, next-restart-derived when the whole
-  fleet is down.
+  fleet is down, and burn-boosted when a `QosAdmission` controller is
+  attached (`admission.derive_retry_after` is the ONE retry_after
+  semantics for every refusal surface).
+* **QoS** — with `admission=QosAdmission(...)` (serving/admission.py,
+  docs/serving.md "Admission & QoS") every submit carries a `lane`
+  (interactive | batch) and optional `tenant`: the controller
+  arbitrates by SLO burn rate + tenant budgets BEFORE dispatch and a
+  shed surfaces as `QosShed` (a FleetOverloaded) with a burn-derived
+  `retry_after`; admitted requests dispatch with their lane's engine
+  queue priority, so interactive work admits into slots ahead of
+  queued batch work. A controller failure (the `admission.decide`
+  fault site) fails OPEN to plain FIFO admission — QoS never wedges
+  submits.
 * **Mirroring** — the router keeps a `FleetRequest` per submission and,
   after every replica step, copies the tokens each live engine Request
   has produced (`folded + output`). This is exactly the information a
@@ -83,12 +95,14 @@ from ..observability import trace as tracing
 from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
                               PoolExhausted, Request, RequestStatus)
 from . import transfer
+from .admission import (Lane, QosAdmission, derive_retry_after,
+                        note_failopen)
 from .policy import DispatchPolicy, PrefixAffinityPolicy, make_policy
 from .prefix_store import FleetPrefixStore
 from .replica import ReplicaHandle, ReplicaRole, ReplicaState
 
 __all__ = ["ServingRouter", "FleetRequest", "FleetOverloaded",
-           "parse_roles"]
+           "QosShed", "parse_roles"]
 
 
 def parse_roles(roles):
@@ -165,6 +179,22 @@ class FleetOverloaded(EngineOverloaded):
         self.retry_after = retry_after
 
 
+class QosShed(FleetOverloaded):
+    """A QoS admission shed (serving/admission.py): the fleet COULD
+    take the request but the SLO burn / tenant-budget arbitration
+    refused it. Same 429 surface as FleetOverloaded; `retry_after` is
+    burn-derived through the shared `derive_retry_after` semantics."""
+
+    def __init__(self, message: str, retry_after: float, *,
+                 lane: str, tenant: str, reason: str,
+                 burn_rate: float):
+        super().__init__(message, retry_after)
+        self.lane = lane
+        self.tenant = tenant
+        self.reason = reason
+        self.burn_rate = burn_rate
+
+
 @dataclass
 class FleetRequest:
     """Router-side mirror of one submitted request (module docstring:
@@ -177,6 +207,11 @@ class FleetRequest:
     max_new_tokens: int
     deadline_abs: Optional[float] = None    # router-clock absolute
     max_queue_time: Optional[float] = None
+    # QoS (serving/admission.py): the lane rides into the engine as a
+    # queue priority; the tenant is admission-side bookkeeping only
+    lane: str = Lane.INTERACTIVE
+    tenant: Optional[str] = None
+    priority: int = 0
     # router-clock request timeline: TTFT for SLO purposes is measured
     # HERE (first mirrored token minus submit), not on any one engine's
     # clock — an engine's arrival_time resets on every failover
@@ -231,6 +266,7 @@ class ServingRouter:
                  clock: Optional[Callable[[], float]] = None,
                  sleep: Callable[[float], None] = time.sleep,
                  slo_monitor=None,
+                 admission: Optional[QosAdmission] = None,
                  seed: int = 0):
         # roles (disaggregated prefill/decode, docs/serving.md
         # "Disaggregation"): a spec — see `parse_roles` — defines both
@@ -256,6 +292,11 @@ class ServingRouter:
         # read-only observability hook (observability.slo.SloMonitor):
         # fed terminal outcomes + TTFT; never consulted for routing
         self.slo_monitor = slo_monitor
+        # QoS admission brain (serving/admission.py) — consulted by
+        # submit() BEFORE dispatch; unlike slo_monitor it DOES shape
+        # traffic. Build it over the same monitor/clock for
+        # burn-arbitrated shedding
+        self.admission = admission
         # the fleet-wide prefix store rides along whenever roles are on
         # (its spill is what makes a prefix outlive its replica); pass
         # `prefix_store=` to share one across routers or tune bounds
@@ -296,14 +337,48 @@ class ServingRouter:
     def submit(self, prompt, max_new_tokens: int = 32,
                request_id: Optional[str] = None,
                deadline: Optional[float] = None,
-               max_queue_time: Optional[float] = None) -> str:
+               max_queue_time: Optional[float] = None,
+               lane: str = Lane.INTERACTIVE,
+               tenant: Optional[str] = None) -> str:
         """Admit one request into the fleet; returns its stable
         request_id. Re-submitting an id already known to the router is
         a no-op returning the same id (idempotent retries: a client
         that lost the response resubmits without double-generating).
-        Raises FleetOverloaded when no replica can accept."""
+        `lane`/`tenant` feed the QoS controller when one is attached
+        (`admission=`): a QoS refusal raises `QosShed`, hard
+        backpressure raises `FleetOverloaded` — both 429-shaped with
+        one `retry_after` semantics. Raises FleetOverloaded when no
+        replica can accept."""
         if request_id is not None and request_id in self.requests:
             return request_id
+        if lane not in Lane.ALL:
+            raise ValueError(f"unknown lane {lane!r}: "
+                             f"{sorted(Lane.ALL)}")
+        toks = [int(t) for t in prompt]
+        decision = None
+        if self.admission is not None:
+            try:
+                decision = self.admission.decide(
+                    prompt_tokens=len(toks),
+                    max_new_tokens=int(max_new_tokens),
+                    lane=lane, tenant=tenant,
+                    queue_depth=min(
+                        (h.outstanding() for h in self.replicas
+                         if h.alive()), default=0))
+            except Exception as e:
+                # fail OPEN: a broken/faulted admission brain degrades
+                # to plain FIFO admission — never wedge submits
+                note_failopen(e, where="router.submit")
+                decision = None
+            if decision is not None and not decision.admit:
+                _M_REJECTIONS.inc(reason="qos_shed")
+                raise QosShed(
+                    f"QoS shed ({decision.reason}): lane "
+                    f"{decision.lane!r}, tenant {decision.tenant!r}, "
+                    f"burn {decision.burn_rate:.2f}",
+                    decision.retry_after, lane=decision.lane,
+                    tenant=decision.tenant, reason=decision.reason,
+                    burn_rate=decision.burn_rate)
         if request_id is None:
             # skip ids the caller already used — colliding would
             # silently overwrite an in-flight record
@@ -311,12 +386,12 @@ class ServingRouter:
                 self._next_id += 1
             request_id = f"fleet-{self._next_id}"
             self._next_id += 1
-        toks = [int(t) for t in prompt]
         now = self._clock()
         rec = FleetRequest(
             request_id, toks, int(max_new_tokens),
             deadline_abs=None if deadline is None else now + deadline,
-            max_queue_time=max_queue_time, submit_time=now)
+            max_queue_time=max_queue_time, submit_time=now,
+            lane=lane, tenant=tenant, priority=Lane.PRIORITY[lane])
         # one distributed trace per request, keyed by the stable id:
         # every span/event below that carries this request_id (dispatch
         # attempts, engine prefill/first-token/terminal, failovers)
@@ -330,6 +405,16 @@ class ServingRouter:
         except BaseException:
             tracing.end_trace(request_id)   # refused: nothing to trace
             raise
+        # budget charge only AFTER the fleet actually accepted — a
+        # fleet_full refusal must not bill the tenant for nothing.
+        # Fail OPEN like decide(): the request is ALREADY dispatched,
+        # so a broken commit must lose the bookkeeping, never the
+        # request
+        if decision is not None:
+            try:
+                self.admission.commit(decision)
+            except Exception as e:
+                note_failopen(e, where="router.commit")
         self.requests[request_id] = rec
         self._live[request_id] = rec
         return request_id
@@ -349,7 +434,25 @@ class ServingRouter:
         return [h for h in capable
                 if h.state == ReplicaState.DEGRADED]
 
+    def _burn_hint(self) -> float:
+        """The QoS controller's cached burn rate for retry_after
+        derivation (0 without a controller — and 0 when the controller
+        is broken: the hint is best-effort, fail open)."""
+        if self.admission is None:
+            return 0.0
+        try:
+            return self.admission.current_burn()
+        except Exception as e:
+            # same fail-open surface as a decide() fault: degraded,
+            # never silent (PDT006)
+            note_failopen(e, where="router.retry_after")
+            return 0.0
+
     def _overloaded(self) -> FleetOverloaded:
+        # both refusal reasons derive retry_after through the SAME
+        # semantics as a QoS shed (admission.derive_retry_after):
+        # queue drain vs burn backoff vs restart wait, whichever is
+        # strongest
         now = self._clock()
         # DRAINING replicas are alive but their capacity is never
         # coming back for NEW work — they must not feed a
@@ -365,14 +468,18 @@ class ServingRouter:
             return FleetOverloaded(
                 f"every replica queue is full "
                 f"({len(alive)} alive, min depth {depth})",
-                retry_after=max(self._retry_cost,
-                                depth * self._retry_cost))
+                retry_after=derive_retry_after(
+                    self._retry_cost, queue_depth=depth,
+                    burn_rate=self._burn_hint()))
         _M_REJECTIONS.inc(reason="no_replicas")
         pending = [h.next_restart_time - now for h in self.replicas
                    if h.next_restart_time is not None]
         return FleetOverloaded(
             "no live replicas",
-            retry_after=max(0.001, min(pending)) if pending else 1.0)
+            retry_after=derive_retry_after(
+                0.001, burn_rate=self._burn_hint(),
+                restart_wait=max(0.001, min(pending))
+                if pending else 1.0))
 
     def _dispatch(self, rec: FleetRequest, forced: bool):
         """Place `rec` on a replica. `forced` (failover) ignores the
@@ -453,7 +560,8 @@ class ServingRouter:
                         self._effective_prompt(rec),
                         self._remaining_budget(rec), rec.request_id,
                         deadline=self._remaining_deadline(rec),
-                        max_queue_time=rec.max_queue_time)
+                        max_queue_time=rec.max_queue_time,
+                        priority=rec.priority)
             except EngineOverloaded:
                 # the engine's OWN admission bound refused (a factory
                 # that set max_waiting): not a health event — try the
@@ -790,9 +898,12 @@ class ServingRouter:
                             rec.status == RequestStatus.FINISHED,
                             replica=replica)
         if rec.first_token_time is not None:
-            mon.observe("ttft",
-                        rec.first_token_time - rec.submit_time,
-                        replica=replica)
+            ttft = rec.first_token_time - rec.submit_time
+            mon.observe("ttft", ttft, replica=replica)
+            # lane-scoped signal (`ttft.interactive` / `ttft.batch`)
+            # so QoS arbitration can burn on the PROTECTED lane's
+            # objective alone — docs/serving.md "Admission & QoS"
+            mon.observe(f"ttft.{rec.lane}", ttft, replica=replica)
 
     # -- operator surface ------------------------------------------------
     def kill_replica(self, index: int, reason: str = "killed"):
@@ -912,6 +1023,10 @@ class ServingRouter:
             agg["acceptance_rate"] = (agg["accepted"]
                                       / max(agg["proposed"], 1))
             info["speculation"] = agg
+        if self.admission is not None:
+            # lane admit/shed counts, tenant budget occupancy, and the
+            # arbitration burn — render with render_fleet_status
+            info["admission"] = self.admission.stats()
         if self.slo_monitor is not None:
             statuses = self.slo_monitor.evaluate()
             info["slo"] = {

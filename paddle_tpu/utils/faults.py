@@ -50,6 +50,11 @@ Instrumented sites (grep ``fault_point(`` for the live list):
   specific busy replica of a fleet); ``router.health`` — inside every
   replica health probe (serving/replica.py — failures drive the
   HEALTHY -> DEGRADED -> DEAD machine and zero-loss failover);
+* ``admission.decide`` — inside ``QosAdmission.decide``
+  (serving/admission.py), before any arbitration: every caller (the
+  router submit path, the engine's ``admission_policy`` hook) treats
+  a controller fault as FAIL OPEN — the request admits plain FIFO,
+  ``pdt_admission_failopen_total`` counts, QoS never wedges submits;
 * ``transfer.serialize`` — before a migration serializes a request's
   KV pages out of its source engine; ``transfer.install`` — before the
   payload installs into the target engine's paged cache

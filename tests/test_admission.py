@@ -1,0 +1,479 @@
+"""QoS-tiered admission (serving/admission.py + the router/engine
+wiring, ISSUE 11): lane-aware queue ordering, sliding-window tenant
+budgets, burn-arbitrated shed ordering, the unified retry_after
+semantics, and the admission.decide fail-OPEN chaos discipline.
+conftest runs this file with PDT_TELEMETRY=1 and
+PDT_CHECK_INVARIANTS=1."""
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as telemetry
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import (ContinuousBatchingEngine,
+                                       EngineOverloaded)
+from paddle_tpu.observability.slo import SloMonitor, SloObjective
+from paddle_tpu.serving import (FleetOverloaded, Lane, QosAdmission,
+                                QosShed, ServingRouter, TenantBudget,
+                                derive_retry_after)
+from paddle_tpu.utils.faults import FaultError, FaultInjector
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      num_key_value_heads=1, max_position_embeddings=64)
+    paddle.seed(7)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _engine(model, clock=None, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", 4)
+    return ContinuousBatchingEngine(model, clock=clock, **kw)
+
+
+def _monitor(clock, threshold=0.1, window=60.0):
+    return SloMonitor(
+        [SloObjective("interactive_ttft_p95", "ttft.interactive",
+                      "latency", threshold, quantile=0.95,
+                      window_s=window)],
+        clock=clock)
+
+
+def _burn(mon, n=20, value=1.0):
+    """Feed `n` breach-shaped interactive TTFT samples (all past the
+    0.1s objective -> burn = 1/0.05 = 20)."""
+    for _ in range(n):
+        mon.observe("ttft.interactive", value)
+
+
+class TestDeriveRetryAfter:
+    def test_floor_is_base(self):
+        assert derive_retry_after(0.05) == 0.05
+
+    def test_queue_drain_term(self):
+        assert derive_retry_after(0.05, queue_depth=10) == \
+            pytest.approx(0.5)
+
+    def test_burn_term(self):
+        assert derive_retry_after(0.05, burn_rate=20.0) == \
+            pytest.approx(1.0)
+
+    def test_restart_wait_term(self):
+        assert derive_retry_after(0.05, restart_wait=3.0) == 3.0
+
+    def test_strongest_wins(self):
+        assert derive_retry_after(0.1, queue_depth=4, burn_rate=2.0,
+                                  restart_wait=0.3) == \
+            pytest.approx(0.4)
+
+    def test_cap(self):
+        assert derive_retry_after(0.05, burn_rate=1e12, cap=60.0) == 60.0
+
+
+class TestTenantBudget:
+    def test_sliding_window_refill(self):
+        clock = FakeClock()
+        b = TenantBudget(100, window_s=10.0, clock=clock)
+        b.charge(80)
+        assert b.used() == 80 and not b.over()
+        clock.advance(5.0)
+        b.charge(40)
+        assert b.used() == 120 and b.over()
+        clock.advance(5.5)               # first charge expired
+        assert b.used() == 40 and not b.over()
+        clock.advance(5.0)               # second charge expired too
+        assert b.used() == 0
+
+    def test_validation(self):
+        clock = FakeClock()
+        with pytest.raises(ValueError):
+            TenantBudget(0, 10.0, clock)
+        with pytest.raises(ValueError):
+            TenantBudget(10, 0.0, clock)
+
+
+class TestLaneOrdering:
+    def test_lane_constants_match_trace_module(self):
+        # trace.py is stdlib-only by design and duplicates the lane
+        # literals; this is the drift pin
+        from paddle_tpu.loadgen import trace
+        assert trace.LANE_INTERACTIVE == Lane.INTERACTIVE
+        assert trace.LANE_BATCH == Lane.BATCH
+        assert Lane.PRIORITY[Lane.INTERACTIVE] \
+            < Lane.PRIORITY[Lane.BATCH]
+
+    def test_queue_orders_by_priority_fifo_within(self, model):
+        eng = _engine(model, max_batch_size=1)
+        eng.add_request([1, 2], 2, priority=1, request_id="b0")
+        eng.add_request([3, 4], 2, priority=0, request_id="i0")
+        eng.add_request([5, 6], 2, priority=1, request_id="b1")
+        eng.add_request([7, 8], 2, priority=0, request_id="i1")
+        assert [r.request_id for r in eng._queue] == \
+            ["i0", "i1", "b0", "b1"]
+
+    def test_interactive_claims_slot_before_queued_batch(self, model):
+        eng = _engine(model, max_batch_size=1)
+        eng.add_request([1, 2, 3], 6, priority=1, request_id="batch")
+        eng.add_request([4, 5, 6], 6, priority=0,
+                        request_id="interactive")
+        eng.step()
+        running = [r for r in eng._slot_req if r is not None]
+        assert [r.request_id for r in running] == ["interactive"]
+
+    def test_requeue_reenters_head_of_own_class(self, model):
+        eng = _engine(model)
+        eng.add_request([1, 2], 4, priority=0, request_id="i0")
+        eng.add_request([3, 4], 4, priority=1, request_id="b0")
+        victim = eng._queue[1]
+        eng._queue.remove(victim)
+        eng._requeue_or_starve(victim, [])
+        # ahead of nothing batch-side, but never ahead of interactive
+        assert [r.request_id for r in eng._queue] == ["i0", "b0"]
+        eng.run()
+
+    def test_priority_survives_migration_payload(self, model):
+        src = _engine(model, max_batch_size=1)
+        dst = _engine(model, max_batch_size=1)
+        rid = src.add_request([5, 4, 3, 2], 6, priority=1)
+        src.step()                       # prefill: now RUNNING
+        payload = src.export_pages(rid)
+        assert payload["priority"] == 1
+        req = dst.import_pages(payload)
+        assert req.priority == 1
+        src.evict_request(rid)
+
+
+class TestQosDecide:
+    def test_no_monitor_admits_everything(self):
+        clock = FakeClock()
+        qos = QosAdmission(clock=clock)
+        for lane in (Lane.INTERACTIVE, Lane.BATCH):
+            d = qos.decide(prompt_tokens=4, max_new_tokens=4,
+                           lane=lane)
+            assert d.admit and d.reason == "ok"
+            qos.commit(d)
+        # the ledger moves at COMMIT, not at the verdict
+        assert qos.stats()["lanes"][Lane.BATCH]["admitted"] == 1
+        d = qos.decide(prompt_tokens=4, max_new_tokens=4,
+                       lane=Lane.BATCH)
+        assert d.admit                   # verdict without commit:
+        assert qos.stats()["lanes"][Lane.BATCH]["admitted"] == 1
+
+    def test_burn_sheds_batch_not_interactive(self):
+        clock = FakeClock()
+        mon = _monitor(clock)
+        _burn(mon)
+        qos = QosAdmission(slo_monitor=mon,
+                           shed_objective="interactive_ttft_p95",
+                           shed_burn=1.0, clock=clock)
+        assert qos.current_burn() > 1.0
+        shed = qos.decide(prompt_tokens=4, max_new_tokens=4,
+                          lane=Lane.BATCH)
+        assert not shed.admit and shed.reason == "burn"
+        assert shed.retry_after > 0
+        ok = qos.decide(prompt_tokens=4, max_new_tokens=4,
+                        lane=Lane.INTERACTIVE)
+        assert ok.admit
+        snap = telemetry.snapshot()["counters"]
+        assert snap["pdt_admission_shed_total"][
+            'lane="batch",reason="burn"'] == 1
+
+    def test_over_budget_tenant_sheds_first_any_lane(self):
+        clock = FakeClock()
+        mon = _monitor(clock)
+        _burn(mon)
+        qos = QosAdmission(slo_monitor=mon,
+                           shed_objective="interactive_ttft_p95",
+                           shed_burn=1.0, budgets={"hog": 10},
+                           clock=clock)
+        d = qos.decide(prompt_tokens=8, max_new_tokens=8,
+                       lane=Lane.INTERACTIVE, tenant="hog")
+        assert d.admit                   # in budget so far
+        qos.commit(d)                    # 16 tokens charged: now over
+        d2 = qos.decide(prompt_tokens=8, max_new_tokens=8,
+                        lane=Lane.INTERACTIVE, tenant="hog")
+        assert not d2.admit and d2.reason == "tenant_budget"
+        # a different, in-budget tenant still admits interactively
+        d3 = qos.decide(prompt_tokens=8, max_new_tokens=8,
+                        lane=Lane.INTERACTIVE, tenant="polite")
+        assert d3.admit
+
+    def test_budgets_idle_without_burn_by_default(self):
+        clock = FakeClock()
+        qos = QosAdmission(budgets={"hog": 10}, clock=clock)
+        d = qos.decide(prompt_tokens=50, max_new_tokens=50,
+                       lane=Lane.BATCH, tenant="hog")
+        qos.commit(d)
+        assert qos.over_budget("hog")
+        # burn is 0 (no monitor): under_burn enforcement stays open
+        assert qos.decide(prompt_tokens=4, max_new_tokens=4,
+                          lane=Lane.BATCH, tenant="hog").admit
+
+    def test_enforce_budgets_always(self):
+        clock = FakeClock()
+        qos = QosAdmission(budgets={"hog": 10},
+                           enforce_budgets="always", clock=clock)
+        qos.commit(qos.decide(prompt_tokens=20, max_new_tokens=20,
+                              lane=Lane.BATCH, tenant="hog"))
+        d = qos.decide(prompt_tokens=4, max_new_tokens=4,
+                       lane=Lane.BATCH, tenant="hog")
+        assert not d.admit and d.reason == "tenant_budget"
+
+    def test_commit_not_decide_charges_the_budget(self):
+        clock = FakeClock()
+        qos = QosAdmission(tenant_budget_tokens=100, clock=clock)
+        d = qos.decide(prompt_tokens=30, max_new_tokens=30,
+                       lane=Lane.BATCH, tenant="t")
+        assert qos.budget_for("t").used() == 0
+        qos.commit(d)
+        assert qos.budget_for("t").used() == 60
+
+    def test_budget_map_bounded_by_live_charges(self):
+        # shed verdicts / unseen tenants never allocate, and drained
+        # default-budget tenants prune — the map tracks tenants with
+        # LIVE charges, not tenants ever seen
+        clock = FakeClock()
+        qos = QosAdmission(tenant_budget_tokens=100,
+                           tenant_window_s=5.0, clock=clock)
+        for i in range(50):
+            qos.decide(prompt_tokens=4, max_new_tokens=4,
+                       lane=Lane.BATCH, tenant=f"drive-by-{i}")
+        assert len(qos._budgets) == 0       # verdicts alone: no entry
+        qos.commit(qos.decide(prompt_tokens=4, max_new_tokens=4,
+                              lane=Lane.BATCH, tenant="t0"))
+        assert len(qos._budgets) == 1
+        clock.advance(6.0)                  # window drained
+        assert not qos.over_budget("t0")
+        assert len(qos._budgets) == 0       # pruned
+        # override-configured budgets are permanent
+        qos2 = QosAdmission(budgets={"vip": 10}, clock=clock)
+        assert not qos2.over_budget("vip")
+        assert "vip" in qos2._budgets
+
+    def test_over_budget_gauge_fresh_from_decide_path(self):
+        clock = FakeClock()
+        qos = QosAdmission(budgets={"hog": 10},
+                           reeval_interval_s=0.25, clock=clock)
+        qos.commit(qos.decide(prompt_tokens=20, max_new_tokens=20,
+                              lane=Lane.BATCH, tenant="hog"))
+        clock.advance(0.3)                  # past the refresh cadence
+        qos.decide(prompt_tokens=1, max_new_tokens=1,
+                   lane=Lane.BATCH, tenant="other")
+        assert telemetry.value(
+            "pdt_admission_tenants_over_budget") == 1
+
+    def test_burn_reevaluation_is_cached(self):
+        clock = FakeClock()
+        mon = _monitor(clock)
+        qos = QosAdmission(slo_monitor=mon,
+                           shed_objective="interactive_ttft_p95",
+                           reeval_interval_s=1.0, clock=clock)
+        assert qos.current_burn() == 0.0
+        _burn(mon)
+        assert qos.current_burn() == 0.0     # cached verdict
+        clock.advance(1.0)
+        assert qos.current_burn() > 1.0      # re-evaluated
+
+    def test_unknown_lane_and_bad_config(self):
+        clock = FakeClock()
+        qos = QosAdmission(clock=clock)
+        with pytest.raises(ValueError):
+            qos.decide(prompt_tokens=1, max_new_tokens=1, lane="vip")
+        with pytest.raises(ValueError):
+            QosAdmission(enforce_budgets="sometimes")
+        with pytest.raises(ValueError):
+            QosAdmission(shed_burn=0.0)
+        with pytest.raises(ValueError):
+            # must fail at construction, never inside a post-dispatch
+            # commit
+            QosAdmission(tenant_budget_tokens=0)
+
+
+def _qos_router(model, clock, qos, mon, **kw):
+    kw.setdefault("page_size", 4)
+    kw.setdefault("sleep", clock.advance)
+
+    def factory(index):
+        return _engine(model, clock=clock)
+
+    return ServingRouter(factory, num_replicas=2,
+                         policy="least_outstanding", clock=clock,
+                         slo_monitor=mon, admission=qos, **kw)
+
+
+class TestRouterQos:
+    def test_shed_is_429_shaped_with_retry_after(self, model):
+        clock = FakeClock()
+        mon = _monitor(clock)
+        _burn(mon)
+        qos = QosAdmission(slo_monitor=mon,
+                           shed_objective="interactive_ttft_p95",
+                           shed_burn=1.0, clock=clock)
+        router = _qos_router(model, clock, qos, mon)
+        with pytest.raises(QosShed) as e:
+            router.submit([1, 2, 3], 4, lane=Lane.BATCH,
+                          tenant="acme")
+        assert isinstance(e.value, FleetOverloaded)
+        assert isinstance(e.value, EngineOverloaded)   # 429
+        assert e.value.retry_after > 0
+        assert e.value.reason == "burn"
+        assert telemetry.value("pdt_router_rejections_total",
+                               reason="qos_shed") == 1
+        # the protected lane still admits, with its queue priority
+        rid = router.submit([1, 2, 3], 4, lane=Lane.INTERACTIVE)
+        assert router.requests[rid].priority == 0
+        assert router.requests[rid].engine_req.priority == 0
+        router.run()
+
+    def test_admits_reconcile_with_terminals(self, model):
+        clock = FakeClock()
+        mon = _monitor(clock, threshold=10.0)   # never burns
+        qos = QosAdmission(slo_monitor=mon,
+                           shed_objective="interactive_ttft_p95",
+                           clock=clock)
+        router = _qos_router(model, clock, qos, mon)
+        for i in range(4):
+            router.submit([5, 4, 3 + i], 4,
+                          lane=Lane.BATCH if i % 2 else
+                          Lane.INTERACTIVE, tenant=f"t{i % 2}")
+        router.run()
+        admits = telemetry.value("pdt_admission_decisions_total",
+                                 lane="interactive",
+                                 decision="admit") + \
+            telemetry.value("pdt_admission_decisions_total",
+                            lane="batch", decision="admit")
+        terminals = sum(
+            v for v in telemetry.snapshot()["counters"]
+            ["pdt_router_requests_terminal_total"].values())
+        assert admits == terminals == 4
+        info = router.fleet_info()
+        assert info["admission"]["lanes"]["interactive"][
+            "admitted"] == 2
+
+    def test_unknown_lane_rejected_before_admission(self, model):
+        clock = FakeClock()
+        router = _qos_router(model, clock, None, None)
+        with pytest.raises(ValueError):
+            router.submit([1, 2], 2, lane="vip")
+
+    def test_backpressure_retry_after_includes_burn(self, model):
+        clock = FakeClock()
+        mon = _monitor(clock)
+        _burn(mon)                       # burn = 20
+        qos = QosAdmission(slo_monitor=mon,
+                           shed_objective="interactive_ttft_p95",
+                           shed_burn=1e9,     # never QoS-shed here
+                           clock=clock)
+        router = _qos_router(model, clock, qos, mon,
+                             max_replica_outstanding=1)
+        router.submit([5, 4, 3], 4)
+        router.submit([9, 1, 2], 4)
+        with pytest.raises(FleetOverloaded) as e:
+            router.submit([7, 7, 1], 4)
+        # unified semantics: the burn term (0.05 * 20 = 1.0) dominates
+        # the depth term here
+        assert e.value.retry_after == pytest.approx(
+            derive_retry_after(0.05, queue_depth=1,
+                               burn_rate=qos.current_burn()))
+        router.run()
+
+
+class TestFailOpen:
+    def test_router_submits_survive_admission_fault(self, model):
+        clock = FakeClock()
+        mon = _monitor(clock)
+        _burn(mon)                       # shedding SHOULD be active
+        qos = QosAdmission(slo_monitor=mon,
+                           shed_objective="interactive_ttft_p95",
+                           shed_burn=1.0, clock=clock)
+        router = _qos_router(model, clock, qos, mon)
+        with FaultInjector(seed=0) as fi:
+            fi.arm("admission.decide", always=True)
+            # a dead admission brain degrades to FIFO: even the batch
+            # lane admits — degrade, never wedge
+            rid = router.submit([1, 2, 3], 4, lane=Lane.BATCH)
+            assert router.requests[rid].engine_req is not None
+            assert fi.trips("admission.decide") == 1
+        assert telemetry.value("pdt_admission_failopen_total") == 1
+        assert telemetry.value("pdt_faults_fired_total",
+                               site="admission.decide") == 1
+        # disarmed: the burn arbitration is back
+        with pytest.raises(QosShed):
+            router.submit([4, 5, 6], 4, lane=Lane.BATCH)
+        router.run()
+
+    def test_engine_policy_hook_sheds_and_fails_open(self, model):
+        clock = FakeClock()
+        mon = _monitor(clock)
+        _burn(mon)
+        qos = QosAdmission(slo_monitor=mon,
+                           shed_objective="interactive_ttft_p95",
+                           shed_burn=1.0, clock=clock)
+        eng = _engine(model, clock=clock,
+                      admission_policy=qos.engine_policy())
+        with pytest.raises(EngineOverloaded):
+            eng.add_request([1, 2, 3], 4, priority=1)   # batch: shed
+        eng.add_request([1, 2, 3], 4, priority=0)       # protected
+        with FaultInjector(seed=0) as fi:
+            fi.arm("admission.decide", always=True)
+            eng.add_request([4, 5, 6], 4, priority=1)   # fail open
+            assert fi.trips("admission.decide") == 1
+        assert telemetry.value("pdt_admission_failopen_total") == 1
+        eng.run()
+
+    def test_broken_commit_never_loses_a_dispatched_request(self,
+                                                            model):
+        # commit runs AFTER dispatch: a failure there must lose only
+        # the bookkeeping, never the in-flight request
+        clock = FakeClock()
+
+        class BrokenCommit(QosAdmission):
+            def commit(self, decision, now=None):
+                raise RuntimeError("ledger on fire")
+
+        qos = BrokenCommit(clock=clock)
+        router = _qos_router(model, clock, qos, None)
+        rid = router.submit([1, 2, 3], 4, lane=Lane.BATCH)
+        assert rid in router.requests
+        assert router.requests[rid].engine_req is not None
+        assert telemetry.value("pdt_admission_failopen_total") == 1
+        out = router.run()
+        assert len(out[rid]) == 4        # served to completion
+
+    def test_broken_monitor_never_wedges_submits(self, model):
+        clock = FakeClock()
+
+        class BrokenMonitor:
+            def evaluate(self, export=True):
+                raise RuntimeError("monitor on fire")
+
+            def observe(self, *a, **k):
+                pass
+
+            def observe_outcome(self, *a, **k):
+                pass
+
+        qos = QosAdmission(slo_monitor=BrokenMonitor(), clock=clock)
+        router = _qos_router(model, clock, qos, None)
+        rid = router.submit([1, 2, 3], 4, lane=Lane.BATCH)
+        assert rid in router.requests
+        assert telemetry.value("pdt_admission_failopen_total") >= 1
+        router.run()
