@@ -231,6 +231,11 @@ REGRESSION_METRICS = (
     # soak (ISSUE 11): the open-loop capacity headline — virtual-time
     # deterministic, so the threshold catches real scheduling drift
     "detail.soak.max_sustainable_qps",
+    # tensor parallelism (ISSUE 12): the tp=1 row guards the shared
+    # engine path; the tp=2 row guards the partitioned dispatch
+    # (collective-overhead drift on CPU, the scale story on a chip)
+    "detail.tp.tp1.decode_tokens_per_sec",
+    "detail.tp.tp2.decode_tokens_per_sec",
 )
 
 # latency-family regression gates: LOWER is better, a rise past the
@@ -620,6 +625,111 @@ def bench_speculative(model, cfg, on_tpu: bool) -> dict:
         model.train()
 
 
+def bench_tp(on_tpu: bool) -> dict:
+    """Tensor-parallel serving A/B (ISSUE 12, serving/submesh.py):
+    the SAME workload through tp=1 / tp=2 / tp=4 engines — decode
+    tokens/sec, prefill (admission) wall, an outputs-identical
+    cross-check against tp=1 (the exact-mode guarantee), and one
+    tp=2 -> tp=2 migration's per-shard payload bytes. On the
+    8-simulated-device CPU mesh the tp>1 rows measure partitioning
+    OVERHEAD (host collectives cost more than tiny-model math saves);
+    on a real chip the same rows become the scale story. The bench
+    model uses 8 q / 4 kv heads so tp=4 still shards the pages."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    import paddle_tpu.observability as telemetry
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+    from paddle_tpu.serving import TpConfig, carve_submeshes, transfer
+
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128,
+                      intermediate_size=256, num_hidden_layers=2,
+                      num_attention_heads=8, num_key_value_heads=4,
+                      max_position_embeddings=256)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    new_toks = 32 if on_tpu else 12
+    n_jobs = 8 if on_tpu else 6
+    rng = np.random.default_rng(0)
+    jobs = [rng.integers(1, cfg.vocab_size,
+                         int(rng.integers(8, 24))).tolist()
+            for _ in range(n_jobs)]
+    n_dev = len(jax.devices())
+
+    def engine(sm):
+        # batch covers every job so the ONE timed eng.step() admits the
+        # whole workload — decode_dt then measures decode dispatches
+        # only (queued jobs would otherwise prefill inside the timed
+        # decode window and pollute the gated decode_tokens_per_sec)
+        return ContinuousBatchingEngine(
+            model, max_batch_size=n_jobs, max_seq_len=128, submesh=sm,
+            attention_impl="ragged")
+
+    def timed_run(sm):
+        # ONE engine across both phases: the warm pass compiles every
+        # program (jit caches are per-engine), the timed pass then
+        # measures steady-state admission + decode walls
+        eng = engine(sm)
+        for phase in ("warm", "timed"):
+            rids = [eng.add_request(p, new_toks) for p in jobs]
+            t0 = time.perf_counter()
+            eng.step()                       # the admission dispatch
+            prefill_dt = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            out = eng.run()
+            decode_dt = time.perf_counter() - t1
+        toks = sum(len(out[r]) for r in rids)
+        return {
+            "decode_tokens_per_sec": round(
+                (toks - n_jobs) / max(decode_dt, 1e-9), 1),
+            "prefill_wall_s": round(prefill_dt, 4),
+            "total_tokens": toks,
+        }, [out[r] for r in rids]
+
+    result = {}
+    base, want = timed_run(None)
+    result["tp1"] = base
+    for tp in (2, 4):
+        if tp > n_dev:
+            # visible skip marker — a missing tp2 row would silently
+            # drop detail.tp.tp2.* out of the regression gate
+            result[f"tp{tp}"] = {
+                "skipped": f"needs {tp} devices, have {n_dev}"}
+            continue
+        sm = carve_submeshes(1, TpConfig(tp=tp))[0]
+        row, got = timed_run(sm)
+        row["outputs_identical_to_tp1"] = got == want
+        result[f"tp{tp}"] = row
+
+    # per-shard migration payload: one tp=2 -> tp=2 move
+    if n_dev >= 4:
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            sms = carve_submeshes(2, TpConfig(tp=2))
+            src, dst = engine(sms[0]), engine(sms[1])
+            rid = src.add_request(jobs[0], new_toks)
+            for _ in range(3):
+                src.step()
+            t0 = time.perf_counter()
+            req, payload = transfer.migrate_request(src, dst, rid)
+            mig_dt = time.perf_counter() - t0
+            shard_bytes = {
+                s: int(telemetry.value(
+                    "pdt_tp_migration_shard_bytes_total", shard=s))
+                for s in ("0", "1")}
+            result["migration"] = {
+                "payload_nbytes": transfer.payload_nbytes(payload),
+                "per_shard_bytes": shard_bytes,
+                "wall_s": round(mig_dt, 4),
+            }
+        finally:
+            telemetry.disable(clear_override=True)
+    return {"tp": result}
+
+
 def bench_soak(model, cfg, on_tpu: bool) -> dict:
     """Open-loop soak capacity (ISSUE 11): max-sustainable-QPS by
     binary search over the arrival rate of a seeded trace driven
@@ -1000,6 +1110,10 @@ def run_bench(on_tpu: bool) -> dict:
     except Exception:
         detail["soak_error"] = traceback.format_exc(limit=3)[-400:]
     try:
+        detail.update(bench_tp(on_tpu))
+    except Exception:
+        detail["tp_error"] = traceback.format_exc(limit=3)[-400:]
+    try:
         detail.update(bench_paged_attention(on_tpu))
     except Exception:
         detail["paged_attention_error"] = \
@@ -1080,6 +1194,16 @@ def main(argv=None):
     if not on_tpu:
         # sitecustomize already imported jax; config.update is the only
         # platform override that still works (see tests/conftest.py).
+        # XLA_FLAGS is still honored because the backend itself has not
+        # initialized yet (the TPU probe runs in a subprocess) — force
+        # the 8-device host platform so the tp>=2 half of bench_tp (and
+        # its detail.tp.tp2 regression gate) runs on the CPU fallback
+        # instead of silently skipping on a 1-device platform.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
         import jax
         jax.config.update("jax_platforms", "cpu")
 

@@ -33,7 +33,11 @@ class TracedHostSyncChecker(Checker):
     rationale = ("no host synchronization inside jit/pallas-traced "
                  "functions (PR 6 jnp-inside-trace pitfall)")
 
-    DEFAULT_SCOPE = ("paddle_tpu/ops/*.py", "paddle_tpu/models/*.py")
+    # serving/submesh.py joined the scope with the TP subsystem (ISSUE
+    # 12): it builds shard_map/NamedSharding plumbing around the same
+    # traced programs, so a host sync there hits the same pitfall
+    DEFAULT_SCOPE = ("paddle_tpu/ops/*.py", "paddle_tpu/models/*.py",
+                     "paddle_tpu/serving/submesh.py")
 
     def __init__(self, scope: Tuple[str, ...] = DEFAULT_SCOPE):
         self.scope = scope

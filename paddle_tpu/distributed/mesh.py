@@ -237,6 +237,52 @@ def create_hybrid_mesh(dcn_axes: dict[str, int] | None = None,
     return ProcessMesh(Mesh(dev_arr, names))
 
 
+# -- serving tensor-parallel trace context -----------------------------------
+# The serving engine (models/serving.py, submesh= mode) sets this around
+# its jit DISPATCH calls so sharding constraints inside model code
+# (llama.py `_tp_repl`) see the replica's submesh at TRACE time — jit
+# traces on the first call, so scoping the call scopes the trace. It is
+# deliberately NOT the training `_current_mesh`: a process hosts many
+# serving replicas on DISJOINT submeshes, and a global training mesh
+# must never leak into a replica's compiled programs (or vice versa).
+_serving_tp = None
+
+
+def serving_tp():
+    """The active serving-TP context (a `serving.submesh.SubMesh`), or
+    None outside an engine's TP dispatch scope."""
+    return _serving_tp
+
+
+@contextlib.contextmanager
+def serving_tp_scope(ctx):
+    """Scope a serving replica's TP submesh over a jit dispatch (and
+    therefore over any trace it triggers)."""
+    global _serving_tp
+    prev = _serving_tp
+    _serving_tp = ctx
+    try:
+        yield ctx
+    finally:
+        _serving_tp = prev
+
+
+def serving_tp_replicate(value):
+    """Constrain a traced value REPLICATED over the active serving-TP
+    submesh — the determinism fence of the exact TP mode: placed before
+    every row matmul (o_proj / down_proj) and the sampling argmax, it
+    forces an all-gather instead of a partial-sum all-reduce, so no
+    cross-device reduction ever changes float accumulation order and
+    greedy outputs stay bit-identical to tp=1. No-op without an active
+    context, or when the context's mode allows row-parallel reductions
+    (`replicate_rows` False)."""
+    ctx = _serving_tp
+    if ctx is None or not getattr(ctx, "replicate_rows", True):
+        return value
+    return jax.lax.with_sharding_constraint(
+        value, NamedSharding(ctx.jax_mesh, PartitionSpec()))
+
+
 # -- current mesh context ----------------------------------------------------
 _current_mesh: Optional[ProcessMesh] = None
 

@@ -145,6 +145,23 @@ def apply_rope(x: Tensor, cos: Tensor, sin: Tensor, position_offset=0):
     return _apply("rope", fn, (x, cos, sin))
 
 
+def _tp_repl(x: Tensor) -> Tensor:
+    """Serving tensor parallelism's determinism fence (exact mode,
+    serving/submesh.py): constrain `x` REPLICATED over the engine's
+    active TP submesh so the next matmul (o_proj / down_proj / the
+    sampling argmax's logits) runs without a partial-sum reduction —
+    the all-gather this forces moves bits, never re-adds them, which
+    is what keeps tp>=2 greedy outputs bit-identical to tp=1. Reads
+    the trace-time context the engine scopes around its dispatches;
+    a no-op (identity, no node) outside one."""
+    from paddle_tpu.distributed.mesh import serving_tp, \
+        serving_tp_replicate
+    if serving_tp() is None:
+        return x
+    from paddle_tpu.core.tensor import apply as _apply
+    return _apply("tp_replicate", serving_tp_replicate, (x,))
+
+
 def _window_band(s: int, n_keys: int, offset: int,
                  window: int | None) -> np.ndarray:
     """(s, n_keys) bool: q row i (global position i + offset) may attend
@@ -233,7 +250,7 @@ class RaggedKVCacheView:
 
     def __init__(self, k_pages, v_pages, block_tables, token_seq,
                  positions, query_start, query_len, context_lens,
-                 block_q=1, pages_bound=None):
+                 block_q=1, pages_bound=None, tp=None):
         self.k_pages = k_pages if isinstance(k_pages, Tensor) \
             else Tensor(k_pages)
         self.v_pages = v_pages if isinstance(v_pages, Tensor) \
@@ -251,6 +268,11 @@ class RaggedKVCacheView:
         self.block_q = int(block_q)
         self.pages_bound = None if pages_bound is None \
             else int(pages_bound)
+        # tensor parallelism (serving/submesh.py): a (jax Mesh, axis)
+        # pair routing the kernel path through its per-shard shard_map;
+        # the pools arrive sharded on their KV-head axis, descriptors
+        # and block tables stay replicated scalars
+        self.tp = tp
 
 
 class LlamaAttention(nn.Layer):
@@ -477,15 +499,17 @@ class LlamaAttention(nn.Layer):
                 qq[0], kp, vp, view.query_start, view.query_len,
                 view.context_lens, bt, window=win,
                 block_q=view.block_q,
-                pages_bound=view.pages_bound)[None]
+                pages_bound=view.pages_bound, tp=view.tp)[None]
         out = _apply("ragged_paged_attention", fn_attn,
                      (q, kp_new, vp_new))
-        out = self.o_proj(out.reshape([1, s, -1]))
+        # TP serving: each device computed ITS heads; gather them
+        # before the o_proj row matmul (exact-mode fence)
+        out = self.o_proj(_tp_repl(out.reshape([1, s, -1])))
         if use_cache:
             return out, RaggedKVCacheView(
                 kp_new, vp_new, bt, seq, pos, view.query_start,
                 view.query_len, view.context_lens, view.block_q,
-                view.pages_bound)
+                view.pages_bound, tp=view.tp)
         return out
 
 
@@ -500,7 +524,10 @@ class LlamaMLP(nn.Layer):
                                    bias_attr=False)
 
     def forward(self, x):
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+        h = F.silu(self.gate_proj(x)) * self.up_proj(x)
+        # TP serving: gather the column-sharded activation before the
+        # row matmul (exact-mode fence; no-op otherwise)
+        return self.down_proj(_tp_repl(h))
 
 
 class LlamaDecoderLayer(nn.Layer):
@@ -586,9 +613,12 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
 
     def _logits(self, hidden):
         if self.lm_head is not None:
-            return self.lm_head(hidden)
-        return paddle.matmul(hidden, self.model.embed_tokens.weight,
-                             transpose_y=True)
+            # TP serving: lm_head is vocab-sharded; gather the logits
+            # so the greedy argmax reduces on every device identically
+            return _tp_repl(self.lm_head(hidden))
+        return _tp_repl(paddle.matmul(hidden,
+                                      self.model.embed_tokens.weight,
+                                      transpose_y=True))
 
     def forward(self, input_ids, labels=None, attention_mask=None,
                 past_key_values=None, position_offset=0, use_cache=False):

@@ -69,6 +69,7 @@ rows) — TPU-native:
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import time
 from collections import OrderedDict
@@ -87,7 +88,32 @@ from .generation import RequestStatus
 
 __all__ = ["ContinuousBatchingEngine", "Request", "RequestStatus",
            "SpecConfig", "EngineOverloaded", "PoolExhausted",
-           "EngineInvariantError"]
+           "EngineInvariantError", "assemble_payload_kv"]
+
+# nullcontext is stateless — one shared instance serves every non-TP
+# dispatch (`_tp_scope` sits on the per-decode-step hot path)
+_NULL_SCOPE = contextlib.nullcontext()
+
+
+def assemble_payload_kv(payload: dict):
+    """Logical per-layer (k, v) page rows of a transfer payload.
+
+    A single-chip source exports them directly (``payload["kv"]``); a
+    tensor-parallel source exports one FRAGMENT per shard
+    (``payload["kv_shards"]``: outer list = shard in head order, inner
+    = layer) so serialize bytes stay local per device — this helper is
+    the consumer-side view that reassembles the logical rows by
+    concatenating fragments on the KV-head axis (`import_pages`, the
+    prefix store's spill). The wire format stays the fragments."""
+    if payload.get("kv") is not None:
+        return payload["kv"]
+    shards = payload["kv_shards"]
+    layers = len(shards[0])
+    if len(shards) == 1:
+        return list(shards[0])
+    return [(np.concatenate([s[li][0] for s in shards], axis=0),
+             np.concatenate([s[li][1] for s in shards], axis=0))
+            for li in range(layers)]
 
 
 # -- telemetry (docs/serving.md "Observability" metric catalog) --------
@@ -268,9 +294,30 @@ class ContinuousBatchingEngine:
                      Callable[["ContinuousBatchingEngine", Request],
                               bool]] = None,
                  clock: Optional[Callable[[], float]] = None,
-                 spec_decode: Optional[SpecConfig] = None):
+                 spec_decode: Optional[SpecConfig] = None,
+                 submesh=None):
         cfg = model.config
         self.model = model
+        # -- tensor parallelism (serving/submesh.py, docs/serving.md
+        # "Tensor parallelism"): one engine = one GSPMD submesh -------
+        # Param/buffer values are device_put onto the submesh per the
+        # column/row placement table and the KV page pools shard their
+        # KV-head axis (one logical page = tp local shards); ALL host-
+        # side accounting (allocator, block tables, descriptors) stays
+        # replicated scalars, untouched by sharding.
+        self._tp = submesh
+        if submesh is not None and int(submesh.tp) > 1:
+            if kv_layout != "paged":
+                raise ValueError(
+                    "tensor parallelism requires kv_layout='paged' — "
+                    "the dense per-slot caches have no page shards")
+            if attention_impl != "ragged":
+                raise ValueError(
+                    "tensor parallelism requires attention_impl="
+                    "'ragged' (the one dispatch the submesh shards)")
+            submesh.validate_model(cfg)
+        elif submesh is not None:
+            submesh.validate_model(cfg)   # tp=1: placement only
         self.B = int(max_batch_size)
         self.S = int(max_seq_len or cfg.max_position_embeddings)
         if self.S > cfg.max_position_embeddings:
@@ -311,6 +358,11 @@ class ContinuousBatchingEngine:
         self._max_prefill = int(max_prefill_programs)
         self._params = list(model.parameters())
         self._buffers = list(model.buffers())
+        if self._tp is not None:
+            # the engine holds its OWN placed copies — replicas on
+            # different submeshes share one model object
+            self._tp_pv, self._tp_bv = \
+                self._tp.shard_model_values(model)
         hk, hd = cfg.num_key_value_heads, cfg.head_dim
         L = cfg.num_hidden_layers
         dt = self._params[0]._value.dtype
@@ -342,10 +394,15 @@ class ContinuousBatchingEngine:
             if self.num_pages < 2:
                 raise ValueError("num_pages must be >= 2 (page 0 is "
                                  "reserved)")
-            self._kv = [
-                (jnp.zeros((hk, self.num_pages, self.page_size, hd), dt),
-                 jnp.zeros((hk, self.num_pages, self.page_size, hd), dt))
-                for _ in range(L)]
+            def _pool():
+                z = jnp.zeros((hk, self.num_pages, self.page_size, hd),
+                              dt)
+                if self._tp is None:
+                    return z
+                # sharded allocator contract: the pool splits on the
+                # KV-head axis, so every page id names tp local shards
+                return jax.device_put(z, self._tp.kv_sharding(hk))
+            self._kv = [(_pool(), _pool()) for _ in range(L)]
             self._bt = np.zeros((self.B, self.pps), np.int32)
             self._free: List[int] = list(range(1, self.num_pages))
             self._slot_pages: List[List[int]] = [[] for _ in range(self.B)]
@@ -469,6 +526,13 @@ class ContinuousBatchingEngine:
             self._spec_k = int(spec_decode.k)
             self._d_params = list(draft.parameters())
             self._d_buffers = list(draft.buffers())
+            if self._tp is not None:
+                # the draft must live on the SAME submesh as the
+                # verify pass; it is small by design, so replicate
+                # (its pages shard only when its own hk divides tp —
+                # kv_sharding falls back to replicated otherwise)
+                self._tp_d_pv, self._tp_d_bv = \
+                    self._tp.replicate_values(draft)
             d_hk = d_cfg.num_key_value_heads
             d_hd = d_cfg.head_dim
             d_dt = self._d_params[0]._value.dtype
@@ -477,12 +541,14 @@ class ContinuousBatchingEngine:
             # the draft pool's trash page, mirroring the target pool)
             self._d_num_pages = int(spec_decode.num_pages
                                     or self.B * self.pps + 1)
-            self._d_kv = [
-                (jnp.zeros((d_hk, self._d_num_pages, self.page_size,
-                            d_hd), d_dt),
-                 jnp.zeros((d_hk, self._d_num_pages, self.page_size,
-                            d_hd), d_dt))
-                for _ in range(d_cfg.num_hidden_layers)]
+            def _d_pool():
+                z = jnp.zeros((d_hk, self._d_num_pages, self.page_size,
+                               d_hd), d_dt)
+                if self._tp is None:
+                    return z
+                return jax.device_put(z, self._tp.kv_sharding(d_hk))
+            self._d_kv = [(_d_pool(), _d_pool())
+                          for _ in range(d_cfg.num_hidden_layers)]
             self._d_bt = np.zeros((self.B, self.pps), np.int32)
             self._d_free: List[int] = list(range(1, self._d_num_pages))
             self._d_slot_pages: List[List[int]] = \
@@ -717,6 +783,27 @@ class ContinuousBatchingEngine:
         pages = np.asarray(self._bt[slot, freed:n_idx], np.int32)
         L, hk, hd, dt = self._kv_shape
         now = self._clock()
+        kv, kv_shards, n_tp = None, None, 1
+        if self._tp is not None and self._tp.tp > 1:
+            # tensor-parallel source: serialize one payload FRAGMENT
+            # per shard — each `shard.data[:, pages]` gather runs on
+            # its own device and only its result crosses to the host,
+            # so migration bytes stay local per shard (the wire format
+            # is the fragments; `assemble_payload_kv` is the
+            # consumer-side logical view)
+            from ..serving import submesh as tp_mod
+            per_layer = [(tp_mod.kv_fragments(kp, pages),
+                          tp_mod.kv_fragments(vp, pages))
+                         for kp, vp in self._kv]
+            n_tp = len(per_layer[0][0])
+            kv_shards = [[(kf[s], vf[s]) for kf, vf in per_layer]
+                         for s in range(n_tp)]
+            tp_mod.record_shard_bytes(
+                [sum(k.nbytes + v.nbytes for k, v in shard)
+                 for shard in kv_shards])
+        else:
+            kv = [(np.asarray(kp[:, pages]), np.asarray(vp[:, pages]))
+                  for kp, vp in self._kv]
         return {
             "request_id": req.request_id,
             "prompt": list(req.prompt),
@@ -738,8 +825,9 @@ class ContinuousBatchingEngine:
             "page_size": self.page_size,
             "max_seq_len": self.S,
             "kv_spec": (L, hk, hd, str(jnp.dtype(dt))),
-            "kv": [(np.asarray(kp[:, pages]), np.asarray(vp[:, pages]))
-                   for kp, vp in self._kv],
+            "kv": kv,
+            "kv_shards": kv_shards,
+            "tp": n_tp,
         }
 
     def import_pages(self, payload: dict,
@@ -843,8 +931,14 @@ class ContinuousBatchingEngine:
             start = m if m else freed
             ids = [int(self._bt[slot, j]) for j in range(start, n_total)]
             off = start - freed
+            # a TP source's per-shard fragments reassemble to the
+            # logical rows here; a TP TARGET re-splits them across its
+            # own shards inside _install_kv — which is what makes
+            # cross-tp migration (tp=2 source -> tp=4 target) legal:
+            # the LOGICAL kv geometry is what the spec check compares
             self._install_kv(ids, [(kp[:, off:], vp[:, off:])
-                                   for kp, vp in payload["kv"]])
+                                   for kp, vp in
+                                   assemble_payload_kv(payload)])
             if self._prefix_enabled and not freed:
                 self._register_prefix(slot, req)
             if shared:
@@ -956,10 +1050,21 @@ class ContinuousBatchingEngine:
                 self._install_jits.popitem(last=False)      # LRU
         else:
             self._install_jits.move_to_end(n)
-        self._kv = jit(self._kv,
-                       jnp.asarray(np.asarray(page_ids, np.int32)),
-                       [(jnp.asarray(rk), jnp.asarray(rv))
-                        for rk, rv in rows])
+        if self._tp is not None:
+            # place the incoming rows with the pools' head sharding so
+            # each device receives only ITS fragment of the transfer
+            hk = self.model.config.num_key_value_heads
+            sh = self._tp.kv_sharding(hk)
+            rows_dev = [(jax.device_put(np.asarray(rk), sh),
+                         jax.device_put(np.asarray(rv), sh))
+                        for rk, rv in rows]
+        else:
+            rows_dev = [(jnp.asarray(rk), jnp.asarray(rv))
+                        for rk, rv in rows]
+        with self._tp_scope():
+            self._kv = jit(self._kv,
+                           jnp.asarray(np.asarray(page_ids, np.int32)),
+                           rows_dev)
 
     def _expire(self) -> List[Request]:
         """Monotonic-clock tick: finalize queued/running requests whose
@@ -1089,9 +1194,60 @@ class ContinuousBatchingEngine:
                         "to 0")
         if self._spec is not None:
             self._check_invariants_draft(errs)
+        if self._tp is not None:
+            self._check_invariants_tp(errs)
         if errs:
             raise EngineInvariantError(
                 "engine invariant violations:\n  " + "\n  ".join(errs))
+
+    def _check_invariants_tp(self, errs: List[str]):
+        """Sharded-allocator invariants (tensor parallelism): the page
+        pools must still live EXACTLY on the engine's submesh with the
+        declared head sharding — a stray dispatch that resharded or
+        relocated a pool would silently turn every 'local shard' claim
+        (per-shard export, the kernel shard_map) into fiction."""
+        def _norm(spec):
+            # PartitionSpec('tp') == PartitionSpec(('tp',), None, ...):
+            # normalize entries to tuples and strip trailing Nones so
+            # propagation's spelling differences don't read as drift
+            out = []
+            for e in spec:
+                out.append(None if e is None
+                           else tuple(e) if isinstance(e, (list, tuple))
+                           else (e,))
+            while out and out[-1] is None:
+                out.pop()
+            return tuple(out)
+
+        want = set(self._tp.devices)
+
+        def _check_pools(pools, hk, label):
+            want_spec = _norm(self._tp.kv_sharding(hk).spec)
+            for li, (kp, vp) in enumerate(pools):
+                for nm, arr in (("k", kp), ("v", vp)):
+                    got = set(arr.sharding.device_set)
+                    if got != want:
+                        errs.append(
+                            f"layer {li} {label}{nm}-pool left its "
+                            f"submesh: on "
+                            f"{sorted(d.id for d in got)}, expected "
+                            f"{sorted(d.id for d in want)}")
+                    spec = getattr(arr.sharding, "spec", None)
+                    if spec is not None and _norm(spec) != want_spec:
+                        errs.append(
+                            f"layer {li} {label}{nm}-pool resharded: "
+                            f"spec {spec} != declared {want_spec}")
+
+        _check_pools(self._kv, self.model.config.num_key_value_heads,
+                     "")
+        if self._spec is not None:
+            # the draft pools feed the same per-shard shard_map path
+            # (placed with kv_sharding(draft hk), replicated-fallback
+            # and all) — a relocated draft pool is the same fiction
+            _check_pools(
+                self._d_kv,
+                self._spec.draft_model.config.num_key_value_heads,
+                "draft-")
 
     def _check_invariants_draft(self, errs: List[str]):
         """Draft-cache page accounting (spec_decode engines): draft
@@ -1370,8 +1526,7 @@ class ContinuousBatchingEngine:
                             ids = np.zeros((1, bucket), np.int32)
                             ids[0, :p_len] = prompt
                             tok, rows = jit(
-                                [p._value for p in self._params],
-                                [b._value for b in self._buffers],
+                                self._pv(), self._bv(),
                                 jnp.asarray(ids), jnp.int32(p_len),
                                 self._next_keys())
                             if self.layout == "paged":
@@ -1442,8 +1597,7 @@ class ContinuousBatchingEngine:
         ids = np.zeros((1, bucket), np.int32)
         ids[0, :len(suffix)] = suffix
         tok, rows = jit(
-            [p._value for p in self._params],
-            [b._value for b in self._buffers],
+            self._pv(), self._bv(),
             self._kv, jnp.asarray(np.asarray(pages, np.int32)),
             jnp.asarray(ids), jnp.int32(len(suffix)), self._next_keys())
         # scatter the suffix rows into the pages AFTER the shared ones:
@@ -1579,11 +1733,11 @@ class ContinuousBatchingEngine:
                 if telemetry.enabled() else ())
         with telemetry.span("serving.ragged_prefill",
                             tokens=int(pk["tokens"]),
-                            t_pad=int(t_pad), rids=rids):
+                            t_pad=int(t_pad), rids=rids), \
+                self._tp_scope():
             jit = self._get_ragged_prefill(t_pad, bound)
             nxt, self._kv = jit(
-                [p._value for p in self._params],
-                [b._value for b in self._buffers],
+                self._pv(), self._bv(),
                 self._kv, jnp.asarray(pk["ids"]),
                 jnp.asarray(pk["token_seq"]),
                 jnp.asarray(pk["positions"]),
@@ -1616,6 +1770,51 @@ class ContinuousBatchingEngine:
                 self._release_slot(s)
                 freed = True
         return freed
+
+    # -- tensor parallelism plumbing (serving/submesh.py) --------------
+    def _pv(self):
+        """Target param VALUES for a dispatch: the submesh-placed
+        copies under TP, the live model values otherwise."""
+        if self._tp is not None:
+            return self._tp_pv
+        return [p._value for p in self._params]
+
+    def _bv(self):
+        if self._tp is not None:
+            return self._tp_bv
+        return [b._value for b in self._buffers]
+
+    def _d_pv(self):
+        if self._tp is not None:
+            return self._tp_d_pv
+        return [p._value for p in self._d_params]
+
+    def _d_bv(self):
+        if self._tp is not None:
+            return self._tp_d_bv
+        return [b._value for b in self._d_buffers]
+
+    def _tp_scope(self):
+        """Scope every jit DISPATCH in: trace-time reads inside model
+        code (`llama._tp_repl`'s determinism fences) then see this
+        replica's submesh. A no-op nullcontext without TP."""
+        if self._tp is None:
+            return _NULL_SCOPE
+        return self._tp.scope()
+
+    def _view_tp(self, draft: bool = False):
+        """The (mesh, axis) pair `RaggedKVCacheView` routes the kernel
+        path's shard_map through — only when the respective pool is
+        actually head-sharded (a replicated draft pool must run the
+        plain kernel)."""
+        if self._tp is None or self._tp.tp <= 1:
+            return None
+        from ..serving.submesh import TP_AXIS
+        hk = (self._spec.draft_model.config.num_key_value_heads
+              if draft else self.model.config.num_key_value_heads)
+        if hk % self._tp.tp:
+            return None
+        return (self._tp.jax_mesh, TP_AXIS)
 
     def _jit_lru(self, cache: "OrderedDict", key, build, cap=None):
         """The one keyed-LRU program-cache discipline (build on miss,
@@ -1669,6 +1868,7 @@ class ContinuousBatchingEngine:
         buffers = self._d_buffers if draft else self._buffers
         strat, temp = self.strategy, self.temperature
         tk, tp = self.top_k, self.top_p
+        view_tp = self._view_tp(draft=draft)
 
         def run(pv, bv, kv, ids, tok_seq, qpos, qstart, qlen, ctx, bt,
                 sample_rows, key):
@@ -1677,7 +1877,7 @@ class ContinuousBatchingEngine:
             with bind_state(params, buffers, pv, bv), no_grad():
                 views = [RaggedKVCacheView(kp, vp, bt, tok_seq, qpos,
                                            qstart, qlen, ctx, block_q,
-                                           pages_bound)
+                                           pages_bound, tp=view_tp)
                          for kp, vp in kv]
                 logits, new = model.forward(
                     Tensor(ids[None]), past_key_values=views,
@@ -1889,8 +2089,7 @@ class ContinuousBatchingEngine:
         n_chunks = -(-p_len // C)
         ids_pad = np.zeros((1, n_chunks * C), np.int32)
         ids_pad[0, :p_len] = prompt
-        pv = [p._value for p in self._params]
-        bv = [b._value for b in self._buffers]
+        pv, bv = self._pv(), self._bv()
         sjit = self._get_scatter(C)
         lg = None
         for ci in range(n_chunks):
@@ -2172,18 +2371,17 @@ class ContinuousBatchingEngine:
             t0 = time.perf_counter()
             if self.layout == "paged" and self.attn_impl == "ragged":
                 bidx = self._decode_idx
-                nxt, new_kv = self._decode_jit(
-                    [p._value for p in self._params],
-                    [b._value for b in self._buffers],
-                    kv, jnp.asarray(self._tok), bidx,
-                    jnp.asarray(pos.astype(np.int32)), bidx,
-                    self._decode_ones,
-                    jnp.asarray((pos + 1).astype(np.int32)), bt, bidx,
-                    self._next_keys())
+                with self._tp_scope():
+                    nxt, new_kv = self._decode_jit(
+                        self._pv(), self._bv(),
+                        kv, jnp.asarray(self._tok), bidx,
+                        jnp.asarray(pos.astype(np.int32)), bidx,
+                        self._decode_ones,
+                        jnp.asarray((pos + 1).astype(np.int32)), bt,
+                        bidx, self._next_keys())
             else:
                 nxt, new_kv = self._decode_jit(
-                    [p._value for p in self._params],
-                    [b._value for b in self._buffers],
+                    self._pv(), self._bv(),
                     kv, jnp.asarray(self._tok), jnp.asarray(pos), bt,
                     self._next_keys())
             if self.layout == "paged":
@@ -2380,17 +2578,18 @@ class ContinuousBatchingEngine:
         bound = self._pages_bound(
             int(pk["context_len"][p["slot"]]) for p in batch)
         jit = self._get_draft_prefill(pk["t_pad"], bound)
-        _, self._d_kv = jit(
-            [p._value for p in self._d_params],
-            [b._value for b in self._d_buffers],
-            self._d_kv, jnp.asarray(pk["ids"]),
-            jnp.asarray(pk["token_seq"]),
-            jnp.asarray(pk["positions"]),
-            jnp.asarray(pk["query_start"]),
-            jnp.asarray(pk["query_len"]),
-            jnp.asarray(pk["context_len"]),
-            jnp.asarray(self._d_bt), jnp.asarray(pk["sample_rows"]),
-            self._spec_key)
+        with self._tp_scope():
+            _, self._d_kv = jit(
+                self._d_pv(), self._d_bv(),
+                self._d_kv, jnp.asarray(pk["ids"]),
+                jnp.asarray(pk["token_seq"]),
+                jnp.asarray(pk["positions"]),
+                jnp.asarray(pk["query_start"]),
+                jnp.asarray(pk["query_len"]),
+                jnp.asarray(pk["context_len"]),
+                jnp.asarray(self._d_bt),
+                jnp.asarray(pk["sample_rows"]),
+                self._spec_key)
 
     def _get_draft_prefill(self, t_pad: int, pages_bound: int):
         return self._jit_lru(
@@ -2411,12 +2610,12 @@ class ContinuousBatchingEngine:
                          for i, r in enumerate(self._slot_req)])
         if not live.any():
             return np.zeros((self.B, self._spec_k), np.int32)
-        props, self._d_kv = self._d_scan_jit(
-            [p._value for p in self._d_params],
-            [b._value for b in self._d_buffers],
-            self._d_kv, jnp.asarray(self._tok),
-            jnp.asarray(self._pos.astype(np.int32)),
-            jnp.asarray(live), jnp.asarray(self._d_bt))
+        with self._tp_scope():
+            props, self._d_kv = self._d_scan_jit(
+                self._d_pv(), self._d_bv(),
+                self._d_kv, jnp.asarray(self._tok),
+                jnp.asarray(self._pos.astype(np.int32)),
+                jnp.asarray(live), jnp.asarray(self._d_bt))
         return np.asarray(props)
 
     def _build_draft_scan(self):
@@ -2436,6 +2635,8 @@ class ContinuousBatchingEngine:
         params, buffers = self._d_params, self._d_buffers
         K, B, S = self._spec_k, self.B, self.S
 
+        view_tp = self._view_tp(draft=True)
+
         def run(pv, bv, kv, tok, pos0, live, bt):
             from .generation import bind_state
             from .llama import RaggedKVCacheView
@@ -2449,7 +2650,8 @@ class ContinuousBatchingEngine:
                     seq = jnp.where(ok, bidx, -1)
                     qlen = ok.astype(jnp.int32)
                     views = [RaggedKVCacheView(kp, vp, bt, seq, posv,
-                                               bidx, qlen, posv + 1, 1)
+                                               bidx, qlen, posv + 1, 1,
+                                               tp=view_tp)
                              for kp, vp in kv]
                     logits, new = model.forward(
                         Tensor(tok[None]), past_key_values=views,
@@ -2501,17 +2703,18 @@ class ContinuousBatchingEngine:
             # (pdt_spec_verify_seconds) — same contract as decode_step
             t0 = time.perf_counter()
             jit = self._get_spec_verify(pk["t_pad"], bound)
-            g_all, self._kv = jit(
-                [p._value for p in self._params],
-                [b._value for b in self._buffers],
-                self._kv, jnp.asarray(pk["ids"]),
-                jnp.asarray(pk["token_seq"]),
-                jnp.asarray(pk["positions"]),
-                jnp.asarray(pk["query_start"]),
-                jnp.asarray(pk["query_len"]),
-                jnp.asarray(pk["context_len"]),
-                jnp.asarray(self._bt), jnp.asarray(pk["sample_rows"]),
-                self._spec_key)
+            with self._tp_scope():
+                g_all, self._kv = jit(
+                    self._pv(), self._bv(),
+                    self._kv, jnp.asarray(pk["ids"]),
+                    jnp.asarray(pk["token_seq"]),
+                    jnp.asarray(pk["positions"]),
+                    jnp.asarray(pk["query_start"]),
+                    jnp.asarray(pk["query_len"]),
+                    jnp.asarray(pk["context_len"]),
+                    jnp.asarray(self._bt),
+                    jnp.asarray(pk["sample_rows"]),
+                    self._spec_key)
             g_all = np.asarray(g_all)
             # pdt-lint: disable=PDT001 same real-wall measurement
             vdt = time.perf_counter() - t0

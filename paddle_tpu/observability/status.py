@@ -18,21 +18,42 @@ from typing import Dict, List, Optional
 __all__ = ["render_fleet_status"]
 
 
+def _submesh_cell(sm: Optional[Dict[str, object]]) -> str:
+    """`tp=2@[0,1]` — the replica's tensor-parallel placement (its
+    GSPMD submesh shape + device ids), or `-` for single-chip."""
+    if not sm:
+        return "-"
+    devs = ",".join(str(d) for d in sm.get("devices", []))
+    return f"tp={sm.get('tp')}@[{devs}]"
+
+
 def render_fleet_status(info: Dict[str, object]) -> str:
     """Format one `ServingRouter.fleet_info()` snapshot."""
     lines: List[str] = ["fleet status"]
+    # the submesh column appears only for TP fleets — a single-chip
+    # fleet's table stays byte-identical to what operators already read
+    replicas = info.get("replicas", [])
+    with_tp = any(r.get("submesh") for r in replicas)
+    # width follows the widest cell: tp=4@[0,1,2,3] must not push the
+    # slo/note columns out of line with the header
+    tp_w = max([7] + [len(_submesh_cell(r.get("submesh")))
+                      for r in replicas]) if with_tp else 0
+    tp_hdr = f" {'submesh':<{tp_w}}" if with_tp else ""
     lines.append(f"  {'replica':<8} {'role':<10} {'state':<9} "
-                 f"{'outstanding':>11} {'restarts':>8} {'slo':<7} note")
-    for r in info.get("replicas", []):
+                 f"{'outstanding':>11} {'restarts':>8}{tp_hdr} "
+                 f"{'slo':<7} note")
+    for r in replicas:
         slo = r.get("slo")
         note = r.get("death_reason") or ""
         if r.get("consecutive_failures"):
             note = (note + " " if note else "") \
                 + f"{r['consecutive_failures']} consecutive failures"
+        tp_cell = f" {_submesh_cell(r.get('submesh')):<{tp_w}}" \
+            if with_tp else ""
         lines.append(
             f"  {r['index']:<8} {r.get('role', 'colocated'):<10} "
             f"{r['state']:<9} "
-            f"{r['outstanding']:>11} {r['restarts']:>8} "
+            f"{r['outstanding']:>11} {r['restarts']:>8}{tp_cell} "
             f"{(slo.upper() if slo else '-'):<7} {note}".rstrip())
     lines.append(
         f"  requests: {info.get('submitted', 0)} submitted, "

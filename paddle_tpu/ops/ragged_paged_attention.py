@@ -390,11 +390,49 @@ def _ragged_pallas(q, k_pages, v_pages, query_start, query_len,
     return jnp.transpose(out, (1, 2, 0, 3, 4)).reshape(t, h, d)
 
 
+def _ragged_tp_shard_map(q, k_pages, v_pages, query_start, query_len,
+                         context_len, block_tables, scale, window,
+                         block_q, interpret, tp):
+    """The Pallas kernel under tensor parallelism (serving/submesh.py):
+    heads are data-parallel in attention, so each TP shard runs the
+    UNCHANGED kernel over its local (H/tp, HK/tp) heads via shard_map —
+    q sharded on its head axis, the page pools on theirs, and the
+    descriptors/block tables REPLICATED in-spec (they are host-side
+    scalars describing every shard's identical page geometry: one
+    logical page = tp local shards). The kernel body never learns
+    about the mesh, which is what keeps its interpret-mode oracle
+    parity meaningful under TP."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+    mesh, axis = tp
+    P = jax.sharding.PartitionSpec
+
+    def local(qq, kp, vp, qs, ql, cl, bt):
+        return _ragged_pallas(qq, kp, vp, qs, ql, cl, bt, scale,
+                              window, block_q, interpret)
+
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(P(None, axis, None), P(axis, None, None, None),
+                  P(axis, None, None, None), P(), P(), P(), P()),
+        out_specs=P(None, axis, None),
+        # pallas_call has no replication rule; the specs above are
+        # exact (descriptors replicated in, heads sharded out), so
+        # skipping the rep check loses nothing
+        check_rep=False,
+    )(q, k_pages, v_pages, query_start.astype(jnp.int32),
+      query_len.astype(jnp.int32), context_len.astype(jnp.int32),
+      block_tables.astype(jnp.int32))
+
+
 def ragged_paged_attention_values(q, k_pages, v_pages, query_start,
                                   query_len, context_len, block_tables,
                                   scale=None, window=None,
                                   block_q=DEFAULT_BLOCK_Q,
-                                  use_kernel=None, pages_bound=None):
+                                  use_kernel=None, pages_bound=None,
+                                  tp=None):
     """q: (T, H, D) packed ragged queries; k_pages/v_pages:
     (HK, P, page_size, D); query_start/query_len/context_len: (N,)
     int32 per-sequence descriptors; block_tables: (N, pages_per_seq)
@@ -412,7 +450,13 @@ def ragged_paged_attention_values(q, k_pages, v_pages, query_start,
     XLA fallback gathers — traced callers (context lengths are tracers,
     so the automatic concrete trim cannot fire) pass their known max
     page demand to keep the gather O(max context), not O(pps). Columns
-    past every context are fully masked, so trimming them is exact."""
+    past every context are fully masked, so trimming them is exact.
+
+    ``tp``: a ``(jax Mesh, axis name)`` pair (the serving engine passes
+    its submesh's) making the dispatch sharding-aware — the XLA path
+    needs nothing (GSPMD propagates the head sharding through the
+    gather and the masked core), the kernel path runs per-shard via
+    `shard_map` with replicated descriptors (`_ragged_tp_shard_map`)."""
     t, h, d = q.shape
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
 
@@ -438,6 +482,11 @@ def ragged_paged_attention_values(q, k_pages, v_pages, query_start,
     if t % block_q:
         raise ValueError(f"packed length {t} not a multiple of "
                          f"block_q {block_q}")
+    if tp is not None:
+        return _ragged_tp_shard_map(q, k_pages, v_pages, query_start,
+                                    query_len, context_len,
+                                    block_tables, sc, window, block_q,
+                                    _interpret(), tp)
     return _ragged_pallas(q, k_pages, v_pages, query_start, query_len,
                           context_len, block_tables, sc, window,
                           block_q, _interpret())

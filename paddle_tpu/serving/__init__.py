@@ -27,6 +27,12 @@ arXiv:2605.25645):
   hashes shared across replicas (replacing per-replica warmth sets for
   role-aware fleets) with host-RAM spill for cold chains, so a warm
   prefix outlives the replicas that computed it.
+* `submesh.py`  — tensor-parallel replicas (ISSUE 12): one replica =
+  one GSPMD submesh carved from the global device set
+  (`ServingRouter(tp=...)`), Megatron column/row weight shardings +
+  KV pages sharded over the head axis (one logical page = tp local
+  shards), exact-mode determinism fences keeping tp>=2 greedy outputs
+  bit-identical to tp=1, and per-shard migration payload fragments.
 * `admission.py` — the QoS admission brain (ISSUE 11): interactive vs
   batch priority lanes, sliding-window per-tenant token budgets, and
   SLO-arbitrated load shedding (the PR-5 burn-rate engine decides
@@ -55,6 +61,8 @@ from .policy import (DispatchPolicy, LeastOutstandingPolicy,  # noqa: F401
 from .prefix_store import FleetPrefixStore, chain_hashes  # noqa: F401
 from .replica import (ReplicaHandle, ReplicaRole,  # noqa: F401
                       ReplicaState)
+from .submesh import (SubMesh, TP_AXIS, TpConfig,  # noqa: F401
+                      carve_submeshes)
 from .router import (FleetOverloaded, FleetRequest,  # noqa: F401
                      QosShed, ServingRouter, parse_roles)
 from .transfer import (install_request, migrate_request,  # noqa: F401
@@ -71,4 +79,5 @@ __all__ = [
     "FleetPrefixStore", "chain_hashes",
     "serialize_request", "install_request", "migrate_request",
     "payload_nbytes",
+    "SubMesh", "TP_AXIS", "TpConfig", "carve_submeshes",
 ]

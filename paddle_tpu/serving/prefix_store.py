@@ -145,6 +145,12 @@ class FleetPrefixStore:
         make prompt KV non-stable — or a page-size mismatch)."""
         if payload.get("freed") or payload["page_size"] != self.page_size:
             return 0
+        # a tensor-parallel source ships per-shard fragments; the spill
+        # stores the LOGICAL rows (import_prefix re-splits them onto
+        # whatever submesh restores the chain). Assembly copies the
+        # whole payload, so defer it until a page actually needs
+        # spilling — the common already-spilled chain stays free
+        kv_layers = None
         prompt = payload["prompt"]
         ps = self.page_size
         hashes = chain_hashes(prompt, ps)
@@ -154,8 +160,11 @@ class FleetPrefixStore:
             parent = h
             if entry["kv"] is not None:
                 continue                       # already spilled
+            if kv_layers is None:
+                from ..models.serving import assemble_payload_kv
+                kv_layers = assemble_payload_kv(payload)
             kv = [(np.asarray(kp[:, f]), np.asarray(vp[:, f]))
-                  for kp, vp in payload["kv"]]
+                  for kp, vp in kv_layers]
             nbytes = sum(a.nbytes + b.nbytes for a, b in kv)
             entry["tokens"] = tuple(prompt[f * ps:(f + 1) * ps])
             entry["kv"] = kv

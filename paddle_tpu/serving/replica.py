@@ -93,14 +93,16 @@ class ReplicaHandle:
     `engine_factory(index)` builds a fresh engine — called at
     construction and again on every restart, so a restarted replica
     comes back with empty queues and a cold KV pool, like a respawned
-    process. Restart pacing reuses the elastic launcher's
+    process. When a `submesh` is attached (TP fleets) the factory is
+    called as `engine_factory(index, submesh)` instead, so every
+    incarnation is built on the SAME device slice. Restart pacing reuses the elastic launcher's
     `restart_backoff` shape (exponential, jittered via the injectable
     `rng`, capped) expressed as a *next-restart deadline* on the
     injectable clock rather than a sleep — the router is step-driven.
     """
 
     def __init__(self, index: int,
-                 engine_factory: Callable[[int], ContinuousBatchingEngine],
+                 engine_factory: Callable[..., ContinuousBatchingEngine],
                  *, clock: Callable[[], float],
                  degraded_after: int = 1,
                  dead_after: int = 3,
@@ -110,11 +112,17 @@ class ReplicaHandle:
                  restart_backoff_max: float = 60.0,
                  max_restarts: Optional[int] = 5,
                  rng: Optional[random.Random] = None,
-                 role: str = ReplicaRole.COLOCATED):
+                 role: str = ReplicaRole.COLOCATED,
+                 submesh=None):
         if role not in ReplicaRole.ALL:
             raise ValueError(f"unknown replica role {role!r}: "
                              f"{sorted(ReplicaRole.ALL)}")
         self.role = role
+        # tensor parallelism (serving/submesh.py): the replica's device
+        # slice. It belongs to the SLOT, not the engine incarnation —
+        # a restarted replica comes back on the SAME submesh, so
+        # replica identity is (submesh, generation)
+        self.submesh = submesh
         # transfer-plane traffic (survives restarts — the counters
         # describe the SLOT in the fleet, not one engine incarnation)
         self.migrations_in = 0
@@ -130,8 +138,8 @@ class ReplicaHandle:
         self._backoff_cap = float(restart_backoff_max)
         self.max_restarts = max_restarts
         self._rng = rng if rng is not None else random.Random(index)
-        self.engine: Optional[ContinuousBatchingEngine] = engine_factory(
-            self.index)
+        self.engine: Optional[ContinuousBatchingEngine] = \
+            self._build_engine()
         # bumped on every restart: a request dispatched to generation g
         # is STRANDED once the handle runs generation g+1 — the fresh
         # engine never heard of it, however alive the replica looks
@@ -154,6 +162,14 @@ class ReplicaHandle:
         self.retired_spec = {"rounds": 0, "proposed": 0, "accepted": 0,
                              "degraded": 0}
         _M_STATE.set(ReplicaState.CODE[self.state], replica=str(index))
+
+    def _build_engine(self) -> ContinuousBatchingEngine:
+        """Factory invocation, submesh-aware: a TP fleet's factory
+        takes (index, submesh) — the router carved the slice and every
+        incarnation of this replica lives on it."""
+        if self.submesh is not None:
+            return self._factory(self.index, self.submesh)
+        return self._factory(self.index)
 
     # -- introspection ---------------------------------------------------
     def outstanding(self) -> int:
@@ -343,7 +359,7 @@ class ReplicaHandle:
                 or self.next_restart_time is None \
                 or now < self.next_restart_time:
             return False
-        self.engine = self._factory(self.index)
+        self.engine = self._build_engine()
         self.generation += 1
         self.consecutive_failures = 0
         self.death_reason = None

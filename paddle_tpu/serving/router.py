@@ -238,7 +238,10 @@ class ServingRouter:
     """Deterministic, step-driven router over a replica fleet.
 
     `engine_factory(index)` builds one replica's engine; it is called
-    N times up front and again on every restart. Pass the router's
+    N times up front and again on every restart. With `tp=` set the
+    router carves one submesh per replica and calls the factory as
+    `engine_factory(index, submesh)` — pass the submesh through to
+    `ContinuousBatchingEngine(submesh=...)`. Pass the router's
     `clock` into the engines it builds when per-request deadlines must
     stay exact across failover (the router re-derives the remaining
     budget on the same clock).
@@ -249,11 +252,12 @@ class ServingRouter:
     """
 
     def __init__(self, engine_factory:
-                 Callable[[int], ContinuousBatchingEngine],
+                 Callable[..., ContinuousBatchingEngine],
                  num_replicas: int = 2,
                  policy="least_outstanding",
                  *, page_size: int = 16,
                  roles=None,
+                 tp=None,
                  prefix_store: Optional[FleetPrefixStore] = None,
                  max_replica_outstanding: Optional[int] = None,
                  degraded_after: int = 1,
@@ -306,9 +310,22 @@ class ServingRouter:
         self.policy: DispatchPolicy = make_policy(
             policy, page_size=page_size, store=prefix_store)
         self._retry_cost = float(retry_after_per_request)
+        # tensor parallelism (serving/submesh.py, docs/serving.md
+        # "Tensor parallelism"): `tp=` (an int or a TpConfig) carves
+        # `num_replicas` DISJOINT tp-device submeshes from the global
+        # device set at construction — one per replica slot, kept
+        # across restarts — and the factory must take (index, submesh)
+        self.submeshes = None
+        if tp is not None:
+            from .submesh import TpConfig, carve_submeshes
+            tp_cfg = tp if isinstance(tp, TpConfig) \
+                else TpConfig(tp=int(tp))
+            self.submeshes = carve_submeshes(num_replicas, tp_cfg)
         rng = random.Random(seed)
         self.replicas: List[ReplicaHandle] = [
             ReplicaHandle(i, engine_factory, clock=self._clock,
+                          submesh=None if self.submeshes is None
+                          else self.submeshes[i],
                           degraded_after=degraded_after,
                           dead_after=dead_after,
                           wedge_timeout=wedge_timeout,
@@ -981,7 +998,11 @@ class ServingRouter:
                  "restarts": h.restarts,
                  "migrations_in": h.migrations_in,
                  "migrations_out": h.migrations_out,
-                 "death_reason": h.death_reason}
+                 "death_reason": h.death_reason,
+                 # operator visibility of PLACEMENT: which devices
+                 # this replica's engine (every incarnation) lives on
+                 "submesh": None if h.submesh is None
+                 else h.submesh.describe()}
                 for h in self.replicas],
             "pending": pending,
             "submitted": len(self.requests),
@@ -992,6 +1013,11 @@ class ServingRouter:
             "prefix_tokens_reused": sum(h.prefix_tokens_reused()
                                         for h in self.replicas),
         }
+        if self.submeshes is not None:
+            info["tp"] = {"tp": self.submeshes[0].tp,
+                          "mode": self.submeshes[0].config.mode,
+                          "submeshes": [m.describe()
+                                        for m in self.submeshes]}
         if self.roles_enabled:
             # per-role aggregates: migrations count OUT of prefill and
             # INTO decode (the same transfers seen from each end)

@@ -52,11 +52,12 @@ from typing import Callable, Optional, Tuple
 
 from .. import observability as telemetry
 from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
-                              PoolExhausted, Request)
+                              PoolExhausted, Request,
+                              assemble_payload_kv)
 from ..utils.faults import fault_point
 
 __all__ = ["serialize_request", "install_request", "migrate_request",
-           "payload_nbytes"]
+           "payload_nbytes", "assemble_payload_kv"]
 
 
 _M_MIGRATIONS = telemetry.counter(
@@ -77,8 +78,16 @@ _M_SECONDS = telemetry.histogram(
 
 
 def payload_nbytes(payload: dict) -> int:
-    """Host bytes of the payload's KV page content."""
-    return sum(k.nbytes + v.nbytes for k, v in payload["kv"])
+    """Host bytes of the payload's KV page content. A tensor-parallel
+    source serializes per-shard FRAGMENTS (`kv_shards` — engine
+    `export_pages`, serving/submesh.py) instead of assembled rows;
+    counting the fragments keeps this honest: the sum IS the bytes
+    that crossed a device->host link, with no double count for an
+    assembled view."""
+    if payload.get("kv") is not None:
+        return sum(k.nbytes + v.nbytes for k, v in payload["kv"])
+    return sum(k.nbytes + v.nbytes
+               for shard in payload["kv_shards"] for k, v in shard)
 
 
 def serialize_request(engine: ContinuousBatchingEngine,

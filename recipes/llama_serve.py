@@ -72,6 +72,14 @@ def main(argv=None):
                         "drill proves outputs identical to a "
                         "colocated fleet through a SIGKILL of a "
                         "prefill replica mid-migration")
+    p.add_argument("--tp", type=int, default=0, metavar="N",
+                   help="run the kill-a-SUBMESH drill: a fleet of "
+                        "tensor-parallel replicas (one replica = one "
+                        "N-device GSPMD submesh, serving/submesh.py), "
+                        "SIGKILL one TP replica mid-decode, assert "
+                        "outputs identical to an unkilled tp=1 fleet, "
+                        "and print the pdt_tp/transfer Prometheus "
+                        "dump (0 = off)")
     p.add_argument("--trace-out", default=None,
                    help="write the failover drill's Perfetto/Chrome "
                         "trace here (default: a temp file)")
@@ -381,6 +389,82 @@ def main(argv=None):
                     if "pdt_transfer" in line or "pdt_prefix_store"
                     in line))
     print("--- end transfer telemetry ---")
+
+    # 3f) tensor parallelism (docs/serving.md "Tensor parallelism"):
+    # the kill-a-submesh drill — a fleet where each replica is one
+    # --tp-device GSPMD submesh (weights column/row-sharded, KV pages
+    # sharded on the head axis), SIGKILL one TP replica mid-decode,
+    # and prove outputs identical to an unkilled tp=1 fleet; then one
+    # roles migration so the per-shard transfer fragments are
+    # exercised and metered
+    if args.tp:
+        import jax as _jax
+        from paddle_tpu.serving import TpConfig
+        n_dev = len(_jax.devices())
+        tp_replicas = min(2, n_dev // args.tp)
+        if tp_replicas < 2:
+            raise SystemExit(
+                f"--tp {args.tp} needs >= {2 * args.tp} devices for a "
+                f"2-replica drill, have {n_dev}")
+        tp_jobs = [system + rng.integers(
+            1, cfg.vocab_size, int(rng.integers(4, 10))).tolist()
+            for _ in range(2 * tp_replicas)]
+
+        def tp_fleet(tp):
+            if tp is None:
+                return ServingRouter(
+                    lambda i: ContinuousBatchingEngine(
+                        model, max_batch_size=2,
+                        max_seq_len=min(256,
+                                        cfg.max_position_embeddings),
+                        enable_prefix_caching=True),
+                    num_replicas=tp_replicas)
+            return ServingRouter(
+                lambda i, sm: ContinuousBatchingEngine(
+                    model, max_batch_size=2,
+                    max_seq_len=min(256, cfg.max_position_embeddings),
+                    enable_prefix_caching=True, submesh=sm),
+                num_replicas=tp_replicas, tp=TpConfig(tp=tp))
+
+        ref = tp_fleet(None)                     # the tp=1 oracle
+        ref_ids = [ref.submit(pr, n) for pr in tp_jobs]
+        tp_want = ref.run()
+        fleet_tp = tp_fleet(args.tp)
+        tp_ids = [fleet_tp.submit(pr, n) for pr in tp_jobs]
+        fleet_tp.step()
+        fleet_tp.step()                          # mid-decode
+        victim = fleet_tp.requests[tp_ids[0]].replica
+        fleet_tp.kill_replica(victim)            # SIGKILL the submesh
+        tp_got = fleet_tp.run()
+        assert [tp_got[i] for i in tp_ids] \
+            == [tp_want[i] for i in ref_ids], \
+            "tensor parallelism changed outputs"
+        info = fleet_tp.fleet_info()
+        print(f"tensor parallelism: {tp_replicas} replicas x "
+              f"tp={args.tp}, killed replica {victim} (submesh "
+              f"{info['replicas'][victim]['submesh']['devices']}) "
+              f"mid-decode -> {info['failovers']} failover(s), "
+              "outputs identical to the tp=1 fleet")
+        assert info["failovers"] >= 1 and info["pending"] == 0
+        # one migration between TP replicas: per-shard payload bytes
+        disagg_tp = ServingRouter(
+            lambda i, sm: ContinuousBatchingEngine(
+                model, max_batch_size=2,
+                max_seq_len=min(256, cfg.max_position_embeddings),
+                enable_prefix_caching=True, submesh=sm),
+            roles="prefill:1,decode:1", tp=args.tp, page_size=16)
+        d_ids = [disagg_tp.submit(pr, n) for pr in tp_jobs]
+        d_got = disagg_tp.run()
+        assert [d_got[i] for i in d_ids] \
+            == [tp_want[i] for i in ref_ids], \
+            "TP migration changed outputs"
+        assert disagg_tp.fleet_info()["migrations"] >= 1
+        print(telemetry.render_fleet_status(info))
+        print("--- tp telemetry (Prometheus text exposition) ---")
+        print("\n".join(line for line in telemetry.to_prometheus()
+                        .splitlines()
+                        if "pdt_tp" in line or "pdt_transfer" in line))
+        print("--- end tp telemetry ---")
 
     # 4) standalone speculative decoding (same draft as the fleet
     # drill's engine-mode speculation)
