@@ -8,6 +8,7 @@ from typing import Dict, List, Optional, Sequence
 from ..core import Checker
 from .catalog import CatalogDriftChecker
 from .clocks import InjectableClockChecker
+from .durablewrites import DurableWriteChecker
 from .faultsites import FaultSiteDriftChecker
 from .pins import PinPairingChecker
 from .supervision import SwallowedErrorChecker
@@ -15,8 +16,9 @@ from .tracedsync import TracedHostSyncChecker
 
 __all__ = ["ALL_CHECKER_CLASSES", "default_checkers", "by_code",
            "CatalogDriftChecker", "InjectableClockChecker",
-           "FaultSiteDriftChecker", "PinPairingChecker",
-           "SwallowedErrorChecker", "TracedHostSyncChecker"]
+           "DurableWriteChecker", "FaultSiteDriftChecker",
+           "PinPairingChecker", "SwallowedErrorChecker",
+           "TracedHostSyncChecker"]
 
 ALL_CHECKER_CLASSES = (
     InjectableClockChecker,      # PDT001
@@ -25,6 +27,7 @@ ALL_CHECKER_CLASSES = (
     CatalogDriftChecker,         # PDT004
     PinPairingChecker,           # PDT005
     SwallowedErrorChecker,       # PDT006
+    DurableWriteChecker,         # PDT007
 )
 
 

@@ -1,0 +1,122 @@
+"""PDT007 — durable-write discipline.
+
+Repo law (ISSUE 13, the router write-ahead journal): control-plane
+state under ``paddle_tpu/serving/`` reaches disk through exactly two
+doors — the journal's append path (``serving/journal.py``, whose
+records are checksummed, length-prefixed, and torn-tail tolerated at
+replay) or the atomic tmp+rename commit helper
+(``journal.commit_bytes``). A bare ``open(path, "w")`` anywhere else
+in the serving layer is a torn-file crash window: a SIGKILL mid-write
+leaves a half-file that no replay rule covers, which is precisely the
+failure mode the journal subsystem exists to close. The checker flags
+
+* ``open()`` / ``io.open()`` / ``os.fdopen()`` calls whose mode
+  literal writes (``w``/``a``/``x``/``+``) — a NON-literal mode is
+  flagged too (the discipline cannot be audited around a variable);
+* ``os.open()`` (low-level descriptors have no business in the
+  serving layer outside the journal);
+* ``pathlib``-style ``.write_text()`` / ``.write_bytes()`` calls.
+
+Read-mode opens pass. ``serving/journal.py`` itself is the allowlist:
+it owns the append files and implements the commit helper.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Tuple
+
+from .._astutil import call_name, import_aliases, literal_str
+from ..core import Checker, Finding, Project
+
+__all__ = ["DurableWriteChecker"]
+
+_OPEN_CALLS = ("open", "io.open", "os.fdopen")
+_WRITE_ATTRS = ("write_text", "write_bytes")
+
+
+def _mode_of(call: ast.Call):
+    """The mode argument of an open()-style call: (literal_or_None,
+    present). Positional arg 1 or keyword ``mode``."""
+    node = None
+    if len(call.args) >= 2:
+        node = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            node = kw.value
+    if node is None:
+        return None, False
+    return literal_str(node), True
+
+
+class DurableWriteChecker(Checker):
+    code = "PDT007"
+    name = "durable-write"
+    rationale = ("serving-layer state reaches disk only through the "
+                 "write-ahead journal appender or the tmp+rename "
+                 "commit helper (ISSUE 13 — a bare write is a "
+                 "torn-file crash window)")
+
+    DEFAULT_SCOPE = ("paddle_tpu/serving/*.py",)
+    # the journal IS the durable-write implementation: its append path
+    # and commit_bytes own their files
+    DEFAULT_ALLOW = ("paddle_tpu/serving/journal.py",)
+
+    def __init__(self, scope: Tuple[str, ...] = DEFAULT_SCOPE,
+                 allow: Tuple[str, ...] = DEFAULT_ALLOW):
+        self.scope = scope
+        self.allow = allow
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.match(self.scope, exclude=self.allow):
+            if sf.tree is None:
+                continue
+            aliases = import_aliases(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                # pathlib-style writes: flagged on the attribute name
+                # alone (the receiver's type is not statically known,
+                # and a false positive here is a reviewable
+                # suppression, not a torn file)
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _WRITE_ATTRS:
+                    yield self.finding(
+                        sf, node,
+                        f".{node.func.attr}() under serving/ — route "
+                        "durable state through the journal appender "
+                        "or journal.commit_bytes (tmp+rename), not a "
+                        "direct file write",
+                        detail=node.func.attr, project=project)
+                    continue
+                name = call_name(node, aliases)
+                if name == "os.open":
+                    yield self.finding(
+                        sf, node,
+                        "os.open() under serving/ — low-level "
+                        "descriptors belong to the journal "
+                        "(serving/journal.py); route writes through "
+                        "its appender or journal.commit_bytes",
+                        detail="os.open", project=project)
+                    continue
+                if name not in _OPEN_CALLS:
+                    continue
+                mode, present = _mode_of(node)
+                if not present:
+                    continue                 # bare open(p) reads
+                if mode is None:
+                    yield self.finding(
+                        sf, node,
+                        "open() with a non-literal mode under "
+                        "serving/ — the durable-write discipline "
+                        "cannot be audited around a variable; use a "
+                        "literal mode (or the journal helpers for "
+                        "writes)",
+                        detail="non-literal-mode", project=project)
+                elif any(c in mode for c in "wax+"):
+                    yield self.finding(
+                        sf, node,
+                        f"open(..., {mode!r}) under serving/ — a bare "
+                        "write is a torn-file crash window; route it "
+                        "through the journal appender or "
+                        "journal.commit_bytes (tmp+rename)",
+                        detail=f"open:{mode}", project=project)

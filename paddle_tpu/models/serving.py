@@ -70,6 +70,7 @@ rows) — TPU-native:
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import os
 import time
 from collections import OrderedDict
@@ -88,7 +89,8 @@ from .generation import RequestStatus
 
 __all__ = ["ContinuousBatchingEngine", "Request", "RequestStatus",
            "SpecConfig", "EngineOverloaded", "PoolExhausted",
-           "EngineInvariantError", "assemble_payload_kv"]
+           "EngineInvariantError", "PayloadCorruption",
+           "assemble_payload_kv", "payload_checksums", "verify_payload"]
 
 # nullcontext is stateless — one shared instance serves every non-TP
 # dispatch (`_tp_scope` sits on the per-decode-step hot path)
@@ -114,6 +116,50 @@ def assemble_payload_kv(payload: dict):
     return [(np.concatenate([s[li][0] for s in shards], axis=0),
              np.concatenate([s[li][1] for s in shards], axis=0))
             for li in range(layers)]
+
+
+def payload_checksums(payload: dict):
+    """Content checksums of a transfer payload's KV page bytes: one
+    ``"sha256:<hex>"`` per key and value array of every SHARD FRAGMENT
+    (the wire unit — `export_pages`), per layer, in wire order. The
+    manifest.py hashing discipline applied to the transfer plane:
+    hashes cover exactly the bytes that cross the device->host link,
+    so a flipped byte anywhere in the payload is detectable before it
+    installs into a target engine's pool."""
+    shards = [payload["kv"]] if payload.get("kv") is not None \
+        else payload["kv_shards"]
+    return [[["sha256:" + hashlib.sha256(
+                  np.ascontiguousarray(k).tobytes()).hexdigest(),
+              "sha256:" + hashlib.sha256(
+                  np.ascontiguousarray(v).tobytes()).hexdigest()]
+             for k, v in shard] for shard in shards]
+
+
+def verify_payload(payload: dict) -> None:
+    """Verify a payload's `kv_sha256` manifest against its actual KV
+    bytes; raises :class:`PayloadCorruption` on any mismatch. A
+    payload without a manifest (a pre-integrity producer) passes —
+    `export_pages` always attaches one, so that case is foreign
+    payloads only. Called by `import_pages` BEFORE any target
+    mutation, so a corrupt payload leaves both engines consistent and
+    the transfer plane counts it as a failure at stage ``verify``."""
+    want = payload.get("kv_sha256")
+    if want is None:
+        return
+    got = payload_checksums(payload)
+    if got != [[list(pair) for pair in shard] for shard in want]:
+        for s, (gs, ws) in enumerate(zip(got, want)):
+            for layer, (gp, wp) in enumerate(zip(gs, ws)):
+                if gp != list(wp):
+                    raise PayloadCorruption(
+                        f"KV payload checksum mismatch for request "
+                        f"{payload.get('request_id')!r} at shard {s} "
+                        f"layer {layer} — the payload was corrupted "
+                        "in flight; refusing to install")
+        raise PayloadCorruption(
+            f"KV payload checksum manifest shape mismatch for request "
+            f"{payload.get('request_id')!r} (manifest "
+            f"{len(want)} shards vs payload {len(got)})")
 
 
 # -- telemetry (docs/serving.md "Observability" metric catalog) --------
@@ -203,6 +249,15 @@ class PoolExhausted(RuntimeError):
 
 class EngineInvariantError(AssertionError):
     """check_invariants() found inconsistent page accounting."""
+
+
+class PayloadCorruption(ValueError):
+    """A transfer payload's KV bytes do not match its `kv_sha256`
+    manifest (`verify_payload`). Raised by `import_pages` BEFORE any
+    target mutation: both engines stay consistent, the transfer plane
+    counts ``pdt_transfer_failures_total{stage="verify"}``, and the
+    router keeps the request decoding on its source (falling back to
+    folded-token failover re-prefill if that source later dies)."""
 
 
 @dataclass
@@ -804,6 +859,7 @@ class ContinuousBatchingEngine:
         else:
             kv = [(np.asarray(kp[:, pages]), np.asarray(vp[:, pages]))
                   for kp, vp in self._kv]
+        payload_kv = {"kv": kv, "kv_shards": kv_shards}
         return {
             "request_id": req.request_id,
             "prompt": list(req.prompt),
@@ -827,6 +883,10 @@ class ContinuousBatchingEngine:
             "kv_spec": (L, hk, hd, str(jnp.dtype(dt))),
             "kv": kv,
             "kv_shards": kv_shards,
+            # integrity manifest (ISSUE 13): sha256 per shard fragment
+            # — import_pages verifies BEFORE install, so in-flight
+            # corruption is a counted refusal, not silent garbage KV
+            "kv_sha256": payload_checksums(payload_kv),
             "tp": n_tp,
         }
 
@@ -865,6 +925,13 @@ class ContinuousBatchingEngine:
         if not free:
             raise EngineOverloaded("no free slot for a migration "
                                    "import — retry after a step")
+        # integrity gate (ISSUE 13): reject corrupt payloads BEFORE any
+        # target mutation — both engines stay consistent and the
+        # transfer plane books stage="verify". Deliberately AFTER the
+        # free-slot check: a capacity-deferred migration retries every
+        # router tick, and hashing the full KV payload per deferral
+        # would be pure wasted step-path work
+        verify_payload(payload)
         now = self._clock()
         budget = payload["deadline_remaining"] if deadline is None \
             else deadline

@@ -40,6 +40,14 @@ arXiv:2605.25645):
   `derive_retry_after` semantics across every refusal surface; fails
   OPEN to plain FIFO when the controller itself breaks.
 
+* `journal.py`  — the crash-durable control plane (ISSUE 13): a
+  checksummed, length-prefixed write-ahead journal of submits
+  (BEFORE dispatch — the durability point), per-step token-progress
+  mirrors, and terminals, with atomic tmp+rename compaction and
+  torn-tail-tolerant replay; `ServingRouter.recover(journal, ...)`
+  rebuilds a SIGKILLed router with zero loss and greedy outputs
+  bit-identical to an uninterrupted fleet.
+
 Telemetry rides `pdt_router_*` / `pdt_transfer_*` /
 `pdt_prefix_store_*` (docs/serving.md "Fleet" + "Disaggregation");
 every future scale layer (autoscaling, multi-host replicas) builds on
@@ -61,6 +69,8 @@ from .policy import (DispatchPolicy, LeastOutstandingPolicy,  # noqa: F401
 from .prefix_store import FleetPrefixStore, chain_hashes  # noqa: F401
 from .replica import (ReplicaHandle, ReplicaRole,  # noqa: F401
                       ReplicaState)
+from .journal import (JournalReplay, ReplayedRequest,  # noqa: F401
+                      RouterJournal, commit_bytes)
 from .submesh import (SubMesh, TP_AXIS, TpConfig,  # noqa: F401
                       carve_submeshes)
 from .router import (FleetOverloaded, FleetRequest,  # noqa: F401
@@ -77,6 +87,8 @@ __all__ = [
     "DispatchPolicy", "RoundRobinPolicy", "LeastOutstandingPolicy",
     "PrefixAffinityPolicy", "POLICIES", "make_policy",
     "FleetPrefixStore", "chain_hashes",
+    "RouterJournal", "JournalReplay", "ReplayedRequest",
+    "commit_bytes",
     "serialize_request", "install_request", "migrate_request",
     "payload_nbytes",
     "SubMesh", "TP_AXIS", "TpConfig", "carve_submeshes",

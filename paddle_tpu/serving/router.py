@@ -65,6 +65,20 @@ on the CPU test mesh, no threads, no sleeps inside `step()`):
   failover path: re-prefill on a survivor, greedy outputs
   bit-identical to a colocated fleet.
 
+* **Durability** — with `journal=RouterJournal(...)` (serving/
+  journal.py, docs/serving.md "Durability") the router write-ahead
+  journals the state it already mirrors: every submit BEFORE dispatch
+  (the durability point), one batched token-progress record per step
+  tick, and every terminal with its final stream. A SIGKILL of the
+  ROUTER process is then zero-loss: `ServingRouter.recover(journal,
+  factory, ...)` builds a fresh incarnation that rehydrates every
+  un-finalized request onto fresh replicas (journaled tokens folded
+  into re-prefill — the PR-4 failover shape), restores finished
+  requests WITHOUT re-execution (idempotent per request_id), restores
+  QoS lane/tenant/budget context, and finalizes honest timeouts for
+  deadlines that died with the old incarnation. Greedy outputs stay
+  bit-identical to an uninterrupted fleet.
+
 Telemetry (`pdt_router_*`, docs/serving.md "Fleet"): dispatch counters
 by {policy, replica}, failover/restart counters, per-replica state and
 queue-depth gauges, affinity hit-rate, fleet terminal counters that
@@ -95,8 +109,10 @@ from ..observability import trace as tracing
 from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
                               PoolExhausted, Request, RequestStatus)
 from . import transfer
+from . import journal as journal_mod
 from .admission import (Lane, QosAdmission, derive_retry_after,
                         note_failopen)
+from .journal import RouterJournal
 from .policy import DispatchPolicy, PrefixAffinityPolicy, make_policy
 from .prefix_store import FleetPrefixStore
 from .replica import ReplicaHandle, ReplicaRole, ReplicaState
@@ -271,6 +287,7 @@ class ServingRouter:
                  sleep: Callable[[float], None] = time.sleep,
                  slo_monitor=None,
                  admission: Optional[QosAdmission] = None,
+                 journal: Optional[RouterJournal] = None,
                  seed: int = 0):
         # roles (disaggregated prefill/decode, docs/serving.md
         # "Disaggregation"): a spec — see `parse_roles` — defines both
@@ -301,6 +318,10 @@ class ServingRouter:
         # traffic. Build it over the same monitor/clock for
         # burn-arbitrated shedding
         self.admission = admission
+        # crash durability (serving/journal.py): submits journal BEFORE
+        # dispatch, token mirrors once per step, terminals with their
+        # final stream — ServingRouter.recover() is the read side
+        self.journal = journal
         # the fleet-wide prefix store rides along whenever roles are on
         # (its spill is what makes a prefix outlive its replica); pass
         # `prefix_store=` to share one across routers or tune bounds
@@ -409,6 +430,18 @@ class ServingRouter:
             deadline_abs=None if deadline is None else now + deadline,
             max_queue_time=max_queue_time, submit_time=now,
             lane=lane, tenant=tenant, priority=Lane.PRIORITY[lane])
+        if self.journal is not None:
+            # the DURABILITY point (docs/serving.md "Durability"): the
+            # submit record lands BEFORE any dispatch, so a router
+            # SIGKILL at any later instant is recoverable. An append
+            # failure here refuses the submit — work the journal
+            # cannot record must not be accepted
+            self.journal.append_submit(
+                request_id=request_id, prompt=toks,
+                max_new_tokens=int(max_new_tokens), lane=lane,
+                tenant=tenant, priority=rec.priority,
+                deadline_abs=rec.deadline_abs,
+                max_queue_time=max_queue_time)
         # one distributed trace per request, keyed by the stable id:
         # every span/event below that carries this request_id (dispatch
         # attempts, engine prefill/first-token/terminal, failovers)
@@ -420,6 +453,14 @@ class ServingRouter:
         try:
             self._dispatch(rec, forced=False)
         except BaseException:
+            if self.journal is not None:
+                # the journaled submit must not be resurrected by
+                # recover(): the client saw this refusal
+                try:
+                    self.journal.append_rejected(request_id)
+                except Exception as e:
+                    journal_mod.note_append_failure(
+                        e, where="router.submit_rejected")
             tracing.end_trace(request_id)   # refused: nothing to trace
             raise
         # budget charge only AFTER the fleet actually accepted — a
@@ -597,6 +638,7 @@ class ServingRouter:
                 rec.engine_req = None
                 self._terminal_backlog.append(rec)
                 self._live.pop(rec.request_id, None)
+                self._journal_terminal(rec)
                 _M_TERMINAL.inc(status=rec.status)
                 telemetry.event("router.terminal",
                                 request_id=rec.request_id,
@@ -702,6 +744,10 @@ class ServingRouter:
                 self._failover_one(rec)
         finished += self._terminal_backlog
         self._terminal_backlog = []
+        # durability: mirror this tick's new tokens into the journal
+        # AFTER harvests and failovers, so one batched progress record
+        # reflects exactly what the router would have streamed
+        self._journal_mirror()
         for h in self.replicas:
             h.update_gauges()
         return finished
@@ -835,6 +881,33 @@ class ServingRouter:
                 if rec.tokens and rec.first_token_time is None:
                     rec.first_token_time = self._clock()
 
+    def _journal_terminal(self, rec: FleetRequest):
+        """Append one terminal record (final status + the complete
+        stream). Counted-but-survived on failure: the request IS
+        terminal regardless, and a greedy recovery re-derives a lost
+        terminal by re-execution, bit-identically."""
+        if self.journal is None:
+            return
+        try:
+            self.journal.append_terminal(rec.request_id, rec.status,
+                                         rec.tokens, rec.error)
+        except Exception as e:
+            journal_mod.note_append_failure(e, where="router.terminal")
+
+    def _journal_mirror(self):
+        """One batched progress record per step tick: the journal
+        diffs the full mirrors against its own table and records only
+        new suffixes. Counted-but-survived on failure (a lost suffix
+        re-generates bit-identically from the folded re-prefill)."""
+        if self.journal is None:
+            return
+        try:
+            self.journal.step_mirror(
+                {rec.request_id: rec.tokens
+                 for rec in self._live.values() if rec.tokens})
+        except Exception as e:
+            journal_mod.note_append_failure(e, where="router.step")
+
     def _finalize(self, rec: FleetRequest, req: Request,
                   finished: List[FleetRequest]):
         rec.tokens = rec.folded + list(req.output)
@@ -845,6 +918,7 @@ class ServingRouter:
         rec.engine_req = None
         self._live.pop(rec.request_id, None)
         finished.append(rec)
+        self._journal_terminal(rec)
         _M_TERMINAL.inc(status=rec.status)
         telemetry.event("router.terminal", request_id=rec.request_id,
                         status=rec.status, replica=rec.replica,
@@ -874,6 +948,7 @@ class ServingRouter:
             rec.engine_req = None
             self._live.pop(rec.request_id, None)
             self._terminal_backlog.append(rec)
+            self._journal_terminal(rec)
             _M_TERMINAL.inc(status=rec.status)
             telemetry.event("router.terminal",
                             request_id=rec.request_id,
@@ -953,6 +1028,116 @@ class ServingRouter:
                              f"{rec.status}; only terminal requests "
                              "can be released")
         del self.requests[request_id]
+        if self.journal is not None:
+            # the client acknowledged delivery: compaction may drop
+            # the request's journal history entirely
+            try:
+                self.journal.append_release(request_id)
+            except Exception as e:
+                journal_mod.note_append_failure(e,
+                                                where="router.release")
+
+    # -- crash recovery (serving/journal.py) -----------------------------
+    @classmethod
+    def recover(cls, journal: RouterJournal, engine_factory,
+                **router_kwargs) -> "ServingRouter":
+        """Build a fresh router incarnation from a write-ahead journal
+        after the previous incarnation died (SIGKILL-shaped — nothing
+        of the old process survives but the journal). Every
+        un-finalized journaled request rehydrates onto the fresh
+        replicas with its journaled tokens FOLDED into re-prefill and
+        its budget shrunk (the PR-4 failover shape, so greedy outputs
+        are bit-identical to an uninterrupted fleet); already-finished
+        request_ids restore WITHOUT re-execution (idempotent per
+        request_id — their final streams stay redeliverable and a
+        client's re-submit of the same id is a no-op); deadlines that
+        expired while the router was dead finalize as honest timeouts;
+        QoS lane/tenant budgets re-charge for the live work
+        (`admission=` in `router_kwargs`). Replay is torn-tail
+        tolerant but an unreadable journal (the `journal.replay` fault
+        site) RAISES — recovery must not silently pretend the journal
+        was empty. `router_kwargs` are the ordinary constructor
+        arguments (replicas, policy, clocks, admission, ...); the
+        journal is re-attached, so the new incarnation keeps
+        journaling where the old one stopped."""
+        router = cls(engine_factory, journal=journal, **router_kwargs)
+        router._rehydrate()
+        return router
+
+    def _rehydrate(self):
+        """Replay the attached journal into this (fresh) router — see
+        `recover()`. Runs under the `journal.replay` span; counts
+        recovered/deduped and the recovery-seconds histogram."""
+        assert self.journal is not None, "recovery needs a journal"
+        t0 = self._clock()
+        with telemetry.span("journal.replay", path=self.journal.path):
+            replay = self.journal.replay()
+        now = self._clock()
+        for st in replay.finished.values():
+            if st.request_id in self.requests:
+                continue
+            # finished before the crash: restore the terminal record
+            # (status + final stream) and NEVER re-execute — the
+            # dedupe half of the idempotent-per-request_id contract
+            rec = FleetRequest(st.request_id, list(st.prompt),
+                               st.max_new_tokens, lane=st.lane,
+                               tenant=st.tenant, priority=st.priority,
+                               submit_time=now)
+            rec.status = st.status
+            rec.tokens = list(st.tokens)
+            rec.error = st.error
+            self.requests[st.request_id] = rec
+        journal_mod.note_deduped(len(replay.finished))
+        for st in replay.live.values():           # journal/submit order
+            if st.request_id in self.requests:
+                continue
+            rec = FleetRequest(st.request_id, list(st.prompt),
+                               st.max_new_tokens,
+                               deadline_abs=st.deadline_abs,
+                               max_queue_time=st.max_queue_time,
+                               lane=st.lane, tenant=st.tenant,
+                               priority=st.priority, submit_time=now)
+            rec.tokens = list(st.tokens)
+            self.requests[st.request_id] = rec
+            self._live[st.request_id] = rec
+            if self.admission is not None:
+                # restore the tenant BUDGET charge (reservation
+                # currency, same as submit-time commit) — but NOT the
+                # admit ledger: the OLD incarnation already counted
+                # this admission, so the cross-incarnation identity is
+                # terminals == committed admits + replay-recovered
+                # (docs/serving.md "Durability"). Fail OPEN like every
+                # admission surface — recovery never wedges on
+                # bookkeeping
+                try:
+                    budget = self.admission.budget_for(
+                        st.tenant if st.tenant is not None
+                        else self.admission.default_tenant)
+                    if budget is not None:
+                        budget.charge(len(st.prompt)
+                                      + st.max_new_tokens)
+                except Exception as e:
+                    note_failopen(e, where="router.recover")
+            # a fresh trace root: the old incarnation's carrier died
+            # with it, and the recovered request's re-prefill/decode
+            # spans should join ONE reconstructable tree
+            tracing.start_trace(st.request_id, name="router.recover",
+                                request_id=st.request_id,
+                                tokens_folded=len(rec.tokens),
+                                budget_left=self._remaining_budget(rec))
+            # the failover shape, one incarnation up: expired
+            # deadlines finalize honestly, everything else re-prefills
+            # with the journaled stream folded in (replica=None, so no
+            # failover counters inflate)
+            self._failover_one(rec)
+        journal_mod.note_recovered(len(replay.live))
+        journal_mod.observe_recovery_seconds(self._clock() - t0)
+        telemetry.event("journal.recovered",
+                        live=len(replay.live),
+                        deduped=len(replay.finished),
+                        corrupt_dropped=replay.corrupt_dropped,
+                        records=replay.records,
+                        segments=replay.segments)
 
     # -- drive-to-completion --------------------------------------------
     def run(self) -> Dict[str, List[int]]:
@@ -1034,6 +1219,10 @@ class ServingRouter:
             info["roles"] = agg
         if self.prefix_store is not None:
             info["prefix_store"] = self.prefix_store.stats()
+        if self.journal is not None:
+            # durability surface: segment/byte footprint + how much
+            # request state the journal is currently carrying
+            info["journal"] = self.journal.stats()
         # speculative decoding (engine spec_decode=): fleet-wide
         # acceptance aggregate, retired incarnations folded in by the
         # handles — the operator's one look at whether speculation is

@@ -29,6 +29,15 @@ folded in, greedy outputs bit-identical to a colocated fleet. Fault
 sites `transfer.serialize` / `transfer.install` (utils/faults.py)
 force both halves deterministically.
 
+Payload integrity (ISSUE 13, the manifest.py hashing discipline):
+`export_pages` attaches a sha256 checksum per KV shard fragment
+(`payload["kv_sha256"]`) and `import_pages` verifies it BEFORE any
+target mutation — a flipped byte in flight surfaces as
+:class:`PayloadCorruption`, counted as
+``pdt_transfer_failures_total{stage="verify"}`` with a
+`transfer.failed` event, and the request keeps decoding on its
+consistent source (ordinary failover covers a source that later dies).
+
 Speculative decoding (engine ``spec_decode=``, ISSUE 10): the payload
 carries TARGET pages only — a source engine's DRAFT-model cache is
 deliberately DROPPED at the hand-off (`evict_request` releases the
@@ -52,12 +61,13 @@ from typing import Callable, Optional, Tuple
 
 from .. import observability as telemetry
 from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
-                              PoolExhausted, Request,
-                              assemble_payload_kv)
+                              PayloadCorruption, PoolExhausted, Request,
+                              assemble_payload_kv, verify_payload)
 from ..utils.faults import fault_point
 
 __all__ = ["serialize_request", "install_request", "migrate_request",
-           "payload_nbytes", "assemble_payload_kv"]
+           "payload_nbytes", "assemble_payload_kv", "PayloadCorruption",
+           "verify_payload"]
 
 
 _M_MIGRATIONS = telemetry.counter(
@@ -65,9 +75,9 @@ _M_MIGRATIONS = telemetry.counter(
     "Requests migrated between engines through the KV transfer plane.")
 _M_FAILURES = telemetry.counter(
     "pdt_transfer_failures_total",
-    "Transfer-plane failures by stage (capacity deferrals — no free "
-    "slot / no pages on the target — are not failures and retry next "
-    "step).", ("stage",))
+    "Transfer-plane failures by stage (serialize | verify | install; "
+    "capacity deferrals — no free slot / no pages on the target — are "
+    "not failures and retry next step).", ("stage",))
 _M_BYTES = telemetry.counter(
     "pdt_transfer_bytes_total",
     "KV page bytes serialized out of source engines.")
@@ -145,6 +155,14 @@ def migrate_request(src: ContinuousBatchingEngine,
         req = install_request(dst, payload, deadline=deadline)
     except (EngineOverloaded, PoolExhausted):
         raise                       # target capacity: defer, not a fault
+    except PayloadCorruption as e:
+        # the integrity gate refused the payload before any target
+        # mutation: book it at its own stage — corruption is a
+        # different operational signal than an install that died
+        _M_FAILURES.inc(stage="verify")
+        telemetry.event("transfer.failed", stage="verify", rid=rid,
+                        error=f"{type(e).__name__}: {e}")
+        raise
     except BaseException as e:
         _M_FAILURES.inc(stage=stage)
         telemetry.event("transfer.failed", stage=stage, rid=rid,

@@ -61,6 +61,13 @@ Instrumented sites (grep ``fault_point(`` for the live list):
   (serving/transfer.py, the disaggregated prefill/decode page transfer
   plane — either fault leaves BOTH engines consistent, and the router
   degrades to failover re-prefill);
+* ``journal.append`` — before any record lands in the router
+  write-ahead journal (serving/journal.py): the router treats a fault
+  on the SUBMIT append as a failed submit (the durability point —
+  nothing was dispatched) and counts-but-survives faults on
+  progress/terminal/release appends; ``journal.replay`` — before a
+  recovery replay reads the journal (``ServingRouter.recover``
+  propagates it — an unreadable journal must not read as empty);
 * ``checkpoint.save`` — before any byte of a state-dict write;
   ``checkpoint.write`` — after one group's bytes land (fires between
   groups of a multi-group save: forces torn ``step_N.tmp`` dirs; for

@@ -29,6 +29,14 @@ The drill then GRADES the run (non-zero exit on failure):
 * the trace replays: the same seed regenerates the identical arrival
   sequence.
 
+A third leg then kills the CONTROL PLANE (docs/serving.md
+"Durability"): a write-ahead-journaled fleet takes the front half of
+a sustainable-rate trace, the router dies mid-decode (SIGKILL-shaped
+teardown), `ServingRouter.recover()` rehydrates a fresh incarnation
+from the journal, the remaining arrivals land on it, and the drill
+grades ZERO lost soak sessions + outputs identical to an unkilled
+fleet, printing the `pdt_journal_*` Prometheus dump.
+
     python recipes/fleet_soak.py                   # search + 2x soak
     python recipes/fleet_soak.py --qps 6 --overload 3
     python recipes/fleet_soak.py --duration 120 --replicas 4  # heavier
@@ -104,7 +112,7 @@ def main(argv=None):
             system_prompt_len=page, shared_prefix_prob=0.4,
             vocab_size=cfg.vocab_size)
 
-    def build_fleet(with_qos):
+    def build_fleet(with_qos, journal=None, recover_from=None):
         clock = VirtualClock()
         # a SHORT window makes the burn responsive: shedding starts
         # within seconds of the first breach-shaped samples and backs
@@ -125,15 +133,23 @@ def main(argv=None):
                 budgets={"free": args.free_budget},
                 tenant_window_s=max(10.0, args.duration / 3),
                 clock=clock)
-        router = ServingRouter(
-            lambda i: ContinuousBatchingEngine(
+        def engine(i):
+            return ContinuousBatchingEngine(
                 model, max_batch_size=args.slots, page_size=page,
                 max_seq_len=prompt_max + page + out_max + 2 * page,
-                clock=clock),
+                clock=clock)
+
+        kw = dict(
             num_replicas=args.replicas, policy="least_outstanding",
             page_size=page, max_replica_outstanding=4 * args.slots,
             clock=clock, sleep=clock.advance, slo_monitor=mon,
             admission=qos)
+        if recover_from is not None:
+            # a fresh incarnation rehydrated from a dead router's
+            # write-ahead journal (docs/serving.md "Durability")
+            router = ServingRouter.recover(recover_from, engine, **kw)
+        else:
+            router = ServingRouter(engine, journal=journal, **kw)
         return router, clock, mon
 
     def soak(qps, with_qos):
@@ -243,6 +259,81 @@ def main(argv=None):
     original = generate_trace(trace_cfg(rate))
     if replay != original:
         failures.append("trace replay diverged for the same seed")
+
+    # -- phase 3: kill the control plane mid-run ------------------------
+    # everything the soak graded above survives REPLICA death; this leg
+    # kills the ROUTER. A journaled fleet takes the front half of a
+    # sustainable-rate trace, dies mid-decode (SIGKILL-shaped teardown:
+    # nothing of the incarnation survives but its write-ahead journal),
+    # `ServingRouter.recover()` rehydrates a fresh incarnation, the
+    # remaining arrivals land on IT, and the drill grades zero lost
+    # sessions + outputs identical to an unkilled fleet on the same
+    # submissions (docs/serving.md "Durability").
+    print(f"\nrestart: kill-the-router drill at {max_qps:.2f} qps")
+    import shutil
+    import tempfile
+    from paddle_tpu.serving import RouterJournal
+
+    # enough sessions to straddle the kill, few enough that open-loop
+    # submission stays inside the fleet's backpressure bound
+    drill_events = generate_trace(trace_cfg(max_qps))[
+        :3 * args.replicas * args.slots]
+
+    def drill_submit(router, events):
+        return [router.submit(list(ev.prompt), ev.max_new_tokens,
+                              request_id=ev.request_id, lane=ev.lane,
+                              tenant=ev.tenant) for ev in events]
+
+    ref_router, _, _ = build_fleet(with_qos=False)
+    ref_ids = drill_submit(ref_router, drill_events)
+    ref_out = ref_router.run()                   # the unkilled oracle
+
+    wal_root = tempfile.mkdtemp(prefix="fleet_soak_wal_")
+    try:
+        wal = os.path.join(wal_root, "wal")
+        router, _, _ = build_fleet(
+            with_qos=False,
+            journal=RouterJournal(wal, fsync="terminal"))
+        half = len(drill_events) // 2
+        drill_submit(router, drill_events[:half])
+        finished_before = []
+        while not finished_before:               # kill mid-decode,
+            finished_before += router.step()     # some work finished
+        del router                               # SIGKILL-shaped
+        recovered, _, _ = build_fleet(
+            with_qos=False,
+            recover_from=RouterJournal(wal, fsync="terminal"))
+        drill_submit(recovered, drill_events[half:])
+        got_out = recovered.run()
+        n_rec = int(telemetry.value(
+            "pdt_journal_replay_recovered_total"))
+        n_dedup = int(telemetry.value(
+            "pdt_journal_replay_deduped_total"))
+        lost = [i for i in ref_ids if i not in got_out]
+        if lost:
+            failures.append(
+                f"router restart lost {len(lost)} soak session(s) "
+                f"(e.g. {lost[0]})")
+        mismatched = [i for i in ref_ids
+                      if got_out.get(i) != ref_out[i]]
+        if mismatched:
+            failures.append(
+                f"router restart changed {len(mismatched)} output "
+                f"stream(s) (e.g. {mismatched[0]})")
+        print(f"restart: killed the router with {half} sessions in "
+              f"flight ({len(finished_before)} already finished) -> "
+              f"recover() rehydrated {n_rec} live, restored {n_dedup} "
+              f"finished without re-execution; "
+              f"{len(drill_events) - half} post-restart arrivals "
+              "served by the recovered incarnation; "
+              f"{len(drill_events) - len(lost)}/{len(drill_events)} "
+              "sessions finished")
+        print("--- journal telemetry (Prometheus text exposition) ---")
+        print("\n".join(line for line in telemetry.to_prometheus()
+                        .splitlines() if "pdt_journal" in line))
+        print("--- end journal telemetry ---")
+    finally:
+        shutil.rmtree(wal_root, ignore_errors=True)
 
     print()
     if failures:

@@ -21,6 +21,13 @@ then drive every decode surface the framework ships —
     the `transfer.serialize` fault site, the source is SIGKILLed, and
     outputs are still identical (KV page transfer plane + fleet-wide
     prefix store stats printed),
+  * the crash-durable control plane (docs/serving.md "Durability"):
+    a write-ahead-journaled fleet loses its ROUTER mid-decode
+    (SIGKILL-shaped teardown), `ServingRouter.recover()` rehydrates a
+    fresh incarnation from the journal — finished requests restored
+    without re-execution, live ones re-prefilled with folded tokens —
+    outputs identical to an unkilled fleet, `pdt_journal_*` dump
+    printed,
   * the operator surface (docs/observability.md): an `SloMonitor`
     grades the drill's TTFT/availability objectives (SLO report +
     fleet status printed), and the failover timeline is written as a
@@ -465,6 +472,79 @@ def main(argv=None):
                         .splitlines()
                         if "pdt_tp" in line or "pdt_transfer" in line))
         print("--- end tp telemetry ---")
+
+    # 3g) crash-durable control plane (docs/serving.md "Durability"):
+    # every drill above killed things BELOW the router; this one kills
+    # the ROUTER. A journaled fleet dies mid-decode (abandoned,
+    # SIGKILL-shaped — nothing of the incarnation survives but the
+    # write-ahead journal directory), `ServingRouter.recover()`
+    # rehydrates a fresh incarnation: requests that finished before
+    # the kill restore WITHOUT re-execution (idempotent per
+    # request_id), live ones re-prefill with their journaled tokens
+    # folded in, and outputs must be identical to an unkilled fleet
+    import shutil
+    import tempfile
+    from paddle_tpu.serving import RouterJournal
+
+    def dur_engine(i):
+        return ContinuousBatchingEngine(
+            model, max_batch_size=2,
+            max_seq_len=min(256, cfg.max_position_embeddings),
+            enable_prefix_caching=True,
+            attention_impl=args.attention_impl)
+
+    dur_kwargs = dict(num_replicas=args.replicas,
+                      policy="prefix_affinity", page_size=16)
+
+    dur_jobs = [system + rng.integers(
+        1, cfg.vocab_size, int(rng.integers(4, 10))).tolist()
+        for _ in range(2 * args.replicas)]
+    # staggered budgets: some requests must FINISH before the kill
+    # (exercising the restore-without-re-execution path) while others
+    # are still mid-decode (the folded re-prefill path)
+    dur_budgets = [n if i % 2 == 0 else max(2, n // 4)
+                   for i in range(len(dur_jobs))]
+    dur_ref = ServingRouter(dur_engine, **dur_kwargs)
+    dur_ref_ids = [dur_ref.submit(pr, b)
+                   for pr, b in zip(dur_jobs, dur_budgets)]
+    dur_want = dur_ref.run()                     # the unkilled oracle
+
+    wal_root = tempfile.mkdtemp(prefix="llama_serve_wal_")
+    try:
+        wal = os.path.join(wal_root, "wal")
+        router = ServingRouter(
+            dur_engine, journal=RouterJournal(wal, fsync="terminal"),
+            **dur_kwargs)
+        dur_ids = [router.submit(pr, b)
+                   for pr, b in zip(dur_jobs, dur_budgets)]
+        finished_before = []
+        while not finished_before:               # someone must finish
+            finished_before += [r.request_id for r in router.step()]
+        assert any(not router.requests[i].done for i in dur_ids)
+        del router                               # SIGKILL-shaped
+        recovered = ServingRouter.recover(
+            RouterJournal(wal, fsync="terminal"), dur_engine,
+            **dur_kwargs)
+        for rid in finished_before:              # restored, not re-run
+            assert recovered.requests[rid].done
+            assert recovered.requests[rid].dispatches == 0
+        dur_out = recovered.run()
+        assert [dur_out[i] for i in dur_ids] \
+            == [dur_want[i] for i in dur_ref_ids], \
+            "router restart changed outputs"
+        n_rec = telemetry.value("pdt_journal_replay_recovered_total")
+        n_dedup = telemetry.value("pdt_journal_replay_deduped_total")
+        print(f"durability: killed the ROUTER mid-decode -> recover() "
+              f"rehydrated {n_rec:.0f} live request(s) and restored "
+              f"{n_dedup:.0f} finished one(s) without re-execution; "
+              "outputs identical to the unkilled fleet")
+        assert n_rec >= 1 and n_dedup >= len(finished_before)
+        print("--- journal telemetry (Prometheus text exposition) ---")
+        print("\n".join(line for line in telemetry.to_prometheus()
+                        .splitlines() if "pdt_journal" in line))
+        print("--- end journal telemetry ---")
+    finally:
+        shutil.rmtree(wal_root, ignore_errors=True)
 
     # 4) standalone speculative decoding (same draft as the fleet
     # drill's engine-mode speculation)

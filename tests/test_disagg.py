@@ -517,3 +517,113 @@ class TestMigrationTiming:
         # any perf_counter leak would observe real (nonzero) wall time
         assert h[""]["count"] == router.num_migrations
         assert h[""]["sum"] == 0.0
+
+
+class TestPayloadIntegrity:
+    """Migration payload integrity (ISSUE 13): `export_pages` attaches
+    a sha256 per KV shard fragment (the manifest.py hashing
+    discipline) and `import_pages` verifies BEFORE install — a flipped
+    byte in flight is a counted `stage="verify"` transfer failure that
+    leaves both engines consistent."""
+
+    @staticmethod
+    def _flip(payload, which=0):
+        """Corrupt one byte of a KV fragment (the exported arrays are
+        read-only views of device memory — corrupting a copy is
+        exactly what in-flight damage looks like)."""
+        pair = list(payload["kv"][0])
+        arr = pair[which].copy()
+        arr.flat[arr.size // 2] += 1.0
+        pair[which] = arr
+        payload["kv"][0] = tuple(pair)
+
+    def _running_payload(self, model):
+        src = _engine(model)
+        rid = src.add_request([5, 4, 3, 2, 6, 7], 6)
+        src.step()
+        src.step()
+        return src, rid, src.export_pages(rid)
+
+    def test_export_attaches_sha256_manifest(self, model):
+        src, rid, payload = self._running_payload(model)
+        want_layers = len(payload["kv"])
+        assert len(payload["kv_sha256"]) == 1          # one shard
+        assert len(payload["kv_sha256"][0]) == want_layers
+        for k_sha, v_sha in payload["kv_sha256"][0]:
+            assert k_sha.startswith("sha256:")
+            assert v_sha.startswith("sha256:")
+        # the manifest covers the actual bytes: recompute == attached
+        from paddle_tpu.models.serving import payload_checksums
+        assert payload_checksums(payload) == payload["kv_sha256"]
+
+    def test_corrupt_payload_refused_before_any_mutation(self, model):
+        from paddle_tpu.models.serving import PayloadCorruption
+        src, rid, payload = self._running_payload(model)
+        dst = _engine(model)
+        self._flip(payload)
+        before = dst.cache_memory_info()["pages_in_use"]
+        with pytest.raises(PayloadCorruption):
+            transfer.install_request(dst, payload)
+        src.check_invariants()
+        dst.check_invariants()
+        assert dst.cache_memory_info()["pages_in_use"] == before
+        assert src.get_request(rid) is not None   # source still owns it
+        # a clean payload still installs afterwards: the refusal left
+        # the target fully serviceable
+        req = transfer.install_request(dst, src.export_pages(rid))
+        assert req.request_id == payload["request_id"]
+
+    def test_migrate_books_stage_verify(self, model):
+        from paddle_tpu.models.serving import PayloadCorruption
+        src, rid, _ = self._running_payload(model)
+        dst = _engine(model)
+        flip = self._flip
+
+        class CorruptingWire:
+            """A source whose exported payloads are damaged in flight."""
+
+            def get_request(self, r):
+                return src.get_request(r)
+
+            def export_pages(self, r):
+                p = src.export_pages(r)
+                flip(p, which=1)
+                return p
+
+        with pytest.raises(PayloadCorruption):
+            transfer.migrate_request(CorruptingWire(), dst, rid)
+        assert telemetry.value("pdt_transfer_failures_total",
+                               stage="verify") == 1
+        events = [e for e in telemetry.events()
+                  if e["name"] == "transfer.failed"]
+        assert events and events[-1]["attrs"]["stage"] == "verify"
+        src.check_invariants()
+        dst.check_invariants()
+        assert src.get_request(rid) is not None   # never evicted
+
+    def test_router_falls_back_to_source_on_corrupt_wire(
+            self, model, oracle, monkeypatch):
+        """A corrupt payload at the router's migration pass: the
+        request keeps decoding on its consistent source and the
+        outputs stay bit-identical to the colocated oracle."""
+        flip = self._flip
+        real_serialize = transfer.serialize_request
+        corrupted = {"n": 0}
+
+        def bad_serialize(engine, rid):
+            p = real_serialize(engine, rid)
+            if corrupted["n"] == 0:
+                corrupted["n"] += 1
+                flip(p)
+            return p
+
+        monkeypatch.setattr(transfer, "serialize_request",
+                            bad_serialize)
+        router, clock = _fleet(model, "prefill:1,decode:1")
+        rids = [router.submit(p, n) for p, n in JOBS[:2]]
+        out = router.run()
+        assert corrupted["n"] == 1
+        assert [out[r] for r in rids] == oracle[:2]
+        assert router.fleet_info()["pending"] == 0
+        assert telemetry.value("pdt_transfer_failures_total",
+                               stage="verify") == 1

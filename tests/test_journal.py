@@ -1,0 +1,653 @@
+"""Crash-durable serving control plane (ISSUE 13): the router
+write-ahead journal (`serving/journal.py`) and zero-loss router
+restart (`ServingRouter.recover`).
+
+The acceptance property threaded through this file: a router SIGKILL
+at ANY phase — post-submit pre-dispatch, mid-decode, pre-terminal-
+flush — followed by `recover()` on the journal yields greedy outputs
+BIT-IDENTICAL to an uninterrupted fleet, finished requests are never
+re-executed (idempotent-per-request_id dedupe, proven by exact
+`pdt_journal_*`-vs-terminal counter reconciliation), and a torn
+journal tail (fuzzed at every byte offset of the final record) is
+dropped and counted, never fatal. conftest runs this file with
+PDT_TELEMETRY=1 and PDT_CHECK_INVARIANTS=1."""
+import json
+import os
+import shutil
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as telemetry
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import (ContinuousBatchingEngine,
+                                       RequestStatus)
+from paddle_tpu.serving import (FleetOverloaded, RouterJournal,
+                                QosAdmission, ServingRouter)
+from paddle_tpu.serving.journal import _HEADER, commit_bytes
+from paddle_tpu.utils.faults import FaultError, FaultInjector
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      num_key_value_heads=1, max_position_embeddings=64)
+    paddle.seed(7)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _factory(model, clock=None, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", 4)
+
+    def make(index):
+        return ContinuousBatchingEngine(model, clock=clock, **kw)
+
+    return make
+
+
+def _jobs(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 64, int(rng.integers(4, 8))).tolist()
+            for _ in range(n)]
+
+
+JOBS = _jobs()
+N_TOK = 8
+# staggered budgets so fleet runs finish at DIFFERENT steps — the
+# mid-decode SIGKILL drill needs finished-and-live requests to coexist
+N_TOKS = [4, 10, 8, 14]
+
+
+def _submit_jobs(router):
+    return [router.submit(p, n) for p, n in zip(JOBS, N_TOKS)]
+
+
+@pytest.fixture(scope="module")
+def oracle(model):
+    """The uninterrupted fleet's outputs for JOBS — every drill below
+    must reproduce these streams exactly."""
+    clock = FakeClock()
+    router = ServingRouter(_factory(model, clock), num_replicas=2,
+                           clock=clock, sleep=clock.advance)
+    ids = _submit_jobs(router)
+    out = router.run()
+    return [out[i] for i in ids]
+
+
+def _segment_files(path):
+    return sorted(fn for fn in os.listdir(path)
+                  if fn.startswith("seg-") and fn.endswith(".wal"))
+
+
+def _record_spans(blob):
+    """(start, end) byte spans of each record in a segment blob."""
+    spans, off = [], 0
+    while off < len(blob):
+        length, _ = _HEADER.unpack_from(blob, off)
+        end = off + _HEADER.size + length
+        spans.append((off, end))
+        off = end
+    return spans
+
+
+# -- the record format + replay ----------------------------------------
+class TestRecordFormat:
+    def test_roundtrip_submit_progress_terminal(self, tmp_path):
+        with RouterJournal(tmp_path / "wal", fsync="off") as jr:
+            jr.append_submit(request_id="a", prompt=[1, 2, 3],
+                             max_new_tokens=8, lane="batch",
+                             tenant="acme", priority=1,
+                             deadline_abs=9.5, max_queue_time=2.0)
+            jr.append_submit(request_id="b", prompt=[4], max_new_tokens=4)
+            assert jr.step_mirror({"a": [7, 8], "b": [9]}) == 2
+            assert jr.step_mirror({"a": [7, 8, 10], "b": [9]}) == 1
+            jr.append_terminal("b", RequestStatus.FINISHED,
+                               [9, 11, 12, 13])
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert set(rep.live) == {"a"} and set(rep.finished) == {"b"}
+        a = rep.live["a"]
+        assert (a.prompt, a.tokens, a.lane, a.tenant, a.priority,
+                a.deadline_abs, a.max_queue_time) \
+            == ([1, 2, 3], [7, 8, 10], "batch", "acme", 1, 9.5, 2.0)
+        b = rep.finished["b"]
+        assert b.status == RequestStatus.FINISHED
+        assert b.tokens == [9, 11, 12, 13]
+        assert rep.corrupt_dropped == 0
+
+    def test_rejected_submit_never_resurrects(self, tmp_path):
+        with RouterJournal(tmp_path / "wal", fsync="off") as jr:
+            jr.append_submit(request_id="a", prompt=[1], max_new_tokens=4)
+            jr.append_rejected("a")
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert not rep.live and not rep.finished
+        assert rep.rejected == 1
+
+    def test_release_lets_replay_drop_the_terminal(self, tmp_path):
+        with RouterJournal(tmp_path / "wal", fsync="off") as jr:
+            jr.append_submit(request_id="a", prompt=[1], max_new_tokens=4)
+            jr.append_terminal("a", RequestStatus.FINISHED, [5])
+            jr.append_release("a")
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert not rep.live and not rep.finished
+
+    def test_mirror_with_no_growth_appends_nothing(self, tmp_path):
+        with RouterJournal(tmp_path / "wal", fsync="off") as jr:
+            jr.append_submit(request_id="a", prompt=[1], max_new_tokens=4)
+            assert jr.step_mirror({"a": [5]}) == 1
+            before = telemetry.value("pdt_journal_records_total",
+                                     kind="progress")
+            assert jr.step_mirror({"a": [5]}) == 0
+            assert telemetry.value("pdt_journal_records_total",
+                                   kind="progress") == before
+
+    def test_every_open_starts_a_fresh_segment(self, tmp_path):
+        j1 = RouterJournal(tmp_path / "wal", fsync="off")
+        j1.append_submit(request_id="a", prompt=[1], max_new_tokens=4)
+        j2 = RouterJournal(tmp_path / "wal", fsync="off")
+        j2.append_submit(request_id="b", prompt=[2], max_new_tokens=4)
+        # never append after a possibly-torn tail: two opens, two
+        # (or more) segments, and replay merges them in order
+        assert len(_segment_files(j1.path)) >= 2
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert set(rep.live) == {"a", "b"}
+
+    def test_segment_rotation_replays_across_segments(self, tmp_path):
+        with RouterJournal(tmp_path / "wal", fsync="off",
+                           segment_bytes=128) as jr:
+            for i in range(10):
+                jr.append_submit(request_id=f"r{i}", prompt=[i],
+                                 max_new_tokens=4)
+        assert len(_segment_files(jr.path)) > 2
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert set(rep.live) == {f"r{i}" for i in range(10)}
+
+    def test_fsync_policy(self, tmp_path):
+        def fsyncs():
+            return telemetry.value("pdt_journal_fsyncs_total")
+
+        with RouterJournal(tmp_path / "w1", fsync="off") as jr:
+            jr.append_submit(request_id="a", prompt=[1], max_new_tokens=4)
+            jr.step_mirror({"a": [5]})
+        assert fsyncs() == 0
+        with RouterJournal(tmp_path / "w2", fsync="terminal") as jr:
+            jr.append_submit(request_id="a", prompt=[1], max_new_tokens=4)
+            jr.step_mirror({"a": [5]})          # progress: no fsync
+            jr.append_terminal("a", RequestStatus.FINISHED, [5])
+        assert fsyncs() == 2                     # submit + terminal
+        with RouterJournal(tmp_path / "w3", fsync="step") as jr:
+            jr.append_submit(request_id="a", prompt=[1], max_new_tokens=4)
+            jr.step_mirror({"a": [5]})
+        # step mode also fsyncs the segment-open record
+        assert fsyncs() == 2 + 3
+        with pytest.raises(ValueError):
+            RouterJournal(tmp_path / "w4", fsync="sometimes")
+
+    def test_unknown_version_raises(self, tmp_path):
+        jr = RouterJournal(tmp_path / "wal", fsync="off")
+        jr.close()
+        from paddle_tpu.serving.journal import _encode
+        blob = _encode({"kind": "open", "v": 99, "segment": 9})
+        commit_bytes(os.path.join(jr.path, "seg-00000009.wal"), blob,
+                     fsync=False)
+        with pytest.raises(ValueError, match="version"):
+            RouterJournal(tmp_path / "wal", fsync="off").replay()
+
+    def test_journal_constructor_validation(self, tmp_path):
+        with pytest.raises(ValueError):
+            RouterJournal(tmp_path / "a", segment_bytes=0)
+        with pytest.raises(ValueError):
+            RouterJournal(tmp_path / "b", compact_finalized=0)
+
+
+# -- torn-tail tolerance (the parse_done tradition) --------------------
+class TestTornTail:
+    def _build(self, path):
+        with RouterJournal(path, fsync="off") as jr:
+            jr.append_submit(request_id="a", prompt=[1, 2],
+                             max_new_tokens=8)
+            jr.step_mirror({"a": [5, 6]})
+            jr.append_submit(request_id="b", prompt=[3], max_new_tokens=8)
+        return jr.path
+
+    def test_truncation_fuzz_every_offset(self, tmp_path):
+        """Truncate the journal at EVERY byte offset inside the final
+        record: replay never raises, always recovers the committed
+        prefix, and counts exactly one corrupt-tail drop."""
+        src = self._build(tmp_path / "wal")
+        seg = _segment_files(src)[-1]
+        blob = open(os.path.join(src, seg), "rb").read()
+        spans = _record_spans(blob)
+        last_start, last_end = spans[-1]
+        assert last_end == len(blob)
+        for cut in range(last_start + 1, last_end):
+            trial = tmp_path / f"trial-{cut}"
+            shutil.copytree(src, trial)
+            with open(os.path.join(trial, seg), "r+b") as f:
+                f.truncate(cut)
+            rep = RouterJournal(trial, fsync="off").replay()
+            assert rep.corrupt_dropped == 1, cut
+            # the committed prefix: "a" + its progress always survive
+            # (they precede the final record); "b" is the drop
+            assert set(rep.live) == {"a"}, cut
+            assert rep.live["a"].tokens == [5, 6], cut
+
+    def test_checksum_flip_drops_the_tail(self, tmp_path):
+        src = self._build(tmp_path / "wal")
+        seg = _segment_files(src)[-1]
+        p = os.path.join(src, seg)
+        blob = bytearray(open(p, "rb").read())
+        start, end = _record_spans(bytes(blob))[-1]
+        blob[(start + _HEADER.size + end) // 2] ^= 0xFF
+        open(p, "wb").write(bytes(blob))
+        before = telemetry.value("pdt_journal_corrupt_tail_total")
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert rep.corrupt_dropped == 1
+        assert set(rep.live) == {"a"}
+        assert telemetry.value("pdt_journal_corrupt_tail_total") \
+            == before + 1
+
+    def test_garbage_length_prefix_is_a_tear_not_an_oom(self, tmp_path):
+        src = self._build(tmp_path / "wal")
+        seg = _segment_files(src)[-1]
+        with open(os.path.join(src, seg), "ab") as f:
+            f.write(struct.pack("<II", 0x7FFFFFFF, 0) + b"xx")
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert rep.corrupt_dropped == 1
+        assert set(rep.live) == {"a", "b"}   # committed prefix intact
+
+    def test_stray_tmp_and_foreign_files_ignored(self, tmp_path):
+        src = self._build(tmp_path / "wal")
+        open(os.path.join(src, "seg-00000042.wal.tmp"), "wb").write(
+            b"garbage from a compaction that never committed")
+        open(os.path.join(src, "NOTES.txt"), "w").write("hi")
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert rep.corrupt_dropped == 0
+        assert set(rep.live) == {"a", "b"}
+
+
+# -- compaction --------------------------------------------------------
+class TestCompaction:
+    def test_compact_condenses_and_preserves_state(self, tmp_path):
+        jr = RouterJournal(tmp_path / "wal", fsync="off",
+                           segment_bytes=128)
+        for i in range(6):
+            jr.append_submit(request_id=f"r{i}", prompt=[i],
+                             max_new_tokens=8)
+            jr.step_mirror({f"r{i}": [100 + i]})
+        jr.append_terminal("r0", RequestStatus.FINISHED, [100, 200])
+        jr.append_terminal("r1", RequestStatus.TIMEOUT, [101],
+                           "deadline")
+        jr.append_release("r0")              # delivered: droppable
+        n_seg_before = len(_segment_files(jr.path))
+        retained = jr.compact()
+        assert retained == 5                 # r0 dropped, r1..r5 kept
+        # one snapshot segment + one fresh active segment
+        assert len(_segment_files(jr.path)) == 2 < n_seg_before
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert set(rep.live) == {f"r{i}" for i in range(2, 6)}
+        assert rep.live["r3"].tokens == [103]
+        assert set(rep.finished) == {"r1"}
+        assert rep.finished["r1"].status == RequestStatus.TIMEOUT
+        assert rep.finished["r1"].error == "deadline"
+
+    def test_auto_compaction_after_finalized_threshold(self, tmp_path):
+        before = telemetry.value("pdt_journal_compactions_total")
+        jr = RouterJournal(tmp_path / "wal", fsync="off",
+                           compact_finalized=2)
+        for i in range(4):
+            jr.append_submit(request_id=f"r{i}", prompt=[i],
+                             max_new_tokens=8)
+            jr.append_terminal(f"r{i}", RequestStatus.FINISHED, [i])
+        assert telemetry.value("pdt_journal_compactions_total") \
+            == before + 2
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert set(rep.finished) == {f"r{i}" for i in range(4)}
+
+    def test_compact_crash_before_segment_deletes(self, tmp_path,
+                                                  monkeypatch):
+        """A crash between the snapshot commit and the old-segment
+        deletes replays consistently: snap records override."""
+        jr = RouterJournal(tmp_path / "wal", fsync="off")
+        jr.append_submit(request_id="a", prompt=[1], max_new_tokens=8)
+        jr.step_mirror({"a": [5]})
+        jr.append_terminal("a", RequestStatus.FINISHED, [5, 6])
+        monkeypatch.setattr(os, "remove", lambda p: None)
+        jr.compact()
+        monkeypatch.undo()
+        assert len(_segment_files(jr.path)) >= 3   # old ones linger
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert set(rep.finished) == {"a"}
+        assert rep.finished["a"].tokens == [5, 6]
+        assert not rep.live
+
+
+# -- fault sites -------------------------------------------------------
+class TestFaultSites:
+    def test_append_fault_fires(self, tmp_path):
+        jr = RouterJournal(tmp_path / "wal", fsync="off")
+        with FaultInjector(seed=0) as fi:
+            fi.arm("journal.append", nth=1)
+            with pytest.raises(FaultError):
+                jr.append_submit(request_id="a", prompt=[1],
+                                 max_new_tokens=4)
+            # the failed submit never landed
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert not rep.live
+
+    def test_replay_fault_fires(self, tmp_path):
+        jr = RouterJournal(tmp_path / "wal", fsync="off")
+        with FaultInjector(seed=0) as fi:
+            fi.arm("journal.replay", nth=1)
+            with pytest.raises(FaultError):
+                jr.replay()
+
+
+# -- router integration ------------------------------------------------
+def _journaled_router(model, tmp_path, clock=None, name="wal", **kw):
+    clock = clock if clock is not None else FakeClock()
+    jr = RouterJournal(os.path.join(str(tmp_path), name), fsync="off",
+                       clock=clock)
+    router = ServingRouter(_factory(model, clock), num_replicas=2,
+                           clock=clock, sleep=clock.advance,
+                           journal=jr, **kw)
+    return router, jr, clock
+
+
+class TestRouterJournalIntegration:
+    def test_submit_lands_in_journal_before_any_step(self, model,
+                                                     tmp_path):
+        router, jr, clock = _journaled_router(model, tmp_path)
+        rid = router.submit(JOBS[0], N_TOK, deadline=50.0,
+                            lane="batch", tenant="acme")
+        rep = RouterJournal(jr.path, fsync="off", clock=clock).replay()
+        assert set(rep.live) == {rid}
+        st = rep.live[rid]
+        assert st.prompt == [int(t) for t in JOBS[0]]
+        assert (st.lane, st.tenant, st.priority) == ("batch", "acme", 1)
+        assert st.deadline_abs == pytest.approx(clock() + 50.0)
+
+    def test_refused_submit_journals_rejected(self, model, tmp_path):
+        router, jr, clock = _journaled_router(
+            model, tmp_path, max_replica_outstanding=1)
+        for p in JOBS[:2]:
+            router.submit(p, N_TOK)
+        with pytest.raises(FleetOverloaded):
+            router.submit(JOBS[2], N_TOK)
+        rep = RouterJournal(jr.path, fsync="off", clock=clock).replay()
+        assert len(rep.live) == 2 and rep.rejected == 1
+        assert router.run()                   # accepted work completes
+
+    def test_submit_append_fault_refuses_the_submit(self, model,
+                                                    tmp_path):
+        router, jr, clock = _journaled_router(model, tmp_path)
+        with FaultInjector(seed=0) as fi:
+            fi.arm("journal.append", nth=1)
+            with pytest.raises(FaultError):
+                router.submit(JOBS[0], N_TOK)
+        assert not router.requests            # nothing was accepted
+        assert all(h.outstanding() == 0 for h in router.replicas)
+
+    def test_terminal_records_reconcile_with_router_counters(
+            self, model, tmp_path, oracle):
+        router, jr, clock = _journaled_router(model, tmp_path)
+        ids = _submit_jobs(router)
+        out = router.run()
+        assert [out[i] for i in ids] == oracle   # journaling is inert
+        snap = telemetry.snapshot()["counters"]
+        terminals = sum(
+            snap["pdt_router_requests_terminal_total"].values())
+        assert telemetry.value("pdt_journal_records_total",
+                               kind="terminal") == terminals == len(JOBS)
+
+    def test_progress_append_fault_counted_not_fatal(self, model,
+                                                     tmp_path, oracle):
+        router, jr, clock = _journaled_router(model, tmp_path)
+        ids = _submit_jobs(router)
+        with FaultInjector(seed=0) as fi:
+            # nth=1 from here lands on the next journal append — a
+            # progress mirror (submits already journaled)
+            fi.arm("journal.append", nth=1)
+            router.step()
+        out = router.run()
+        assert [out[i] for i in ids] == oracle
+        assert telemetry.value("pdt_journal_append_failures_total") >= 1
+
+    def test_release_request_journals_release(self, model, tmp_path):
+        router, jr, clock = _journaled_router(model, tmp_path)
+        rid = router.submit(JOBS[0], N_TOK)
+        router.run()
+        router.release_request(rid)
+        jr.compact()
+        rep = RouterJournal(jr.path, fsync="off", clock=clock).replay()
+        assert not rep.live and not rep.finished
+
+    def test_fleet_info_journal_section(self, model, tmp_path):
+        router, jr, clock = _journaled_router(model, tmp_path)
+        router.submit(JOBS[0], N_TOK)
+        info = router.fleet_info()
+        assert info["journal"]["segments"] >= 1
+        assert info["journal"]["tracked_live"] == 1
+        assert info["journal"]["fsync"] == "off"
+
+
+# -- the chaos drill: SIGKILL the router at every phase ----------------
+class TestRouterRecovery:
+    def _recover(self, model, tmp_path, clock, name="wal", **kw):
+        """A fresh incarnation: new journal object on the same path
+        (SIGKILL semantics — nothing of the old process survives but
+        the directory)."""
+        jr2 = RouterJournal(os.path.join(str(tmp_path), name),
+                            fsync="off", clock=clock)
+        return ServingRouter.recover(
+            jr2, _factory(model, clock), num_replicas=2, clock=clock,
+            sleep=clock.advance, **kw), jr2
+
+    def test_phase1_post_submit_pre_dispatch(self, model, tmp_path,
+                                             oracle):
+        """The durability point: the submit record alone (no dispatch
+        ever happened) recovers to the full bit-identical stream."""
+        clock = FakeClock()
+        jr = RouterJournal(tmp_path / "wal", fsync="off", clock=clock)
+        for i, p in enumerate(JOBS):
+            jr.append_submit(request_id=f"fleet-{i}", prompt=p,
+                             max_new_tokens=N_TOKS[i])
+        jr.close()
+        router, _ = self._recover(model, tmp_path, clock)
+        out = router.run()
+        assert [out[f"fleet-{i}"] for i in range(len(JOBS))] == oracle
+        assert telemetry.value("pdt_journal_replay_recovered_total") \
+            == len(JOBS)
+
+    def test_phase2_mid_decode_with_dedupe_reconciliation(
+            self, model, tmp_path, oracle):
+        """SIGKILL mid-decode with some requests already finished:
+        live ones re-prefill from the journaled mirror, finished ones
+        restore WITHOUT re-execution, and the journal/terminal
+        counters reconcile exactly."""
+        router, jr, clock = _journaled_router(model, tmp_path)
+        ids = _submit_jobs(router)
+        finished_before = []
+        while len(finished_before) < 1:       # run until someone ends
+            finished_before += [r.request_id for r in router.step()]
+        assert any(not router.requests[i].done for i in ids)
+        del router                            # SIGKILL-shaped
+        recovered, jr2 = self._recover(model, tmp_path, clock)
+        # dedupe: the finished ones came back terminal, un-dispatched
+        for rid in finished_before:
+            rec = recovered.requests[rid]
+            assert rec.done and rec.dispatches == 0
+        assert telemetry.value("pdt_journal_replay_deduped_total") \
+            == len(finished_before)
+        assert telemetry.value("pdt_journal_replay_recovered_total") \
+            == len(JOBS) - len(finished_before)
+        out = recovered.run()
+        assert [out[i] for i in ids] == oracle
+        # exact reconciliation across BOTH incarnations: every fleet
+        # terminal wrote exactly one journal terminal record — the
+        # restored (deduped) ones did NOT write or count a second one
+        snap = telemetry.snapshot()["counters"]
+        terminals = sum(
+            snap["pdt_router_requests_terminal_total"].values())
+        assert terminals == len(JOBS)
+        assert telemetry.value("pdt_journal_records_total",
+                               kind="terminal") == terminals
+
+    def test_phase3_pre_terminal_flush(self, model, tmp_path, oracle):
+        """SIGKILL in the window where a request finished on the
+        engine but its terminal record never flushed: recovery re-runs
+        it (it is live per the journal) and greedy determinism makes
+        the re-execution bit-identical."""
+        router, jr, clock = _journaled_router(model, tmp_path)
+        ids = _submit_jobs(router)
+        lost_terminals = []
+        while not lost_terminals:
+            with FaultInjector(seed=0) as fi:
+                # every journal append in this tick fails — when the
+                # tick finalizes a request, its terminal record is
+                # exactly the write a pre-flush SIGKILL would lose
+                fi.arm("journal.append", always=True)
+                lost_terminals += [r.request_id for r in router.step()]
+        del router
+        recovered, jr2 = self._recover(model, tmp_path, clock)
+        # the lost-terminal request replays as LIVE: re-executed, not
+        # deduped
+        assert telemetry.value("pdt_journal_replay_deduped_total") == 0
+        out = recovered.run()
+        assert [out[i] for i in ids] == oracle
+
+    def test_torn_progress_tail_still_bit_identical(self, model,
+                                                    tmp_path, oracle):
+        """Truncate the journal mid-record before recovery: the lost
+        mirror suffix re-generates bit-identically from the shorter
+        folded re-prefill (why fsync="terminal" stays zero-loss)."""
+        router, jr, clock = _journaled_router(model, tmp_path)
+        ids = _submit_jobs(router)
+        router.step()
+        router.step()
+        del router
+        # the buffered progress mirrors reached the OS page cache ...
+        jr.flush()
+        seg = _segment_files(jr.path)[-1]
+        p = os.path.join(jr.path, seg)
+        blob = open(p, "rb").read()
+        start, end = _record_spans(blob)[-1]
+        with open(p, "r+b") as f:
+            f.truncate((start + end) // 2)    # ... then the OS tore it
+        recovered, _ = self._recover(model, tmp_path, clock)
+        out = recovered.run()
+        assert [out[i] for i in ids] == oracle
+        assert telemetry.value("pdt_journal_corrupt_tail_total") == 1
+
+    def test_recover_finalizes_expired_deadlines_honestly(
+            self, model, tmp_path):
+        router, jr, clock = _journaled_router(model, tmp_path)
+        rid = router.submit(JOBS[0], N_TOK, deadline=5.0)
+        router.step()
+        del router
+        clock.advance(60.0)                   # the router was dead
+        recovered, jr2 = self._recover(model, tmp_path, clock)
+        rec = recovered.requests[rid]
+        assert rec.status == RequestStatus.TIMEOUT
+        delivered = recovered.step()          # backlog delivery
+        assert [r.request_id for r in delivered] == [rid]
+        # the honest timeout was journaled: a SECOND recovery dedupes
+        recovered2, _ = self._recover(model, tmp_path, clock,
+                                      name="wal")
+        assert recovered2.requests[rid].status == RequestStatus.TIMEOUT
+        assert recovered2.requests[rid].dispatches == 0
+
+    def test_recover_restores_qos_budget_context(self, model, tmp_path):
+        clock = FakeClock()
+        jr = RouterJournal(tmp_path / "wal", fsync="off", clock=clock)
+        admission = QosAdmission(budgets={"acme": 1000},
+                                 tenant_window_s=300.0, clock=clock)
+        router = ServingRouter(_factory(model, clock), num_replicas=2,
+                               clock=clock, sleep=clock.advance,
+                               journal=jr, admission=admission)
+        rid = router.submit(JOBS[0], N_TOK, lane="batch", tenant="acme")
+        cost = len(JOBS[0]) + N_TOK
+        assert admission.stats()["tenants"]["acme"]["used_tokens"] \
+            == cost
+        router.step()
+        del router
+        adm2 = QosAdmission(budgets={"acme": 1000},
+                            tenant_window_s=300.0, clock=clock)
+        jr2 = RouterJournal(tmp_path / "wal", fsync="off", clock=clock)
+        recovered = ServingRouter.recover(
+            jr2, _factory(model, clock), num_replicas=2, clock=clock,
+            sleep=clock.advance, admission=adm2)
+        # the live request re-charged its TENANT BUDGET in the new
+        # incarnation, but not the admit ledger (the old incarnation
+        # counted that admission): the cross-incarnation identity is
+        # terminals == committed admits + replay-recovered
+        assert adm2.stats()["tenants"]["acme"]["used_tokens"] == cost
+        assert recovered.requests[rid].lane == "batch"
+        recovered.run()
+        snap = telemetry.snapshot()["counters"]
+        admits = sum(
+            v for k, v in snap["pdt_admission_decisions_total"].items()
+            if 'decision="admit"' in k)
+        terminals = sum(
+            snap["pdt_router_requests_terminal_total"].values())
+        recovered_n = telemetry.value(
+            "pdt_journal_replay_recovered_total")
+        assert admits == 1 and recovered_n == 1
+        assert terminals == admits == recovered_n
+
+    def test_recovered_ids_stay_idempotent(self, model, tmp_path,
+                                           oracle):
+        router, jr, clock = _journaled_router(model, tmp_path)
+        ids = _submit_jobs(router)
+        router.step()
+        del router
+        recovered, _ = self._recover(model, tmp_path, clock)
+        # a client re-submitting after the crash (it never saw the
+        # response) gets the SAME id back, no double-generation
+        assert recovered.submit(JOBS[0], N_TOKS[0],
+                                request_id=ids[0]) == ids[0]
+        out = recovered.run()
+        assert [out[i] for i in ids] == oracle
+
+    def test_replay_fault_propagates_to_recover(self, model, tmp_path):
+        clock = FakeClock()
+        jr = RouterJournal(tmp_path / "wal", fsync="off", clock=clock)
+        jr.append_submit(request_id="a", prompt=[1], max_new_tokens=4)
+        with FaultInjector(seed=0) as fi:
+            fi.arm("journal.replay", nth=1)
+            with pytest.raises(FaultError):
+                ServingRouter.recover(jr, _factory(model, clock),
+                                      num_replicas=2, clock=clock,
+                                      sleep=clock.advance)
+
+    def test_recovery_emits_span_and_histogram(self, model, tmp_path):
+        router, jr, clock = _journaled_router(model, tmp_path)
+        router.submit(JOBS[0], N_TOK)
+        router.step()
+        del router
+        recovered, _ = self._recover(model, tmp_path, clock)
+        names = [e["name"] for e in telemetry.events()]
+        assert "journal.replay" in names
+        assert "journal.recovered" in names
+        snap = telemetry.snapshot()["histograms"]
+        assert snap["pdt_journal_recovery_seconds"][""]["count"] == 1
+        recovered.run()

@@ -1,7 +1,7 @@
 """pdt-lint (paddle_tpu.analysis) — the AST-based invariant analyzer
 (ISSUE 9). Three layers of coverage:
 
-* **fixtures** — every checker PDT001–PDT006 against minimal positive
+* **fixtures** — every checker PDT001–PDT007 against minimal positive
   AND negative synthetic trees, so each rule's trigger is pinned
   independently of the real repo's state;
 * **policy** — suppression parsing (reason mandatory, unused reported),
@@ -23,6 +23,7 @@ from paddle_tpu.analysis import (Baseline, Project, by_code,
 from paddle_tpu.analysis.__main__ import BASELINE_NAME
 from paddle_tpu.analysis.__main__ import main as cli_main
 from paddle_tpu.analysis.checkers import (CatalogDriftChecker,
+                                          DurableWriteChecker,
                                           FaultSiteDriftChecker,
                                           InjectableClockChecker,
                                           PinPairingChecker,
@@ -332,6 +333,70 @@ class TestSwallowedErrors:
         assert res.new[0].symbol == "R.a"
 
 
+# -- PDT007 durable-write discipline -----------------------------------
+class TestDurableWrite:
+    def test_write_opens_flagged_reads_not(self, tmp_path):
+        res = run_one(tmp_path, DurableWriteChecker(), {
+            "paddle_tpu/serving/state_store.py": """\
+                import io
+                import os
+                import json
+
+                def bad_w(path, doc):
+                    with open(path, "w") as f:       # finding
+                        json.dump(doc, f)
+
+                def bad_append(path, line):
+                    io.open(path, mode="ab").write(line)  # finding
+
+                def bad_fd(path):
+                    return os.open(path, os.O_WRONLY)     # finding
+
+                def bad_pathlib(p, doc):
+                    p.write_text(doc)                # finding
+
+                def bad_opaque(path, mode):
+                    return open(path, mode)          # finding: opaque
+
+                def good_read(path):
+                    with open(path) as f:            # read: fine
+                        return f.read()
+
+                def good_read_mode(path):
+                    return open(path, "rb").read()   # read: fine
+            """})
+        assert [(f.code, f.detail) for f in res.new] == [
+            ("PDT007", "open:w"), ("PDT007", "open:ab"),
+            ("PDT007", "os.open"), ("PDT007", "write_text"),
+            ("PDT007", "non-literal-mode")]
+
+    def test_journal_is_allowlisted_other_files_are_not(self, tmp_path):
+        files = {
+            "paddle_tpu/serving/journal.py": """\
+                def appender(path, blob):
+                    with open(path, "ab") as f:      # the appender
+                        f.write(blob)
+            """,
+            "paddle_tpu/serving/prefix_store.py": """\
+                def spill(path, blob):
+                    with open(path, "wb") as f:      # finding
+                        f.write(blob)
+            """,
+        }
+        res = run_one(tmp_path, DurableWriteChecker(), files)
+        assert [(f.code, f.path) for f in res.new] == [
+            ("PDT007", "paddle_tpu/serving/prefix_store.py")]
+
+    def test_scope_is_serving_only(self, tmp_path):
+        res = run_one(tmp_path, DurableWriteChecker(), {
+            "paddle_tpu/distributed/checkpoint/manifest.py": """\
+                def write(path, doc):
+                    with open(path, "w") as f:   # not serving/: fine
+                        f.write(doc)
+            """})
+        assert res.new == []
+
+
 # -- suppressions -------------------------------------------------------
 class TestSuppressions:
     FILES = {
@@ -583,7 +648,7 @@ class TestCli:
         assert cli_main(["--list-checkers"]) == 0
         out = capsys.readouterr().out
         for code in ("PDT001", "PDT002", "PDT003", "PDT004", "PDT005",
-                     "PDT006"):
+                     "PDT006", "PDT007"):
             assert code in out
 
     def test_unparseable_file_is_a_finding(self, tmp_path, capsys):
@@ -653,6 +718,14 @@ class TestRepoGate:
         res = self._lint_snippet("paddle_tpu/serving/router.py",
                                  rbroken, SwallowedErrorChecker())
         assert "PDT006" in [f.code for f in res.new]
+        # PDT007 teeth: the journal's OWN writes are legal only via
+        # the allowlist — the identical source at any other serving/
+        # path fires, so the appender cannot be cargo-culted
+        jsrc = open(os.path.join(
+            REPO, "paddle_tpu", "serving", "journal.py")).read()
+        res = self._lint_snippet("paddle_tpu/serving/journal2.py",
+                                 jsrc, DurableWriteChecker())
+        assert "PDT007" in [f.code for f in res.new]
 
     def _lint_snippet(self, relpath, source, checker, tmp=None):
         import tempfile
@@ -668,7 +741,8 @@ class TestRepoGate:
 
     def test_registry_is_complete(self):
         assert sorted(by_code()) == ["PDT001", "PDT002", "PDT003",
-                                     "PDT004", "PDT005", "PDT006"]
+                                     "PDT004", "PDT005", "PDT006",
+                                     "PDT007"]
         assert len(default_checkers(["PDT003", "PDT004"])) == 2
         with pytest.raises(ValueError):
             default_checkers(["PDT777"])
