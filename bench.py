@@ -240,6 +240,10 @@ REGRESSION_METRICS = (
     # at the default fsync="terminal" policy — the <=3% overhead bar
     # made a standing regression gate
     "detail.journal.journal_on_decode_tokens_per_sec",
+    # gray-failure defense (ISSUE 14): decode throughput with the
+    # every-Nth-step numeric sentry attached (the production default;
+    # the <=3% overhead bar itself is graded inside detail.sentry)
+    "detail.sentry.sentry_on_decode_tokens_per_sec",
 )
 
 # latency-family regression gates: LOWER is better, a rise past the
@@ -1223,6 +1227,125 @@ def bench_journal(model, cfg, on_tpu: bool) -> dict:
     return {"journal": detail}
 
 
+def bench_sentry(model, cfg, on_tpu: bool) -> dict:
+    """Gray-failure defense overhead (ISSUE 14): decode tokens/sec
+    with numeric sentries off / every-step / every-Nth on warm
+    fleets, plus canary probe wall-time quantiles. The acceptance
+    bar: the every-Nth scan mode (the production default) costs <= 3%
+    decode tokens/sec vs sentries-off.
+
+    Measurement discipline = PR 13's: this container's step-time
+    differencing swings +-10% between identical configs, so the 3%
+    bar is graded SURGICALLY — the sentry accumulates its own in-step
+    wall seconds (`NumericSentry.spent`: token checks, the logit
+    host pull, the scan) and overhead_pct = sentry-seconds per step
+    over the sentries-OFF fleet's median step. Three separate warm
+    fleets (not one fleet with swapped sentries): `attach_sentry`
+    rebuilds the decode program, and mid-measurement recompiles would
+    poison every neighboring block. One cost `spent` cannot see: the
+    sentry variant's decode program RETURNS its sampled-row logits
+    (an extra output buffer per dispatch) — the per-mode
+    decode_tokens_per_sec rows bound that side visibly, noise
+    notwithstanding, next to the surgical number. Returns a detail
+    sub-dict;
+    `sentry_on_decode_tokens_per_sec` (the every-Nth row) is wired
+    into REGRESSION_METRICS."""
+    import numpy as np
+    import paddle_tpu.observability as telemetry
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+    from paddle_tpu.serving import (CanaryConfig, SentryConfig,
+                                    ServingRouter)
+
+    model.eval()
+    if on_tpu:
+        slots, p_len, warm, steps, max_seq, nth = 8, 128, 8, 64, 1024, 8
+    else:
+        # max_seq sized so every request outlasts the measured window
+        # (an emptying batch hands later steps a cheaper batch)
+        slots, p_len, warm, steps, max_seq, nth = 4, 8, 3, 48, 256, 8
+    rng = np.random.default_rng(0)
+    jobs = [list(rng.integers(1, cfg.vocab_size, p_len))
+            for _ in range(slots)]
+    telemetry.enable()
+    detail = {}
+    try:
+        def fleet(sentry):
+            # the canary is mandatory alongside a sentry; a huge
+            # interval keeps it inert through the measured window
+            # (the quantile section below turns it on explicitly)
+            return ServingRouter(
+                lambda i: ContinuousBatchingEngine(
+                    model, max_batch_size=slots + 1,
+                    max_seq_len=max_seq,
+                    attention_impl=ATTENTION_IMPL),
+                num_replicas=1, sentry=sentry,
+                canary=None if sentry is None
+                else CanaryConfig(interval=3600.0))
+
+        modes = {"off": None,
+                 "every_step": SentryConfig(scan_every=1),
+                 "every_nth": SentryConfig(scan_every=nth)}
+        step_med, spent_med = {}, {}
+        routers = {}
+        for mode, scfg in modes.items():
+            router = fleet(scfg)
+            routers[mode] = router
+            for p in jobs:
+                router.submit(p, max_new_tokens=max_seq - p_len - 1)
+            for _ in range(warm):
+                router.step()
+            h = router.replicas[0]
+            st, sp = [], []
+            for _ in range(steps):
+                if h.sentry is not None:
+                    h.sentry.spent = 0.0
+                t0 = time.perf_counter()
+                router.step()
+                st.append(time.perf_counter() - t0)
+                if h.sentry is not None:
+                    sp.append(h.sentry.spent)
+            step_med[mode] = sorted(st)[len(st) // 2]
+            spent_med[mode] = (sorted(sp)[len(sp) // 2] if sp else 0.0)
+        bare = step_med["off"]
+        detail["sentry_off_decode_tokens_per_sec"] = \
+            round(slots / bare, 1)
+        for mode in ("every_step", "every_nth"):
+            h = routers[mode].replicas[0]
+            detail[mode] = {
+                "decode_tokens_per_sec": round(
+                    slots / step_med[mode], 1),
+                "sentry_us_per_step": round(spent_med[mode] * 1e6, 1),
+                "overhead_pct": round(
+                    spent_med[mode] / bare * 100, 2),
+                "scans": h.sentry.scans, "trips": h.sentry.trips,
+            }
+        detail["sentry_on_decode_tokens_per_sec"] = \
+            detail["every_nth"]["decode_tokens_per_sec"]
+
+        # canary wall-time quantiles: wake the every-Nth fleet's
+        # scheduled probe and run several rounds to a verdict each
+        router = routers["every_nth"]
+        router.canary_cfg.interval = 1e-9
+        h = router.replicas[0]
+        want = 6 if not on_tpu else 10
+        for _ in range(4000):
+            router.step()
+            if h.canary_runs >= want:
+                break
+        snap = telemetry.snapshot()["histograms"]
+        canary = snap.get("pdt_sentry_canary_seconds", {}).get("")
+        detail["canary"] = {
+            "runs": h.canary_runs,
+            "passes": int(telemetry.value(
+                "pdt_sentry_canary_runs_total", result="pass")),
+            "wall_quantiles_s": _hist_quantiles(canary),
+        }
+    finally:
+        telemetry.disable(clear_override=True)
+        model.train()
+    return {"sentry": detail}
+
+
 def run_bench(on_tpu: bool) -> dict:
     import jax
     import paddle_tpu as paddle
@@ -1332,6 +1455,10 @@ def run_bench(on_tpu: bool) -> dict:
         detail.update(bench_journal(model, cfg, on_tpu))
     except Exception:
         detail["journal_error"] = traceback.format_exc(limit=3)[-400:]
+    try:
+        detail.update(bench_sentry(model, cfg, on_tpu))
+    except Exception:
+        detail["sentry_error"] = traceback.format_exc(limit=3)[-400:]
 
     return {
         "metric": "llama_train_mfu" if on_tpu else "llama_train_mfu_cpu_ci",

@@ -8,6 +8,7 @@ from typing import Dict, List, Optional, Sequence
 from ..core import Checker
 from .catalog import CatalogDriftChecker
 from .clocks import InjectableClockChecker
+from .coverage import FaultCoverageChecker
 from .durablewrites import DurableWriteChecker
 from .faultsites import FaultSiteDriftChecker
 from .pins import PinPairingChecker
@@ -16,9 +17,9 @@ from .tracedsync import TracedHostSyncChecker
 
 __all__ = ["ALL_CHECKER_CLASSES", "default_checkers", "by_code",
            "CatalogDriftChecker", "InjectableClockChecker",
-           "DurableWriteChecker", "FaultSiteDriftChecker",
-           "PinPairingChecker", "SwallowedErrorChecker",
-           "TracedHostSyncChecker"]
+           "DurableWriteChecker", "FaultCoverageChecker",
+           "FaultSiteDriftChecker", "PinPairingChecker",
+           "SwallowedErrorChecker", "TracedHostSyncChecker"]
 
 ALL_CHECKER_CLASSES = (
     InjectableClockChecker,      # PDT001
@@ -28,6 +29,7 @@ ALL_CHECKER_CLASSES = (
     PinPairingChecker,           # PDT005
     SwallowedErrorChecker,       # PDT006
     DurableWriteChecker,         # PDT007
+    FaultCoverageChecker,        # PDT008
 )
 
 

@@ -1,0 +1,147 @@
+"""PDT008 — fault-site coverage.
+
+Repo law (ISSUE 14): every fault site in the ``utils/faults.py``
+docstring registry must be ARMED by at least one test under
+``tests/`` — a fault site nobody drills is a failure branch nobody
+has ever executed, which is exactly the untested-recovery-path bug
+class the injector exists to kill. New sites therefore cannot land
+undrilled: adding a ``fault_point``/``fault_value`` call (PDT003
+forces the docstring entry) makes this checker fail until a test arms
+it.
+
+What counts as "armed", mechanically: an AST scan of the test tree
+for
+
+* ``arm("site.name", ...)`` / ``arm_corrupt("site.name", ...)`` calls
+  with a LITERAL first argument, plus
+* any non-docstring string literal equal to a documented site in a
+  file that calls ``arm``/``arm_corrupt`` at all — test helpers
+  routinely take the site as a parameter
+  (``self._run(model, fault=("speculative.draft", ...))``), and the
+  literal-plus-armer heuristic keeps those honest without a full
+  dataflow analysis. A site named only in DOCSTRINGS does not count.
+
+This is a coverage FLOOR, not a proof the drill is good — review owns
+that — but it is the difference between "forgot to drill it" failing
+in tier-1 versus failing in production.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Set
+
+from .._astutil import literal_str
+from ..core import Checker, Finding, Project
+from .faultsites import collect_doc_sites
+
+__all__ = ["FaultCoverageChecker", "collect_armed_sites"]
+
+_ARMERS = ("arm", "arm_corrupt")
+
+
+def _docstring_spans(tree: ast.AST) -> Set[int]:
+    """Line numbers occupied by module/class/function docstrings —
+    string literals there describe sites, they do not arm them."""
+    spans: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Module, ast.ClassDef,
+                                 ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        body = getattr(node, "body", [])
+        if body and isinstance(body[0], ast.Expr) \
+                and isinstance(body[0].value, ast.Constant) \
+                and isinstance(body[0].value.value, str):
+            doc = body[0].value
+            end = getattr(doc, "end_lineno", doc.lineno)
+            spans.update(range(doc.lineno, end + 1))
+    return spans
+
+
+def collect_armed_sites(project: Project, scope,
+                        known_sites: Set[str]) -> Set[str]:
+    """Sites armed by the test tree (module docstring for what
+    counts). `known_sites` bounds the bare-literal heuristic to real
+    site names."""
+    armed: Set[str] = set()
+    for sf in project.match(scope):
+        if sf.tree is None:
+            continue
+        literals: Set[str] = set()
+        has_armer = False
+        doc_lines = _docstring_spans(sf.tree)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                func = node.func
+                name = func.attr if isinstance(func, ast.Attribute) \
+                    else func.id if isinstance(func, ast.Name) else None
+                if name in _ARMERS:
+                    has_armer = True
+                    lit = literal_str(node.args[0]) if node.args \
+                        else None
+                    if lit is not None:
+                        armed.add(lit)
+            elif isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.value in known_sites \
+                    and node.lineno not in doc_lines:
+                literals.add(node.value)
+        if has_armer:
+            armed |= literals
+    return armed
+
+
+class FaultCoverageChecker(Checker):
+    code = "PDT008"
+    name = "fault-site-coverage"
+    rationale = ("every documented fault site must be armed by at "
+                 "least one test — an undrilled site is an untested "
+                 "recovery path (ISSUE 14)")
+
+    DEFAULT_SCOPE = ("tests/*.py", "tests/**/*.py")
+    DEFAULT_FAULTS_FILE = "paddle_tpu/utils/faults.py"
+    DEFAULT_TESTS_DIR = "tests"
+
+    def __init__(self, scope=DEFAULT_SCOPE,
+                 faults_file=DEFAULT_FAULTS_FILE,
+                 tests_dir=DEFAULT_TESTS_DIR):
+        self.scope = scope
+        self.faults_file = faults_file
+        self.tests_dir = tests_dir
+
+    def _tests_project(self, project: Project) -> Project:
+        """The CLI's default Project scans ``paddle_tpu/`` only; this
+        checker needs the TEST tree. Reuse the given project when it
+        already contains matching files (fixture projects do),
+        otherwise parse ``<root>/tests`` on demand."""
+        if project.match(self.scope):
+            return project
+        return Project(project.root,
+                       [os.path.join(project.root, self.tests_dir)])
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        faults_sf = project.file(self.faults_file)
+        if faults_sf is None:
+            return
+        doc_sites = collect_doc_sites(project, self.faults_file)
+        if not doc_sites:
+            return
+        tests = self._tests_project(project)
+        if not tests.match(self.scope):
+            return          # no test tree to grade (fixture projects)
+        armed = collect_armed_sites(tests, self.scope, doc_sites)
+        for site in sorted(doc_sites - armed):
+            line = 0
+            for i, ln in enumerate(faults_sf.lines, start=1):
+                if f"``{site}``" in ln:
+                    line = i
+                    break
+            yield Finding(
+                self.code, faults_sf.relpath, line,
+                f"fault site \"{site}\" is armed by no test under "
+                f"{self.tests_dir}/ — add a drill (arm(\"{site}\", "
+                "...) or arm_corrupt) so the failure branch it guards "
+                "is actually executed",
+                symbol="<module docstring>", detail=site,
+                checker=self.name)

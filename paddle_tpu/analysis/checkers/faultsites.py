@@ -29,8 +29,13 @@ _DOC_SITE_RE = re.compile(r"``([a-z_]+\.[a-z_]+)``")
 
 def collect_code_sites(project: Project, scope, faults_file,
                        ) -> Dict[str, List[Tuple[str, ast.Call]]]:
-    """``fault_point("...")`` literal sites across `scope` (excluding
-    the declaring module itself): {site: [(relpath, call node)]}."""
+    """``fault_point("...")`` / ``fault_value("...", ...)`` /
+    ``value_armed("...")`` literal sites across `scope` (excluding the
+    declaring module itself): {site: [(relpath, call node)]}. VALUE
+    sites (ISSUE 14 corrupt mode) are declarations exactly like raise
+    sites — the docstring catalog covers both, and `value_armed` is
+    counted so a gather-guard without its paired `fault_value` still
+    registers the site it guards."""
     sites: Dict[str, List[Tuple[str, ast.Call]]] = {}
     for sf in project.match(scope, exclude=(faults_file,)):
         if sf.tree is None:
@@ -40,7 +45,8 @@ def collect_code_sites(project: Project, scope, faults_file,
             if not isinstance(node, ast.Call):
                 continue
             name = call_name(node, aliases)
-            if name is None or name.split(".")[-1] != "fault_point":
+            if name is None or name.split(".")[-1] not in (
+                    "fault_point", "fault_value", "value_armed"):
                 continue
             lit = literal_str(node.args[0]) if node.args else None
             key = lit if lit is not None else ""

@@ -83,7 +83,8 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from ..autograd import no_grad
-from ..utils.faults import FaultError, fault_point
+from ..utils.faults import (FaultError, fault_point, fault_value,
+                            value_armed)
 from .. import observability as telemetry
 from .generation import RequestStatus
 
@@ -537,6 +538,16 @@ class ContinuousBatchingEngine:
         self._slot_seq = np.zeros(self.B, np.int64)
         self._decode_jit = None
         self._insert_jit = None
+        # gray-failure defense (ISSUE 14, serving/sentry.py): an
+        # attached numeric sentry observes every token harvest (and,
+        # every Nth step, the ragged decode program's sampled-row
+        # logits); fault_tag pins corrupt-mode VALUE faults
+        # (serving.kv_page / serving.logits) to THIS engine — a fleet
+        # replica sets it to its index, so one sick chip is drillable
+        # inside a healthy fleet
+        self._sentry = None
+        self._decode_logits = False
+        self.fault_tag: Optional[str] = None
         self._prefill_jits: "OrderedDict[int, object]" = OrderedDict()
         # ragged path: ONE program family keyed only on the padded
         # token count of the admission batch (the decode program lives
@@ -810,6 +821,53 @@ class ContinuousBatchingEngine:
             if req is not None and req.rid == rid:
                 return req
         return None
+
+    # -- gray-failure sentries (ISSUE 14, serving/sentry.py) ------------
+    def attach_sentry(self, sentry) -> None:
+        """Attach a `serving.sentry.NumericSentry`: token in-vocab
+        checks ride every harvest (decode, ragged admission, spec
+        verify), and when the sentry scans logits the RAGGED decode
+        program is rebuilt to return its sampled-row logits for the
+        every-Nth-step scan (legacy/dense decode paths run token
+        checks only — the scan needs the ragged program's row output).
+        One sentry per engine incarnation; a fleet's ReplicaHandle
+        attaches a fresh one on every (re)build. A sentry trip never
+        raises — the step completes and the router reads
+        ``sentry.trips`` to drive SUSPECT -> canary -> quarantine."""
+        self._sentry = sentry
+        self._decode_jit = None       # rebuild with/without logits out
+
+    def _corrupt_kv_site(self):
+        """The ``serving.kv_page`` VALUE fault site (utils/faults.py
+        CORRUPT mode), visited once per KV commit of a BUSY paged
+        engine — decode step, ragged admission, spec verify — so
+        ``nth=`` visit counting targets one replica like
+        ``router.step`` (or arm with ``tag=``). The mutation gathers
+        the slot-owned live pages of the layer-0 KEY pool to host,
+        lets the armed rule damage them, and scatters the result back:
+        seeded-deterministic, and guaranteed to land in pages a live
+        request (or an in-flight canary) will actually read — damage
+        in free/trash pages would drill nothing."""
+        if self.layout != "paged" \
+                or not value_armed("serving.kv_page", self.fault_tag):
+            return
+        live = sorted({p for pages in self._slot_pages for p in pages})
+        if not live:
+            return
+        kp, vp = self._kv[0]
+        idx = np.asarray(live, np.int32)
+        sub = np.asarray(kp[:, idx])
+        mut = fault_value("serving.kv_page", sub, tag=self.fault_tag)
+        if mut is sub:
+            return
+        new_kp = kp.at[:, jnp.asarray(idx)].set(
+            jnp.asarray(np.asarray(mut), kp.dtype))
+        if self._tp is not None:
+            # keep the pool on its declared submesh sharding — the
+            # eager scatter above may have resolved to replicated
+            new_kp = jax.device_put(new_kp,
+                                    self._tp.kv_sharding(kp.shape[0]))
+        self._kv[0] = (new_kp, vp)
 
     # -- migration hooks (serving/transfer.py, disaggregated fleets) ----
     def _resident_slot(self, rid: int) -> int:
@@ -1814,6 +1872,11 @@ class ContinuousBatchingEngine:
                 jnp.asarray(self._bt), jnp.asarray(pk["sample_rows"]),
                 self._next_keys())
             nxt = np.asarray(nxt)
+        self._corrupt_kv_site()
+        if self._sentry is not None:
+            rows = [p["slot"] for p in batch if p["sample"]]
+            if rows:
+                self._sentry.observe_tokens(nxt[rows])
         freed = False
         for piece in batch:
             if not piece["sample"]:
@@ -1918,7 +1981,8 @@ class ContinuousBatchingEngine:
 
     def _build_ragged_step(self, block_q: int, pages_bound=None,
                            draft: bool = False,
-                           select_rows: bool = True):
+                           select_rows: bool = True,
+                           return_logits: bool = False):
         """The one ragged program: packed ids -> per-token rope ->
         ONE KV scatter into the pages -> ragged paged attention with
         per-sequence descriptors -> sample each slot's designated row.
@@ -1929,7 +1993,11 @@ class ContinuousBatchingEngine:
         back). `select_rows=False` drops the per-slot row select and
         returns EVERY packed row's pick (`sample_rows` is ignored) —
         the speculative VERIFY pass, whose acceptance needs the
-        target's choice at all k+1 positions."""
+        target's choice at all k+1 positions. `return_logits=True`
+        additionally returns the (selected) logit rows — the decode
+        program's sentry variant, so the every-Nth-step numeric scan
+        (serving/sentry.py) can pull them to host without a second
+        dispatch."""
         model = self._spec.draft_model if draft else self.model
         params = self._d_params if draft else self._params
         buffers = self._d_buffers if draft else self._buffers
@@ -1954,8 +2022,11 @@ class ContinuousBatchingEngine:
                     rows = rows[jnp.clip(sample_rows, 0,
                                          rows.shape[0] - 1)]
                 nxt, _ = _sample_token(rows, key, strat, temp, tk, tp)
-                return nxt, [(v.k_pages._value, v.v_pages._value)
-                             for v in new]
+                kv_out = [(v.k_pages._value, v.v_pages._value)
+                          for v in new]
+                if return_logits:
+                    return nxt, rows, kv_out
+                return nxt, kv_out
 
         return jax.jit(run, donate_argnums=(2,))
 
@@ -2384,10 +2455,18 @@ class ContinuousBatchingEngine:
             # lifetime and re-uploading them every step would tax the
             # exact hot loop this path exists to speed up.
             if self.layout == "paged" and self.attn_impl == "ragged":
-                self._decode_jit = self._build_ragged_step(1)
+                # sentry variant: the program also returns its
+                # sampled-row logits, so the every-Nth scan is a host
+                # pull, not a second dispatch (attach_sentry resets
+                # _decode_jit so this rebuild happens)
+                self._decode_logits = (self._sentry is not None
+                                       and self._sentry.wants_logits)
+                self._decode_jit = self._build_ragged_step(
+                    1, return_logits=self._decode_logits)
                 self._decode_idx = jnp.arange(self.B, dtype=jnp.int32)
                 self._decode_ones = jnp.ones(self.B, jnp.int32)
             else:
+                self._decode_logits = False
                 self._decode_jit = self._build_decode()
         # inactive slots decode garbage at a clamped position; their
         # outputs are never read. Paged: their block-table rows are all
@@ -2436,16 +2515,21 @@ class ContinuousBatchingEngine:
             # (tokens/sec derives from it) — a fake clock here would
             # fabricate hardware throughput, not make tests exact
             t0 = time.perf_counter()
+            lg_rows = None
             if self.layout == "paged" and self.attn_impl == "ragged":
                 bidx = self._decode_idx
                 with self._tp_scope():
-                    nxt, new_kv = self._decode_jit(
+                    out = self._decode_jit(
                         self._pv(), self._bv(),
                         kv, jnp.asarray(self._tok), bidx,
                         jnp.asarray(pos.astype(np.int32)), bidx,
                         self._decode_ones,
                         jnp.asarray((pos + 1).astype(np.int32)), bt,
                         bidx, self._next_keys())
+                if self._decode_logits:
+                    nxt, lg_rows, new_kv = out
+                else:
+                    nxt, new_kv = out
             else:
                 nxt, new_kv = self._decode_jit(
                     self._pv(), self._bv(),
@@ -2465,6 +2549,34 @@ class ContinuousBatchingEngine:
             _M_DECODE_TOKENS.inc(n_active)
             if dt > 0:
                 _M_TOKENS_PER_SEC.set(n_active / dt)
+        # gray-failure corrupt site + sentry checks, AFTER the timed
+        # window so decode_step_seconds stays comparable across
+        # sentry-on/off engines (the sentry's own cost rides
+        # sentry.spent — the bench's in-situ overhead numerator)
+        self._corrupt_kv_site()
+        if self._sentry is not None:
+            # pdt-lint: disable=PDT001 sentry cost is a REAL-wall
+            # hardware-honesty number (the <=3% bench bar divides it
+            # by real step time) — a fake clock would fabricate it
+            s0 = time.perf_counter()
+            scan = self._sentry.step_tick()
+            act = [i for i, r in enumerate(self._slot_req)
+                   if r is not None]
+            lg_np = None
+            if scan and lg_rows is not None:
+                # the logit harvest — and its VALUE fault site: the
+                # ACTIVE rows are what the scan inspects, so a
+                # corrupt-armed rule poisons exactly that view (the
+                # NaN-poisoned-logits drill; an inactive slot's
+                # garbage row is not a harvest)
+                lg_np = fault_value("serving.logits",
+                                    np.asarray(lg_rows)[act],
+                                    tag=self.fault_tag)
+            # pdt-lint: disable=PDT001 same real-wall measurement
+            self._sentry.note_cost(time.perf_counter() - s0)
+            self._sentry.observe_tokens(nxt[act])
+            if lg_np is not None:
+                self._sentry.observe_logits(lg_np)
         for i, r in enumerate(self._slot_req):
             if r is not None:
                 self._tok[i] = nxt[i]
@@ -2798,7 +2910,9 @@ class ContinuousBatchingEngine:
             gm[idx, :ki + 1] = g_all[r0:r0 + ki + 1]
             pm[idx, :ki] = props[i, :ki]
         j_arr = np.asarray(spec_accept_greedy(gm, pm)[0])
+        self._corrupt_kv_site()
         emitted = proposed = accepted = 0
+        committed: List[int] = []
         for idx, i in enumerate(active):
             r = self._slot_req[i]
             ki, j = int(kuse[i]), int(j_arr[idx])
@@ -2816,12 +2930,15 @@ class ContinuousBatchingEngine:
             proposed += ki
             accepted += j
             emitted += len(toks)
+            committed.extend(toks)
             if (self.eos is not None and toks[-1] == self.eos) \
                     or len(r.output) >= r.max_new_tokens \
                     or int(self._pos[i]) >= self.S - 1:
                 self._finalize(r, RequestStatus.FINISHED, None,
                                finished)
                 self._release_slot(i)
+        if self._sentry is not None and committed:
+            self._sentry.observe_tokens(np.asarray(committed, np.int32))
         return emitted, proposed, accepted
 
     def _get_spec_verify(self, t_pad: int, pages_bound: int):

@@ -39,7 +39,9 @@ def render_fleet_status(info: Dict[str, object]) -> str:
     tp_w = max([7] + [len(_submesh_cell(r.get("submesh")))
                       for r in replicas]) if with_tp else 0
     tp_hdr = f" {'submesh':<{tp_w}}" if with_tp else ""
-    lines.append(f"  {'replica':<8} {'role':<10} {'state':<9} "
+    # width fits the gray-failure states too ("quarantined" = 11)
+    st_w = max([5] + [len(str(r.get("state", ""))) for r in replicas])
+    lines.append(f"  {'replica':<8} {'role':<10} {'state':<{st_w}} "
                  f"{'outstanding':>11} {'restarts':>8}{tp_hdr} "
                  f"{'slo':<7} note")
     for r in replicas:
@@ -52,7 +54,7 @@ def render_fleet_status(info: Dict[str, object]) -> str:
             if with_tp else ""
         lines.append(
             f"  {r['index']:<8} {r.get('role', 'colocated'):<10} "
-            f"{r['state']:<9} "
+            f"{r['state']:<{st_w}} "
             f"{r['outstanding']:>11} {r['restarts']:>8}{tp_cell} "
             f"{(slo.upper() if slo else '-'):<7} {note}".rstrip())
     lines.append(
@@ -111,6 +113,16 @@ def render_fleet_status(info: Dict[str, object]) -> str:
                 + (" OVER" if d.get("over") else "")
                 for name, d in sorted(tenants.items())]
             lines.append("  tenant budgets: " + " ".join(t_parts))
+    sentry: Optional[Dict[str, object]] = \
+        info.get("sentry")  # type: ignore
+    if sentry:
+        lines.append(
+            f"  sentry: {sentry.get('sentry_trips', 0)} trip(s), "
+            f"canaries {sentry.get('canary_runs', 0)} run / "
+            f"{sentry.get('canary_failures', 0)} failed, "
+            f"{sentry.get('quarantines', 0)} quarantine(s), "
+            f"{sentry.get('tainted_tokens_dropped', 0)} tainted "
+            "token(s) dropped")
     slo: Optional[Dict[str, dict]] = info.get("slo")  # type: ignore
     if slo:
         parts = []

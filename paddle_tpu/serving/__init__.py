@@ -40,6 +40,16 @@ arXiv:2605.25645):
   `derive_retry_after` semantics across every refusal surface; fails
   OPEN to plain FIFO when the controller itself breaks.
 
+* `sentry.py`   — the gray-failure defense (ISSUE 14): per-dispatch
+  numeric sentries (sampled-token in-vocab every step, amortized
+  every-Nth-step logit finiteness/abs-max scan) and canary probes (a
+  fixed prompt's golden greedy stream replayed through each replica
+  on a schedule and on suspicion — greedy is batching-invariant, so
+  a mismatch is PROOF of corruption). The router grows
+  SUSPECT -> QUARANTINED on top of the health machine, drops tainted
+  token suffixes and re-serves them from healthy replicas, and gates
+  every restart through canary PROBATION.
+
 * `journal.py`  — the crash-durable control plane (ISSUE 13): a
   checksummed, length-prefixed write-ahead journal of submits
   (BEFORE dispatch — the durability point), per-step token-progress
@@ -75,7 +85,10 @@ from .submesh import (SubMesh, TP_AXIS, TpConfig,  # noqa: F401
                       carve_submeshes)
 from .router import (FleetOverloaded, FleetRequest,  # noqa: F401
                      QosShed, ServingRouter, parse_roles)
-from .transfer import (install_request, migrate_request,  # noqa: F401
+from .sentry import (CanaryConfig, NumericSentry,  # noqa: F401
+                     SentryConfig)
+from .transfer import (TransferStageTimeout,  # noqa: F401
+                       install_request, migrate_request,
                        payload_nbytes, serialize_request)
 
 __all__ = [
@@ -90,6 +103,7 @@ __all__ = [
     "RouterJournal", "JournalReplay", "ReplayedRequest",
     "commit_bytes",
     "serialize_request", "install_request", "migrate_request",
-    "payload_nbytes",
+    "payload_nbytes", "TransferStageTimeout",
+    "SentryConfig", "NumericSentry", "CanaryConfig",
     "SubMesh", "TP_AXIS", "TpConfig", "carve_submeshes",
 ]

@@ -24,6 +24,16 @@ write-ahead journal of the exact state the router already mirrors:
   WITHOUT re-execution (idempotent-per-request_id, the transfer-plane
   contract) so a finished response is redeliverable until
   `release_request` appends the `release` that lets compaction drop it.
+* **rewind** — the ONE exception to the append-only mirror contract
+  (ISSUE 14, docs/serving.md "Gray failures"): a gray-failure
+  quarantine dropped a request's TAINTED token suffix (streamed since
+  the corrupt replica's last clean canary), and the journal must
+  forget it too — replay truncates the request's stream to the
+  journaled verified length, so a recovery that lands between the
+  quarantine and the request's terminal re-prefills from the verified
+  prefix, never the tainted one. Durable like a terminal (a LOST
+  rewind would resurrect tainted tokens at recovery — the flush/fsync
+  rung below).
 
 Wire format — append-only segments of checksummed, length-prefixed
 records::
@@ -104,7 +114,8 @@ _MAX_RECORD = 64 << 20
 FSYNC_MODES = ("step", "terminal", "off")
 # record kinds whose loss breaks a durability contract — under
 # fsync="terminal" only these pay the disk round-trip
-_DURABLE_KINDS = frozenset({"submit", "terminal", "rejected"})
+_DURABLE_KINDS = frozenset({"submit", "terminal", "rejected",
+                            "rewind"})
 
 _M_RECORDS = telemetry.counter(
     "pdt_journal_records_total",
@@ -421,6 +432,23 @@ class RouterJournal:
                 st.tokens.extend(toks)
         return len(delta)
 
+    def rewind(self, request_id: str, length: int) -> None:
+        """Truncate a request's journaled token stream to `length` —
+        the gray-failure quarantine path (module docstring: the one
+        exception to the append-only mirror contract). Later
+        `step_mirror` calls then diff against the truncated stream, so
+        the healthy replica's regenerated suffix journals at the
+        RIGHT offsets, and a replay that lands before the request's
+        terminal recovers the verified prefix only."""
+        rid = str(request_id)
+        self._append({"kind": "rewind", "rid": rid,
+                      "len": max(0, int(length))})
+        st = self._state.get(rid)
+        if st is not None and st.status is None:
+            # finalized streams are authoritative (terminal records
+            # carry the COMPLETE stream) — same guard as replay
+            st.tokens = st.tokens[:max(0, int(length))]
+
     def append_terminal(self, request_id: str, status: str,
                         tokens: List[int],
                         error: Optional[str] = None) -> None:
@@ -556,6 +584,13 @@ class RouterJournal:
                         st = table.get(rid)
                         if st is not None and st.status is None:
                             st.tokens.extend(int(t) for t in toks)
+                elif kind == "rewind":
+                    # quarantine dropped a tainted suffix: the replay
+                    # stream forgets it exactly like the live mirror
+                    st = table.get(rec["rid"])
+                    if st is not None and st.status is None:
+                        st.tokens = st.tokens[:max(
+                            0, int(rec.get("len") or 0))]
                 elif kind == "terminal":
                     st = table.get(rec["rid"])
                     if st is None:

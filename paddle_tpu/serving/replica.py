@@ -17,6 +17,32 @@ wall-clock reads, so every transition is forcible in tests)::
     DRAINING --in-flight work reaches zero--> DEAD         ("drained")
     DEAD --router restart after exponential backoff--> HEALTHY
 
+Gray-failure arm (ISSUE 14, docs/serving.md "Gray failures") — the
+states above all describe LIVENESS; these describe CORRECTNESS, and
+only exist on fleets with a canary configured (`ServingRouter(
+sentry=, canary=)`)::
+
+    HEALTHY|DEGRADED --numeric sentry trip--> SUSPECT
+    SUSPECT --canary passes with a clean sentry window--> HEALTHY
+    SUSPECT --canary token mismatch, or max_suspect_rounds
+              dirty passes--> QUARANTINED
+    QUARANTINED --backoff restart--> PROBATION
+    DEAD --backoff restart (canary-gated fleets)--> PROBATION
+    PROBATION --canary passes--> HEALTHY       (restart budget resets)
+    PROBATION --canary token mismatch--> QUARANTINED
+
+SUSPECT replicas keep stepping their in-flight work (the streams are
+re-verified if quarantine lands) but accept nothing new, donate no
+migrations, and their terminals PARK until the canary's verdict — a
+tainted stream must not finalize. QUARANTINED is DEAD-shaped (the
+engine is discarded: a corrupt chip's state is untrustworthy) but
+distinct, so operators can tell corruption from crash; it restarts on
+the SAME backoff ladder and re-enters through PROBATION, where it must
+reproduce the canary's golden stream before taking real traffic — and
+ONLY a passed canary (or real served work) resets the restart budget,
+closing the PR-4 hole where an idle restarted replica sat HEALTHY
+without ever proving it works.
+
 Death is SIGKILL-shaped: the engine object is DISCARDED the moment the
 replica dies (``self.engine = None``) — its queues, slots, and KV pages
 are unrecoverable, exactly as if the serving process had been killed.
@@ -63,20 +89,30 @@ class ReplicaRole:
 
 class ReplicaState:
     """Replica health states + the numeric encoding exported on the
-    `pdt_router_replica_state` gauge (higher = less healthy)."""
+    `pdt_router_replica_state` gauge (0-3: the liveness ladder,
+    higher = less healthy; 4-6: the gray-failure arm, appended so the
+    PR-4 encodings stay stable)."""
 
     HEALTHY = "healthy"
     DEGRADED = "degraded"
     DRAINING = "draining"
     DEAD = "dead"
-    LIVE = frozenset({HEALTHY, DEGRADED, DRAINING})
+    # gray-failure arm (module docstring): correctness, not liveness
+    SUSPECT = "suspect"
+    QUARANTINED = "quarantined"
+    PROBATION = "probation"
+    LIVE = frozenset({HEALTHY, DEGRADED, DRAINING, SUSPECT, PROBATION})
+    # engine discarded, restart pending on the backoff ladder
+    DOWN = frozenset({DEAD, QUARANTINED})
     # gauge encoding: docs/serving.md "Fleet" metric catalog
-    CODE = {HEALTHY: 0, DEGRADED: 1, DRAINING: 2, DEAD: 3}
+    CODE = {HEALTHY: 0, DEGRADED: 1, DRAINING: 2, DEAD: 3,
+            SUSPECT: 4, QUARANTINED: 5, PROBATION: 6}
 
 
 _M_STATE = telemetry.gauge(
     "pdt_router_replica_state",
-    "Replica health state (0=healthy 1=degraded 2=draining 3=dead).",
+    "Replica health state (0=healthy 1=degraded 2=draining 3=dead "
+    "4=suspect 5=quarantined 6=probation).",
     ("replica",))
 _M_QDEPTH = telemetry.gauge(
     "pdt_router_replica_queue_depth",
@@ -113,7 +149,9 @@ class ReplicaHandle:
                  max_restarts: Optional[int] = 5,
                  rng: Optional[random.Random] = None,
                  role: str = ReplicaRole.COLOCATED,
-                 submesh=None):
+                 submesh=None,
+                 sentry_config=None,
+                 probation_gate: bool = False):
         if role not in ReplicaRole.ALL:
             raise ValueError(f"unknown replica role {role!r}: "
                              f"{sorted(ReplicaRole.ALL)}")
@@ -138,6 +176,25 @@ class ReplicaHandle:
         self._backoff_cap = float(restart_backoff_max)
         self.max_restarts = max_restarts
         self._rng = rng if rng is not None else random.Random(index)
+        # -- gray-failure defense (ISSUE 14, serving/sentry.py) --------
+        # sentry_config builds one NumericSentry per engine INCARNATION
+        # (attached in _build_engine); probation_gate=True (set by a
+        # router with a canary) makes every restart land in PROBATION —
+        # canary-gated readmission — instead of HEALTHY
+        self.sentry_config = sentry_config
+        self.probation_gate = bool(probation_gate)
+        self.sentry = None
+        self.sentry_seen = 0          # trips the router has acted on
+        self.canary = None            # in-flight canary probe state
+        self.canary_seq = 0
+        self.last_canary_start: Optional[float] = clock()
+        self.last_canary_pass: Optional[float] = None
+        self.suspect_rounds = 0       # consecutive dirty canary passes
+        self.canary_runs = 0
+        self.canary_failures = 0
+        # terminals harvested while SUSPECT: (FleetRequest, Request)
+        # pairs the router parks until the canary's verdict
+        self.parked: List[tuple] = []
         self.engine: Optional[ContinuousBatchingEngine] = \
             self._build_engine()
         # bumped on every restart: a request dispatched to generation g
@@ -159,6 +216,7 @@ class ReplicaHandle:
         # survive replica death
         self.retired_prefix_hits = 0
         self.retired_prefix_tokens_reused = 0
+        self.retired_sentry_trips = 0
         self.retired_spec = {"rounds": 0, "proposed": 0, "accepted": 0,
                              "degraded": 0}
         _M_STATE.set(ReplicaState.CODE[self.state], replica=str(index))
@@ -166,10 +224,26 @@ class ReplicaHandle:
     def _build_engine(self) -> ContinuousBatchingEngine:
         """Factory invocation, submesh-aware: a TP fleet's factory
         takes (index, submesh) — the router carved the slice and every
-        incarnation of this replica lives on it."""
+        incarnation of this replica lives on it. Every incarnation
+        gets its replica index as the engine `fault_tag` (corrupt-mode
+        drills pin a sick chip to one replica, utils/faults.py) and,
+        on sentried fleets, a FRESH NumericSentry — a restarted
+        replica's trip history must not follow it."""
         if self.submesh is not None:
-            return self._factory(self.index, self.submesh)
-        return self._factory(self.index)
+            eng = self._factory(self.index, self.submesh)
+        else:
+            eng = self._factory(self.index)
+        eng.fault_tag = str(self.index)
+        self.sentry = None
+        self.sentry_seen = 0
+        if self.sentry_config is not None:
+            from .sentry import NumericSentry
+            self.sentry = NumericSentry(
+                self.sentry_config,
+                vocab_size=eng.model.config.vocab_size,
+                replica=self.index)
+            eng.attach_sentry(self.sentry)
+        return eng
 
     # -- introspection ---------------------------------------------------
     def outstanding(self) -> int:
@@ -178,6 +252,19 @@ class ReplicaHandle:
             return 0
         info = self.engine.lifecycle_info()
         return info["waiting"] + info["running"]
+
+    def real_outstanding(self) -> int:
+        """`outstanding()` minus an in-flight canary probe: the
+        did-work ledger (restart-budget resets, busy-step accounting)
+        must not count infra probes as served traffic — a canary
+        RUNNING proves nothing, only its PASS does."""
+        n = self.outstanding()
+        if n and self.canary is not None and self.engine is not None \
+                and self.canary["generation"] == self.generation \
+                and self.engine.get_request(self.canary["rid"]) \
+                is not None:
+            n -= 1
+        return n
 
     def can_accept(self) -> bool:
         """Eligible for NEW dispatches: healthy/degraded with room in
@@ -199,6 +286,14 @@ class ReplicaHandle:
         live = (self.engine.prefix_tokens_reused
                 if self.engine is not None else 0)
         return self.retired_prefix_tokens_reused + live
+
+    def sentry_trips(self) -> int:
+        """Numeric-sentry trips for this replica SLOT (live sentry +
+        retired incarnations) — the fleet aggregate must keep the
+        evidence that explained a quarantine after the engine (and
+        its sentry) were discarded by it."""
+        live = self.sentry.trips if self.sentry is not None else 0
+        return self.retired_sentry_trips + live
 
     def spec_info(self) -> dict:
         """Speculative-decoding counters for this replica SLOT (live
@@ -264,7 +359,10 @@ class ReplicaHandle:
         a DEGRADED replica recovers. The restart-backoff budget resets
         only when the step served REAL work (`did_work`) — an idle tick
         after a restart proves nothing, and resetting on it would let a
-        dies-under-load replica restart forever."""
+        dies-under-load replica restart forever. SUSPECT and PROBATION
+        never clear here: a step that merely COMPLETED is liveness
+        evidence, and those states question correctness — only a
+        canary verdict moves them (`note_canary_pass`)."""
         self.consecutive_failures = 0
         self.last_progress = now
         if self._stabilizing and did_work:
@@ -278,7 +376,7 @@ class ReplicaHandle:
         the failure killed the replica (caller must fail over)."""
         self.consecutive_failures += 1
         self.last_error = f"{type(error).__name__}: {error}"
-        if self.state == ReplicaState.DEAD:
+        if self.state in ReplicaState.DOWN:
             return False
         if self.consecutive_failures >= self.dead_after:
             self.die("failures", now)
@@ -287,6 +385,30 @@ class ReplicaHandle:
                 and self.consecutive_failures >= self.degraded_after:
             self._transition(ReplicaState.DEGRADED, self.last_error)
         return False
+
+    # -- gray-failure arm (module docstring; ISSUE 14) -------------------
+    def mark_suspect(self, reason: str):
+        """A numeric sentry tripped on this replica's data: stop
+        taking new work, keep stepping what is in flight (its stream
+        is re-verified if quarantine lands), and let the router run a
+        canary immediately. Only HEALTHY/DEGRADED replicas move —
+        draining or down replicas are already on their way out."""
+        if self.state in (ReplicaState.HEALTHY, ReplicaState.DEGRADED):
+            self._transition(ReplicaState.SUSPECT, reason)
+
+    def note_canary_pass(self, now: float):
+        """A canary reproduced the golden stream with a clean sentry
+        window: suspicion lifts, probation ends, and — the ISSUE-14
+        restart-budget rule — a restarted replica's backoff budget
+        resets HERE (proof of correct work), not on an idle tick."""
+        self.last_canary_pass = now
+        self.suspect_rounds = 0
+        if self.state == ReplicaState.SUSPECT:
+            self._transition(ReplicaState.HEALTHY, "canary_pass")
+        elif self.state == ReplicaState.PROBATION:
+            self._transition(ReplicaState.HEALTHY, "probation_pass")
+            self._stabilizing = False
+            self.restart_attempt = 0
 
     def check_health(self, now: float):
         """Health probe, run by the router once per step tick. Raises
@@ -317,11 +439,16 @@ class ReplicaHandle:
             self.auto_restart = False
             self.die("drained", now)
 
-    def die(self, reason: str, now: float):
+    def die(self, reason: str, now: float,
+            to_state: str = ReplicaState.DEAD):
         """SIGKILL-shaped death: the engine object (queues, slots, KV
         pool) is discarded outright. The router re-routes this
-        replica's in-flight requests from its own mirror."""
-        if self.state == ReplicaState.DEAD:
+        replica's in-flight requests from its own mirror.
+        ``to_state=QUARANTINED`` is the gray-failure flavor — same
+        discard and same backoff ladder (a corrupt chip's engine
+        state is untrustworthy, exactly like a killed process's), but
+        a distinct state so corruption reads differently from crash."""
+        if self.state in ReplicaState.DOWN:
             return
         if self.engine is not None:        # fold counters before discard
             self.retired_prefix_hits += self.engine.prefix_hits
@@ -331,8 +458,16 @@ class ReplicaHandle:
             for k in self.retired_spec:
                 self.retired_spec[k] += live_spec[k]
         self.engine = None
+        if self.sentry is not None:
+            # fold trips like the prefix/spec counters above: the
+            # evidence trail that EXPLAINS a quarantine must survive
+            # the engine discard it causes
+            self.retired_sentry_trips += self.sentry.trips
+        self.sentry = None                 # died with its incarnation
+        self.canary = None
+        self.suspect_rounds = 0
         self.death_reason = reason
-        self._transition(ReplicaState.DEAD, reason)
+        self._transition(to_state, reason)
         _M_QDEPTH.set(0, replica=str(self.index))
         if self.auto_restart and (self.max_restarts is None
                                   or self.restart_attempt
@@ -352,10 +487,15 @@ class ReplicaHandle:
                             attempt=self.restart_attempt)
 
     def maybe_restart(self, now: float) -> bool:
-        """Restart a dead replica once its backoff deadline passes:
-        fresh engine from the factory, HEALTHY, cold caches. Returns
-        True when a restart happened this tick."""
-        if self.state != ReplicaState.DEAD \
+        """Restart a dead/quarantined replica once its backoff
+        deadline passes: fresh engine from the factory, cold caches.
+        Canary-gated fleets (`probation_gate`) land EVERY restart in
+        PROBATION — no real traffic, and no restart-budget reset,
+        until a canary passes (the ISSUE-14 readmission rule; without
+        a canary there is nothing to gate with, so plain fleets keep
+        the PR-4 HEALTHY + real-work-resets semantics). Returns True
+        when a restart happened this tick."""
+        if self.state not in ReplicaState.DOWN \
                 or self.next_restart_time is None \
                 or now < self.next_restart_time:
             return False
@@ -367,7 +507,10 @@ class ReplicaHandle:
         self.last_progress = now
         self.restarts += 1
         self._stabilizing = True
-        self._transition(ReplicaState.HEALTHY, "restarted")
+        if self.probation_gate:
+            self._transition(ReplicaState.PROBATION, "restarted")
+        else:
+            self._transition(ReplicaState.HEALTHY, "restarted")
         _M_RESTARTS.inc(replica=str(self.index))
         telemetry.event("router.replica_restart", replica=self.index,
                         restarts=self.restarts)
@@ -376,8 +519,9 @@ class ReplicaHandle:
     def restore(self, now: float):
         """Manually bring back a drained (or permanently dead) replica:
         immediate fresh engine, no backoff — an operator action, not a
-        crash recovery."""
-        if self.state != ReplicaState.DEAD:
+        crash recovery. Canary-gated fleets still route the fresh
+        engine through PROBATION — operators cannot waive the proof."""
+        if self.state not in ReplicaState.DOWN:
             return
         self.auto_restart = True
         self.restart_attempt = 0

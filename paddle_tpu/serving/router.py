@@ -65,6 +65,25 @@ on the CPU test mesh, no threads, no sleeps inside `step()`):
   failover path: re-prefill on a survivor, greedy outputs
   bit-identical to a colocated fleet.
 
+* **Gray failures** — with `sentry=SentryConfig(...)` and
+  `canary=CanaryConfig(...)` (serving/sentry.py, docs/serving.md
+  "Gray failures") the fleet defends the CORRECTNESS of its outputs,
+  not just the liveness of its processes: every replica incarnation
+  carries a numeric sentry (token in-vocab every step, every-Nth-step
+  logit scan), a trip marks the replica SUSPECT (no new traffic,
+  terminals PARK), and a canary probe — a fixed prompt whose golden
+  greedy stream was computed once at fleet build — replays through
+  the replica's ordinary step path immediately on suspicion and on a
+  clock-driven schedule. A token mismatch is proof of corruption
+  (greedy decode is batching-invariant): the replica QUARANTINES
+  (engine discarded, backoff restart into canary-gated PROBATION),
+  its in-flight work re-dispatches zero-loss, and tokens streamed
+  since its last clean canary are TAINTED — dropped from the mirror
+  and re-generated on a healthy replica, so users get correct
+  streams, not fast wrong ones. A clean canary restores a SUSPECT
+  replica with zero failovers and advances every resident request's
+  verified-prefix frontier.
+
 * **Durability** — with `journal=RouterJournal(...)` (serving/
   journal.py, docs/serving.md "Durability") the router write-ahead
   journals the state it already mirrors: every submit BEFORE dispatch
@@ -116,6 +135,8 @@ from .journal import RouterJournal
 from .policy import DispatchPolicy, PrefixAffinityPolicy, make_policy
 from .prefix_store import FleetPrefixStore
 from .replica import ReplicaHandle, ReplicaRole, ReplicaState
+from . import sentry as sentry_mod
+from .sentry import CanaryConfig, SentryConfig
 
 __all__ = ["ServingRouter", "FleetRequest", "FleetOverloaded",
            "QosShed", "parse_roles"]
@@ -228,6 +249,12 @@ class FleetRequest:
     lane: str = Lane.INTERACTIVE
     tenant: Optional[str] = None
     priority: int = 0
+    # gray-failure taint frontier (docs/serving.md "Gray failures"):
+    # tokens[:verified_len] are trusted — folded at dispatch onto the
+    # current replica, or mirrored before that replica's last CLEAN
+    # canary. On quarantine the suffix past it is dropped and
+    # re-generated on a healthy replica
+    verified_len: int = 0
     # router-clock request timeline: TTFT for SLO purposes is measured
     # HERE (first mirrored token minus submit), not on any one engine's
     # clock — an engine's arrival_time resets on every failover
@@ -288,6 +315,9 @@ class ServingRouter:
                  slo_monitor=None,
                  admission: Optional[QosAdmission] = None,
                  journal: Optional[RouterJournal] = None,
+                 sentry: Optional[SentryConfig] = None,
+                 canary: Optional[CanaryConfig] = None,
+                 transfer_stage_deadline: Optional[float] = None,
                  seed: int = 0):
         # roles (disaggregated prefill/decode, docs/serving.md
         # "Disaggregation"): a spec — see `parse_roles` — defines both
@@ -342,6 +372,24 @@ class ServingRouter:
             tp_cfg = tp if isinstance(tp, TpConfig) \
                 else TpConfig(tp=int(tp))
             self.submeshes = carve_submeshes(num_replicas, tp_cfg)
+        # gray-failure defense (serving/sentry.py, docs/serving.md
+        # "Gray failures"): sentry trips need a canary to clear or
+        # condemn them — a SUSPECT replica with no probe would park
+        # forever, so the pairing is mandatory
+        if sentry is not None and canary is None:
+            raise ValueError(
+                "sentry= requires canary= — a SUSPECT replica can "
+                "only be cleared or condemned by a canary probe")
+        self.sentry_cfg = sentry
+        self.canary_cfg = canary
+        # per-stage migration deadline (serving/transfer.py): a slow
+        # serialize/install is counted, deferred, and charged to the
+        # slow endpoint's health instead of silently eaten
+        self.transfer_stage_deadline = transfer_stage_deadline
+        self._canary_golden: Optional[List[int]] = None
+        if canary is not None:
+            self._canary_golden = self._compute_canary_golden(
+                engine_factory)
         rng = random.Random(seed)
         self.replicas: List[ReplicaHandle] = [
             ReplicaHandle(i, engine_factory, clock=self._clock,
@@ -355,8 +403,12 @@ class ServingRouter:
                           restart_backoff_max=restart_backoff_max,
                           max_restarts=max_restarts,
                           rng=random.Random(rng.random()),
-                          role=role_list[i])
+                          role=role_list[i],
+                          sentry_config=sentry,
+                          probation_gate=canary is not None)
             for i in range(num_replicas)]
+        self.num_quarantines = 0
+        self.num_tainted_tokens = 0
         self.num_migrations = 0
         self.requests: Dict[str, FleetRequest] = {}
         # non-terminal requests only: the per-step harvest/failover
@@ -655,6 +707,11 @@ class ServingRouter:
             rec.replica = h.index
             rec.generation = h.generation
             rec.folded = list(rec.tokens)
+            # the folded prefix is the trusted baseline on the new
+            # replica: whatever it streams past this point is inside
+            # ITS taint window until a clean canary advances the
+            # frontier (quarantine truncates back to here)
+            rec.verified_len = len(rec.tokens)
             rec.status = RequestStatus.QUEUED
             rec.dispatches += 1
             self.policy.on_dispatch(h, self._effective_prompt(rec))
@@ -700,23 +757,55 @@ class ServingRouter:
                 # erase the probe failure and the probe would mean
                 # nothing
                 unhealthy.add(h.index)
+        # canary probes launch where due (suspect/probation replicas
+        # immediately, healthy ones on the schedule) so this same
+        # tick's replica steps start serving them
+        self._launch_canaries(now)
         for h in self.replicas:
             if not h.alive() or h.index in unhealthy:
                 continue
-            busy = h.outstanding() > 0
+            # canary probes are infra, not traffic: they neither make
+            # a step "busy" for the restart-budget ledger nor count as
+            # served work — only a canary PASS proves anything
+            busy = h.real_outstanding() > 0
             try:
                 done = h.step()
             except Exception as e:
                 h.note_failure(self._clock(), e)
                 continue
+            canary_id = (h.canary["request_id"]
+                         if h.canary is not None else None)
             # an idle tick is not evidence of stability: only steps that
             # served real work reset the restart-backoff budget
-            h.note_success(self._clock(), did_work=busy or bool(done))
+            h.note_success(self._clock(),
+                           did_work=busy or any(
+                               r.request_id != canary_id for r in done))
+            # poll sentry trips BEFORE delivering this step's
+            # terminals: a trip raised inside h.step() must park the
+            # very terminals it casts doubt on
+            if h.sentry is not None and h.sentry.trips > h.sentry_seen:
+                h.sentry_seen = h.sentry.trips
+                h.mark_suspect("sentry_trip")
+            canary_done = None
             for req in done:
+                if canary_id is not None \
+                        and req.request_id == canary_id:
+                    canary_done = req
+                    continue
                 rec = self.requests.get(req.request_id)
-                if rec is not None:
+                if rec is None:
+                    continue
+                if h.state == ReplicaState.SUSPECT:
+                    # a terminal from a replica under suspicion must
+                    # not finalize until the canary rules — its stream
+                    # may be tainted (docs/serving.md "Gray failures")
+                    h.parked.append((rec, req))
+                else:
                     self._finalize(rec, req, finished)
             self._harvest(h)
+            if canary_done is not None:
+                self._canary_verdict(h, canary_done, finished,
+                                     self._clock())
             h.finish_drain_if_empty(self._clock())
         # disaggregation hand-off: finished prefills on prefill-role
         # replicas migrate to decode replicas through the transfer
@@ -724,6 +813,17 @@ class ServingRouter:
         # read as stranded on its source)
         if self.roles_enabled:
             self._migrate_ready()
+        # suspicion that resolved WITHOUT a canary verdict (the
+        # replica died, was killed, or drained mid-suspicion): deliver
+        # the parked terminals as the engine reported them — the taint
+        # window closes unproven, a documented detection-latency hole
+        # (docs/serving.md failure matrix), not silent data loss
+        for h in self.replicas:
+            if h.parked and h.state != ReplicaState.SUSPECT:
+                for rec, req in h.parked:
+                    if not rec.done:
+                        self._finalize(rec, req, finished)
+                h.parked = []
         # failover pass: anything mirrored onto a replica that is no
         # longer alive (died in the health or step pass, or was killed
         # between ticks), plus orphans parked by an earlier all-dead tick
@@ -813,7 +913,12 @@ class ServingRouter:
                 continue
             src = self.replicas[rec.replica]
             if src.role != ReplicaRole.PREFILL or not src.alive() \
-                    or rec.generation != src.generation:
+                    or rec.generation != src.generation \
+                    or src.state == ReplicaState.SUSPECT:
+                # a SUSPECT source neither donates nor receives
+                # migrations: its pages are in question, and moving
+                # them would carry the taint outside the quarantine
+                # machinery's reach
                 continue
             req = rec.engine_req
             if req.status != RequestStatus.RUNNING or not req.output:
@@ -838,11 +943,24 @@ class ServingRouter:
                     new_req, payload = transfer.migrate_request(
                         src.engine, dst.engine, req.rid,
                         deadline=self._remaining_deadline(rec),
-                        clock=self._clock)
+                        clock=self._clock,
+                        stage_deadline=self.transfer_stage_deadline)
             except (EngineOverloaded, PoolExhausted):
                 # target full RIGHT NOW: try other targets for later
                 # requests, retry this one next tick
                 targets = [t for t in targets if t is not dst]
+                continue
+            except transfer.TransferStageTimeout as e:
+                # a stage that RETURNED but overran its deadline: the
+                # migration is deferred (both engines are consistent —
+                # a late install was backed out) and the SLOW endpoint
+                # is charged a health failure, so a persistently slow
+                # replica degrades instead of wedging every tick's
+                # migration pass (transfer.py already counted
+                # stage="timeout" + the transfer.failed event)
+                slow = src if e.stage == "serialize" else dst
+                if slow.note_failure(self._clock(), e):
+                    self._failover_replica(slow)
                 continue
             # pdt-lint: disable=PDT006 transfer.migrate_request already
             # counted pdt_transfer_failures_total{stage=} and emitted
@@ -857,6 +975,10 @@ class ServingRouter:
             rec.engine_req = new_req    # rec.folded is unchanged: the
             #                             target holds the same output
             #                             stream the source did
+            # hand-off closes the source's taint window (same scope
+            # rule as a dispatch fold-in): the target's window opens
+            # at the full mirrored stream
+            rec.verified_len = len(rec.tokens)
             rec.dispatches += 1
             self.num_migrations += 1
             src.migrations_out += 1
@@ -996,6 +1118,171 @@ class ServingRouter:
             # so QoS arbitration can burn on the PROTECTED lane's
             # objective alone — docs/serving.md "Admission & QoS"
             mon.observe(f"ttft.{rec.lane}", ttft, replica=replica)
+
+    # -- gray-failure defense (serving/sentry.py, ISSUE 14) --------------
+    def _compute_canary_golden(self, engine_factory) -> List[int]:
+        """The canary's golden greedy stream, computed ONCE per
+        (model, tp) at fleet build on a SCRATCH engine from the same
+        factory (replica-0 signature, same submesh under TP) — a live
+        replica's engine would be left warm and its counters skewed.
+        Greedy decoding is batching-invariant (test-pinned since
+        PR 1), so any healthy replica must reproduce this stream
+        exactly, whatever traffic it is serving alongside."""
+        cfg = self.canary_cfg
+        if self.submeshes is not None:
+            eng = engine_factory(0, self.submeshes[0])
+        else:
+            eng = engine_factory(0)
+        rid = eng.add_request(list(cfg.prompt),
+                              int(cfg.max_new_tokens))
+        out = eng.run()[rid]
+        return [int(t) for t in out]
+
+    def _launch_canaries(self, now: float):
+        """Start canary probes where due: immediately on SUSPECT and
+        PROBATION replicas, on the clock-driven schedule for healthy
+        ones. The probe is an ordinary engine request (reserved
+        ``__canary_*`` id, never a FleetRequest) riding the replica's
+        normal step path — corruption in that engine corrupts the
+        canary too, which is the point. An overloaded engine defers
+        the launch to the next tick."""
+        if self.canary_cfg is None:
+            return
+        for h in self.replicas:
+            if not h.alive() or h.engine is None \
+                    or h.canary is not None:
+                continue
+            if h.state in (ReplicaState.SUSPECT,
+                           ReplicaState.PROBATION):
+                due = True
+            elif h.state in (ReplicaState.HEALTHY,
+                             ReplicaState.DEGRADED):
+                itv = self.canary_cfg.interval
+                due = itv is not None \
+                    and now - h.last_canary_start >= itv
+            else:
+                due = False            # draining: on its way out
+            if not due:
+                continue
+            cid = f"__canary_{h.index}_{h.canary_seq}"
+            try:
+                rid = h.engine.add_request(
+                    list(self.canary_cfg.prompt),
+                    int(self.canary_cfg.max_new_tokens),
+                    request_id=cid)
+            except EngineOverloaded:
+                continue               # full queue: retry next tick
+            h.canary_seq += 1
+            h.last_canary_start = now
+            h.canary = {"request_id": cid, "rid": rid,
+                        "generation": h.generation, "started": now,
+                        "trips0": h.sentry.trips
+                        if h.sentry is not None else 0}
+
+    def _canary_verdict(self, h: ReplicaHandle, req: Request,
+                        finished: List[FleetRequest], now: float):
+        """One canary completed on `h`: grade it and act.
+
+        * **pass** — tokens == golden AND no sentry trips in the
+          run's window: suspicion lifts / probation ends (restart
+          budget resets), parked terminals deliver with ZERO
+          failovers, and every resident request's verified-prefix
+          frontier advances to its full mirror.
+        * **dirty** — tokens match but the sentry tripped during the
+          run: inconclusive. Stay SUSPECT and probe again; after
+          `max_suspect_rounds` consecutive dirty passes the replica
+          is quarantined as persistently sick.
+        * **fail** — token mismatch: PROOF of corruption (greedy is
+          batching-invariant) — quarantine.
+        * **aborted** — the probe finalized without finishing
+          (starved/timed out): no verdict; relaunch next tick.
+        """
+        state = h.canary
+        h.canary = None
+        trips = (h.sentry.trips - state["trips0"]) \
+            if h.sentry is not None else 0
+        if req.status != RequestStatus.FINISHED:
+            result = "aborted"
+        elif [int(t) for t in req.output] != self._canary_golden:
+            result = "fail"
+        elif trips > 0:
+            result = "dirty"
+        else:
+            result = "pass"
+        h.canary_runs += 1
+        sentry_mod.note_canary(result, now - state["started"])
+        telemetry.event("sentry.canary", replica=h.index,
+                        result=result, tokens=len(req.output),
+                        trips=trips, probe=state["request_id"])
+        if result == "pass":
+            for rec in self._live.values():
+                if rec.replica == h.index \
+                        and rec.generation == h.generation \
+                        and not rec.done:
+                    rec.verified_len = len(rec.tokens)
+            for prec, preq in h.parked:
+                if not prec.done:      # delivered: zero failovers
+                    self._finalize(prec, preq, finished)
+            h.parked = []
+            h.note_canary_pass(now)
+        elif result == "dirty":
+            h.canary_failures += 1
+            h.suspect_rounds += 1
+            if h.suspect_rounds >= self.canary_cfg.max_suspect_rounds:
+                self._quarantine(h, "sentry_dirty")
+        elif result == "fail":
+            h.canary_failures += 1
+            self._quarantine(h, "canary_mismatch")
+
+    def _quarantine(self, h: ReplicaHandle, reason: str):
+        """Canary evidence condemned `h`: drop every resident
+        request's TAINTED suffix (tokens mirrored since the replica's
+        last clean canary — `verified_len` is the frontier), then
+        kill the replica into QUARANTINED. The same step's failover
+        scan re-dispatches the stranded work from the truncated
+        mirrors — greedy re-generates the dropped suffix
+        bit-identically on a healthy replica, so zero tainted tokens
+        can reach a finished stream. Parked terminals re-serve the
+        same way (their recs never left `_live`)."""
+        now = self._clock()
+        h.parked = []
+        for rec in list(self._live.values()):
+            if rec.replica != h.index \
+                    or rec.generation != h.generation or rec.done:
+                continue
+            dropped = len(rec.tokens) - rec.verified_len
+            if dropped > 0:
+                self.num_tainted_tokens += dropped
+                sentry_mod.note_tainted(dropped)
+                telemetry.event("sentry.tainted",
+                                request_id=rec.request_id,
+                                replica=h.index, dropped=dropped,
+                                kept=rec.verified_len)
+                rec.tokens = rec.tokens[:rec.verified_len]
+                if self.journal is not None:
+                    # the journal mirrored the tainted suffix as
+                    # progress records — it must forget it too, or a
+                    # recovery landing before this request's terminal
+                    # would fold tainted tokens back in as a trusted
+                    # prefix (and later suffixes would journal at
+                    # misaligned offsets). Counted-but-survived like
+                    # a terminal append; the double-fault window
+                    # (rewind append lost AND router killed pre-
+                    # terminal) is in the failure matrix
+                    try:
+                        self.journal.rewind(rec.request_id,
+                                            rec.verified_len)
+                    except Exception as e:
+                        journal_mod.note_append_failure(
+                            e, where="router.quarantine")
+            rec.engine_req = None
+        self.num_quarantines += 1
+        sentry_mod.note_quarantine(h.index)
+        telemetry.event("replica.quarantine", replica=h.index,
+                        reason=reason,
+                        suspect_rounds=h.suspect_rounds)
+        h.die(reason, now, to_state=ReplicaState.QUARANTINED)
+        self._forget_caches(h.index)   # its warm pages are condemned
 
     # -- operator surface ------------------------------------------------
     def kill_replica(self, index: int, reason: str = "killed"):
@@ -1223,6 +1510,23 @@ class ServingRouter:
             # durability surface: segment/byte footprint + how much
             # request state the journal is currently carrying
             info["journal"] = self.journal.stats()
+        if self.canary_cfg is not None:
+            # gray-failure surface: canary verdicts, quarantines, and
+            # the tainted tokens that were dropped instead of served
+            trips = sum(h.sentry_trips() for h in self.replicas)
+            info["sentry"] = {
+                "canary_runs": sum(h.canary_runs
+                                   for h in self.replicas),
+                "canary_failures": sum(h.canary_failures
+                                       for h in self.replicas),
+                "quarantines": self.num_quarantines,
+                "tainted_tokens_dropped": self.num_tainted_tokens,
+                "sentry_trips": trips,
+                "golden_tokens": len(self._canary_golden or ()),
+            }
+            for row, h in zip(info["replicas"], self.replicas):
+                row["canary_runs"] = h.canary_runs
+                row["last_canary_pass"] = h.last_canary_pass
         # speculative decoding (engine spec_decode=): fleet-wide
         # acceptance aggregate, retired incarnations folded in by the
         # handles — the operator's one look at whether speculation is

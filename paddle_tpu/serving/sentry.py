@@ -1,0 +1,251 @@
+"""Silent-corruption sentries + canary probes (ISSUE 14).
+
+Every failure the fleet survived before this layer was FAIL-STOP:
+SIGKILL, raised faults, torn files, wedged ticks. A replica that keeps
+answering but answers *wrong* — a flipped KV page, NaN-poisoned
+logits, a sick chip — is a GRAY failure: liveness supervision cannot
+see it, and every token it streams is a lie served to a user. This
+module is the detection half of the gray-failure defense
+(docs/serving.md "Gray failures"); the response half (SUSPECT ->
+QUARANTINED, tainted-token re-serve, probation) lives in
+`replica.py` / `router.py`.
+
+Two detectors, two cost classes:
+
+* :class:`NumericSentry` — per-dispatch numeric checks inside the
+  engine's step path (`ContinuousBatchingEngine.attach_sentry`):
+
+  - **token in-vocab check, every step**: every harvested sampled
+    token must lie in ``[0, vocab)``. Greedy argmax can only leave
+    that range through corruption of the harvested value itself, so
+    a trip is proof, not heuristic. Cost: one numpy compare over B
+    ints — noise.
+  - **logit scan, every Nth step** (``scan_every``): the decode
+    program returns its sampled-row logits alongside the tokens and
+    the sentry pulls them to host every Nth step, checking
+    finiteness and an ``|logit| <= logit_abs_max`` ceiling. The scan
+    amortizes: the bench-verified budget is <= 3% decode tokens/sec
+    at the default stride (bench.py `detail.sentry`, measured in
+    situ — the sentry clocks its own in-step work into ``spent``).
+
+  A trip NEVER raises — the step completes (suspect tokens are
+  re-verified by the quarantine machinery, not lost here) and the
+  trip surfaces as ``pdt_sentry_trips_total{kind=}`` + a
+  ``sentry.trip`` event; the router reads ``trips`` after each
+  replica step and marks the replica SUSPECT.
+
+* **Canary probes** (:class:`CanaryConfig`) — a fixed prompt whose
+  golden greedy stream is computed ONCE per (model, tp) at fleet
+  build on a scratch engine from the same factory. The router replays
+  it through each replica's ordinary step path on a clock-driven
+  schedule and immediately on suspicion. Greedy decoding is
+  batching-invariant (bit-identity under continuous batching is
+  test-pinned since PR 1), so a mismatch is PROOF of corruption, not
+  load — which is exactly what quarantine needs to act on. A canary
+  occupies one engine slot while it runs; its engine-side terminal
+  counters are accounted to the fleet's `sentry` section, never to
+  client traffic.
+
+Telemetry: ``pdt_sentry_*`` (docs/observability.md catalog).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .. import observability as telemetry
+
+__all__ = ["SentryConfig", "NumericSentry", "CanaryConfig"]
+
+
+_M_CHECKS = telemetry.counter(
+    "pdt_sentry_checks_total",
+    "Numeric sentry checks run, by kind (token | logit_scan).",
+    ("kind",))
+_M_TRIPS = telemetry.counter(
+    "pdt_sentry_trips_total",
+    "Numeric sentry violations, by kind (token_oov | logit_nonfinite "
+    "| logit_absmax).", ("kind",))
+_M_SCAN_SECONDS = telemetry.histogram(
+    "pdt_sentry_scan_seconds",
+    "Wall time of one every-Nth-step logit scan (host pull + checks).")
+_M_CANARY_RUNS = telemetry.counter(
+    "pdt_sentry_canary_runs_total",
+    "Canary probe completions, by result (pass | dirty | fail | "
+    "aborted).", ("result",))
+_M_CANARY_SECONDS = telemetry.histogram(
+    "pdt_sentry_canary_seconds",
+    "Wall time of one canary probe, launch to verdict, on the "
+    "router's clock.")
+_M_QUARANTINES = telemetry.counter(
+    "pdt_sentry_quarantines_total",
+    "Replicas quarantined on canary evidence, by replica.",
+    ("replica",))
+_M_TAINTED = telemetry.counter(
+    "pdt_sentry_tainted_tokens_total",
+    "Mirrored tokens DROPPED at quarantine (streamed since the "
+    "replica's last clean canary — regenerated on a healthy replica, "
+    "never delivered).")
+
+
+def note_canary(result: str, seconds: float) -> None:
+    """Book one canary completion (the router's verdict path)."""
+    _M_CANARY_RUNS.inc(result=result)
+    if telemetry.enabled():
+        _M_CANARY_SECONDS.observe(seconds)
+
+
+def note_quarantine(replica: int) -> None:
+    _M_QUARANTINES.inc(replica=str(replica))
+
+
+def note_tainted(n: int) -> None:
+    _M_TAINTED.inc(n)
+
+
+@dataclass
+class SentryConfig:
+    """Numeric-sentry knobs. ``scan_every=0`` disables the logit scan
+    (token checks still run every step); ``scan_every=1`` scans every
+    step (the bench A/B's expensive arm). ``logit_abs_max`` is the
+    finite ceiling a healthy model's logits never cross — size it per
+    model family; the default is generous for fp32/bf16 heads."""
+
+    scan_every: int = 8
+    logit_abs_max: float = 1e4
+
+    def __post_init__(self):
+        if int(self.scan_every) < 0:
+            raise ValueError(
+                f"scan_every must be >= 0, got {self.scan_every}")
+        if float(self.logit_abs_max) <= 0:
+            raise ValueError(
+                f"logit_abs_max must be > 0, got {self.logit_abs_max}")
+
+
+@dataclass
+class CanaryConfig:
+    """Canary-probe knobs (module docstring). ``interval`` is the
+    clock-driven replay period per replica on the ROUTER's injectable
+    clock (None = suspicion/probation-triggered only);
+    ``max_suspect_rounds`` caps consecutive inconclusive canaries on a
+    SUSPECT replica — a canary whose tokens match golden but whose run
+    window saw fresh sentry trips is a DIRTY pass, and a replica that
+    cannot produce a clean one is quarantined as persistently sick."""
+
+    prompt: Tuple[int, ...] = (3, 1, 4, 1, 5, 9, 2, 6)
+    max_new_tokens: int = 8
+    interval: Optional[float] = 60.0
+    max_suspect_rounds: int = 2
+
+    def __post_init__(self):
+        if not self.prompt:
+            raise ValueError("canary prompt must be non-empty")
+        if int(self.max_new_tokens) < 1:
+            raise ValueError("canary max_new_tokens must be >= 1")
+        if self.interval is not None and float(self.interval) <= 0:
+            raise ValueError(
+                f"canary interval must be > 0 or None, got "
+                f"{self.interval}")
+        if int(self.max_suspect_rounds) < 1:
+            raise ValueError("max_suspect_rounds must be >= 1")
+
+
+class NumericSentry:
+    """Per-engine numeric sentry (one per replica INCARNATION — a
+    restarted replica gets a fresh one, like its engine). The engine
+    calls `observe_tokens` / `observe_logits` from its step path;
+    `trips` is the running violation count the router polls. `spent`
+    accumulates the sentry's own wall seconds (checks + the logit
+    host pull happens in the engine, which adds it via `note_cost`) —
+    the in-situ denominator bench.py's overhead bar divides by.
+
+    `clock` is injectable for tests; the default measures REAL wall
+    (the sentry's cost is a hardware-honesty number, like
+    decode_step_seconds)."""
+
+    def __init__(self, config: SentryConfig, vocab_size: int,
+                 replica: Optional[int] = None,
+                 clock=time.perf_counter):
+        self.config = config
+        self.vocab = int(vocab_size)
+        self.replica = replica
+        self._clock = clock
+        self.trips = 0
+        self.last_trip: Optional[dict] = None
+        self.steps = 0
+        self.scans = 0
+        self.spent = 0.0               # sentry-seconds, in-step
+
+    # -- engine-facing ------------------------------------------------
+    @property
+    def wants_logits(self) -> bool:
+        """True when the engine's decode program must return its
+        sampled-row logits (the every-Nth scan needs them)."""
+        return int(self.config.scan_every) > 0
+
+    def step_tick(self) -> bool:
+        """One decode step happened; returns True when THIS step's
+        logits should be harvested and scanned (every Nth)."""
+        due = self.wants_logits \
+            and self.steps % int(self.config.scan_every) == 0
+        self.steps += 1
+        return due
+
+    def observe_tokens(self, tokens) -> None:
+        """In-vocab check over one dispatch's harvested sampled
+        tokens (active rows only)."""
+        t0 = self._clock()
+        toks = np.asarray(tokens)
+        _M_CHECKS.inc(kind="token")
+        if toks.size and (np.any(toks < 0)
+                          or np.any(toks >= self.vocab)):
+            bad = toks[(toks < 0) | (toks >= self.vocab)]
+            self._trip("token_oov",
+                       f"sampled token(s) {bad[:4].tolist()} outside "
+                       f"[0, {self.vocab})")
+        self.spent += self._clock() - t0
+
+    def observe_logits(self, logits) -> None:
+        """Finiteness + abs-max scan over one step's sampled-row
+        logits (already on host; the engine pulled them)."""
+        t0 = self._clock()
+        lg = np.asarray(logits)
+        self.scans += 1
+        _M_CHECKS.inc(kind="logit_scan")
+        if lg.size and not np.all(np.isfinite(lg)):
+            n = int(np.size(lg) - np.count_nonzero(np.isfinite(lg)))
+            self._trip("logit_nonfinite",
+                       f"{n} non-finite logit value(s) in the decode "
+                       "step's sampled rows")
+        elif lg.size and float(np.max(np.abs(lg))) \
+                > float(self.config.logit_abs_max):
+            self._trip("logit_absmax",
+                       f"|logit| {float(np.max(np.abs(lg))):.3g} over "
+                       f"the {self.config.logit_abs_max:g} ceiling")
+        dt = self._clock() - t0
+        self.spent += dt
+        if telemetry.enabled():
+            _M_SCAN_SECONDS.observe(dt)
+
+    def note_cost(self, seconds: float) -> None:
+        """Engine-side sentry work done outside observe_* (the logit
+        D2H pull) — folded into `spent` so the bench's in-situ
+        overhead number covers the WHOLE sentry cost."""
+        self.spent += seconds
+
+    # -- internals ----------------------------------------------------
+    def _trip(self, kind: str, detail: str):
+        self.trips += 1
+        self.last_trip = {"kind": kind, "detail": detail,
+                          "step": self.steps}
+        _M_TRIPS.inc(kind=kind)
+        telemetry.event("sentry.trip", kind=kind, detail=detail,
+                        replica=self.replica, step=self.steps)
+
+    def info(self) -> dict:
+        return {"trips": self.trips, "steps": self.steps,
+                "scans": self.scans, "last_trip": self.last_trip}

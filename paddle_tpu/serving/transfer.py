@@ -63,11 +63,31 @@ from .. import observability as telemetry
 from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
                               PayloadCorruption, PoolExhausted, Request,
                               assemble_payload_kv, verify_payload)
-from ..utils.faults import fault_point
+from ..utils.faults import fault_point, fault_value, value_armed
 
 __all__ = ["serialize_request", "install_request", "migrate_request",
            "payload_nbytes", "assemble_payload_kv", "PayloadCorruption",
-           "verify_payload"]
+           "verify_payload", "TransferStageTimeout"]
+
+
+class TransferStageTimeout(RuntimeError):
+    """A migration stage RETURNED but overran its per-stage deadline
+    (`migrate_request(stage_deadline=)`, ISSUE 14 satellite): the
+    migration is refused — a late install is backed out of the target
+    first — counted as ``pdt_transfer_failures_total{stage="timeout"}``
+    so the router can defer it and charge the SLOW endpoint's health.
+    ``stage`` names the offender (``serialize`` | ``install``).
+
+    Scope, honestly: deadlines are checked at stage BOUNDARIES on the
+    injectable clock. A stage that never returns is still the
+    replica-level ``wedge_timeout``'s job one level up (no threads in
+    the step path by design); what this closes is the gray zone below
+    it — a serialize/install that finishes, but so slowly it would
+    otherwise silently eat the router tick every tick."""
+
+    def __init__(self, message: str, stage: str):
+        super().__init__(message)
+        self.stage = stage
 
 
 _M_MIGRATIONS = telemetry.counter(
@@ -100,17 +120,41 @@ def payload_nbytes(payload: dict) -> int:
                for shard in payload["kv_shards"] for k, v in shard)
 
 
+def _corrupt_payload_site(payload: dict, tag=None) -> None:
+    """The ``transfer.payload`` VALUE fault site (utils/faults.py
+    CORRUPT mode): mutate the first KV leaf — layer-0 keys of the
+    first shard fragment — AFTER `export_pages` attached its sha256
+    manifest. That is in-flight wire damage by construction, and the
+    PR-13 `verify_payload` gate must refuse it at install
+    (``stage="verify"``), never let it reach a target pool. `tag` is
+    the SOURCE engine's `fault_tag` (a fleet replica's index), so a
+    tag-pinned rule damages one replica's outbound payloads only —
+    the same sick-chip pinning the engine sites honor."""
+    if not value_armed("transfer.payload", tag):
+        return
+    shards = [payload["kv"]] if payload.get("kv") is not None \
+        else payload["kv_shards"]
+    k, v = shards[0][0]
+    mut = fault_value("transfer.payload", k, tag=tag)
+    if mut is not k:
+        shards[0][0] = (mut, v)
+
+
 def serialize_request(engine: ContinuousBatchingEngine,
                       rid: int) -> dict:
     """Serialize one RUNNING request's pages + state out of `engine`.
     Read-only: the source still owns the request until
-    `engine.evict_request`. Fault site: ``transfer.serialize``."""
+    `engine.evict_request`. Fault sites: ``transfer.serialize``
+    (raise) and ``transfer.payload`` (corrupt-mode damage to the
+    serialized bytes, post-manifest)."""
     req = engine.get_request(rid)
     request_id = req.request_id if req is not None else str(rid)
     with telemetry.span("transfer.serialize", rid=rid,
                         request_id=request_id):
         fault_point("transfer.serialize")
-        return engine.export_pages(rid)
+        payload = engine.export_pages(rid)
+    _corrupt_payload_site(payload, getattr(engine, "fault_tag", None))
+    return payload
 
 
 def install_request(engine: ContinuousBatchingEngine, payload: dict,
@@ -131,10 +175,25 @@ def install_request(engine: ContinuousBatchingEngine, payload: dict,
         return engine.import_pages(payload, deadline=deadline)
 
 
+def _stage_overrun(stage: str, elapsed: float, deadline: float,
+                   rid: int) -> TransferStageTimeout:
+    """Book one per-stage deadline overrun (counter + event) and
+    build the typed error the router defers on."""
+    _M_FAILURES.inc(stage="timeout")
+    err = TransferStageTimeout(
+        f"migration {stage} took {elapsed:.3f}s, over the "
+        f"{deadline:.3f}s per-stage deadline — migration deferred, "
+        f"slow endpoint degraded", stage)
+    telemetry.event("transfer.failed", stage="timeout", rid=rid,
+                    error=f"{type(err).__name__}: {err}")
+    return err
+
+
 def migrate_request(src: ContinuousBatchingEngine,
                     dst: ContinuousBatchingEngine, rid: int,
                     *, deadline: Optional[float] = None,
                     clock: Callable[[], float] = time.perf_counter,
+                    stage_deadline: Optional[float] = None,
                     ) -> Tuple[Request, dict]:
     """One complete migration: serialize from `src`, install into
     `dst`, then evict the source copy (ordered so a failure at any
@@ -146,15 +205,39 @@ def migrate_request(src: ContinuousBatchingEngine,
     `clock` times the `pdt_transfer_seconds` observation — the router
     passes ITS injected clock, so the tests' fake clocks drive the
     bench's migration-latency quantiles (PDT001, the pdt-lint rule
-    this module was the live hit for)."""
+    this module was the live hit for). `stage_deadline` bounds each
+    stage on the same clock (:class:`TransferStageTimeout` — before
+    it, the only bound on a slow serialize/install was the replica
+    wedge_timeout, which covers ENGINE steps, not the migration pass:
+    a hung stage wedged the router tick with nothing counting)."""
     t0 = clock()
     stage = "serialize"
     try:
         payload = serialize_request(src, rid)
+        if stage_deadline is not None \
+                and clock() - t0 > stage_deadline:
+            # slow source: nothing was installed — refuse before
+            # touching the target at all
+            raise _stage_overrun("serialize", clock() - t0,
+                                 stage_deadline, rid)
         stage = "install"
+        # deadline-only reads: callers drive `clock` with exact tick
+        # sequences (TestMigrationTiming) — never consume ticks the
+        # un-deadlined path did not
+        t1 = clock() if stage_deadline is not None else t0
         req = install_request(dst, payload, deadline=deadline)
+        if stage_deadline is not None \
+                and clock() - t1 > stage_deadline:
+            # slow target: the install LANDED, so back it out — the
+            # source never evicted and stays authoritative, both
+            # engines consistent (the transactional contract)
+            dst.evict_request(req.rid)
+            raise _stage_overrun("install", clock() - t1,
+                                 stage_deadline, rid)
     except (EngineOverloaded, PoolExhausted):
         raise                       # target capacity: defer, not a fault
+    except TransferStageTimeout:
+        raise                       # counted by _stage_overrun already
     except PayloadCorruption as e:
         # the integrity gate refused the payload before any target
         # mutation: book it at its own stage — corruption is a

@@ -23,6 +23,24 @@ Design:
   ``PoolExhausted`` to force the preemption path.
 * **Zero cost when idle**: ``fault_point`` is a dict-free early return
   when no injector is active.
+* **CORRUPT mode** (ISSUE 14, the gray-failure drills): some sites are
+  VALUE sites — ``fault_value(site, array)`` hooks on data as it moves
+  (the KV page commit, the decode step's logit harvest, a migration
+  payload). ``arm_corrupt(site, mode=...)`` makes the hook MUTATE the
+  array instead of raising: ``"bitflip"`` XORs one byte of one seeded
+  element with 0xFF (the `flip_ocdbt_shards` damage shape — for floats
+  that flips sign+exponent bits, a loud silent corruption),
+  ``"nan"`` poisons one seeded element with NaN (integer arrays take
+  ``-(2**31 - 1)``), ``"scale"`` multiplies the WHOLE array by
+  ``factor`` (a sick chip's systematic error). Triggers are the
+  raise-mode set (nth/probability/always/times, same seeded RNG), plus
+  an optional ``tag=`` filter: value sites pass the owning engine's
+  ``fault_tag`` (a fleet replica sets it to its index), so a drill
+  pins corruption to ONE replica the way a sick chip is one device —
+  visits from non-matching tags neither count nor fire. A RAISE rule
+  armed at a value site raises there too (every site is
+  exception-capable); a corrupt rule visited via ``fault_point`` only
+  counts the visit (there is no value to mutate).
 
 Usage::
 
@@ -31,6 +49,7 @@ Usage::
     with FaultInjector(seed=0) as fi:
         fi.arm("serving.prefill", nth=1)          # fail first prefill
         fi.arm("serving.alloc_page", nth=5, exc=PoolExhausted)
+        fi.arm_corrupt("serving.kv_page", always=True, tag="1")
         engine.run()                              # failure paths forced
     assert fi.trips("serving.prefill") == 1
 
@@ -38,6 +57,22 @@ Instrumented sites (grep ``fault_point(`` for the live list):
 
 * ``serving.alloc_page``, ``serving.prefill``, ``serving.decode`` —
   continuous-batching engine (models/serving.py);
+* ``serving.kv_page`` — VALUE site on the engine's KV page commit
+  (after the decode / ragged-admission / spec-verify scatter lands;
+  busy engines only, so ``nth=`` visit counting targets one replica
+  like ``router.step`` — or use ``tag=``): corrupt mode mutates a
+  seeded element of the LIVE pages of the layer-0 key pool, the
+  silent-disk-flip sibling of `flip_ocdbt_shards` for serving HBM;
+  ``serving.logits`` — VALUE site on the decode step's logit harvest
+  (visited only when an attached sentry's every-Nth scan actually
+  pulls logits to host — serving/sentry.py): corrupt mode poisons
+  what the numeric sentry inspects, the NaN-poisoned-logits drill;
+* ``transfer.payload`` — VALUE site on a freshly serialized migration
+  payload (serving/transfer.py, after `export_pages` attached its
+  sha256 manifest): corrupt mode flips payload KV bytes IN FLIGHT, so
+  the PR-13 `verify_payload` gate must refuse the install
+  (``pdt_transfer_failures_total{stage="verify"}``), proving
+  corruption detection end to end on the transfer plane;
 * ``speculative.draft`` — before a speculative round's draft pass
   (backfill prefills + the k-step draft scan); ``speculative.verify``
   — before the batched target verify dispatch (models/serving.py
@@ -87,8 +122,10 @@ from typing import Dict, List, Optional, Type
 
 from .. import observability as telemetry
 
-__all__ = ["FaultError", "FaultInjector", "fault_point",
-           "flip_ocdbt_shards"]
+__all__ = ["FaultError", "FaultInjector", "fault_point", "fault_value",
+           "value_armed", "flip_ocdbt_shards"]
+
+CORRUPT_MODES = ("bitflip", "nan", "scale")
 
 # chaos runs assert fault counts via telemetry.snapshot() (site label),
 # not only via exception side effects — docs/serving.md "Observability"
@@ -113,6 +150,9 @@ class _Rule:
     always: bool
     times: Optional[int]           # max firings; None = unlimited
     exc: Type[BaseException]
+    corrupt: Optional[str] = None  # bitflip|nan|scale: a VALUE rule
+    factor: float = 1e6            # scale-mode multiplier
+    tag: Optional[str] = None      # only visits carrying this tag count
     calls: int = 0
     trips: int = 0
 
@@ -142,6 +182,38 @@ class FaultInjector:
         ``times`` caps total firings (default: 1 for ``nth``, unlimited
         otherwise). ``exc`` is the exception class raised (it receives
         one message argument). Re-arming a site replaces its rule."""
+        self._rules[site] = self._make_rule(site, nth, probability,
+                                            always, times, exc)
+        return self
+
+    def arm_corrupt(self, site: str, *, mode: str = "bitflip",
+                    nth: Optional[int] = None,
+                    probability: Optional[float] = None,
+                    always: bool = False,
+                    times: Optional[int] = None,
+                    factor: float = 1e6,
+                    tag: Optional[str] = None) -> "FaultInjector":
+        """Arm a VALUE site (module docstring, CORRUPT mode): instead
+        of raising, a firing visit MUTATES the array passing through
+        ``fault_value(site, arr)`` — ``mode`` picks the damage shape
+        (``bitflip`` | ``nan`` | ``scale``, with ``factor`` the scale
+        multiplier), the trigger set is arm()'s, and ``tag=`` pins the
+        rule to visits carrying that tag (a fleet replica's index) so
+        one sick chip can be simulated inside a healthy fleet."""
+        if mode not in CORRUPT_MODES:
+            raise ValueError(f"corrupt mode {mode!r}: "
+                             f"{'|'.join(CORRUPT_MODES)}")
+        rule = self._make_rule(site, nth, probability, always, times,
+                               FaultError)
+        rule.corrupt = mode
+        rule.factor = float(factor)
+        rule.tag = None if tag is None else str(tag)
+        self._rules[site] = rule
+        return self
+
+    @staticmethod
+    def _make_rule(site, nth, probability, always, times,
+                   exc) -> _Rule:
         modes = (nth is not None) + (probability is not None) + bool(always)
         if modes != 1:
             raise ValueError(
@@ -153,9 +225,7 @@ class FaultInjector:
                              f"{probability}")
         if times is None and nth is not None:
             times = 1
-        self._rules[site] = _Rule(site, nth, probability, always, times,
-                                  exc)
-        return self
+        return _Rule(site, nth, probability, always, times, exc)
 
     def disarm(self, site: str):
         self._rules.pop(site, None)
@@ -185,16 +255,22 @@ class FaultInjector:
         return False
 
     # -- firing --------------------------------------------------------
-    def _visit(self, site: str):
-        rule = self._rules[site]
+    def _should_fire(self, rule: _Rule) -> bool:
         rule.calls += 1
         if rule.times is not None and rule.trips >= rule.times:
-            return
-        fire = (rule.always
+            return False
+        return (rule.always
                 or (rule.nth is not None and rule.calls == rule.nth)
                 or (rule.probability is not None
                     and self._rng.random() < rule.probability))
-        if not fire:
+
+    def _visit(self, site: str):
+        rule = self._rules[site]
+        if not self._should_fire(rule):
+            return
+        if rule.corrupt is not None:
+            # a value rule reached through fault_point: there is no
+            # array to mutate here — the visit counts, nothing fires
             return
         rule.trips += 1
         _M_FAULT_FIRES.inc(site=site)
@@ -205,6 +281,27 @@ class FaultInjector:
         if isinstance(err, FaultError):
             err.site = site
         raise err
+
+    def _visit_value(self, site: str, arr):
+        """Value-site visit: corrupt rules mutate and return a NEW
+        array (callers detect firing by identity — ``mut is not arr``);
+        raise rules raise exactly like fault_point."""
+        rule = self._rules[site]
+        if not self._should_fire(rule):
+            return arr
+        rule.trips += 1
+        _M_FAULT_FIRES.inc(site=site)
+        if rule.corrupt is None:
+            telemetry.event("fault.fire", site=site, visit=rule.calls,
+                            exc=rule.exc.__name__)
+            msg = f"injected fault at {site!r} (visit #{rule.calls})"
+            err = rule.exc(msg)
+            if isinstance(err, FaultError):
+                err.site = site
+            raise err
+        telemetry.event("fault.fire", site=site, visit=rule.calls,
+                        exc=f"corrupt:{rule.corrupt}")
+        return _mutate(arr, rule, self._rng)
 
 
 def flip_ocdbt_shards(step_dir, group: str = "model") -> int:
@@ -225,6 +322,75 @@ def flip_ocdbt_shards(step_dir, group: str = "model") -> int:
             f.seek(0)
             f.write(blob)
     return len(files)
+
+
+def _mutate(arr, rule: _Rule, rng: random.Random):
+    """Apply `rule`'s corrupt mode to a COPY of `arr` (numpy or jax;
+    the same array namespace comes back). Element choice draws from
+    the injector's seeded RNG, so damage is reproducible."""
+    import numpy as np
+    src = np.asarray(arr)
+    out = np.array(src)                       # host copy, owned
+    flat = out.reshape(-1)
+    if flat.size == 0:
+        return arr                            # nothing to damage
+    idx = rng.randrange(flat.size)
+    if rule.corrupt == "scale":
+        out = (out * rule.factor).astype(out.dtype)
+    elif rule.corrupt == "nan":
+        if np.issubdtype(out.dtype, np.floating):
+            flat[idx] = np.nan
+        else:
+            # integer arrays have no NaN: poison with an extreme value
+            # (out of every real vocab, visibly wrong in any stream)
+            flat[idx] = -(2 ** 31 - 1)
+    else:                                     # bitflip
+        b = flat[idx:idx + 1].tobytes()
+        # flip the HIGH byte 0xFF (flip_ocdbt_shards' damage shape):
+        # for little-endian floats that is sign+exponent — loud
+        blob = bytearray(b)
+        blob[-1] ^= 0xFF
+        flat[idx:idx + 1] = np.frombuffer(bytes(blob), out.dtype)
+    if type(arr) is np.ndarray:
+        return out
+    import jax.numpy as jnp                   # mirror the input type
+    return jnp.asarray(out)
+
+
+def value_armed(site: str, tag=None) -> bool:
+    """True iff an active injector holds a rule for value site `site`
+    that applies to `tag` — the zero-cost-when-idle guard callers use
+    before gathering data for :func:`fault_value`."""
+    if not _ACTIVE:
+        return False
+    for inj in reversed(_ACTIVE):
+        rule = inj._rules.get(site)
+        if rule is not None:
+            return rule.tag is None or rule.tag == (
+                None if tag is None else str(tag))
+    return False
+
+
+def fault_value(site: str, arr, tag=None):
+    """Declare a named VALUE fault site over `arr` (module docstring,
+    CORRUPT mode). Returns `arr` untouched unless the innermost active
+    injector armed `site` (and its ``tag=`` filter matches): corrupt
+    rules return a mutated COPY — callers detect firing via
+    ``result is not arr`` and commit the damage — and raise rules
+    raise, so every value site doubles as an exception site. Visits
+    with a non-matching tag neither count nor fire (the rule is
+    pinned to one replica's data)."""
+    if not _ACTIVE:
+        return arr
+    for inj in reversed(_ACTIVE):
+        rule = inj._rules.get(site)
+        if rule is None:
+            continue
+        if rule.tag is not None and rule.tag != (
+                None if tag is None else str(tag)):
+            return arr
+        return inj._visit_value(site, arr)
+    return arr
 
 
 def fault_point(site: str) -> None:

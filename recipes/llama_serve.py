@@ -87,6 +87,16 @@ def main(argv=None):
                         "outputs identical to an unkilled tp=1 fleet, "
                         "and print the pdt_tp/transfer Prometheus "
                         "dump (0 = off)")
+    p.add_argument("--corrupt-drill", action="store_true",
+                   help="run the GRAY-FAILURE drill (docs/serving.md "
+                        "\"Gray failures\"): arm a seeded KV bit-flip "
+                        "corrupt-mode fault on one replica of a "
+                        "sentried fleet — the replica keeps answering "
+                        "but answers WRONG — prove the canary probe "
+                        "quarantines it and every stream re-serves "
+                        "bit-identical to a clean fleet, then print "
+                        "the pdt_sentry quarantine/canary Prometheus "
+                        "dump")
     p.add_argument("--trace-out", default=None,
                    help="write the failover drill's Perfetto/Chrome "
                         "trace here (default: a temp file)")
@@ -545,6 +555,67 @@ def main(argv=None):
         print("--- end journal telemetry ---")
     finally:
         shutil.rmtree(wal_root, ignore_errors=True)
+
+    # 3h) gray-failure drill (docs/serving.md "Gray failures"): every
+    # drill above is FAIL-STOP — this one is fail-WRONG. One replica
+    # of a sentried fleet gets a seeded always-firing KV bit-flip
+    # (corrupt-mode fault, pinned by tag= like one sick chip); its
+    # streams go silently wrong, the scheduled canary replays the
+    # golden prompt THROUGH the corrupt engine and mismatches, the
+    # replica quarantines, tainted token suffixes are dropped, and
+    # every request re-serves bit-identically to a clean fleet
+    if args.corrupt_drill:
+        from paddle_tpu.serving import CanaryConfig, SentryConfig
+
+        def gray_fleet(sentried):
+            return ServingRouter(
+                lambda i: ContinuousBatchingEngine(
+                    model, max_batch_size=3,
+                    max_seq_len=min(256, cfg.max_position_embeddings),
+                    attention_impl=args.attention_impl),
+                num_replicas=args.replicas, policy="round_robin",
+                page_size=16,
+                sentry=SentryConfig(scan_every=8) if sentried
+                else None,
+                canary=CanaryConfig(interval=0.05, max_new_tokens=8)
+                if sentried else None,
+                restart_backoff_base=0.2, restart_backoff_max=0.5)
+
+        gray_jobs = [rng.integers(
+            1, cfg.vocab_size, int(rng.integers(5, 11))).tolist()
+            for _ in range(2 * args.replicas)]
+        clean = gray_fleet(False)
+        clean_ids = [clean.submit(pr, n) for pr in gray_jobs]
+        clean_out = clean.run()                  # the uncorrupted oracle
+
+        gray = gray_fleet(True)
+        g_ids = [gray.submit(pr, n) for pr in gray_jobs]
+        gray.step()
+        victim = gray.requests[g_ids[0]].replica
+        with FaultInjector(seed=0) as fi:
+            # the sick chip: every KV commit on the victim flips one
+            # seeded byte of a LIVE page — requests AND the canary
+            # replay decode through the damage
+            fi.arm_corrupt("serving.kv_page", mode="bitflip",
+                           always=True, tag=str(victim))
+            g_out = gray.run()
+        assert [g_out[i] for i in g_ids] \
+            == [clean_out[i] for i in clean_ids], \
+            "gray failure leaked tainted tokens into a finished stream"
+        info = gray.fleet_info()
+        sn = info["sentry"]
+        assert sn["quarantines"] >= 1, "corrupt replica never caught"
+        print(f"gray failure: replica {victim} served a seeded KV "
+              f"bit-flip -> canary caught it ({sn['canary_runs']} "
+              f"probe(s), {sn['canary_failures']} failure(s)), "
+              f"{sn['quarantines']} quarantine(s), "
+              f"{sn['tainted_tokens_dropped']} tainted token(s) "
+              "dropped and re-served; outputs identical to a clean "
+              "fleet")
+        print("--- sentry telemetry (Prometheus text exposition) ---")
+        print("\n".join(line for line in telemetry.to_prometheus()
+                        .splitlines() if "pdt_sentry" in line))
+        print("--- end sentry telemetry ---")
 
     # 4) standalone speculative decoding (same draft as the fleet
     # drill's engine-mode speculation)

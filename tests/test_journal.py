@@ -150,6 +150,47 @@ class TestRecordFormat:
         rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
         assert not rep.live and not rep.finished
 
+    def test_rewind_truncates_live_stream_and_realigns(self, tmp_path):
+        """ISSUE 14: a gray-failure quarantine drops a request's
+        tainted token suffix — `rewind()` makes the journal forget it
+        too, so a replay before the terminal recovers the VERIFIED
+        prefix only and the regenerated suffix journals at the right
+        offsets (not misaligned past ghost tokens)."""
+        with RouterJournal(tmp_path / "wal", fsync="off") as jr:
+            jr.append_submit(request_id="a", prompt=[1, 2],
+                             max_new_tokens=8)
+            jr.step_mirror({"a": [5, 6, 7, 8]})      # 6,7,8 tainted
+            jr.rewind("a", 1)
+            # the healthy replica regenerates a DIFFERENT suffix —
+            # the diff must run against the truncated stream
+            assert jr.step_mirror({"a": [5, 9, 10]}) == 1
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert rep.live["a"].tokens == [5, 9, 10]
+        assert telemetry.value("pdt_journal_records_total",
+                               kind="rewind") == 1
+
+    def test_rewind_replays_without_later_progress(self, tmp_path):
+        """The crash window the record exists for: router dies right
+        after the quarantine's rewind, before any regeneration —
+        replay must hand recovery the verified prefix, not the
+        tainted stream the earlier progress records committed."""
+        with RouterJournal(tmp_path / "wal", fsync="off") as jr:
+            jr.append_submit(request_id="a", prompt=[1],
+                             max_new_tokens=8)
+            jr.step_mirror({"a": [5, 6, 7]})
+            jr.rewind("a", 0)
+        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert rep.live["a"].tokens == []
+        # a rewind for a FINISHED request is inert at replay (the
+        # terminal's complete stream is authoritative)
+        with RouterJournal(tmp_path / "w2", fsync="off") as jr:
+            jr.append_submit(request_id="b", prompt=[1],
+                             max_new_tokens=4)
+            jr.append_terminal("b", RequestStatus.FINISHED, [5, 6])
+            jr.rewind("b", 0)
+        rep = RouterJournal(tmp_path / "w2", fsync="off").replay()
+        assert rep.finished["b"].tokens == [5, 6]
+
     def test_mirror_with_no_growth_appends_nothing(self, tmp_path):
         with RouterJournal(tmp_path / "wal", fsync="off") as jr:
             jr.append_submit(request_id="a", prompt=[1], max_new_tokens=4)

@@ -24,6 +24,7 @@ from paddle_tpu.analysis.__main__ import BASELINE_NAME
 from paddle_tpu.analysis.__main__ import main as cli_main
 from paddle_tpu.analysis.checkers import (CatalogDriftChecker,
                                           DurableWriteChecker,
+                                          FaultCoverageChecker,
                                           FaultSiteDriftChecker,
                                           InjectableClockChecker,
                                           PinPairingChecker,
@@ -182,6 +183,96 @@ class TestFaultSiteDrift:
         doc_only = [f for f in res.new if f.detail == "eng.alpha"]
         assert doc_only[0].path == "paddle_tpu/utils/faults.py"
         assert doc_only[0].line > 0      # anchored at the docstring row
+
+
+# -- PDT008 fault-site coverage ----------------------------------------
+class TestFaultCoverage:
+    FAULTS = '''\
+        """Fault sites: ``eng.alpha``, ``eng.beta`` and ``eng.gamma``."""
+        def fault_point(site):
+            pass
+    '''
+
+    def _run(self, tmp_path, tests):
+        files = {"paddle_tpu/utils/faults.py": self.FAULTS}
+        files.update(tests)
+        project = make_project(tmp_path, files)
+        # fixture projects scan paddle_tpu/ only, like the CLI — the
+        # checker must find the tests tree from the repo root itself
+        return run_checkers(project, [FaultCoverageChecker()])
+
+    def test_all_sites_armed_is_clean(self, tmp_path):
+        res = self._run(tmp_path, {"tests/test_x.py": """\
+            def test_a(fi):
+                fi.arm("eng.alpha", nth=1)
+                fi.arm_corrupt("eng.beta", always=True)
+            def test_b(run):
+                run(fault=("eng.gamma", dict(nth=2)))
+                arm = True    # a helper file still needs a real armer
+                fi.arm("eng.alpha", always=True)
+            """})
+        assert res.new == []
+
+    def test_unarmed_site_is_a_finding(self, tmp_path):
+        res = self._run(tmp_path, {"tests/test_x.py": """\
+            def test_a(fi):
+                fi.arm("eng.alpha", nth=1)
+            """})
+        got = {(f.code, f.detail) for f in res.new}
+        assert got == {("PDT008", "eng.beta"), ("PDT008", "eng.gamma")}
+        f = res.new[0]
+        assert f.path == "paddle_tpu/utils/faults.py"
+        assert f.line > 0            # anchored at the docstring row
+
+    def test_docstring_mention_does_not_count(self, tmp_path):
+        """A site named only in a test DOCSTRING is description, not a
+        drill — and a bare literal in a file with no armer at all
+        counts for nothing either."""
+        res = self._run(tmp_path, {
+            "tests/test_doc.py": '''\
+                """This file talks about ``eng.beta`` at length."""
+                def test_a(fi):
+                    fi.arm("eng.alpha", nth=1)
+                def test_b():
+                    """eng.gamma is mentioned here too."""
+            ''',
+            "tests/helpers.py": """\
+                SITE = "eng.gamma"    # no arm() anywhere in this file
+            """})
+        got = {f.detail for f in res.new}
+        assert got == {"eng.beta", "eng.gamma"}
+
+    def test_literal_in_armer_file_counts(self, tmp_path):
+        """The tuple-indirection idiom test_chaos.py actually uses:
+        the site literal rides a helper argument, the arm() call sits
+        in the helper — same file, both present, covered."""
+        res = self._run(tmp_path, {"tests/test_spec.py": """\
+            def _run(fi, fault):
+                fi.arm(fault[0], **fault[1])
+            def test_a(fi):
+                _run(fi, ("eng.alpha", dict(nth=2)))
+                _run(fi, ("eng.beta", dict(always=True)))
+                _run(fi, ("eng.gamma", dict(nth=1)))
+            """})
+        assert res.new == []
+
+    def test_teeth_real_registry_fails_with_empty_test_tree(
+            self, tmp_path):
+        """Teeth: the REAL faults.py docstring against an empty test
+        tree — every documented site must fire, proving the checker
+        actually reads the live registry (a broken collector would
+        silently pass everything)."""
+        real = open(os.path.join(
+            REPO, "paddle_tpu", "utils", "faults.py")).read()
+        project = make_project(tmp_path, {
+            "paddle_tpu/utils/faults.py": real,
+            "tests/test_empty.py": "def test_nothing():\n    pass\n"})
+        res = run_checkers(project, [FaultCoverageChecker()])
+        from paddle_tpu.analysis.checkers.faultsites import (
+            collect_doc_sites)
+        sites = collect_doc_sites(
+            project, FaultCoverageChecker.DEFAULT_FAULTS_FILE)
+        assert sites and {f.detail for f in res.new} == sites
 
 
 # -- PDT004 catalog drift ----------------------------------------------
@@ -742,7 +833,7 @@ class TestRepoGate:
     def test_registry_is_complete(self):
         assert sorted(by_code()) == ["PDT001", "PDT002", "PDT003",
                                      "PDT004", "PDT005", "PDT006",
-                                     "PDT007"]
+                                     "PDT007", "PDT008"]
         assert len(default_checkers(["PDT003", "PDT004"])) == 2
         with pytest.raises(ValueError):
             default_checkers(["PDT777"])

@@ -1,0 +1,691 @@
+"""Gray-failure defense (ISSUE 14): corrupt-mode fault injection,
+numeric sentries, canary probes, SUSPECT -> QUARANTINED with
+tainted-token re-serve, canary-gated restart probation, and the
+transfer plane's per-stage deadlines.
+
+The chaos drills here are the fail-WRONG siblings of test_chaos.py's
+fail-stop drills: a replica keeps answering but answers incorrectly
+(bit-flipped KV pages, NaN-poisoned logits, corrupted migration
+payloads), and the fleet must NOTICE — sentry trip or canary mismatch
+— then quarantine and re-serve tainted streams bit-identically to an
+uncorrupted fleet. conftest enables PDT_TELEMETRY=1 and
+PDT_CHECK_INVARIANTS=1 for this file."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as telemetry
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+from paddle_tpu.serving import (CanaryConfig, NumericSentry,
+                                ReplicaState, SentryConfig,
+                                ServingRouter, TransferStageTimeout,
+                                transfer)
+from paddle_tpu.utils.faults import (FaultError, FaultInjector,
+                                     fault_point, fault_value,
+                                     value_armed)
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=64)
+    paddle.seed(7)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+JOBS = [([5, 4, 3, 2, 6, 7], 10), ([9, 1, 2], 10), ([7, 7, 1, 2], 10),
+        ([3, 3, 9], 10)]
+
+
+def _fleet(model, n=4, clock=None, engine_kw=None, **kw):
+    clock = clock if clock is not None else FakeClock()
+    ekw = dict(max_batch_size=3, max_seq_len=64, page_size=4)
+    ekw.update(engine_kw or {})
+    kw.setdefault("page_size", 4)
+    kw.setdefault("sleep", clock.advance)
+    router = ServingRouter(
+        lambda i: ContinuousBatchingEngine(model, clock=clock, **ekw),
+        num_replicas=n, policy="round_robin", clock=clock, **kw)
+    return router, clock
+
+
+def _sentried(model, n=4, scan_every=2, interval=5.0, **kw):
+    kw.setdefault("restart_backoff_base", 3.0)
+    kw.setdefault("restart_backoff_max", 3.0)
+    return _fleet(model, n=n,
+                  sentry=SentryConfig(scan_every=scan_every),
+                  canary=CanaryConfig(interval=interval,
+                                      max_new_tokens=6), **kw)
+
+
+def _reference(model, jobs, n=4):
+    router, _ = _fleet(model, n=n)
+    rids = [router.submit(p, m) for p, m in jobs]
+    out = router.run()
+    return [out[r] for r in rids]
+
+
+# ---------------------------------------------------------------------
+class TestCorruptFaultMode:
+    """utils/faults.py CORRUPT arming: deterministic value mutation
+    with the raise-mode trigger set, plus tag pinning."""
+
+    def test_bitflip_nth_deterministic_and_identity(self):
+        a = np.ones((4, 4), np.float32)
+        with FaultInjector(seed=0) as fi:
+            fi.arm_corrupt("serving.kv_page", nth=2)
+            assert fault_value("serving.kv_page", a) is a   # visit 1
+            b = fault_value("serving.kv_page", a)           # fires
+            assert b is not a and not np.array_equal(b, a)
+            assert (b != a).sum() == 1      # ONE element damaged
+            assert fi.trips("serving.kv_page") == 1
+            assert fi.calls("serving.kv_page") == 2
+            # nth defaults times=1: no further damage
+            assert fault_value("serving.kv_page", a) is a
+        with FaultInjector(seed=0) as fi:   # same seed -> same damage
+            fi.arm_corrupt("serving.kv_page", nth=2)
+            fault_value("serving.kv_page", a)
+            b2 = fault_value("serving.kv_page", a)
+        assert np.array_equal(b, b2)
+
+    def test_nan_and_scale_modes(self):
+        a = np.ones(8, np.float32)
+        with FaultInjector() as fi:
+            fi.arm_corrupt("serving.logits", mode="nan", always=True)
+            out = fault_value("serving.logits", a)
+            assert np.isnan(out).sum() == 1
+            ints = fault_value("serving.logits",
+                               np.arange(5, dtype=np.int32))
+            assert (ints < 0).sum() == 1    # int arrays: extreme value
+        with FaultInjector() as fi:
+            fi.arm_corrupt("transfer.payload", mode="scale",
+                           always=True, factor=10.0)
+            out = fault_value("transfer.payload", a)
+            assert np.allclose(out, 10.0)   # scale hits the WHOLE array
+
+    def test_tag_filter_pins_visits(self):
+        a = np.ones(4, np.float32)
+        with FaultInjector() as fi:
+            fi.arm_corrupt("serving.kv_page", always=True, tag="1")
+            assert not value_armed("serving.kv_page")        # no tag
+            assert not value_armed("serving.kv_page", tag="0")
+            assert value_armed("serving.kv_page", tag="1")
+            assert fault_value("serving.kv_page", a, tag="0") is a
+            assert fi.calls("serving.kv_page") == 0   # filtered: no
+            #                                           visit consumed
+            out = fault_value("serving.kv_page", a, tag="1")
+            assert out is not a
+            assert fi.calls("serving.kv_page") == 1
+
+    def test_raise_rule_fires_at_value_site(self):
+        """Every value site doubles as an exception site: arm() (not
+        arm_corrupt) raises through fault_value."""
+        with FaultInjector() as fi:
+            fi.arm("serving.kv_page", always=True)
+            with pytest.raises(FaultError) as ei:
+                fault_value("serving.kv_page", np.ones(2))
+            assert ei.value.site == "serving.kv_page"
+
+    def test_corrupt_rule_at_fault_point_counts_only(self):
+        """A corrupt rule visited through fault_point has no value to
+        mutate: the visit counts, nothing raises, nothing trips."""
+        with FaultInjector() as fi:
+            fi.arm_corrupt("serving.kv_page", always=True)
+            fault_point("serving.kv_page")
+            assert fi.calls("serving.kv_page") == 1
+            assert fi.trips("serving.kv_page") == 0
+
+    def test_arm_corrupt_validation(self):
+        fi = FaultInjector()
+        with pytest.raises(ValueError, match="corrupt mode"):
+            fi.arm_corrupt("x.y", mode="melt", always=True)
+        with pytest.raises(ValueError, match="exactly one"):
+            fi.arm_corrupt("x.y")
+        with pytest.raises(ValueError, match="exactly one"):
+            fi.arm_corrupt("x.y", nth=1, always=True)
+
+    def test_corrupt_fire_counts_and_event(self):
+        telemetry.reset()
+        telemetry.clear_events()
+        with FaultInjector() as fi:
+            fi.arm_corrupt("serving.kv_page", always=True, times=1)
+            fault_value("serving.kv_page", np.ones(2, np.float32))
+            fault_value("serving.kv_page", np.ones(2, np.float32))
+        assert telemetry.value("pdt_faults_fired_total",
+                               site="serving.kv_page") == 1
+        ev = [e for e in telemetry.events() if e["name"] == "fault.fire"]
+        assert len(ev) == 1
+        assert ev[0]["attrs"]["exc"] == "corrupt:bitflip"
+
+
+# ---------------------------------------------------------------------
+class TestNumericSentry:
+    def test_token_oov_trips(self):
+        telemetry.clear_events()
+        s = NumericSentry(SentryConfig(), vocab_size=64, replica=3)
+        s.observe_tokens(np.asarray([1, 5, 63]))
+        assert s.trips == 0
+        s.observe_tokens(np.asarray([1, 64]))
+        s.observe_tokens(np.asarray([-7]))
+        assert s.trips == 2
+        assert s.last_trip["kind"] == "token_oov"
+        assert telemetry.value("pdt_sentry_trips_total",
+                               kind="token_oov") == 2
+        ev = [e for e in telemetry.events()
+              if e["name"] == "sentry.trip"]
+        assert len(ev) == 2 and ev[0]["attrs"]["replica"] == 3
+
+    def test_logit_scan_trips_nonfinite_and_absmax(self):
+        s = NumericSentry(SentryConfig(logit_abs_max=100.0),
+                          vocab_size=64)
+        s.observe_logits(np.asarray([[1.0, -3.0], [2.0, 99.0]]))
+        assert s.trips == 0
+        s.observe_logits(np.asarray([[1.0, np.nan]]))
+        assert s.trips == 1 \
+            and s.last_trip["kind"] == "logit_nonfinite"
+        s.observe_logits(np.asarray([[1.0, -101.0]]))
+        assert s.trips == 2 and s.last_trip["kind"] == "logit_absmax"
+        assert s.spent > 0.0
+
+    def test_scan_cadence(self):
+        s = NumericSentry(SentryConfig(scan_every=3), vocab_size=8)
+        due = [s.step_tick() for _ in range(7)]
+        assert due == [True, False, False, True, False, False, True]
+        off = NumericSentry(SentryConfig(scan_every=0), vocab_size=8)
+        assert not off.wants_logits
+        assert [off.step_tick() for _ in range(3)] == [False] * 3
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="scan_every"):
+            SentryConfig(scan_every=-1)
+        with pytest.raises(ValueError, match="logit_abs_max"):
+            SentryConfig(logit_abs_max=0)
+        with pytest.raises(ValueError, match="non-empty"):
+            CanaryConfig(prompt=())
+        with pytest.raises(ValueError, match="interval"):
+            CanaryConfig(interval=0.0)
+        with pytest.raises(ValueError, match="max_suspect_rounds"):
+            CanaryConfig(max_suspect_rounds=0)
+
+
+# ---------------------------------------------------------------------
+class TestEngineSentry:
+    """Engine-level hooks: the sentry observes every harvest without
+    perturbing the stream, and the `serving.logits` corrupt site
+    poisons exactly what the scan inspects."""
+
+    def _run(self, model, sentry=None, fault=None):
+        eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                       max_seq_len=64, page_size=4)
+        if sentry is not None:
+            eng.attach_sentry(sentry)
+        rids = [eng.add_request(p, n) for p, n in JOBS[:2]]
+        if fault is not None:
+            with FaultInjector(seed=0) as fi:
+                fi.arm_corrupt(fault[0], **fault[1])
+                out = eng.run()
+        else:
+            out = eng.run()
+        return [out[r] for r in rids]
+
+    def test_sentry_on_stream_identical_and_scans(self, model):
+        want = self._run(model)
+        s = NumericSentry(SentryConfig(scan_every=2), vocab_size=64)
+        got = self._run(model, sentry=s)
+        assert got == want          # observation never perturbs
+        assert s.scans >= 2 and s.steps >= 4 and s.trips == 0
+        assert telemetry.value("pdt_sentry_checks_total",
+                               kind="logit_scan") == s.scans
+
+    def test_nan_poisoned_logits_caught_within_n_steps(self, model):
+        """Drill (b), engine half: with the scan at every Nth step, a
+        NaN poisoning of the logit harvest trips within N steps of
+        arming — the amortization bound is the detection bound."""
+        want = self._run(model)
+        s = NumericSentry(SentryConfig(scan_every=2), vocab_size=64)
+        got = self._run(model, sentry=s,
+                        fault=("serving.logits",
+                               dict(mode="nan", always=True)))
+        assert s.trips >= 1
+        assert s.last_trip["kind"] == "logit_nonfinite"
+        # the first scan after arming caught it: trip step within
+        # scan_every of the first scanned step
+        assert got == want          # harvest poisoning never touches
+        #                             the sampled stream itself
+
+    def test_kv_corrupt_site_diverges_stream(self, model):
+        """Sanity for drill (a): the `serving.kv_page` mutation lands
+        in LIVE pages, so the greedy stream actually diverges — damage
+        in free pages would drill nothing."""
+        want = self._run(model)
+        got = self._run(model, fault=("serving.kv_page",
+                                      dict(always=True)))
+        assert got != want
+
+    def test_attach_sentry_rebuilds_decode_program(self, model):
+        eng = ContinuousBatchingEngine(model, max_batch_size=2,
+                                       max_seq_len=64, page_size=4)
+        eng.add_request([5, 4, 3], 4)
+        eng.run()
+        assert eng._decode_jit is not None and not eng._decode_logits
+        eng.attach_sentry(NumericSentry(SentryConfig(scan_every=1),
+                                        vocab_size=64))
+        assert eng._decode_jit is None      # rebuild pending
+        eng.add_request([5, 4, 3], 4)
+        eng.run()
+        assert eng._decode_logits           # sentry variant built
+
+
+# ---------------------------------------------------------------------
+class TestCanaryFleet:
+    def test_sentry_requires_canary(self, model):
+        with pytest.raises(ValueError, match="requires canary"):
+            _fleet(model, n=1, sentry=SentryConfig())
+
+    def test_scheduled_canary_passes_on_healthy_fleet(self, model):
+        want = _reference(model, JOBS[:2], n=2)
+        router, clock = _sentried(model, n=2, interval=5.0)
+        ids = [router.submit(p, m) for p, m in JOBS[:2]]
+        clock.advance(6.0)          # schedule due on both replicas
+        out = router.run()
+        for _ in range(30):         # let in-flight canaries conclude
+            if all(h.canary is None and h.canary_runs >= 1
+                   for h in router.replicas):
+                break
+            router.step()
+        assert [out[i] for i in ids] == want
+        assert router.num_failovers == 0
+        assert telemetry.value("pdt_sentry_canary_runs_total",
+                               result="pass") >= 2
+        assert all(h.state == ReplicaState.HEALTHY
+                   for h in router.replicas)
+        info = router.fleet_info()
+        assert info["sentry"]["quarantines"] == 0
+        assert info["sentry"]["canary_runs"] >= 2
+
+    def test_false_positive_restores_with_zero_failovers(self, model):
+        """Drill (d): ONE spurious sentry trip (a single NaN-poisoned
+        logit harvest; the stream itself is untouched) marks the
+        replica SUSPECT — its terminals PARK — then the immediate
+        canary passes with a clean window and everything delivers
+        exactly as an unfaulted fleet would: zero failovers, zero
+        quarantines, zero tokens dropped."""
+        want = _reference(model, JOBS[:2], n=2)
+        # a LONG canary (24-token golden stream): the suspect
+        # replica's in-flight request must reach its terminal while
+        # the probe is still running, so the parking path is exercised
+        router, clock = _fleet(
+            model, n=2, sentry=SentryConfig(scan_every=1),
+            canary=CanaryConfig(interval=1000.0, max_new_tokens=16),
+            restart_backoff_base=3.0, restart_backoff_max=3.0)
+        ids = [router.submit(p, m) for p, m in JOBS[:2]]
+        with FaultInjector(seed=0) as fi:
+            fi.arm_corrupt("serving.logits", mode="nan", nth=1,
+                           tag="0")
+            router.step()           # replica 0's first scan poisoned
+            router.step()
+        assert router.replicas[0].state == ReplicaState.SUSPECT
+        # drive to completion: replica 0's request must finish PARKED
+        # (not finalized) until the canary clears it
+        parked_seen = False
+        for _ in range(60):
+            router.step()
+            parked_seen = parked_seen or bool(router.replicas[0].parked)
+            if all(router.requests[i].done for i in ids):
+                break
+        assert parked_seen, "suspect replica's terminal never parked"
+        assert all(router.requests[i].done for i in ids)
+        assert [router.requests[i].tokens for i in ids] == want
+        assert router.replicas[0].state == ReplicaState.HEALTHY
+        assert router.num_failovers == 0
+        assert router.num_quarantines == 0
+        assert router.num_tainted_tokens == 0
+        assert telemetry.value("pdt_sentry_canary_runs_total",
+                               result="pass") >= 1
+
+    def test_nan_storm_quarantines_via_dirty_canaries(self, model):
+        """Drill (b), fleet half: a PERSISTENT NaN poisoning of one
+        replica's logit harvest trips the scan every step. The canary's
+        tokens still match golden (harvest poisoning never alters the
+        stream) but its windows are dirty — after max_suspect_rounds
+        dirty passes the replica quarantines as persistently sick, and
+        its streams re-serve bit-identically."""
+        want = _reference(model, JOBS, n=4)
+        router, clock = _sentried(model, n=4, scan_every=1,
+                                  interval=1000.0)
+        ids = [router.submit(p, m) for p, m in JOBS]
+        with FaultInjector(seed=0) as fi:
+            fi.arm_corrupt("serving.logits", mode="nan", always=True,
+                           tag="1")
+            for _ in range(80):
+                router.step()
+                if router.replicas[1].state \
+                        == ReplicaState.QUARANTINED:
+                    break
+            assert router.replicas[1].state \
+                == ReplicaState.QUARANTINED
+            clock.advance(4.0)
+            out = router.run()
+        assert [out[i] for i in ids] == want
+        ev = [e for e in telemetry.events()
+              if e["name"] == "replica.quarantine"]
+        assert ev and ev[0]["attrs"]["reason"] == "sentry_dirty"
+        assert telemetry.value("pdt_sentry_canary_runs_total",
+                               result="dirty") >= 2
+        # the trips that EXPLAIN the quarantine survive the engine
+        # discard it caused (retired-counter fold, like prefix/spec)
+        info = router.fleet_info()
+        assert info["sentry"]["sentry_trips"] >= 1
+        assert router.replicas[1].sentry_trips() >= 1
+
+    def test_journaled_quarantine_rewinds_tainted_tokens(
+            self, model, tmp_path):
+        """Journal x gray-failure composition: the quarantine journals
+        a durable `rewind` record truncating the tainted stream, so a
+        router SIGKILL between the quarantine and the request's
+        terminal recovers the VERIFIED prefix only — tainted tokens
+        cannot resurface through replay, and outputs stay
+        bit-identical to an uncorrupted fleet."""
+        from paddle_tpu.serving import RouterJournal
+        want = _reference(model, JOBS, n=4)
+        clock = FakeClock()
+        jr_kw = dict(
+            n=4, clock=clock,
+            sentry=SentryConfig(scan_every=4),
+            canary=CanaryConfig(interval=5.0, max_new_tokens=6),
+            restart_backoff_base=3.0, restart_backoff_max=3.0)
+        router, _ = _fleet(model,
+                           journal=RouterJournal(tmp_path / "wal",
+                                                 fsync="off"),
+                           **jr_kw)
+        ids = [router.submit(p, m) for p, m in JOBS]
+        with FaultInjector(seed=0) as fi:
+            fi.arm_corrupt("serving.kv_page", always=True, tag="1")
+            router.step()
+            router.step()
+            clock.advance(6.0)
+            for _ in range(60):
+                router.step()
+                if router.replicas[1].state \
+                        == ReplicaState.QUARANTINED:
+                    break
+            assert router.replicas[1].state \
+                == ReplicaState.QUARANTINED
+        assert router.num_tainted_tokens >= 1
+        assert telemetry.value("pdt_journal_records_total",
+                               kind="rewind") >= 1
+        live_left = [i for i in ids if not router.requests[i].done]
+        assert live_left, "kill window missed: all requests terminal"
+        del router                       # SIGKILL-shaped, PRE-terminal
+        recovered = ServingRouter.recover(
+            RouterJournal(tmp_path / "wal", fsync="off"),
+            lambda i: ContinuousBatchingEngine(
+                model, clock=clock, max_batch_size=3, max_seq_len=64,
+                page_size=4),
+            num_replicas=4, policy="round_robin", clock=clock,
+            sleep=clock.advance, page_size=4,
+            sentry=SentryConfig(scan_every=4),
+            canary=CanaryConfig(interval=5.0, max_new_tokens=6),
+            restart_backoff_base=3.0, restart_backoff_max=3.0)
+        out = recovered.run()
+        assert [out[i] for i in ids] == want
+
+    def test_probation_gates_every_restart(self, model):
+        """Satellite: EVERY restart re-enters through canary-gated
+        PROBATION — no real traffic, and no restart-budget reset,
+        until a canary passes. Closes the PR-4 hole where an idle
+        restarted replica sat HEALTHY unproven."""
+        router, clock = _sentried(model, n=2, interval=1000.0)
+        a = router.submit(*JOBS[0])             # round robin: r0
+        router.step()
+        router.kill_replica(0)                  # plain fail-stop kill
+        clock.advance(4.0)                      # past the backoff
+        router.step()                           # restart lands...
+        h = router.replicas[0]
+        assert h.state == ReplicaState.PROBATION
+        assert h.restart_attempt == 1           # budget NOT reset yet
+        assert not h.can_accept()
+        # new submits must avoid the probation replica entirely
+        b = router.submit(*JOBS[1])
+        assert router.requests[b].replica != 0
+        for _ in range(40):                     # canary must clear it
+            router.step()
+            if h.state == ReplicaState.HEALTHY:
+                break
+        assert h.state == ReplicaState.HEALTHY
+        assert h.restart_attempt == 0           # reset by the PASS
+        assert h.last_canary_pass is not None
+        out = router.run()
+        assert len(out[a]) == JOBS[0][1] and len(out[b]) == JOBS[1][1]
+        ev = [e for e in telemetry.events()
+              if e["name"] == "router.replica_state"]
+        assert any(e["attrs"]["state"] == "probation" for e in ev)
+        assert any(e["attrs"]["reason"] == "probation_pass"
+                   for e in ev)
+
+
+# ---------------------------------------------------------------------
+class TestGrayFailureTp2:
+    """Acceptance drill (a), tp=2 variant: the corrupt replica is a
+    whole GSPMD submesh — the sick-chip surface TP multiplies — and
+    quarantine + re-serve still land bit-identical to an uncorrupted
+    tp=1 fleet (8-simulated-device harness)."""
+
+    def test_kv_bitflip_tp2_quarantine_bit_identical(self):
+        paddle.seed(0)
+        m = LlamaForCausalLM(LlamaConfig.tiny())
+        m.eval()
+        rng = np.random.default_rng(7)
+        jobs = [rng.integers(1, 512, int(rng.integers(5, 10))).tolist()
+                for _ in range(4)]
+        clock = FakeClock()
+
+        def tp_factory(i, sm):
+            return ContinuousBatchingEngine(
+                m, max_batch_size=2, max_seq_len=96, submesh=sm,
+                clock=clock)
+
+        ref = ServingRouter(
+            lambda i: ContinuousBatchingEngine(m, max_batch_size=2,
+                                               max_seq_len=96),
+            num_replicas=4, policy="round_robin")
+        rids = [ref.submit(p, 8) for p in jobs]
+        want = ref.run()
+
+        router = ServingRouter(
+            tp_factory, num_replicas=4, policy="round_robin", tp=2,
+            clock=clock, sleep=clock.advance,
+            sentry=SentryConfig(scan_every=4),
+            canary=CanaryConfig(interval=4.0, max_new_tokens=5),
+            restart_backoff_base=3.0, restart_backoff_max=3.0)
+        ids = [router.submit(p, 8) for p in jobs]
+        with FaultInjector(seed=0) as fi:
+            fi.arm_corrupt("serving.kv_page", always=True, tag="1")
+            for _ in range(2):
+                router.step()
+            clock.advance(5.0)      # canary schedule fires
+            for _ in range(60):
+                router.step()
+                if router.replicas[1].state \
+                        == ReplicaState.QUARANTINED:
+                    break
+            assert router.replicas[1].state \
+                == ReplicaState.QUARANTINED
+            clock.advance(4.0)
+            out = router.run()
+        assert [out[i] for i in ids] == [want[r] for r in rids]
+        assert router.num_quarantines >= 1
+        assert telemetry.value("pdt_sentry_quarantines_total",
+                               replica="1") >= 1
+
+
+# ---------------------------------------------------------------------
+class TestTransferStageDeadline:
+    """Satellite: per-stage migration deadlines on the injectable
+    clock — a stage that returns late is counted
+    (`stage="timeout"`), the migration defers, and the SLOW endpoint
+    is degraded; both engines stay consistent."""
+
+    def _pair(self, model):
+        e = dict(max_batch_size=2, max_seq_len=64, page_size=4)
+        return (ContinuousBatchingEngine(model, **e),
+                ContinuousBatchingEngine(model, **e))
+
+    def test_slow_serialize_times_out_consistent(self, model):
+        src, dst = self._pair(model)
+        rid = src.add_request([5, 4, 3, 2, 6, 7], 6)
+        src.step()
+        clock = FakeClock()
+        real_export = src.export_pages
+
+        def slow_export(r):
+            clock.advance(2.0)      # the stage "hangs" for 2 virtual s
+            return real_export(r)
+
+        src.export_pages = slow_export
+        base = telemetry.value("pdt_transfer_failures_total",
+                               stage="timeout")
+        with pytest.raises(TransferStageTimeout) as ei:
+            transfer.migrate_request(src, dst, rid, clock=clock,
+                                     stage_deadline=1.0)
+        assert ei.value.stage == "serialize"
+        assert telemetry.value("pdt_transfer_failures_total",
+                               stage="timeout") - base == 1
+        # nothing moved: source still owns the request, target empty
+        assert src.get_request(rid) is not None
+        assert dst.lifecycle_info()["running"] == 0
+        src.check_invariants()
+        dst.check_invariants()
+
+    def test_slow_install_backs_out_of_target(self, model):
+        src, dst = self._pair(model)
+        rid = src.add_request([5, 4, 3, 2, 6, 7], 6)
+        src.step()
+        clock = FakeClock()
+        real_import = dst.import_pages
+
+        def slow_import(payload, deadline=None):
+            clock.advance(2.0)
+            return real_import(payload, deadline=deadline)
+
+        dst.import_pages = slow_import
+        with pytest.raises(TransferStageTimeout) as ei:
+            transfer.migrate_request(src, dst, rid, clock=clock,
+                                     stage_deadline=1.0)
+        assert ei.value.stage == "install"
+        # the late install was BACKED OUT: source stays authoritative,
+        # exactly one live copy (the transactional contract)
+        assert src.get_request(rid) is not None
+        assert dst.lifecycle_info()["running"] == 0
+        src.check_invariants()
+        dst.check_invariants()
+
+    def test_router_defers_and_degrades_slow_endpoint(self, model):
+        clock = FakeClock()
+        ekw = dict(max_batch_size=2, max_seq_len=64, page_size=4)
+        slow_engines = []
+
+        def factory(i):
+            eng = ContinuousBatchingEngine(model, clock=clock, **ekw)
+            if i == 0:              # the prefill replica is slow
+                real = eng.export_pages
+
+                def slow_export(r):
+                    clock.advance(2.0)
+                    return real(r)
+                eng.export_pages = slow_export
+                slow_engines.append(eng)
+            return eng
+
+        router = ServingRouter(
+            factory, roles="prefill:1,decode:1", policy="round_robin",
+            page_size=4, clock=clock, sleep=clock.advance,
+            degraded_after=1, dead_after=99,
+            transfer_stage_deadline=1.0)
+        rid = router.submit([5, 4, 3, 2, 6, 7], 8)
+        out = router.run()
+        assert len(out[rid]) == 8          # served despite deferrals
+        assert router.num_migrations == 0  # every attempt deferred
+        # the slow endpoint was charged a health failure per overrun
+        # (successful steps between attempts recover it — the ladder
+        # works; the EVENT stream proves the charge landed)
+        ev = [e for e in telemetry.events()
+              if e["name"] == "router.replica_state"
+              and e["attrs"]["replica"] == 0
+              and e["attrs"]["state"] == "degraded"]
+        assert ev and "TransferStageTimeout" in ev[0]["attrs"]["reason"]
+        assert telemetry.value("pdt_transfer_failures_total",
+                               stage="timeout") >= 1
+
+    def test_corrupt_payload_refused_by_sha256(self, model):
+        """Drill (c), plane half: a corrupt-mode `transfer.payload`
+        fault damages serialized KV bytes AFTER the manifest was
+        attached — the PR-13 verify gate refuses the install at
+        stage="verify", both engines consistent, and the sentry
+        counters stay untouched (payload-verify and sentry are
+        separate ledgers)."""
+        src, dst = self._pair(model)
+        rid = src.add_request([5, 4, 3, 2, 6, 7], 6)
+        src.step()
+        base_v = telemetry.value("pdt_transfer_failures_total",
+                                 stage="verify")
+        base_t = telemetry.value("pdt_sentry_trips_total",
+                                 kind="token_oov")
+        from paddle_tpu.models.serving import PayloadCorruption
+        with FaultInjector(seed=0) as fi:
+            fi.arm_corrupt("transfer.payload", nth=1)
+            with pytest.raises(PayloadCorruption):
+                transfer.migrate_request(src, dst, rid)
+            assert fi.trips("transfer.payload") == 1
+        assert telemetry.value("pdt_transfer_failures_total",
+                               stage="verify") - base_v == 1
+        assert telemetry.value("pdt_sentry_trips_total",
+                               kind="token_oov") == base_t
+        assert src.get_request(rid) is not None   # source untouched
+        assert dst.lifecycle_info()["running"] == 0
+        src.check_invariants()
+        dst.check_invariants()
+
+    def test_payload_corrupt_honors_source_tag(self, model):
+        """A tag-pinned `transfer.payload` rule damages ONE replica's
+        outbound payloads only — the serialize path threads the
+        source engine's fault_tag through, same as the engine sites
+        (a mismatched tag neither fires nor consumes visits, so a
+        mis-pinned drill reads 0 trips instead of passing vacuously)."""
+        src, dst = self._pair(model)
+        src.fault_tag = "1"
+        rid = src.add_request([5, 4, 3, 2, 6, 7], 6)
+        src.step()
+        with FaultInjector(seed=0) as fi:
+            fi.arm_corrupt("transfer.payload", always=True, tag="0")
+            req, _ = transfer.migrate_request(src, dst, rid)
+            assert fi.calls("transfer.payload") == 0   # wrong replica
+        assert req is not None                         # clean install
+        dst.evict_request(req.rid)
+        rid2 = dst.add_request([9, 1, 2], 6)
+        dst.fault_tag = "0"
+        dst.step()
+        from paddle_tpu.models.serving import PayloadCorruption
+        with FaultInjector(seed=0) as fi:
+            fi.arm_corrupt("transfer.payload", always=True, tag="0")
+            with pytest.raises(PayloadCorruption):
+                transfer.migrate_request(dst, src, rid2)
+            assert fi.trips("transfer.payload") == 1   # right replica
