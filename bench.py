@@ -244,6 +244,11 @@ REGRESSION_METRICS = (
     # every-Nth-step numeric sentry attached (the production default;
     # the <=3% overhead bar itself is graded inside detail.sentry)
     "detail.sentry.sentry_on_decode_tokens_per_sec",
+    # quantized serving (ISSUE 15): the int8-weights + int8-KV engine's
+    # own decode throughput — on the CPU oracle the win is residency
+    # (detail.quant.residency_ratio), but this row keeps the quantized
+    # dispatch path itself from regressing
+    "detail.quant.quant_decode_tokens_per_sec",
 )
 
 # latency-family regression gates: LOWER is better, a rise past the
@@ -1025,6 +1030,138 @@ def bench_int8(on_tpu: bool) -> dict:
     }
 
 
+def bench_quant(model, cfg, on_tpu: bool) -> dict:
+    """Quantized-vs-full-width serving A/B (ISSUE 15): decode
+    tokens/sec, CONCURRENT RESIDENCY at fixed pool bytes (the
+    half-width-page prize: how many requests' KV fit the same HBM),
+    migration payload quantiles, and the end-to-end logit error of the
+    quantized engine against the full-width one on fixed prompts
+    (compared per decode step only while the two token streams still
+    agree — after a divergence the positions differ and the rows stop
+    being comparable). Returns a detail sub-dict;
+    `quant_decode_tokens_per_sec` is gated by REGRESSION_METRICS."""
+    import numpy as np
+    from paddle_tpu.models.serving import (ContinuousBatchingEngine,
+                                           QuantServingConfig)
+    from paddle_tpu.serving.transfer import payload_nbytes
+
+    model.eval()
+    if on_tpu:
+        slots, p_len, warm, steps, max_seq = 8, 128, 8, 64, 1024
+    else:
+        slots, p_len, warm, steps, max_seq = 2, 8, 2, 6, 64
+    rng = np.random.default_rng(0)
+    quant = QuantServingConfig(weights="int8", kv="int8")
+
+    class _Recorder:
+        """Minimal sentry-shaped logit recorder (attach_sentry
+        contract): pulls every step's sampled-row logits to host."""
+        wants_logits = True
+
+        def __init__(self):
+            self.logits, self.trips = [], 0
+
+        def step_tick(self):
+            return True
+
+        def observe_tokens(self, toks):
+            pass
+
+        def observe_logits(self, lg):
+            self.logits.append(np.asarray(lg, np.float32))
+
+        def note_cost(self, s):
+            pass
+
+    def build(q, num_pages=None, batch=slots, sentry=None):
+        eng = ContinuousBatchingEngine(
+            model, max_batch_size=batch, max_seq_len=max_seq,
+            num_pages=num_pages, quant=q)
+        if sentry is not None:
+            eng.attach_sentry(sentry)
+        return eng
+
+    out = {}
+    # -- decode throughput + logit error, one warm engine per mode ----
+    toks_per_sec, recorders, streams = {}, {}, {}
+    prompts = [list(rng.integers(1, cfg.vocab_size, p_len))
+               for _ in range(slots)]
+    for name, q in (("fp", None), ("quant", quant)):
+        rec = _Recorder()
+        eng = build(q, sentry=rec)
+        for p in prompts:
+            eng.add_request(list(p), max_new_tokens=max_seq - p_len - 1)
+        for _ in range(warm):
+            eng.step()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            eng.step()
+        dt = time.perf_counter() - t0
+        toks_per_sec[name] = round(slots * steps / dt, 1)
+        recorders[name] = rec
+        streams[name] = [list(r.output) for r in eng._slot_req
+                         if r is not None]
+    out["fp_decode_tokens_per_sec"] = toks_per_sec["fp"]
+    out["quant_decode_tokens_per_sec"] = toks_per_sec["quant"]
+    out["quant_decode_speedup"] = round(
+        toks_per_sec["quant"] / toks_per_sec["fp"], 3)
+    # logit error over the agreeing stream prefix (steps compare 1:1
+    # until the first token divergence)
+    err, agree = 0.0, 0
+    for a, b in zip(recorders["fp"].logits, recorders["quant"].logits):
+        if a.shape != b.shape:
+            break
+        err = max(err, float(np.max(np.abs(a - b))))
+        agree += 1
+        if [s[:agree] for s in streams["fp"]] \
+                != [s[:agree] for s in streams["quant"]]:
+            break
+    out["logit_max_abs_err"] = round(err, 4)
+    out["logit_steps_compared"] = agree
+    # -- concurrent residency at FIXED pool bytes ---------------------
+    # budget = what 2 full-width slots' worst case costs; each mode
+    # gets num_pages = budget // its own page_bytes (scales included —
+    # cache_memory_info is the honest bill)
+    probe_fp = build(None, batch=1)
+    probe_q = build(quant, batch=1)
+    pb_fp = probe_fp.cache_memory_info()["page_bytes"]
+    pb_q = probe_q.cache_memory_info()["page_bytes"]
+    budget = pb_fp * (2 * (-(-max_seq // probe_fp.page_size)))
+    res = {}
+    for name, q, pb in (("fp", None, pb_fp), ("quant", quant, pb_q)):
+        eng = build(q, num_pages=max(2, budget // pb + 1), batch=64)
+        for _ in range(64):
+            eng.add_request(
+                list(rng.integers(1, cfg.vocab_size, p_len)),
+                max_new_tokens=max_seq - p_len - 1)
+        peak = 0
+        for _ in range(3):
+            eng.step()
+            peak = max(peak, sum(r is not None
+                                 for r in eng._slot_req))
+        res[name] = peak
+    out["residency_at_fixed_bytes"] = res
+    out["page_bytes"] = {"fp": pb_fp, "quant": pb_q}
+    out["residency_ratio"] = round(res["quant"] / max(res["fp"], 1), 3)
+    # -- migration payload bytes --------------------------------------
+    ratios = []
+    for n in (p_len, 2 * p_len, 3 * p_len):
+        pair = {}
+        for name, q in (("fp", None), ("quant", quant)):
+            eng = build(q)
+            rid = eng.add_request(
+                list(rng.integers(1, cfg.vocab_size, n)),
+                max_new_tokens=8)
+            eng.step()
+            pair[name] = payload_nbytes(eng.export_pages(rid))
+        ratios.append(pair["quant"] / pair["fp"])
+    ratios.sort()
+    out["payload_bytes_ratio"] = {
+        "p50": round(ratios[len(ratios) // 2], 3),
+        "max": round(ratios[-1], 3)}
+    return {"quant": out}
+
+
 def bench_journal(model, cfg, on_tpu: bool) -> dict:
     """Durability A/B (ISSUE 13): decode tokens/sec of a journaled
     router vs a journal-free one, per fsync policy, plus recovery-time
@@ -1451,6 +1588,10 @@ def run_bench(on_tpu: bool) -> dict:
         detail.update(bench_int8(on_tpu))
     except Exception:
         detail["int8_error"] = traceback.format_exc(limit=3)[-400:]
+    try:
+        detail.update(bench_quant(model, cfg, on_tpu))
+    except Exception:
+        detail["quant_error"] = traceback.format_exc(limit=3)[-400:]
     try:
         detail.update(bench_journal(model, cfg, on_tpu))
     except Exception:

@@ -250,11 +250,21 @@ class RaggedKVCacheView:
 
     def __init__(self, k_pages, v_pages, block_tables, token_seq,
                  positions, query_start, query_len, context_lens,
-                 block_q=1, pages_bound=None, tp=None):
+                 block_q=1, pages_bound=None, tp=None, k_scale=None,
+                 v_scale=None):
         self.k_pages = k_pages if isinstance(k_pages, Tensor) \
             else Tensor(k_pages)
         self.v_pages = v_pages if isinstance(v_pages, Tensor) \
             else Tensor(v_pages)
+        # quantized serving (docs/serving.md "Quantized serving"):
+        # int8 page pools ride with (P, page_size) f32 per-page-row
+        # DEQUANT scale pools — the scatter quantizes on commit
+        # (ragged_scatter_quantized), the attention dequantizes per
+        # page in flight. None = full-width pools, the default.
+        self.k_scale = None if k_scale is None else (
+            k_scale if isinstance(k_scale, Tensor) else Tensor(k_scale))
+        self.v_scale = None if v_scale is None else (
+            v_scale if isinstance(v_scale, Tensor) else Tensor(v_scale))
 
         def _i32(x):
             return jnp.asarray(x._value if isinstance(x, Tensor) else x,
@@ -470,7 +480,8 @@ class LlamaAttention(nn.Layer):
         from paddle_tpu.core.tensor import apply as _apply
         from paddle_tpu.ops.rope import rope_rotate_values
         from paddle_tpu.ops.ragged_paged_attention import (
-            ragged_paged_attention_values, ragged_scatter_values)
+            ragged_paged_attention_values, ragged_scatter_quantized,
+            ragged_scatter_values)
         if b != 1:
             raise ValueError(
                 "ragged KV cache wants a packed (1, T, ...) batch")
@@ -485,23 +496,48 @@ class LlamaAttention(nn.Layer):
         q = _apply("rope_ragged", fn_rope, (q, cos, sin))
         k = _apply("rope_ragged", fn_rope, (k, cos, sin))
 
-        def fn_scatter(kp, vp, kk, vv):
-            return ragged_scatter_values(kp, vp, kk[0], vv[0], bt, seq,
-                                         pos)
-        kp_new, vp_new = _apply(
-            "ragged_kv_scatter", fn_scatter,
-            (view.k_pages, view.v_pages, k, v), multi_output=True)
-
         win = self.sliding_window
+        quantized = view.k_scale is not None
+        if quantized:
+            # quantized pools: the scatter quantizes on commit and the
+            # attention reads the POST-scatter int8 pages + scales —
+            # so a prefill row attends exactly the quantized values a
+            # later decode step would, the invariant the chaos drills'
+            # bit-identity rests on
+            def fn_scatter_q(kp, vp, ks, vs, kk, vv):
+                return ragged_scatter_quantized(kp, vp, ks, vs, kk[0],
+                                                vv[0], bt, seq, pos)
+            kp_new, vp_new, ks_new, vs_new = _apply(
+                "ragged_kv_scatter_q", fn_scatter_q,
+                (view.k_pages, view.v_pages, view.k_scale,
+                 view.v_scale, k, v), multi_output=True)
 
-        def fn_attn(qq, kp, vp):
-            return ragged_paged_attention_values(
-                qq[0], kp, vp, view.query_start, view.query_len,
-                view.context_lens, bt, window=win,
-                block_q=view.block_q,
-                pages_bound=view.pages_bound, tp=view.tp)[None]
-        out = _apply("ragged_paged_attention", fn_attn,
-                     (q, kp_new, vp_new))
+            def fn_attn_q(qq, kp, vp, ks, vs):
+                return ragged_paged_attention_values(
+                    qq[0], kp, vp, view.query_start, view.query_len,
+                    view.context_lens, bt, window=win,
+                    block_q=view.block_q,
+                    pages_bound=view.pages_bound, tp=view.tp,
+                    k_scale=ks, v_scale=vs)[None]
+            out = _apply("ragged_paged_attention", fn_attn_q,
+                         (q, kp_new, vp_new, ks_new, vs_new))
+        else:
+            def fn_scatter(kp, vp, kk, vv):
+                return ragged_scatter_values(kp, vp, kk[0], vv[0], bt,
+                                             seq, pos)
+            kp_new, vp_new = _apply(
+                "ragged_kv_scatter", fn_scatter,
+                (view.k_pages, view.v_pages, k, v), multi_output=True)
+            ks_new = vs_new = None
+
+            def fn_attn(qq, kp, vp):
+                return ragged_paged_attention_values(
+                    qq[0], kp, vp, view.query_start, view.query_len,
+                    view.context_lens, bt, window=win,
+                    block_q=view.block_q,
+                    pages_bound=view.pages_bound, tp=view.tp)[None]
+            out = _apply("ragged_paged_attention", fn_attn,
+                         (q, kp_new, vp_new))
         # TP serving: each device computed ITS heads; gather them
         # before the o_proj row matmul (exact-mode fence)
         out = self.o_proj(_tp_repl(out.reshape([1, s, -1])))
@@ -509,7 +545,8 @@ class LlamaAttention(nn.Layer):
             return out, RaggedKVCacheView(
                 kp_new, vp_new, bt, seq, pos, view.query_start,
                 view.query_len, view.context_lens, view.block_q,
-                view.pages_bound, tp=view.tp)
+                view.pages_bound, tp=view.tp, k_scale=ks_new,
+                v_scale=vs_new)
         return out
 
 

@@ -89,9 +89,10 @@ from .. import observability as telemetry
 from .generation import RequestStatus
 
 __all__ = ["ContinuousBatchingEngine", "Request", "RequestStatus",
-           "SpecConfig", "EngineOverloaded", "PoolExhausted",
-           "EngineInvariantError", "PayloadCorruption",
-           "assemble_payload_kv", "payload_checksums", "verify_payload"]
+           "SpecConfig", "QuantServingConfig", "EngineOverloaded",
+           "PoolExhausted", "EngineInvariantError", "PayloadCorruption",
+           "QuantMismatch", "assemble_payload_kv", "payload_checksums",
+           "payload_scale_checksums", "verify_payload"]
 
 # nullcontext is stateless — one shared instance serves every non-TP
 # dispatch (`_tp_scope` sits on the per-decode-step hot path)
@@ -136,6 +137,23 @@ def payload_checksums(payload: dict):
              for k, v in shard] for shard in shards]
 
 
+def payload_scale_checksums(payload: dict):
+    """Content checksums of a QUANTIZED payload's per-page scale rows
+    (`payload["kv_scales"]`, one (k_scale, v_scale) pair per layer —
+    replicated across TP shards, so there is exactly one copy): a
+    flipped scale byte corrupts every row of a page at dequant, so the
+    scales are manifested exactly like the int8 page bytes. None for
+    full-width payloads."""
+    scales = payload.get("kv_scales")
+    if scales is None:
+        return None
+    return [["sha256:" + hashlib.sha256(
+                 np.ascontiguousarray(ks).tobytes()).hexdigest(),
+             "sha256:" + hashlib.sha256(
+                 np.ascontiguousarray(vs).tobytes()).hexdigest()]
+            for ks, vs in scales]
+
+
 def verify_payload(payload: dict) -> None:
     """Verify a payload's `kv_sha256` manifest against its actual KV
     bytes; raises :class:`PayloadCorruption` on any mismatch. A
@@ -161,6 +179,14 @@ def verify_payload(payload: dict) -> None:
             f"KV payload checksum manifest shape mismatch for request "
             f"{payload.get('request_id')!r} (manifest "
             f"{len(want)} shards vs payload {len(got)})")
+    want_sc = payload.get("scales_sha256")
+    if want_sc is not None:
+        got_sc = payload_scale_checksums(payload)
+        if got_sc != [list(pair) for pair in want_sc]:
+            raise PayloadCorruption(
+                f"KV payload SCALE checksum mismatch for request "
+                f"{payload.get('request_id')!r} — the per-page dequant "
+                "scales were corrupted in flight; refusing to install")
 
 
 # -- telemetry (docs/serving.md "Observability" metric catalog) --------
@@ -234,6 +260,26 @@ _M_SPEC_DRAFT_SECONDS = telemetry.histogram(
 _M_SPEC_VERIFY_SECONDS = telemetry.histogram(
     "pdt_spec_verify_seconds",
     "Wall time of one batched verify dispatch incl. the D2H sync.")
+# -- quantized serving (quant=QuantServingConfig(...), ISSUE 15) -------
+_M_QUANT_WEIGHT_LAYERS = telemetry.gauge(
+    "pdt_quant_weight_layers",
+    "Matmul weights held quantized (int8/fp8 + per-channel scale) by "
+    "the most recently built quantized engine.")
+_M_QUANT_WEIGHT_BYTES = telemetry.gauge(
+    "pdt_quant_weight_bytes",
+    "Bytes of the most recently built engine's quantized weights, "
+    "storage plus scales (the HBM the full-width copies would have "
+    "multiplied).")
+_M_QUANT_PAGE_BYTES = telemetry.gauge(
+    "pdt_quant_page_bytes",
+    "Bytes of ONE quantized KV page across layers, int8 storage plus "
+    "per-page-row scales (cache_memory_info page_bytes of the most "
+    "recently built quantized engine).")
+_M_QUANT_MISMATCH = telemetry.counter(
+    "pdt_quant_mode_mismatch_total",
+    "Cross-quant-mode installs refused with QuantMismatch, by entry "
+    "path (import = migration payload, prefix = spill-chain restore).",
+    ("kind",))
 
 
 class EngineOverloaded(RuntimeError):
@@ -259,6 +305,17 @@ class PayloadCorruption(ValueError):
     counts ``pdt_transfer_failures_total{stage="verify"}``, and the
     router keeps the request decoding on its source (falling back to
     folded-token failover re-prefill if that source later dies)."""
+
+
+class QuantMismatch(ValueError):
+    """A KV install crossed quantization modes: a quantized engine's
+    payload (int8 pages + per-page scales) offered to a full-width
+    engine, or vice versa — the page bytes are not interpretable on
+    the other side, so installing them would be silent corruption,
+    not a conversion. Raised by `import_pages` / `import_prefix`
+    BEFORE any target mutation and counted
+    ``pdt_quant_mode_mismatch_total{kind=}``; fleets must be
+    quant-homogeneous (docs/serving.md "Quantized serving")."""
 
 
 @dataclass
@@ -289,6 +346,60 @@ class SpecConfig:
     draft_model: object
     k: int = 4
     num_pages: Optional[int] = None
+
+
+# the Megatron-placed matmuls a quantized engine converts — exactly the
+# weights serving/submesh.py's placement table shards (embeddings stay
+# full-width: the embed lookup is a gather, not a matmul, and a tied
+# lm_head reuses the embedding so it is excluded with it)
+QUANT_MATMULS = ("q_proj", "k_proj", "v_proj", "o_proj", "gate_proj",
+                 "up_proj", "down_proj", "lm_head")
+
+
+@dataclass
+class QuantServingConfig:
+    """Quantized serving as an ENGINE mode (ISSUE 15 / ROADMAP 2):
+    ``ContinuousBatchingEngine(quant=QuantServingConfig(...))``.
+
+    ``weights``: ``"int8"`` | ``"fp8"`` | None — the Megatron-placed
+    matmul weights (`QUANT_MATMULS`) are converted at engine build to
+    quantized storage + one f32 scale per OUTPUT channel
+    (`ops.quant_matmul.quantize_weight_values`) and consumed by the
+    fused dequant-matmul epilogue (`dequant_matmul_values`; the
+    per-channel scale multiplies the f32 accumulator, exact). Under
+    tensor parallelism the scales shard with their out dim. The model
+    OBJECT is untouched — the engine binds `QuantizedWeight` values
+    per dispatch, so replicas sharing one model compose.
+
+    ``kv``: ``"int8"`` | None — the KV page pools store int8 with
+    (P, page_size) f32 per-page-row DEQUANT scales
+    (`ragged_scatter_quantized` quantizes on commit, the ragged
+    kernel dequantizes per page in flight). Half-width pages double
+    concurrent residency and prefix-store warmth per byte and halve
+    migration payloads; per-ROW quantization keeps the bytes
+    path-invariant, so quantized-mode greedy streams stay
+    BIT-IDENTICAL through preemption / failover / migration /
+    quarantine re-serve (values differ from bf16 within a test-pinned
+    logit-error budget). Spec-decode draft pools quantize alongside.
+
+    Requires ``kv_layout="paged"`` + ``attention_impl="ragged"`` (the
+    one dispatch family the quantized page layout threads through).
+    Fleets must be quant-homogeneous: cross-mode migration or spill
+    restore is refused with :class:`QuantMismatch`."""
+
+    weights: Optional[str] = None
+    kv: Optional[str] = None
+
+    def __post_init__(self):
+        if self.weights not in (None, "int8", "fp8"):
+            raise ValueError(
+                f"quant weights {self.weights!r}: int8|fp8|None")
+        if self.kv not in (None, "int8"):
+            raise ValueError(f"quant kv {self.kv!r}: int8|None")
+        if self.weights is None and self.kv is None:
+            raise ValueError(
+                "QuantServingConfig with neither weights nor kv set — "
+                "drop the quant= argument instead")
 
 
 @dataclass
@@ -351,9 +462,21 @@ class ContinuousBatchingEngine:
                               bool]] = None,
                  clock: Optional[Callable[[], float]] = None,
                  spec_decode: Optional[SpecConfig] = None,
-                 submesh=None):
+                 submesh=None,
+                 quant: Optional[QuantServingConfig] = None):
         cfg = model.config
         self.model = model
+        # -- quantized serving (QuantServingConfig docstring) ----------
+        self._quant = quant
+        self._qw_mode = quant.weights if quant is not None else None
+        self._qkv = quant.kv if quant is not None else None
+        if quant is not None and (kv_layout != "paged"
+                                  or attention_impl != "ragged"):
+            raise ValueError(
+                "quant= requires kv_layout='paged' with "
+                "attention_impl='ragged' — the quantized page layout "
+                "and the fused dequant epilogue thread through the "
+                "ragged dispatch family only")
         # -- tensor parallelism (serving/submesh.py, docs/serving.md
         # "Tensor parallelism"): one engine = one GSPMD submesh -------
         # Param/buffer values are device_put onto the submesh per the
@@ -451,14 +574,31 @@ class ContinuousBatchingEngine:
                 raise ValueError("num_pages must be >= 2 (page 0 is "
                                  "reserved)")
             def _pool():
+                pool_dt = jnp.int8 if self._qkv else dt
                 z = jnp.zeros((hk, self.num_pages, self.page_size, hd),
-                              dt)
+                              pool_dt)
                 if self._tp is None:
                     return z
                 # sharded allocator contract: the pool splits on the
                 # KV-head axis, so every page id names tp local shards
                 return jax.device_put(z, self._tp.kv_sharding(hk))
-            self._kv = [(_pool(), _pool()) for _ in range(L)]
+
+            def _spool():
+                # per-page-row dequant scales of a QUANTIZED pool:
+                # head-free (one scale per row, shared by every head),
+                # so they REPLICATE over a TP submesh like the
+                # descriptors
+                z = jnp.zeros((self.num_pages, self.page_size),
+                              jnp.float32)
+                if self._tp is None:
+                    return z
+                return jax.device_put(z, self._tp.replicated())
+
+            if self._qkv:
+                self._kv = [(_pool(), _pool(), _spool(), _spool())
+                            for _ in range(L)]
+            else:
+                self._kv = [(_pool(), _pool()) for _ in range(L)]
             self._bt = np.zeros((self.B, self.pps), np.int32)
             self._free: List[int] = list(range(1, self.num_pages))
             self._slot_pages: List[List[int]] = [[] for _ in range(self.B)]
@@ -609,12 +749,28 @@ class ContinuousBatchingEngine:
                                     or self.B * self.pps + 1)
             def _d_pool():
                 z = jnp.zeros((d_hk, self._d_num_pages, self.page_size,
-                               d_hd), d_dt)
+                               d_hd),
+                              jnp.int8 if self._qkv else d_dt)
                 if self._tp is None:
                     return z
                 return jax.device_put(z, self._tp.kv_sharding(d_hk))
-            self._d_kv = [(_d_pool(), _d_pool())
-                          for _ in range(d_cfg.num_hidden_layers)]
+
+            def _d_spool():
+                z = jnp.zeros((self._d_num_pages, self.page_size),
+                              jnp.float32)
+                if self._tp is None:
+                    return z
+                return jax.device_put(z, self._tp.replicated())
+
+            if self._qkv:
+                # the draft cache rides the same quantized page layout
+                # — draft pools are the other half of the KV byte bill
+                self._d_kv = [(_d_pool(), _d_pool(), _d_spool(),
+                               _d_spool())
+                              for _ in range(d_cfg.num_hidden_layers)]
+            else:
+                self._d_kv = [(_d_pool(), _d_pool())
+                              for _ in range(d_cfg.num_hidden_layers)]
             self._d_bt = np.zeros((self.B, self.pps), np.int32)
             self._d_free: List[int] = list(range(1, self._d_num_pages))
             self._d_slot_pages: List[List[int]] = \
@@ -642,6 +798,51 @@ class ContinuousBatchingEngine:
             from ..ops import on_tpu
             self._verify_block_q = self._ragged_block_q if on_tpu() \
                 else self._spec_k + 1
+        # -- quantized weights (QuantServingConfig docstring) ----------
+        self._qpv = None
+        if self._qw_mode is not None:
+            self._qpv = self._build_quant_weights()
+        if self._qkv:
+            L_, hk_, hd_, dt_ = self._kv_shape
+            _M_QUANT_PAGE_BYTES.set(
+                self.page_size * hk_ * hd_ * 2 * L_      # int8 storage
+                + self.page_size * 4 * 2 * L_)           # f32 scales
+
+    def _build_quant_weights(self):
+        """Quantize the Megatron-placed matmul weights once at engine
+        build: the dispatch param list swaps each converted weight's
+        value for a `QuantizedWeight` (int8/fp8 storage + per-OUT-
+        channel f32 scale) that `nn.functional.linear` routes through
+        the fused dequant-matmul epilogue. The model object is never
+        mutated. Under TP the storage takes the weight's own placement
+        and the scale shards WITH ITS OUT DIM (a column-sharded weight
+        owns a slice of output channels; each shard dequantizes with
+        exactly its channels' scales)."""
+        from ..ops.quant_matmul import (QuantizedWeight,
+                                        quantize_weight_values)
+        names = {id(p): nm for nm, p in self.model.named_parameters()}
+        base = self._tp_pv if self._tp is not None \
+            else [p._value for p in self._params]
+        out, n_q, n_bytes = [], 0, 0
+        for p, bv in zip(self._params, base):
+            nm = names.get(id(p), "").lower()
+            if p._value.ndim != 2 \
+                    or not any(k in nm for k in QUANT_MATMULS):
+                out.append(bv)
+                continue
+            qw, sc = quantize_weight_values(p._value, self._qw_mode)
+            if self._tp is not None:
+                spec = self._tp._param_spec(nm, p._value.shape)
+                qw = jax.device_put(qw, self._tp.sharding(*spec))
+                out_ax = spec[1] if len(spec) > 1 else None
+                sc = jax.device_put(sc, self._tp.sharding(out_ax))
+            w = QuantizedWeight(qw, sc)
+            n_q += 1
+            n_bytes += w.nbytes
+            out.append(w)
+        _M_QUANT_WEIGHT_LAYERS.set(n_q)
+        _M_QUANT_WEIGHT_BYTES.set(n_bytes)
+        return out
 
     # -- public API ----------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32,
@@ -854,7 +1055,8 @@ class ContinuousBatchingEngine:
         live = sorted({p for pages in self._slot_pages for p in pages})
         if not live:
             return
-        kp, vp = self._kv[0]
+        entry = self._kv[0]
+        kp = entry[0]
         idx = np.asarray(live, np.int32)
         sub = np.asarray(kp[:, idx])
         mut = fault_value("serving.kv_page", sub, tag=self.fault_tag)
@@ -867,7 +1069,10 @@ class ContinuousBatchingEngine:
             # eager scatter above may have resolved to replicated
             new_kp = jax.device_put(new_kp,
                                     self._tp.kv_sharding(kp.shape[0]))
-        self._kv[0] = (new_kp, vp)
+        # quantized engines keep their scale pools untouched: the
+        # damage lands in the int8 lattice bytes (a flipped high bit
+        # is a sign/magnitude flip after dequant — same loudness)
+        self._kv[0] = (new_kp,) + tuple(entry[1:])
 
     # -- migration hooks (serving/transfer.py, disaggregated fleets) ----
     def _resident_slot(self, rid: int) -> int:
@@ -895,8 +1100,15 @@ class ContinuousBatchingEngine:
         n_idx = int(self._slot_next_idx[slot])
         pages = np.asarray(self._bt[slot, freed:n_idx], np.int32)
         L, hk, hd, dt = self._kv_shape
+        pool_dt = jnp.int8 if self._qkv else dt
         now = self._clock()
         kv, kv_shards, n_tp = None, None, 1
+        kv_scales = None
+        if self._qkv:
+            # per-page scale rows ride the payload once (head-free, so
+            # replicated across TP shards — no fragments to assemble)
+            kv_scales = [(np.asarray(e[2][pages]),
+                          np.asarray(e[3][pages])) for e in self._kv]
         if self._tp is not None and self._tp.tp > 1:
             # tensor-parallel source: serialize one payload FRAGMENT
             # per shard — each `shard.data[:, pages]` gather runs on
@@ -905,9 +1117,9 @@ class ContinuousBatchingEngine:
             # is the fragments; `assemble_payload_kv` is the
             # consumer-side logical view)
             from ..serving import submesh as tp_mod
-            per_layer = [(tp_mod.kv_fragments(kp, pages),
-                          tp_mod.kv_fragments(vp, pages))
-                         for kp, vp in self._kv]
+            per_layer = [(tp_mod.kv_fragments(e[0], pages),
+                          tp_mod.kv_fragments(e[1], pages))
+                         for e in self._kv]
             n_tp = len(per_layer[0][0])
             kv_shards = [[(kf[s], vf[s]) for kf, vf in per_layer]
                          for s in range(n_tp)]
@@ -915,9 +1127,10 @@ class ContinuousBatchingEngine:
                 [sum(k.nbytes + v.nbytes for k, v in shard)
                  for shard in kv_shards])
         else:
-            kv = [(np.asarray(kp[:, pages]), np.asarray(vp[:, pages]))
-                  for kp, vp in self._kv]
-        payload_kv = {"kv": kv, "kv_shards": kv_shards}
+            kv = [(np.asarray(e[0][:, pages]), np.asarray(e[1][:, pages]))
+                  for e in self._kv]
+        payload_kv = {"kv": kv, "kv_shards": kv_shards,
+                      "kv_scales": kv_scales}
         return {
             "request_id": req.request_id,
             "prompt": list(req.prompt),
@@ -938,13 +1151,20 @@ class ContinuousBatchingEngine:
             "n_pages": int(n_idx - freed),
             "page_size": self.page_size,
             "max_seq_len": self.S,
-            "kv_spec": (L, hk, hd, str(jnp.dtype(dt))),
+            "kv_spec": (L, hk, hd, str(jnp.dtype(pool_dt))),
             "kv": kv,
             "kv_shards": kv_shards,
+            # quantized serving: int8 page bytes + per-page scale rows
+            # + the mode tag import_pages refuses cross-mode on
+            "kv_scales": kv_scales,
+            "kv_quant": self._qkv,
             # integrity manifest (ISSUE 13): sha256 per shard fragment
             # — import_pages verifies BEFORE install, so in-flight
-            # corruption is a counted refusal, not silent garbage KV
+            # corruption is a counted refusal, not silent garbage KV.
+            # Quantized payloads manifest their scale rows too: the
+            # hashes cover exactly the bytes that cross the wire.
             "kv_sha256": payload_checksums(payload_kv),
+            "scales_sha256": payload_scale_checksums(payload_kv),
             "tp": n_tp,
         }
 
@@ -965,9 +1185,21 @@ class ContinuousBatchingEngine:
         capacity deferrals, distinct from transfer failures."""
         if self.layout != "paged":
             raise ValueError("import_pages requires the paged layout")
+        pq = payload.get("kv_quant")
+        if pq != self._qkv:
+            # cross-mode pages are not interpretable on the other
+            # side; refusing here (typed, counted) is what keeps a
+            # mixed fleet from silently corrupting a pool
+            _M_QUANT_MISMATCH.inc(kind="import")
+            raise QuantMismatch(
+                f"cross-quant-mode migration refused: payload KV is "
+                f"{pq or 'full-width'}, this engine serves "
+                f"{self._qkv or 'full-width'} pages — fleets must be "
+                "quant-homogeneous")
         L, hk, hd, dt = self._kv_shape
+        pool_dt = jnp.int8 if self._qkv else dt
         spec = tuple(payload["kv_spec"])
-        mine = (L, hk, hd, str(jnp.dtype(dt)))
+        mine = (L, hk, hd, str(jnp.dtype(pool_dt)))
         if spec != mine:
             raise ValueError(f"kv geometry mismatch: payload {spec} vs "
                              f"engine {mine}")
@@ -1061,9 +1293,14 @@ class ContinuousBatchingEngine:
             # own shards inside _install_kv — which is what makes
             # cross-tp migration (tp=2 source -> tp=4 target) legal:
             # the LOGICAL kv geometry is what the spec check compares
+            scale_rows = None
+            if self._qkv:
+                scale_rows = [(ks[off:], vs[off:])
+                              for ks, vs in payload["kv_scales"]]
             self._install_kv(ids, [(kp[:, off:], vp[:, off:])
                                    for kp, vp in
-                                   assemble_payload_kv(payload)])
+                                   assemble_payload_kv(payload)],
+                             scale_rows)
             if self._prefix_enabled and not freed:
                 self._register_prefix(slot, req)
             if shared:
@@ -1097,7 +1334,7 @@ class ContinuousBatchingEngine:
         raise ValueError(f"no live request with rid {rid}")
 
     def import_prefix(self, pages_tokens: List[List[int]],
-                      kv_rows) -> int:
+                      kv_rows, kv_scales=None) -> int:
         """Install an externally-held prefix chain (the fleet prefix
         store's host-RAM spill, serving/prefix_store.py) into this
         engine's prefix cache: `pages_tokens` is a list of FULL-page
@@ -1114,9 +1351,20 @@ class ContinuousBatchingEngine:
         (an eviction between registrations could delete a node the
         chain under construction already linked through). Returns the
         pages newly installed (0 when prefix caching is off, the
-        chain is already resident, or the pool has nothing free)."""
+        chain is already resident, or the pool has nothing free).
+        Quantized engines require `kv_scales` (per-layer (k_scale,
+        v_scale) rows of the quantized chain, shaped (n, page_size));
+        a cross-mode chain is refused with :class:`QuantMismatch` —
+        the spilled bytes are only interpretable in their own mode."""
         if self.layout != "paged" or not self._prefix_enabled:
             return 0
+        if (kv_scales is None) == bool(self._qkv):
+            _M_QUANT_MISMATCH.inc(kind="prefix")
+            raise QuantMismatch(
+                f"cross-quant-mode prefix install refused: chain is "
+                f"{'quantized' if kv_scales is not None else 'full-width'}"
+                f", this engine serves "
+                f"{self._qkv or 'full-width'} pages")
         parent, missing_from = None, None
         for f, ptoks in enumerate(pages_tokens):
             if len(ptoks) != self.page_size:
@@ -1150,7 +1398,10 @@ class ContinuousBatchingEngine:
             self._install_kv(
                 page_ids, [(kp[:, missing_from:end],
                             vp[:, missing_from:end])
-                           for kp, vp in kv_rows])
+                           for kp, vp in kv_rows],
+                None if kv_scales is None else
+                [(ks[missing_from:end], vs[missing_from:end])
+                 for ks, vs in kv_scales])
         # entry-budget cap AFTER content lands: an eviction here can
         # only take a fully-installed, consistent node
         while len(self._prefix_nodes) > self._max_prefix_entries:
@@ -1158,14 +1409,28 @@ class ContinuousBatchingEngine:
                 break
         return len(page_ids)
 
-    def _install_kv(self, page_ids: List[int], rows):
+    def _install_kv(self, page_ids: List[int], rows, scale_rows=None):
         """Write transferred page contents into the pool — one donated
         program per page count, LRU-capped like the scatter programs
-        (migration imports + prefix-store spill restores land here)."""
+        (migration imports + prefix-store spill restores land here).
+        Quantized engines additionally install each page's per-row
+        dequant scales (`scale_rows`: one (k_scale, v_scale) pair of
+        (n_pages, page_size) arrays per layer) — the quantized BYTES
+        move verbatim, never re-quantized, which is what keeps
+        migrated streams bit-identical."""
         n = len(page_ids)
+        quant = bool(self._qkv)
         jit = self._install_jits.get(n)
         if jit is None:
-            def _ins(kv, ids_, rows_):
+            def _ins(kv, ids_, rows_, srows_):
+                if quant:
+                    return [
+                        (kp.at[:, ids_].set(rk.astype(kp.dtype)),
+                         vp.at[:, ids_].set(rv.astype(vp.dtype)),
+                         ks.at[ids_].set(sk.astype(ks.dtype)),
+                         vs.at[ids_].set(sv.astype(vs.dtype)))
+                        for (kp, vp, ks, vs), (rk, rv), (sk, sv)
+                        in zip(kv, rows_, srows_)]
                 return [(kp.at[:, ids_].set(rk.astype(kp.dtype)),
                          vp.at[:, ids_].set(rv.astype(vp.dtype)))
                         for (kp, vp), (rk, rv) in zip(kv, rows_)]
@@ -1183,13 +1448,20 @@ class ContinuousBatchingEngine:
             rows_dev = [(jax.device_put(np.asarray(rk), sh),
                          jax.device_put(np.asarray(rv), sh))
                         for rk, rv in rows]
+            srows_dev = None if scale_rows is None else [
+                (jax.device_put(np.asarray(sk), self._tp.replicated()),
+                 jax.device_put(np.asarray(sv), self._tp.replicated()))
+                for sk, sv in scale_rows]
         else:
             rows_dev = [(jnp.asarray(rk), jnp.asarray(rv))
                         for rk, rv in rows]
+            srows_dev = None if scale_rows is None else [
+                (jnp.asarray(sk), jnp.asarray(sv))
+                for sk, sv in scale_rows]
         with self._tp_scope():
             self._kv = jit(self._kv,
                            jnp.asarray(np.asarray(page_ids, np.int32)),
-                           rows_dev)
+                           rows_dev, srows_dev)
 
     def _expire(self) -> List[Request]:
         """Monotonic-clock tick: finalize queued/running requests whose
@@ -1233,10 +1505,19 @@ class ContinuousBatchingEngine:
             total = self.B * self.S * hk * hd * itemsize * 2 * L
             return {"layout": "dense", "bytes_pool": total,
                     "bytes_in_use": total, "utilization": 1.0}
-        page_bytes = self.page_size * hk * hd * itemsize * 2 * L
+        if self._qkv:
+            # int8 storage + (page_size,) f32 scale rows per page per
+            # pool — the HONEST per-page bill the residency A/B in
+            # bench.py divides fixed pool bytes by
+            itemsize = 1
+            page_bytes = self.page_size * hk * hd * itemsize * 2 * L \
+                + self.page_size * 4 * 2 * L
+        else:
+            page_bytes = self.page_size * hk * hd * itemsize * 2 * L
         usable = self.num_pages - 1
         in_use = usable - len(self._free)
         info = {"layout": "paged", "page_bytes": page_bytes,
+                "kv_quant": self._qkv,
                 "total_pages": usable, "pages_in_use": in_use,
                 "bytes_pool": self.num_pages * page_bytes,
                 "bytes_in_use": in_use * page_bytes,
@@ -1348,8 +1629,16 @@ class ContinuousBatchingEngine:
 
         def _check_pools(pools, hk, label):
             want_spec = _norm(self._tp.kv_sharding(hk).spec)
-            for li, (kp, vp) in enumerate(pools):
-                for nm, arr in (("k", kp), ("v", vp)):
+            for li, e in enumerate(pools):
+                pairs = [("k", e[0], want_spec), ("v", e[1], want_spec)]
+                if len(e) == 4:
+                    # quantized pools: the scale pools are declared
+                    # REPLICATED (head-free) — a sharded scale pool
+                    # would dequantize different heads with different
+                    # factors, silent corruption by construction
+                    pairs += [("k-scale", e[2], ()),
+                              ("v-scale", e[3], ())]
+                for nm, arr, wspec in pairs:
                     got = set(arr.sharding.device_set)
                     if got != want:
                         errs.append(
@@ -1358,10 +1647,10 @@ class ContinuousBatchingEngine:
                             f"{sorted(d.id for d in got)}, expected "
                             f"{sorted(d.id for d in want)}")
                     spec = getattr(arr.sharding, "spec", None)
-                    if spec is not None and _norm(spec) != want_spec:
+                    if spec is not None and _norm(spec) != wspec:
                         errs.append(
                             f"layer {li} {label}{nm}-pool resharded: "
-                            f"spec {spec} != declared {want_spec}")
+                            f"spec {spec} != declared {wspec}")
 
         _check_pools(self._kv, self.model.config.num_key_value_heads,
                      "")
@@ -1903,8 +2192,13 @@ class ContinuousBatchingEngine:
 
     # -- tensor parallelism plumbing (serving/submesh.py) --------------
     def _pv(self):
-        """Target param VALUES for a dispatch: the submesh-placed
-        copies under TP, the live model values otherwise."""
+        """Target param VALUES for a dispatch: the quantized list when
+        the engine runs quantized weights (converted matmuls carry
+        `QuantizedWeight` values the model's linears dequantize in the
+        matmul epilogue), else the submesh-placed copies under TP,
+        else the live model values."""
+        if self._qpv is not None:
+            return self._qpv
         if self._tp is not None:
             return self._tp_pv
         return [p._value for p in self._params]
@@ -2004,16 +2298,18 @@ class ContinuousBatchingEngine:
         strat, temp = self.strategy, self.temperature
         tk, tp = self.top_k, self.top_p
         view_tp = self._view_tp(draft=draft)
+        qkv = bool(self._qkv)
 
         def run(pv, bv, kv, ids, tok_seq, qpos, qstart, qlen, ctx, bt,
                 sample_rows, key):
             from .generation import bind_state, _sample_token
             from .llama import RaggedKVCacheView
             with bind_state(params, buffers, pv, bv), no_grad():
-                views = [RaggedKVCacheView(kp, vp, bt, tok_seq, qpos,
-                                           qstart, qlen, ctx, block_q,
-                                           pages_bound, tp=view_tp)
-                         for kp, vp in kv]
+                views = [RaggedKVCacheView(
+                    e[0], e[1], bt, tok_seq, qpos, qstart, qlen, ctx,
+                    block_q, pages_bound, tp=view_tp,
+                    k_scale=e[2] if qkv else None,
+                    v_scale=e[3] if qkv else None) for e in kv]
                 logits, new = model.forward(
                     Tensor(ids[None]), past_key_values=views,
                     use_cache=True)
@@ -2022,8 +2318,11 @@ class ContinuousBatchingEngine:
                     rows = rows[jnp.clip(sample_rows, 0,
                                          rows.shape[0] - 1)]
                 nxt, _ = _sample_token(rows, key, strat, temp, tk, tp)
-                kv_out = [(v.k_pages._value, v.v_pages._value)
-                          for v in new]
+                kv_out = [
+                    (v.k_pages._value, v.v_pages._value,
+                     v.k_scale._value, v.v_scale._value) if qkv
+                    else (v.k_pages._value, v.v_pages._value)
+                    for v in new]
                 if return_logits:
                     return nxt, rows, kv_out
                 return nxt, kv_out
@@ -2815,6 +3114,7 @@ class ContinuousBatchingEngine:
         K, B, S = self._spec_k, self.B, self.S
 
         view_tp = self._view_tp(draft=True)
+        qkv = bool(self._qkv)
 
         def run(pv, bv, kv, tok, pos0, live, bt):
             from .generation import bind_state
@@ -2828,10 +3128,11 @@ class ContinuousBatchingEngine:
                     posv = jnp.minimum(pos0 + step, S - 1)
                     seq = jnp.where(ok, bidx, -1)
                     qlen = ok.astype(jnp.int32)
-                    views = [RaggedKVCacheView(kp, vp, bt, seq, posv,
-                                               bidx, qlen, posv + 1, 1,
-                                               tp=view_tp)
-                             for kp, vp in kv]
+                    views = [RaggedKVCacheView(
+                        e[0], e[1], bt, seq, posv, bidx, qlen,
+                        posv + 1, 1, tp=view_tp,
+                        k_scale=e[2] if qkv else None,
+                        v_scale=e[3] if qkv else None) for e in kv]
                     logits, new = model.forward(
                         Tensor(tok[None]), past_key_values=views,
                         use_cache=True)
@@ -2840,8 +3141,11 @@ class ContinuousBatchingEngine:
                     nxt = jnp.argmax(
                         logits._value[0].astype(jnp.float32),
                         -1).astype(jnp.int32)
-                    new_kv = [(v.k_pages._value, v.v_pages._value)
-                              for v in new]
+                    new_kv = [
+                        (v.k_pages._value, v.v_pages._value,
+                         v.k_scale._value, v.v_scale._value) if qkv
+                        else (v.k_pages._value, v.v_pages._value)
+                        for v in new]
                     return (new_kv, nxt), nxt
 
                 (kv, _), props = jax.lax.scan(

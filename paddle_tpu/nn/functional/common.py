@@ -21,7 +21,37 @@ def cast(x, dtype):
 
 def linear(x, weight, bias=None, name=None):
     """y = x @ W + b with W shaped (in, out) — reference convention
-    («paddle/phi/kernels/.../matmul» consumers [U]). Single XLA dot."""
+    («paddle/phi/kernels/.../matmul» consumers [U]). Single XLA dot.
+
+    Quantized serving (docs/serving.md "Quantized serving"): when the
+    weight's bound value is an `ops.quant_matmul.QuantizedWeight` —
+    the engine's `bind_state` installs one per quantized matmul
+    parameter — the dot runs through the fused dequant-matmul epilogue
+    instead (int8/fp8 storage, per-out-channel scale on the
+    accumulator); the model code calling this never forks."""
+    wv = getattr(weight, "_value", None)
+    if wv is not None and type(wv).__name__ == "QuantizedWeight":
+        # cheap name pre-filter keeps the lazy import off the ordinary
+        # (unquantized) path; the isinstance makes the dispatch exact
+        from paddle_tpu.ops.quant_matmul import (QuantizedWeight,
+                                                 dequant_matmul_values)
+        if not isinstance(wv, QuantizedWeight):
+            raise TypeError(
+                "weight value is named QuantizedWeight but is not "
+                "ops.quant_matmul.QuantizedWeight — refusing to guess "
+                "a dequant layout")
+        # qw/scale are traced values of the SAME program trace (they
+        # arrived through the dispatch's bound param list); only the
+        # activation (and bias) flow through the tape
+        if bias is not None:
+            return apply(
+                "dequant_linear",
+                lambda v, b: dequant_matmul_values(v, wv.qw, wv.scale)
+                + b, (_t(x), _t(bias)))
+        return apply("dequant_linear",
+                     lambda v: dequant_matmul_values(v, wv.qw,
+                                                     wv.scale),
+                     (_t(x),))
     if bias is not None:
         return apply("linear", lambda v, w, b: jnp.matmul(v, w) + b,
                      (_t(x), _t(weight), _t(bias)))

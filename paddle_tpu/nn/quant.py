@@ -25,7 +25,8 @@ import jax.numpy as jnp
 from ..core.tensor import Tensor, apply, to_tensor
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
-           "llm_int8_linear", "int8_dot", "quantize_activation_dynamic"]
+           "llm_int8_linear", "int8_dot", "quantize_activation_dynamic",
+           "absmax_round_clip_values"]
 
 _Q8 = 127.0
 _Q4 = 7.0
@@ -33,6 +34,28 @@ _Q4 = 7.0
 
 def _t(x):
     return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def absmax_round_clip_values(v, absmax, qmax, out_dtype=None,
+                             round_fn=jnp.round):
+    """THE absmax round-clip quantization core:
+    ``q = clip(round(v / max(absmax, 1e-9) * qmax), -qmax-1, qmax)``.
+
+    Every quantizer in the repo — `weight_quantize_values`,
+    `quantize_activation_dynamic_values`, `quantization.quantize_linear`,
+    `quantization.fake_quant`, the serving engine's weight and KV-page
+    quantization (`ops/quant_matmul.py`,
+    `ops/ragged_paged_attention.ragged_scatter_quantized`) — routes
+    through this one function, so the rounding mode, the tiny-scale
+    guard, and the asymmetric clip (``-qmax-1`` keeps int8's -128
+    reachable) cannot drift between paths. ``absmax`` broadcasts
+    against ``v``; ``round_fn`` lets QAT substitute the
+    straight-through-estimator round without forking the core;
+    ``out_dtype=None`` returns the float lattice values (fake-quant
+    callers re-scale them)."""
+    s = jnp.maximum(absmax, 1e-9)
+    q = jnp.clip(round_fn(v / s * qmax), -qmax - 1, qmax)
+    return q if out_dtype is None else q.astype(out_dtype)
 
 
 # -- value-level kernels (usable inside shard_map / models) ------------
@@ -53,8 +76,8 @@ def weight_quantize_values(w, algo: str = "weight_only_int8",
     wg = w.reshape(k // g, g, n).astype(jnp.float32)
     scales = jnp.max(jnp.abs(wg), axis=1)                 # (K/g, N)
     scales = jnp.maximum(scales, 1e-9)
-    q = jnp.clip(jnp.round(wg / scales[:, None, :] * qmax),
-                 -qmax - 1, qmax).astype(jnp.int8).reshape(k, n)
+    q = absmax_round_clip_values(wg, scales[:, None, :], qmax,
+                                 out_dtype=jnp.int8).reshape(k, n)
     if bits == 4:
         if k % 2:
             raise ValueError("int4 packing needs even in-features")
@@ -116,8 +139,8 @@ def quantize_activation_dynamic_values(x):
     """Per-tensor dynamic activation quantization (inference): live
     abs-max scale, int8 values. Returns (xq int8, scale fp32)."""
     scale = jnp.maximum(jnp.max(jnp.abs(x)).astype(jnp.float32), 1e-9)
-    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / scale * _Q8),
-                  -128, 127).astype(jnp.int8)
+    xq = absmax_round_clip_values(x.astype(jnp.float32), scale, _Q8,
+                                  out_dtype=jnp.int8)
     return xq, scale
 
 

@@ -39,5 +39,6 @@ from . import flash_varlen  # noqa: F401,E402
 from . import grouped_matmul  # noqa: F401,E402
 from . import norm_kernels  # noqa: F401,E402
 from . import paged_attention  # noqa: F401,E402
+from . import quant_matmul  # noqa: F401,E402
 from . import ragged_paged_attention  # noqa: F401,E402
 from . import rope  # noqa: F401,E402

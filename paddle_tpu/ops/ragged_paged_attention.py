@@ -60,6 +60,7 @@ NEG_INF = -1e30
 LANES = 128
 DEFAULT_BLOCK_Q = 8
 TRASH_PAGE = 0
+KV_QMAX = 127.0     # int8 absmax lattice of a quantized KV page row
 
 
 def _interpret() -> bool:
@@ -154,6 +155,41 @@ def token_arrays(query_start, query_len, context_len, total_rows):
 # ---------------------------------------------------------------------------
 # shared masked-attention core (also backs paged_attention._paged_xla)
 # ---------------------------------------------------------------------------
+def page_gather_bound(block_tables, context_lens, pages_bound,
+                      page_size) -> int:
+    """STATIC column bound of a block-table gather: ``pages_bound``
+    when the (traced) caller supplied one, else the concrete-context
+    trim ``ceil(max(ctx) / page_size)``, else the full table. Shared
+    by the page gather and the quantized-page SCALE gather so the two
+    can never trim differently."""
+    pps = block_tables.shape[1]
+    if pages_bound is not None:
+        return max(1, min(int(pages_bound), pps))
+    if context_lens is not None:
+        try:
+            # concrete (host/eager) context lengths: trim statically;
+            # traced ones raise TracerArrayConversionError and keep the
+            # full table (the compiled-engine case, where the bound is
+            # the slot reservation anyway)
+            ctx_np = np.asarray(context_lens)
+        except Exception:
+            ctx_np = None
+        if ctx_np is not None and ctx_np.size:
+            max_ctx = int(np.max(ctx_np))
+            return max(1, min(-(-max_ctx // page_size), pps))
+    return pps
+
+
+def gather_page_scales(scale_pool, block_tables, bound):
+    """Gather a per-page scale pool (P, page_size) along the first
+    `bound` block-table columns to per-sequence dense rows (N, S) —
+    the XLA oracle's dequant companion of `gather_pages` (same bound,
+    same row order)."""
+    bt = block_tables[:, :bound]
+    sg = scale_pool[bt]                       # (N, bound, page_size)
+    return sg.reshape(bt.shape[0], bound * scale_pool.shape[1])
+
+
 def gather_pages(k_pages, v_pages, block_tables, context_lens=None,
                  pages_bound=None):
     """Gather block-table pages to per-sequence contiguous caches
@@ -165,21 +201,8 @@ def gather_pages(k_pages, v_pages, block_tables, context_lens=None,
     explicitly (traced callers that know a static bound)."""
     page_size = k_pages.shape[2]
     pps = block_tables.shape[1]
-    bound = pps
-    if pages_bound is not None:
-        bound = max(1, min(int(pages_bound), pps))
-    elif context_lens is not None:
-        try:
-            # concrete (host/eager) context lengths: trim statically;
-            # traced ones raise TracerArrayConversionError and keep the
-            # full table (the compiled-engine case, where the bound is
-            # the slot reservation anyway)
-            ctx_np = np.asarray(context_lens)
-        except Exception:
-            ctx_np = None
-        if ctx_np is not None and ctx_np.size:
-            max_ctx = int(np.max(ctx_np))
-            bound = max(1, min(-(-max_ctx // page_size), pps))
+    bound = page_gather_bound(block_tables, context_lens, pages_bound,
+                              page_size)
     bt = block_tables[:, :bound]
     n = bt.shape[0]
     kg = jnp.transpose(k_pages[:, bt], (1, 2, 3, 0, 4))
@@ -216,12 +239,16 @@ def masked_page_attention(q, kc, vc, q_positions, context_lens, scale,
 
 
 def _ragged_xla(q, k_pages, v_pages, query_start, query_len, context_len,
-                block_tables, scale, window=None, pages_bound=None):
+                block_tables, scale, window=None, pages_bound=None,
+                k_scale=None, v_scale=None):
     """Reference/CI path: bounded page gather + the shared masked core.
     Semantically identical to the kernel; padding rows output zero.
     ``pages_bound`` is the TRACED caller's static trim (the engine
     passes its batch's max reserved page count — context lengths are
-    tracers there, so the concrete-trim path cannot fire)."""
+    tracers there, so the concrete-trim path cannot fire).
+    ``k_scale``/``v_scale`` (P, page_size) dequantize int8 page pools
+    per row right after the gather, so the masked core itself stays
+    dtype-oblivious."""
     t, h, d = q.shape
     hk = k_pages.shape[0]
     g = h // hk
@@ -229,6 +256,13 @@ def _ragged_xla(q, k_pages, v_pages, query_start, query_len, context_len,
     kc, vc = gather_pages(k_pages, v_pages, block_tables,
                           context_lens=context_len,
                           pages_bound=pages_bound)
+    if k_scale is not None:
+        bound = page_gather_bound(block_tables, context_len,
+                                  pages_bound, k_pages.shape[2])
+        ks = gather_page_scales(k_scale, block_tables, bound)  # (N, S)
+        vs = gather_page_scales(v_scale, block_tables, bound)
+        kc = kc.astype(jnp.float32) * ks[:, :, None, None]
+        vc = vc.astype(jnp.float32) * vs[:, :, None, None]
     # post-trim: normalize descriptors to device arrays (a numpy base
     # indexed by a traced index array would not convert)
     query_start = jnp.asarray(query_start, jnp.int32)
@@ -248,16 +282,33 @@ def _ragged_xla(q, k_pages, v_pages, query_start, query_len, context_len,
     out = masked_page_attention(qh, kc[tok_seq], vc[tok_seq],
                                 jnp.where(live, tok_pos, -1), tok_ctx,
                                 scale, window)
-    return out.reshape(t, h, d)
+    # quantized pools dequantized kc/vc to f32 above; match the kernel
+    # path's contract (output in q's dtype) on every route
+    return out.reshape(t, h, d).astype(q.dtype)
 
 
 # ---------------------------------------------------------------------------
 # Pallas kernel
 # ---------------------------------------------------------------------------
 def _ragged_kernel(qb_seq_ref, qstart_ref, qlen_ref, ctx_ref, bt_ref,
-                   q_ref, k_ref, v_ref, o_ref,
-                   acc_ref, m_ref, l_ref, *, scale, page_size, block_q,
-                   group, window):
+                   q_ref, k_ref, v_ref, *rest, scale, page_size,
+                   block_q, group, window, quantized=False):
+    # quantized page pools (int8 storage) add two (1, page_size) f32
+    # per-page-row scale blocks; the dequant folds into the existing
+    # multiplies — logits scale per KEY row (columns of sim), the p@v
+    # weights scale per VALUE row (columns of p) — so the int8 tiles
+    # feed the MXU unwidened in HBM and no transposed broadcast is
+    # ever materialized
+    if quantized:
+        ks3_ref, vs3_ref, o_ref, acc_ref, m_ref, l_ref = rest
+        # (1, 1, page_size) blocks of the (P, 1, page_size) pools —
+        # the middle unit axis exists purely so the block's last two
+        # dims equal the array's (the Mosaic block-shape rule); drop
+        # it to the (1, page_size) row the broadcasts below want
+        ks_ref = ks3_ref[0]
+        vs_ref = vs3_ref[0]
+    else:
+        o_ref, acc_ref, m_ref, l_ref = rest
     qb = pl.program_id(0)
     i = pl.program_id(2)
     n_pages = pl.num_programs(2)
@@ -287,6 +338,9 @@ def _ragged_kernel(qb_seq_ref, qstart_ref, qlen_ref, ctx_ref, bt_ref,
         sim = mxu_dot(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        if quantized:
+            # per-key-row dequant: sim[r, j] owes one factor ks[j]
+            sim = sim * ks_ref[:]                    # (1, ps) bcast
         kpos = i * page_size + jax.lax.broadcasted_iota(
             jnp.int32, sim.shape, 1)
         row = jax.lax.broadcasted_iota(jnp.int32, sim.shape, 0) // group
@@ -301,8 +355,9 @@ def _ragged_kernel(qb_seq_ref, qstart_ref, qlen_ref, ctx_ref, bt_ref,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.where(sim > NEG_INF * 0.5, jnp.exp(sim - m_new), 0.0)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, -1, keepdims=True)
+        pv = p * vs_ref[:] if quantized else p       # value-row dequant
         acc_ref[:] = acc_ref[:] * alpha + mxu_dot(
-            p, v, (((1,), (0,)), ((), ())),
+            pv, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -333,14 +388,29 @@ def _page_index_map(qb, hh, ii, qb_seq, qstart, qlen, ctx, bt, *,
     return (hh, jnp.where(live, bt[sc, ii], TRASH_PAGE), 0, 0)
 
 
+def _scale_index_map(qb, hh, ii, qb_seq, qstart, qlen, ctx, bt, *,
+                     page_size, block_q, window):
+    """Index map for the (P, 1, page_size) per-page scale pools of a
+    QUANTIZED page pool: EXACTLY the page index map's live/dead
+    routing (delegated, so the two can never drift — a scale routed
+    to a different page than its values would be silent
+    mis-dequantization), minus the head dim the scale pools do not
+    have. Dead pages ride the trash page's scales; their logits are
+    fully masked anyway."""
+    return _page_index_map(qb, hh, ii, qb_seq, qstart, qlen, ctx, bt,
+                           page_size=page_size, block_q=block_q,
+                           window=window)[1:]
+
+
 def _ragged_pallas(q, k_pages, v_pages, query_start, query_len,
                    context_len, block_tables, scale, window, block_q,
-                   interpret):
+                   interpret, k_scale=None, v_scale=None):
     t, h, d = q.shape
     hk, _, page_size, _ = k_pages.shape
     g = h // hk
     n = block_tables.shape[0]
     pps = block_tables.shape[1]
+    quantized = k_scale is not None
     nqb = t // block_q
     # q block qb -> owning sequence (padding blocks: -1); every block
     # belongs to at most one sequence because starts are block-aligned
@@ -354,19 +424,30 @@ def _ragged_pallas(q, k_pages, v_pages, query_start, query_len,
     qk = jnp.transpose(q.reshape(t, hk, g, d), (1, 0, 2, 3))
     qk = qk.reshape(hk, nqb, block_q * g, d)
 
+    page_map = functools.partial(
+        _page_index_map, page_size=page_size, block_q=block_q,
+        window=window)
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q * g, d),
+                     lambda qb, hh, ii, *refs: (hh, qb, 0, 0)),
+        pl.BlockSpec((1, 1, page_size, d), page_map),
+        pl.BlockSpec((1, 1, page_size, d), page_map),
+    ]
+    inputs = [qk, k_pages, v_pages]
+    if quantized:
+        scale_map = functools.partial(
+            _scale_index_map, page_size=page_size, block_q=block_q,
+            window=window)
+        # (P, ps) -> (P, 1, ps): the unit middle axis makes the block's
+        # last two dims equal the array's (the Mosaic block rule — a
+        # (1, ps) block of a (P, ps) array has an undividable sublane)
+        in_specs += [pl.BlockSpec((1, 1, page_size), scale_map),
+                     pl.BlockSpec((1, 1, page_size), scale_map)]
+        inputs += [k_scale[:, None, :], v_scale[:, None, :]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(nqb, hk, pps),
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q * g, d),
-                         lambda qb, hh, ii, *refs: (hh, qb, 0, 0)),
-            pl.BlockSpec((1, 1, page_size, d), functools.partial(
-                _page_index_map, page_size=page_size, block_q=block_q,
-                window=window)),
-            pl.BlockSpec((1, 1, page_size, d), functools.partial(
-                _page_index_map, page_size=page_size, block_q=block_q,
-                window=window)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, block_q * g, d),
                                lambda qb, hh, ii, *refs: (hh, qb, 0, 0)),
         scratch_shapes=[
@@ -375,24 +456,26 @@ def _ragged_pallas(q, k_pages, v_pages, query_start, query_len,
             pltpu.VMEM((block_q * g, LANES), jnp.float32),
         ],
     )
+    out_dtype = q.dtype
     out = pl.pallas_call(
         functools.partial(_ragged_kernel, scale=scale,
                           page_size=page_size, block_q=block_q, group=g,
-                          window=window),
+                          window=window, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((hk, nqb, block_q * g, d),
-                                       q.dtype),
+                                       out_dtype),
         interpret=interpret,
     )(qb_seq, query_start.astype(jnp.int32),
       query_len.astype(jnp.int32), context_len.astype(jnp.int32),
-      block_tables.astype(jnp.int32), qk, k_pages, v_pages)
+      block_tables.astype(jnp.int32), *inputs)
     out = out.reshape(hk, nqb, block_q, g, d)
     return jnp.transpose(out, (1, 2, 0, 3, 4)).reshape(t, h, d)
 
 
 def _ragged_tp_shard_map(q, k_pages, v_pages, query_start, query_len,
                          context_len, block_tables, scale, window,
-                         block_q, interpret, tp):
+                         block_q, interpret, tp, k_scale=None,
+                         v_scale=None):
     """The Pallas kernel under tensor parallelism (serving/submesh.py):
     heads are data-parallel in attention, so each TP shard runs the
     UNCHANGED kernel over its local (H/tp, HK/tp) heads via shard_map —
@@ -408,23 +491,35 @@ def _ragged_tp_shard_map(q, k_pages, v_pages, query_start, query_len,
         from jax.experimental.shard_map import shard_map
     mesh, axis = tp
     P = jax.sharding.PartitionSpec
+    quantized = k_scale is not None
 
-    def local(qq, kp, vp, qs, ql, cl, bt):
+    def local(qq, kp, vp, qs, ql, cl, bt, *scales):
+        ks, vs = scales if quantized else (None, None)
         return _ragged_pallas(qq, kp, vp, qs, ql, cl, bt, scale,
-                              window, block_q, interpret)
+                              window, block_q, interpret,
+                              k_scale=ks, v_scale=vs)
 
+    in_specs = (P(None, axis, None), P(axis, None, None, None),
+                P(axis, None, None, None), P(), P(), P(), P())
+    args = (q, k_pages, v_pages, query_start.astype(jnp.int32),
+            query_len.astype(jnp.int32), context_len.astype(jnp.int32),
+            block_tables.astype(jnp.int32))
+    if quantized:
+        # per-page scales are head-free (one scale per page row,
+        # shared by every head): replicated in-spec like the
+        # descriptors, so each shard dequantizes its local heads with
+        # the identical factors
+        in_specs = in_specs + (P(), P())
+        args = args + (k_scale, v_scale)
     return shard_map(
         local, mesh=mesh,
-        in_specs=(P(None, axis, None), P(axis, None, None, None),
-                  P(axis, None, None, None), P(), P(), P(), P()),
+        in_specs=in_specs,
         out_specs=P(None, axis, None),
         # pallas_call has no replication rule; the specs above are
         # exact (descriptors replicated in, heads sharded out), so
         # skipping the rep check loses nothing
         check_rep=False,
-    )(q, k_pages, v_pages, query_start.astype(jnp.int32),
-      query_len.astype(jnp.int32), context_len.astype(jnp.int32),
-      block_tables.astype(jnp.int32))
+    )(*args)
 
 
 def ragged_paged_attention_values(q, k_pages, v_pages, query_start,
@@ -432,7 +527,7 @@ def ragged_paged_attention_values(q, k_pages, v_pages, query_start,
                                   scale=None, window=None,
                                   block_q=DEFAULT_BLOCK_Q,
                                   use_kernel=None, pages_bound=None,
-                                  tp=None):
+                                  tp=None, k_scale=None, v_scale=None):
     """q: (T, H, D) packed ragged queries; k_pages/v_pages:
     (HK, P, page_size, D); query_start/query_len/context_len: (N,)
     int32 per-sequence descriptors; block_tables: (N, pages_per_seq)
@@ -456,7 +551,17 @@ def ragged_paged_attention_values(q, k_pages, v_pages, query_start,
     its submesh's) making the dispatch sharding-aware — the XLA path
     needs nothing (GSPMD propagates the head sharding through the
     gather and the masked core), the kernel path runs per-shard via
-    `shard_map` with replicated descriptors (`_ragged_tp_shard_map`)."""
+    `shard_map` with replicated descriptors (`_ragged_tp_shard_map`).
+
+    ``k_scale``/``v_scale``: (P, page_size) f32 per-page-row DEQUANT
+    multipliers of QUANTIZED int8 page pools (quantized serving,
+    docs/serving.md "Quantized serving"; written by
+    `ragged_scatter_quantized`). The XLA oracle dequantizes right
+    after the gather; the kernel dequantizes per page in flight —
+    key-row scales fold into the logits, value-row scales into the
+    softmax weights — so page DMA moves int8 bytes only. Trash-page
+    routing and dead-page skipping are unchanged (a dead page's
+    scales ride the resident trash page like its values)."""
     t, h, d = q.shape
     sc = scale if scale is not None else 1.0 / math.sqrt(d)
 
@@ -474,11 +579,14 @@ def ragged_paged_attention_values(q, k_pages, v_pages, query_start,
     query_len = _i32(query_len)
     context_len = _i32(context_len)
     block_tables = _i32(block_tables)
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be passed together")
     kernel = use_kernel if use_kernel is not None else on_tpu()
     if not kernel:
         return _ragged_xla(q, k_pages, v_pages, query_start, query_len,
                            context_len, block_tables, sc, window,
-                           pages_bound=pages_bound)
+                           pages_bound=pages_bound, k_scale=k_scale,
+                           v_scale=v_scale)
     if t % block_q:
         raise ValueError(f"packed length {t} not a multiple of "
                          f"block_q {block_q}")
@@ -486,10 +594,12 @@ def ragged_paged_attention_values(q, k_pages, v_pages, query_start,
         return _ragged_tp_shard_map(q, k_pages, v_pages, query_start,
                                     query_len, context_len,
                                     block_tables, sc, window, block_q,
-                                    _interpret(), tp)
+                                    _interpret(), tp, k_scale=k_scale,
+                                    v_scale=v_scale)
     return _ragged_pallas(q, k_pages, v_pages, query_start, query_len,
                           context_len, block_tables, sc, window,
-                          block_q, _interpret())
+                          block_q, _interpret(), k_scale=k_scale,
+                          v_scale=v_scale)
 
 
 def ragged_scatter_values(k_pages, v_pages, k_rows, v_rows, block_tables,
@@ -512,6 +622,48 @@ def ragged_scatter_values(k_pages, v_pages, k_rows, v_rows, block_tables,
     vp = v_pages.at[:, page_idx, slot].set(
         jnp.swapaxes(v_rows, 0, 1).astype(v_pages.dtype))
     return kp, vp
+
+
+def ragged_scatter_quantized(k_pages, v_pages, k_scale, v_scale,
+                             k_rows, v_rows, block_tables, token_seq,
+                             positions):
+    """`ragged_scatter_values` for QUANTIZED page pools: quantize on
+    commit. Each packed row quantizes INDEPENDENTLY — absmax over its
+    own (HK, D) values, shared across heads so the scale pools
+    (P, page_size) carry no head axis and replicate under tensor
+    parallelism — through the ONE shared round-clip core
+    (`nn.quant.absmax_round_clip_values`). Per-ROW granularity is what
+    makes the quantized bytes PATH-INVARIANT: a page written
+    incrementally by decode steps holds bit-identical content to the
+    same rows written at once by a preemption re-prefill (each row
+    sees only its own values), which is why quantized-mode greedy
+    streams stay bit-identical through the chaos drills. int8 pools
+    store the lattice values; the scale pools store the DEQUANT
+    multiplier absmax/127 (0 for all-zero rows — dequant returns
+    exact zeros, never a division). Padding rows trash-route values
+    AND scales to page 0."""
+    from ..nn.quant import absmax_round_clip_values
+    page_size = k_pages.shape[2]
+    live = token_seq >= 0
+    sc = jnp.maximum(token_seq, 0)
+    page_idx = jnp.where(
+        live, block_tables[sc, positions // page_size], TRASH_PAGE)
+    slot = jnp.where(live, positions % page_size, 0)
+
+    def _q(rows):
+        rf = rows.astype(jnp.float32)
+        amax = jnp.max(jnp.abs(rf), axis=(1, 2))            # (T,)
+        qr = absmax_round_clip_values(rf, amax[:, None, None],
+                                      KV_QMAX, out_dtype=jnp.int8)
+        return qr, (amax / KV_QMAX).astype(jnp.float32)
+
+    kq, ks_row = _q(k_rows)
+    vq, vs_row = _q(v_rows)
+    kp = k_pages.at[:, page_idx, slot].set(jnp.swapaxes(kq, 0, 1))
+    vp = v_pages.at[:, page_idx, slot].set(jnp.swapaxes(vq, 0, 1))
+    ks = k_scale.at[page_idx, slot].set(ks_row)
+    vs = v_scale.at[page_idx, slot].set(vs_row)
+    return kp, vp, ks, vs
 
 
 def ragged_paged_attention(q: Tensor, k_pages: Tensor, v_pages: Tensor,

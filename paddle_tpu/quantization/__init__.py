@@ -17,11 +17,24 @@ import jax.numpy as jnp
 import paddle_tpu as paddle
 from ..core.tensor import Tensor, apply, to_tensor
 from ..nn.layer.layers import Layer
+from ..nn.quant import absmax_round_clip_values
 
 __all__ = ["fake_quant", "quantize_linear", "dequantize_linear",
            "AbsmaxObserver", "EMAObserver", "FakeQuanterWithAbsMax",
            "QuantConfig", "QAT", "PTQ", "QuantedLinear",
-           "WeightOnlyLinear", "quantize_model_weight_only"]
+           "WeightOnlyLinear", "quantize_model_weight_only",
+           "absmax_round_clip_values", "QuantServingConfig"]
+
+
+def __getattr__(name):
+    # QuantServingConfig (the serving engine's quant=... mode) lives in
+    # models/serving.py next to SpecConfig; re-exported here lazily so
+    # `from paddle_tpu.quantization import QuantServingConfig` works
+    # without importing the serving stack at package-import time
+    if name == "QuantServingConfig":
+        from ..models.serving import QuantServingConfig
+        return QuantServingConfig
+    raise AttributeError(name)
 
 
 def _ste_round(x):
@@ -38,9 +51,8 @@ def fake_quant(x: Tensor, scale, bit_length=8, channel_axis=None) -> Tensor:
             shape = [1] * v.ndim
             shape[channel_axis] = -1
             s = s.reshape(shape)
-        s = jnp.maximum(s, 1e-9)
-        q = jnp.clip(_ste_round(v / s * qmax), -qmax - 1, qmax)
-        return q * s / qmax
+        q = absmax_round_clip_values(v, s, qmax, round_fn=_ste_round)
+        return q * jnp.maximum(s, 1e-9) / qmax
     s_t = scale if isinstance(scale, Tensor) else to_tensor(scale)
     return apply("fake_quant", fn, (x, s_t))
 
@@ -54,8 +66,7 @@ def quantize_linear(x: Tensor, scale, zero_point=0, bit_length=8,
             shape = [1] * v.ndim
             shape[axis] = -1
             s = s.reshape(shape)
-        return jnp.clip(jnp.round(v / jnp.maximum(s, 1e-9) * qmax),
-                        -qmax - 1, qmax).astype(jnp.int8)
+        return absmax_round_clip_values(v, s, qmax, out_dtype=jnp.int8)
     s_t = scale if isinstance(scale, Tensor) else to_tensor(scale)
     return apply("quantize_linear", fn, (x, s_t))
 

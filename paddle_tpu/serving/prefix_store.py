@@ -101,7 +101,8 @@ class FleetPrefixStore:
         entry = self._chains.get(h)
         if entry is None:
             entry = {"parent": parent, "replicas": set(),
-                     "tokens": None, "kv": None, "bytes": 0}
+                     "tokens": None, "kv": None, "bytes": 0,
+                     "scales": None, "quant": None}
             self._chains[h] = entry
             self._cap_chains()
         else:
@@ -151,6 +152,8 @@ class FleetPrefixStore:
         # whole payload, so defer it until a page actually needs
         # spilling — the common already-spilled chain stays free
         kv_layers = None
+        quant = payload.get("kv_quant")
+        kv_scales = payload.get("kv_scales")
         prompt = payload["prompt"]
         ps = self.page_size
         hashes = chain_hashes(prompt, ps)
@@ -166,6 +169,15 @@ class FleetPrefixStore:
             kv = [(np.asarray(kp[:, f]), np.asarray(vp[:, f]))
                   for kp, vp in kv_layers]
             nbytes = sum(a.nbytes + b.nbytes for a, b in kv)
+            if kv_scales is not None:
+                # quantized chains spill HALF-WIDTH: int8 page bytes
+                # plus one (page_size,) f32 scale row pair per layer —
+                # double the prefix warmth per byte of host RAM
+                scales = [(np.asarray(ks[f]), np.asarray(vs[f]))
+                          for ks, vs in kv_scales]
+                nbytes += sum(a.nbytes + b.nbytes for a, b in scales)
+                entry["scales"] = scales
+            entry["quant"] = quant
             entry["tokens"] = tuple(prompt[f * ps:(f + 1) * ps])
             entry["kv"] = kv
             entry["bytes"] = nbytes
@@ -180,11 +192,17 @@ class FleetPrefixStore:
         """Longest spilled chain prefix of `prompt`, ready for
         `engine.import_prefix`: (page token tuples, per-layer (k, v)
         arrays shaped (hk, n, page_size, hd)), or None when nothing is
-        spilled for this prefix."""
+        spilled for this prefix. A QUANTIZED chain (spilled from a
+        ``kv_quant`` engine) returns a third element — per-layer
+        (k_scale, v_scale) rows shaped (n, page_size) — and the walk
+        stops at any entry whose quant mode differs from the chain
+        head's (mixed-mode bytes are not one installable chain)."""
         chain = []
         for h in chain_hashes(prompt, self.page_size):
             entry = self._chains.get(h)
             if entry is None or entry["kv"] is None:
+                break
+            if chain and entry.get("quant") != chain[0].get("quant"):
                 break
             self._chains.move_to_end(h)
             chain.append(entry)
@@ -195,6 +213,12 @@ class FleetPrefixStore:
         kv_rows = [(np.stack([e["kv"][li][0] for e in chain], axis=1),
                     np.stack([e["kv"][li][1] for e in chain], axis=1))
                    for li in range(layers)]
+        if chain[0].get("scales") is not None:
+            kv_scales = [
+                (np.stack([e["scales"][li][0] for e in chain], axis=0),
+                 np.stack([e["scales"][li][1] for e in chain], axis=0))
+                for li in range(layers)]
+            return tokens, kv_rows, kv_scales
         return tokens, kv_rows
 
     # -- accounting ------------------------------------------------------
@@ -231,6 +255,7 @@ class FleetPrefixStore:
             self.spilled_bytes -= entry["bytes"]
             entry["kv"] = None
             entry["tokens"] = None
+            entry["scales"] = None
             entry["bytes"] = 0
             self.evictions += 1
             _M_EVICTIONS.inc()

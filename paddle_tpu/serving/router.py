@@ -1122,12 +1122,17 @@ class ServingRouter:
     # -- gray-failure defense (serving/sentry.py, ISSUE 14) --------------
     def _compute_canary_golden(self, engine_factory) -> List[int]:
         """The canary's golden greedy stream, computed ONCE per
-        (model, tp) at fleet build on a SCRATCH engine from the same
-        factory (replica-0 signature, same submesh under TP) — a live
-        replica's engine would be left warm and its counters skewed.
-        Greedy decoding is batching-invariant (test-pinned since
-        PR 1), so any healthy replica must reproduce this stream
-        exactly, whatever traffic it is serving alongside."""
+        (model, tp, quant) at fleet build on a SCRATCH engine from the
+        same factory (replica-0 signature, same submesh under TP, same
+        `quant=` mode — a QUANTIZED replica's correct stream differs
+        from bf16's, so a golden from any other configuration would
+        false-quarantine healthy replicas; deriving it from the fleet's
+        own factory is what keeps the golden in the replicas' numeric
+        regime by construction) — a live replica's engine would be
+        left warm and its counters skewed. Greedy decoding is
+        batching-invariant (test-pinned since PR 1), so any healthy
+        replica must reproduce this stream exactly, whatever traffic
+        it is serving alongside."""
         cfg = self.canary_cfg
         if self.submeshes is not None:
             eng = engine_factory(0, self.submeshes[0])

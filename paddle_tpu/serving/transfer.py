@@ -38,6 +38,17 @@ target mutation — a flipped byte in flight surfaces as
 `transfer.failed` event, and the request keeps decoding on its
 consistent source (ordinary failover covers a source that later dies).
 
+Quantized serving (engine ``quant=QuantServingConfig``, ISSUE 15): a
+quantized source's payload carries the int8 page bytes, the per-page
+dequant scale rows (``kv_scales``), and a ``kv_quant`` mode tag; the
+bytes move VERBATIM (never re-quantized — migrated streams stay
+bit-identical) and are roughly half a bf16 payload / a quarter of an
+f32 one (`payload_nbytes` counts the scales too). `import_pages`
+refuses a cross-mode payload with :class:`QuantMismatch` BEFORE any
+target mutation — booked ``stage="install"`` like any install
+refusal — because int8 lattice bytes installed into a full-width pool
+(or vice versa) would be silent corruption, not a conversion.
+
 Speculative decoding (engine ``spec_decode=``, ISSUE 10): the payload
 carries TARGET pages only — a source engine's DRAFT-model cache is
 deliberately DROPPED at the hand-off (`evict_request` releases the
@@ -61,13 +72,14 @@ from typing import Callable, Optional, Tuple
 
 from .. import observability as telemetry
 from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
-                              PayloadCorruption, PoolExhausted, Request,
+                              PayloadCorruption, PoolExhausted,
+                              QuantMismatch, Request,
                               assemble_payload_kv, verify_payload)
 from ..utils.faults import fault_point, fault_value, value_armed
 
 __all__ = ["serialize_request", "install_request", "migrate_request",
            "payload_nbytes", "assemble_payload_kv", "PayloadCorruption",
-           "verify_payload", "TransferStageTimeout"]
+           "QuantMismatch", "verify_payload", "TransferStageTimeout"]
 
 
 class TransferStageTimeout(RuntimeError):
@@ -113,11 +125,20 @@ def payload_nbytes(payload: dict) -> int:
     `export_pages`, serving/submesh.py) instead of assembled rows;
     counting the fragments keeps this honest: the sum IS the bytes
     that crossed a device->host link, with no double count for an
-    assembled view."""
+    assembled view. A QUANTIZED payload's per-page scale rows
+    (`kv_scales`) count too — they cross the wire with the int8
+    bytes, and the bench's migration-payload A/B must not flatter
+    the quantized side by dropping them."""
+    n = 0
     if payload.get("kv") is not None:
-        return sum(k.nbytes + v.nbytes for k, v in payload["kv"])
-    return sum(k.nbytes + v.nbytes
-               for shard in payload["kv_shards"] for k, v in shard)
+        n += sum(k.nbytes + v.nbytes for k, v in payload["kv"])
+    else:
+        n += sum(k.nbytes + v.nbytes
+                 for shard in payload["kv_shards"] for k, v in shard)
+    if payload.get("kv_scales") is not None:
+        n += sum(ks.nbytes + vs.nbytes
+                 for ks, vs in payload["kv_scales"])
+    return n
 
 
 def _corrupt_payload_site(payload: dict, tag=None) -> None:

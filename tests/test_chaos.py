@@ -1223,3 +1223,101 @@ class TestGrayFailureChaos:
         snap = telemetry.snapshot()["counters"]
         assert "pdt_sentry_trips_total" not in snap
         assert "pdt_sentry_tainted_tokens_total" not in snap
+
+
+class TestQuantChaos:
+    """Quantized-serving four-fates drill (ISSUE 15): the PR-4
+    acceptance drill re-run with every engine in
+    ``quant=QuantServingConfig(weights="int8", kv="int8")`` mode. One
+    quantized fleet run lands PREEMPTED / FAILED / TIMEOUT / FINISHED
+    (including a request SIGKILLed off its replica mid-decode), the
+    fleet-vs-engine terminal counters reconcile exactly, and every
+    surviving stream is BIT-IDENTICAL to an uninterrupted quantized
+    engine — determinism through chaos is preserved inside quantized
+    mode even though values legitimately differ from bf16 (per-row
+    page quantization is commit-order invariant, so a failover's
+    re-prefilled pages hold the same int8 bytes the dead replica's
+    did)."""
+
+    def _quant(self):
+        from paddle_tpu.models.serving import QuantServingConfig
+        return QuantServingConfig(weights="int8", kv="int8")
+
+    def _fleet(self, model, n=3, clock=None, engine_kw=None, **kw):
+        clock = clock if clock is not None else FakeClock()
+        ekw = dict(max_batch_size=2, max_seq_len=64, page_size=4,
+                   quant=self._quant())
+        ekw.update(engine_kw or {})
+        kw.setdefault("page_size", 4)
+        kw.setdefault("sleep", clock.advance)
+        router = ServingRouter(
+            lambda i: ContinuousBatchingEngine(model, clock=clock,
+                                               **ekw),
+            num_replicas=n, policy="round_robin", clock=clock, **kw)
+        return router, clock
+
+    def _ref(self, model, jobs):
+        eng = ContinuousBatchingEngine(
+            model, max_batch_size=2, max_seq_len=64, page_size=4,
+            quant=self._quant())
+        rids = [eng.add_request(p, m) for p, m in jobs]
+        res = eng.run()
+        return [res[r] for r in rids]
+
+    def test_quant_four_fates_reconcile(self, model):
+        jobs = [([5, 4, 3, 2, 6, 7], 8), ([9, 1, 2], 6),
+                ([7, 7, 1, 2], 5)]
+        ref = self._ref(model, jobs)
+        statuses = (RequestStatus.FINISHED, RequestStatus.FAILED,
+                    RequestStatus.TIMEOUT, RequestStatus.PREEMPTED)
+        eng_base = {s: telemetry.value(
+            "pdt_serving_requests_terminal_total", status=s)
+            for s in statuses}
+        router, clock = self._fleet(
+            model, n=3, restart_backoff_base=3.0,
+            restart_backoff_max=3.0,
+            engine_kw=dict(max_preemptions=0))
+
+        # fate 1 — PREEMPTED (starvation guard under forced pool
+        # exhaustion; same alloc-visit arithmetic as the full-width
+        # drill — the quantized allocator is the SAME allocator)
+        d = router.submit([5, 4, 3, 2, 6, 7], 8)        # round robin: r0
+        with FaultInjector() as fi:
+            fi.arm("serving.alloc_page", nth=3, exc=PoolExhausted)
+            while not router.requests[d].done:
+                router.step()
+        assert router.requests[d].status == RequestStatus.PREEMPTED
+        assert router.replicas[0].state == ReplicaState.HEALTHY
+
+        # fate 2 — FAILED (injected prefill fault, request-isolated)
+        c = router.submit([9, 1, 2], 6)                 # round robin: r1
+        with FaultInjector() as fi:
+            fi.arm("serving.prefill", nth=1)
+            while not router.requests[c].done:
+                router.step()
+        assert router.requests[c].status == RequestStatus.FAILED
+        assert router.replicas[1].state == ReplicaState.HEALTHY
+
+        # fates 3+4 — TIMEOUT and FINISHED-after-SIGKILL-failover
+        a1, a2, a3 = [router.submit(p, m) for p, m in jobs]
+        b = router.submit([1, 2, 3], 40, deadline=5.0)
+        router.step()
+        router.step()                           # mid-decode everywhere
+        assert not router.requests[a2].done
+        router.kill_replica(0)                  # SIGKILL: a2 stranded
+        clock.advance(6.0)
+        out = router.run()
+        assert [out[i] for i in (a1, a2, a3)] == ref
+        assert router.requests[a2].failovers == 1
+        assert router.requests[b].status == RequestStatus.TIMEOUT
+        assert router.replicas[0].restarts == 1
+
+        fates = {RequestStatus.FINISHED: 3, RequestStatus.FAILED: 1,
+                 RequestStatus.TIMEOUT: 1, RequestStatus.PREEMPTED: 1}
+        for status, want in fates.items():
+            assert telemetry.value("pdt_router_requests_terminal_total",
+                                   status=status) == want, status
+            assert telemetry.value("pdt_serving_requests_terminal_total",
+                                   status=status) \
+                - eng_base[status] == want, status
+        assert telemetry.value("pdt_router_failovers_total") == 1

@@ -173,6 +173,47 @@ class TestRaggedPagedAttentionLowering:
         _lower(lambda q, kp, vp: ragged_paged_attention_values(
             q, kp, vp, qs, ql, cl, bt, block_q=1), q, kp, kp)
 
+    @pytest.mark.parametrize("block_q", [8, 1])
+    def test_quantized_pages(self, block_q):
+        """ISSUE 15: int8 page pools + (P, 1, page_size) scale blocks
+        (the dequant-in-flight inputs) must survive the Mosaic pass at
+        bench shapes, mixed and decode forms."""
+        from paddle_tpu.ops.ragged_paged_attention import (
+            pack_ragged_starts, ragged_paged_attention_values)
+
+        pages, page_size = 256, 16
+        if block_q == 8:
+            ql = np.array([512, 512, 1, 1], np.int32)
+            cl = np.array([512, 512, 900, 800], np.int32)
+        else:
+            ql = np.ones(4, np.int32)
+            cl = np.array([100, 90, 80, 70], np.int32)
+        qs, total = pack_ragged_starts(ql, block_q=block_q)
+        q = jnp.zeros((total, BENCH_H, BENCH_D), jnp.bfloat16)
+        kp = jnp.zeros((BENCH_HK, pages, page_size, BENCH_D), jnp.int8)
+        ks = jnp.zeros((pages, page_size), jnp.float32)
+        bt = jnp.zeros((len(ql), 64), jnp.int32)
+        _lower(lambda q, kp, vp, ks, vs: ragged_paged_attention_values(
+            q, kp, vp, qs, ql, cl, bt, block_q=block_q,
+            k_scale=ks, v_scale=vs), q, kp, kp, ks, ks)
+
+
+class TestQuantMatmulLowering:
+    """ISSUE 15: the fused dequant-matmul epilogue — int8 weight tiles
+    widened in VMEM, per-out-channel scale applied to the f32
+    accumulator on the last K step — at decode (M=8) and prefill
+    (M=1024) shapes."""
+
+    @pytest.mark.parametrize("m", [8, 1024])
+    def test_int8_epilogue(self, m):
+        from paddle_tpu.ops.quant_matmul import (dequant_matmul_values,
+                                                 quantize_weight_values)
+        k, n = 1024, 4096
+        qw, sc = quantize_weight_values(jnp.zeros((k, n)), "int8")
+        x = jnp.zeros((m, k), jnp.bfloat16)
+        _lower(lambda x, qw, sc: dequant_matmul_values(x, qw, sc),
+               x, qw, sc)
+
 
 class TestGroupedMatmulLowering:
     def test_grouped(self):
