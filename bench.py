@@ -249,6 +249,10 @@ REGRESSION_METRICS = (
     # (detail.quant.residency_ratio), but this row keeps the quantized
     # dispatch path itself from regressing
     "detail.quant.quant_decode_tokens_per_sec",
+    # elastic autoscaling (ISSUE 16): chip-time the autoscaled fleet
+    # saved vs a static peak fleet on the same diurnal trace at the
+    # same served work — the whole point of elasticity, as a gate
+    "detail.autoscale.replica_step_savings_pct",
 )
 
 # latency-family regression gates: LOWER is better, a rise past the
@@ -256,6 +260,11 @@ REGRESSION_METRICS = (
 # TTFT under 2x overload must stay guarded like tokens/sec)
 REGRESSION_METRICS_LOWER = (
     "detail.soak.overload.interactive_p95_ttft_s",
+    # elastic autoscaling (ISSUE 16): the autoscaled fleet's
+    # interactive p95 TTFT must track the static peak fleet's, and the
+    # hysteresis-bounded burst reaction must not creep
+    "detail.autoscale.ttft_p95_autoscaled_s",
+    "detail.autoscale.burst_reaction_s",
 )
 
 
@@ -844,6 +853,122 @@ def bench_soak(model, cfg, on_tpu: bool) -> dict:
             "outcomes": over["outcomes"],
             "sheds_by_reason": over["sheds_by_reason"],
         },
+    }}
+
+
+def bench_autoscale(model, cfg, on_tpu: bool) -> dict:
+    """Elastic autoscaling (ISSUE 16): one pronounced-diurnal trace
+    driven twice in virtual time — a STATIC fleet pinned at peak size,
+    then an AUTOSCALED one (journal-attached: every resize a two-phase
+    INTENT/COMMIT transaction) starting at one replica under a
+    `FleetAutoscaler` with the arrival-rate capacity model. The
+    headline is `replica_step_savings_pct` — chip-time the elastic
+    fleet did NOT spend for the same served work — gated higher-better
+    in REGRESSION_METRICS, with the autoscaled interactive p95 TTFT
+    and the burst reaction time gated lower-better. Virtual-time
+    determinism makes all three exact replay quantities. Returns a
+    detail sub-dict (`detail.autoscale`)."""
+    import os
+    import shutil
+    import tempfile
+
+    import paddle_tpu.observability as telemetry
+    from paddle_tpu.loadgen import (SoakDriver, TraceConfig,
+                                    VirtualClock, generate_trace)
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+    from paddle_tpu.serving import (AutoscalePolicy, FleetAutoscaler,
+                                    RouterJournal, ServingRouter)
+
+    page = 16
+    step_dt = 0.05
+    peak_replicas = 2
+    if on_tpu:
+        slots, duration, out_max, prompt_max = 8, 80.0, 24, 64
+        replica_qps, base_qps = 4.0, 4.8
+    else:
+        slots, duration, out_max, prompt_max = 2, 40.0, 10, 24
+        # one replica's capacity share + a base whose diurnal peak
+        # (1.6x) needs the whole fleet and whose trough (0.4x) fits
+        # one replica — the gap elasticity harvests
+        replica_qps, base_qps = 1.0, 1.2
+
+    def trace():
+        return generate_trace(TraceConfig(
+            seed=1, duration_s=duration, base_qps=base_qps,
+            diurnal_amplitude=0.6, diurnal_period_s=duration,
+            burst_start_prob=0.0, burst_mean_s=1.0,
+            burst_multiplier=1.0,
+            prompt_len_median=8.0, prompt_len_max=prompt_max,
+            output_len_median=6.0, output_len_max=out_max,
+            interactive_fraction=0.4,
+            vocab_size=cfg.vocab_size))
+
+    def drive(autoscaled, journal=None):
+        clock = VirtualClock()
+        router = ServingRouter(
+            lambda i: ContinuousBatchingEngine(
+                model, max_batch_size=slots, page_size=page,
+                max_seq_len=prompt_max + out_max + 2 * page,
+                attention_impl=ATTENTION_IMPL, clock=clock),
+            num_replicas=peak_replicas, policy="least_outstanding",
+            page_size=page, max_replica_outstanding=4 * slots,
+            clock=clock, sleep=clock.advance, journal=journal)
+        scaler = None
+        if autoscaled:
+            router.resize(num_replicas=1,
+                          reason="autoscale-bench-floor")
+            scaler = FleetAutoscaler(
+                router,
+                AutoscalePolicy(
+                    min_replicas=1, max_replicas=peak_replicas,
+                    scale_up_depth=2.0 * slots, scale_down_depth=0.75,
+                    replica_qps=replica_qps, up_ticks=2, down_ticks=6,
+                    cooldown_s=2.0, max_step=1),
+                interval_s=1.0, clock=clock)
+        result = SoakDriver(router, trace(), clock=clock,
+                            step_dt=step_dt, max_wall_s=240,
+                            autoscaler=scaler).run()
+        return result, router, scaler
+
+    telemetry.enable()
+    telemetry.reset()
+    try:
+        model.eval()
+        static_res, _, _ = drive(autoscaled=False)
+        wal_root = tempfile.mkdtemp(prefix="bench_autoscale_wal_")
+        try:
+            auto_res, auto_router, scaler = drive(
+                autoscaled=True,
+                journal=RouterJournal(os.path.join(wal_root, "wal"),
+                                      fsync="off"))
+            journaled_resizes = auto_router.fleet_info()["resizes"]
+        finally:
+            shutil.rmtree(wal_root, ignore_errors=True)
+    finally:
+        model.train()
+        telemetry.disable(clear_override=True)
+    static_sum, auto_sum = static_res.summary(), auto_res.summary()
+    savings = 100.0 * (1.0 - auto_res.replica_steps
+                       / max(1, static_res.replica_steps))
+    return {"autoscale": {
+        "step_dt_s": step_dt,
+        "ttft_p95_static_s": (static_sum["lanes"]
+                              .get("interactive", {})
+                              .get("ttft_p95_s")),
+        "ttft_p95_autoscaled_s": (auto_sum["lanes"]
+                                  .get("interactive", {})
+                                  .get("ttft_p95_s")),
+        "replica_steps_static": static_res.replica_steps,
+        "replica_steps_autoscaled": auto_res.replica_steps,
+        "replica_step_savings_pct": round(savings, 2),
+        "burst_reaction_s": max(scaler.reactions, default=None),
+        "grows": sum(1 for a in scaler.actions
+                     if a["action"] == "grow"),
+        "shrinks": sum(1 for a in scaler.actions
+                       if a["action"] == "shrink"),
+        "journaled_resizes": journaled_resizes,
+        "lost_sessions": (auto_sum["sessions"]
+                          - auto_sum["outcomes"].get("finished", 0)),
     }}
 
 
@@ -1600,6 +1725,11 @@ def run_bench(on_tpu: bool) -> dict:
         detail.update(bench_sentry(model, cfg, on_tpu))
     except Exception:
         detail["sentry_error"] = traceback.format_exc(limit=3)[-400:]
+    try:
+        detail.update(bench_autoscale(model, cfg, on_tpu))
+    except Exception:
+        detail["autoscale_error"] = \
+            traceback.format_exc(limit=3)[-400:]
 
     return {
         "metric": "llama_train_mfu" if on_tpu else "llama_train_mfu_cpu_ci",

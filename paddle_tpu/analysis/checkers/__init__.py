@@ -12,6 +12,7 @@ from .coverage import FaultCoverageChecker
 from .durablewrites import DurableWriteChecker
 from .faultsites import FaultSiteDriftChecker
 from .pins import PinPairingChecker
+from .resizeintent import ResizeIntentChecker
 from .supervision import SwallowedErrorChecker
 from .tracedsync import TracedHostSyncChecker
 
@@ -19,7 +20,8 @@ __all__ = ["ALL_CHECKER_CLASSES", "default_checkers", "by_code",
            "CatalogDriftChecker", "InjectableClockChecker",
            "DurableWriteChecker", "FaultCoverageChecker",
            "FaultSiteDriftChecker", "PinPairingChecker",
-           "SwallowedErrorChecker", "TracedHostSyncChecker"]
+           "ResizeIntentChecker", "SwallowedErrorChecker",
+           "TracedHostSyncChecker"]
 
 ALL_CHECKER_CLASSES = (
     InjectableClockChecker,      # PDT001
@@ -30,6 +32,7 @@ ALL_CHECKER_CLASSES = (
     SwallowedErrorChecker,       # PDT006
     DurableWriteChecker,         # PDT007
     FaultCoverageChecker,        # PDT008
+    ResizeIntentChecker,         # PDT009
 )
 
 

@@ -1,0 +1,109 @@
+"""PDT009 — resize-intent discipline.
+
+Repo law (ISSUE 16, the elastic autoscaling control plane): the fleet
+topology — replica count, the prefill:decode roles mix, the tp carve —
+mutates ONLY inside a two-phase journal transaction. A durable
+``resize_intent`` record must land BEFORE the first handle is built or
+torn down, and a ``resize_commit`` after; a SIGKILL between the two
+rolls FORWARD at replay. A topology mutation the journal never heard
+about is the one crash window ``ServingRouter.recover()`` cannot
+close: the journal would rehydrate the fleet into a shape that no
+longer exists, stranding every live request on submeshes nobody
+carved.
+
+The check: inside ``paddle_tpu/serving/``, every CALL of a
+fleet-topology mutator (``_apply_topology`` and the ``_topology_*``
+family) must be textually dominated — an earlier call in the same
+enclosing function — by either ``append_resize_intent`` (the resize
+transaction's phase 1) or ``replay`` (crash recovery: the journaled
+intent/commit IS the dominator, already durable). Calls inside the
+mutator family itself are exempt (the discipline holds at the
+transaction boundary, and mutators compose: ``_apply_topology``
+fans out to grow/shrink/recarve under the caller's intent record).
+
+Textual order is a sound approximation here because the mutation sites
+live in straight-line transaction bodies (``resize()``/
+``_rehydrate()``); a mutator call reached down a branch that skips the
+intent append still flags, which is exactly the bug class the rule
+exists for.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Tuple
+
+from .._astutil import walk_functions
+from ..core import Checker, Finding, Project
+
+__all__ = ["ResizeIntentChecker"]
+
+# the fleet-topology mutation surface (serving/router.py): each of
+# these rebuilds, adds, removes, or re-roles replica handles
+MUTATORS = frozenset({
+    "_apply_topology", "_topology_grow", "_topology_shrink",
+    "_topology_recarve", "_topology_set_roles", "_topology_recover",
+})
+# phase-1 appenders: an earlier call to one of these in the same
+# function establishes the journal transaction (replay = recovery,
+# where the journaled intent/commit is already durable)
+DOMINATORS = frozenset({"append_resize_intent", "replay"})
+
+
+def _called(node: ast.Call) -> str:
+    """The bare trailing name of a call: ``self._topology_grow(...)``
+    and ``_topology_grow(...)`` both give ``_topology_grow``."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+class ResizeIntentChecker(Checker):
+    code = "PDT009"
+    name = "resize-intent"
+    rationale = ("fleet-topology mutations happen only inside a "
+                 "two-phase journal transaction (ISSUE 16 — an "
+                 "unjournaled resize is a crash window recover() "
+                 "cannot close)")
+
+    DEFAULT_SCOPE = ("paddle_tpu/serving/*.py",)
+    DEFAULT_ALLOW: Tuple[str, ...] = ()
+
+    def __init__(self, scope: Tuple[str, ...] = DEFAULT_SCOPE,
+                 allow: Tuple[str, ...] = DEFAULT_ALLOW):
+        self.scope = scope
+        self.allow = allow
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.match(self.scope, exclude=self.allow):
+            if sf.tree is None:
+                continue
+            for fn in walk_functions(sf.tree):
+                if fn.name in MUTATORS:
+                    # inside the mutator family the discipline is the
+                    # CALLER's: mutators compose under one intent
+                    continue
+                calls = [n for n in ast.walk(fn)
+                         if isinstance(n, ast.Call)]
+                dominator_lines = sorted(
+                    n.lineno for n in calls
+                    if _called(n) in DOMINATORS)
+                for node in calls:
+                    name = _called(node)
+                    if name not in MUTATORS:
+                        continue
+                    if any(ln < node.lineno
+                           for ln in dominator_lines):
+                        continue
+                    yield self.finding(
+                        sf, node,
+                        f"{name}() mutates the fleet topology with no "
+                        "earlier append_resize_intent() in "
+                        f"{fn.name}() — every resize must journal a "
+                        "durable INTENT record before the first "
+                        "handle changes (two-phase resize, ISSUE 16), "
+                        "or a SIGKILL here strands recovery on a "
+                        "topology the journal never heard of",
+                        detail=f"{fn.name}:{name}", project=project)

@@ -107,6 +107,7 @@ class SoakResult:
     trace_span_s: float        # first..last arrival (the open window)
     steps: int
     wall_s: float
+    replica_steps: int = 0     # alive-replica-steps (chip-time proxy)
     sessions: List[SessionRecord] = field(default_factory=list)
     fleet_info: Dict[str, object] = field(default_factory=dict)
 
@@ -148,6 +149,7 @@ class SoakResult:
             "duration_s": self.duration_s,
             "trace_span_s": self.trace_span_s,
             "steps": self.steps,
+            "replica_steps": self.replica_steps,
             "outcomes": dict(sorted(by_outcome.items())),
             # arrival rate over the ARRIVAL window — duration_s also
             # spans the drain, which would understate the offered load
@@ -180,7 +182,8 @@ class SoakDriver:
                  arrivals: Iterable[ArrivalEvent], *,
                  clock: VirtualClock, step_dt: float = 0.05,
                  release_terminal: bool = True,
-                 max_wall_s: Optional[float] = None):
+                 max_wall_s: Optional[float] = None,
+                 autoscaler=None):
         if step_dt <= 0:
             raise ValueError(f"step_dt must be > 0, got {step_dt}")
         self.router = router
@@ -189,6 +192,10 @@ class SoakDriver:
         self.step_dt = float(step_dt)
         self.release_terminal = release_terminal
         self.max_wall_s = max_wall_s
+        # a serving.FleetAutoscaler ticked once per driver step, AFTER
+        # harvest — elastic soaks (recipes/fleet_soak.py --autoscale)
+        # grade its replica-step savings against a static fleet
+        self.autoscaler = autoscaler
         self._live: Dict[str, SessionRecord] = {}
 
     # -- submit / harvest ------------------------------------------------
@@ -241,6 +248,7 @@ class SoakDriver:
         wall0 = time.perf_counter()
         sessions: List[SessionRecord] = []
         steps = 0
+        replica_steps = 0
         last_arrival = 0.0
         it = iter(self.arrivals)
         nxt = next(it, None)
@@ -274,11 +282,19 @@ class SoakDriver:
                 self.clock.advance(self.step_dt)
                 self._harvest(self.router.step())
                 steps += 1
+                # replica-steps: the soak's chip-time proxy — one unit
+                # per serving replica per driver step, the denominator
+                # the --autoscale grade saves against a static fleet
+                replica_steps += sum(1 for h in self.router.replicas
+                                     if h.alive())
+                if self.autoscaler is not None:
+                    self.autoscaler.tick()
                 _M_OPEN.set(len(self._live))
                 _M_VTIME.set(self.clock() - t_start)
         return SoakResult(
             duration_s=self.clock() - t_start,
             trace_span_s=last_arrival, steps=steps,
+            replica_steps=replica_steps,
             wall_s=time.perf_counter() - wall0, sessions=sessions,
             fleet_info=self.router.fleet_info())
 

@@ -50,6 +50,17 @@ arXiv:2605.25645):
   token suffixes and re-serves them from healthy replicas, and gates
   every restart through canary PROBATION.
 
+* `autoscaler.py` — the elastic control plane (ISSUE 16):
+  `FleetAutoscaler`, a deterministic step-driven loop observing
+  arrival rate / queue depth / SLO burn and steering replica count,
+  the prefill:decode roles mix, and the tp carve through
+  `ServingRouter.resize()` — every transition a two-phase
+  INTENT/COMMIT journal transaction (SIGKILL mid-resize recovers into
+  old or new topology, zero lost tokens), scale-down drains via
+  migration, scale-up lands in canary PROBATION, with hysteresis +
+  cooldown + max-step flapping guards and degraded-mode refusals
+  while any replica is QUARANTINED or the journal is failing.
+
 * `journal.py`  — the crash-durable control plane (ISSUE 13): a
   checksummed, length-prefixed write-ahead journal of submits
   (BEFORE dispatch — the durability point), per-step token-progress
@@ -77,8 +88,10 @@ from .policy import (DispatchPolicy, LeastOutstandingPolicy,  # noqa: F401
                      POLICIES, PrefixAffinityPolicy, RoundRobinPolicy,
                      make_policy)
 from .prefix_store import FleetPrefixStore, chain_hashes  # noqa: F401
-from .replica import (ReplicaHandle, ReplicaRole,  # noqa: F401
-                      ReplicaState)
+from .replica import (ReplicaHandle, ReplicaOpRefused,  # noqa: F401
+                      ReplicaRole, ReplicaState)
+from .autoscaler import (AutoscaleObservation,  # noqa: F401
+                         AutoscalePolicy, FleetAutoscaler)
 from .journal import (JournalReplay, ReplayedRequest,  # noqa: F401
                       RouterJournal, commit_bytes)
 from .submesh import (SubMesh, TP_AXIS, TpConfig,  # noqa: F401
@@ -96,7 +109,8 @@ __all__ = [
     "parse_roles",
     "Lane", "QosAdmission", "TenantBudget", "AdmissionDecision",
     "derive_retry_after",
-    "ReplicaHandle", "ReplicaState", "ReplicaRole",
+    "ReplicaHandle", "ReplicaState", "ReplicaRole", "ReplicaOpRefused",
+    "FleetAutoscaler", "AutoscalePolicy", "AutoscaleObservation",
     "DispatchPolicy", "RoundRobinPolicy", "LeastOutstandingPolicy",
     "PrefixAffinityPolicy", "POLICIES", "make_policy",
     "FleetPrefixStore", "chain_hashes",

@@ -1,0 +1,384 @@
+"""Elastic autoscaling control plane over `ServingRouter` (ISSUE 16).
+
+Every primitive a production autoscaler needs already exists one layer
+down as a manual operator call — `drain_replica`/`restore_replica`,
+zero-loss migration, prefix spill, the write-ahead journal, burn-rate
+SLOs, canary probation. This module closes the loop: a deterministic,
+step-driven control loop that observes **arrival rate** (submit
+attempts per second, refusals included), **queue depth** (outstanding
+work per serving replica), and **SLO burn** (the QoS controller's
+cached burn rate), and resizes the fleet through
+`ServingRouter.resize()` — replica count, the prefill:decode roles
+mix, and the tp carve — every transition a journaled two-phase
+INTENT/COMMIT transaction, so a SIGKILL mid-resize recovers into the
+old or the new topology with zero lost tokens.
+
+Control discipline (the flapping guard, docs/serving.md
+"Autoscaling"):
+
+* **hysteresis** — a scale-up needs `up_ticks` CONSECUTIVE
+  high-pressure observations, a scale-down `down_ticks` consecutive
+  low-pressure ones (down is slower than up on purpose: adding
+  capacity late costs latency, removing it late costs only
+  chip-hours);
+* **cooldown** — after any action the loop holds for
+  `cooldown_for(obs)` seconds, which is `max(cooldown_s,
+  derive_retry_after(...))` — the cooldown can never undercut the
+  retry-after hint the fleet handed its shed clients, so capacity
+  cannot disappear before the clients it turned away were told to
+  come back;
+* **max-step clamp** — one action changes the replica count by at
+  most `max_step`, bounded to [min_replicas, max_replicas].
+
+Degraded mode (graceful degradation over oscillation): scale-UP is
+refused while any replica is QUARANTINED (a corrupt chip means the
+fleet's capacity math is lying — growing it doubles down on a sick
+mesh) or while the journal is failing appends (a resize intent that
+cannot reach disk must not mutate the fleet); scale-down and holds
+proceed. Refusals are counted (`pdt_autoscaler_refusals_total`) and
+evented (`autoscale.refused`), never silent.
+
+tp scaling (the GSPMD re-partitioning shape on the 8-device harness):
+with `wide_tp` set, a fleet that has been idle long enough to sit at
+`min_replicas` trades replicas for wider tensor-parallel engines
+(fewer, faster replicas — the latency-optimized carve); the first
+scale-up pressure recarves back to the base tp before count-growth
+resumes (more, narrower replicas — the throughput carve). Both
+directions are ordinary `resize()` transactions.
+
+Everything is driven by `tick()` — call it from the serving loop
+(`loadgen.SoakDriver(autoscaler=...)` does) on the router's injectable
+clock; there are no threads and no wall-clock reads, so every decision
+is reproducible in tests.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from .. import observability as telemetry
+from .admission import derive_retry_after
+from .replica import ReplicaRole, ReplicaState
+
+__all__ = ["AutoscalePolicy", "AutoscaleObservation",
+           "FleetAutoscaler"]
+
+_M_DECISIONS = telemetry.counter(
+    "pdt_autoscaler_decisions_total",
+    "Autoscaler evaluations by outcome (grow | shrink | recarve | "
+    "hold).", ("action",))
+_M_REFUSALS = telemetry.counter(
+    "pdt_autoscaler_refusals_total",
+    "Scale-ups refused by degraded mode, by reason (quarantined | "
+    "journal_failing | resize_failed).", ("reason",))
+_M_TARGET = telemetry.gauge(
+    "pdt_autoscaler_replicas_target",
+    "Replica count the autoscaler last steered the fleet to.")
+_M_REACTION = telemetry.histogram(
+    "pdt_autoscaler_reaction_seconds",
+    "Burst reaction time: first high-pressure observation to the "
+    "scale-up that answered it, on the router clock.")
+
+
+@dataclass
+class AutoscalePolicy:
+    """Knobs for one `FleetAutoscaler` (module docstring has the
+    control discipline). Depth thresholds are OUTSTANDING WORK PER
+    SERVING REPLICA; `replica_qps` (optional) adds an arrival-rate
+    capacity model: pressure is high whenever arrivals exceed
+    `replica_qps * serving_replicas`."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    scale_up_depth: float = 4.0      # per-replica outstanding
+    scale_down_depth: float = 1.0
+    replica_qps: Optional[float] = None
+    burn_up: float = 1.0             # SLO burn >= this votes UP
+    up_ticks: int = 2                # consecutive observations needed
+    down_ticks: int = 5
+    cooldown_s: float = 10.0
+    max_step: int = 1
+    # roles-mix policy: target prefill share of a role-managed fleet
+    # (None = leave roles alone). Applied on every resize action.
+    prefill_fraction: Optional[float] = None
+    # tp policy: the latency-optimized wide carve to recarve INTO at
+    # sustained min-replicas idle (None = never touch tp)
+    wide_tp: Optional[int] = None
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if self.max_step < 1:
+            raise ValueError("max_step must be >= 1")
+        if self.scale_down_depth > self.scale_up_depth:
+            raise ValueError("scale_down_depth must not exceed "
+                             "scale_up_depth (hysteresis band)")
+        if self.up_ticks < 1 or self.down_ticks < 1:
+            raise ValueError("up_ticks/down_ticks must be >= 1")
+
+
+@dataclass
+class AutoscaleObservation:
+    """One tick's inputs, all on the router clock."""
+
+    t: float
+    arrival_qps: float
+    queue_depth: float        # per-serving-replica outstanding
+    queue_min: int            # min outstanding (the shed-hint depth)
+    burn: float
+    replicas: int             # current slot count
+    serving: int              # slots in a traffic-taking state
+    quarantined: int
+    journal_failing: bool
+
+
+class FleetAutoscaler:
+    """The deterministic control loop (module docstring). Drive it by
+    calling `tick()` from the serving loop; it evaluates at most once
+    per `interval_s` on the router's clock and returns the action dict
+    it took (or the refusal/hold), None between evaluations."""
+
+    def __init__(self, router, policy: Optional[AutoscalePolicy] = None,
+                 *, interval_s: float = 1.0,
+                 clock: Optional[Callable[[], float]] = None):
+        self.router = router
+        self.policy = policy if policy is not None else AutoscalePolicy()
+        self.interval_s = float(interval_s)
+        self._clock = clock if clock is not None else router._clock
+        # the fleet's construction tp is the throughput carve the
+        # wide_tp mode recarves back to under pressure
+        self._base_tp = (None if router._tp_cfg is None
+                         else router._tp_cfg.tp)
+        self._next_eval = self._clock()
+        self._cooldown_until: Optional[float] = None
+        self._hi_streak = 0
+        self._lo_streak = 0
+        self._hi_since: Optional[float] = None
+        self._seen_submits = router.num_submit_attempts
+        self._seen_journal_failures = router.journal_append_failures
+        self._last_obs_t: Optional[float] = None
+        self.actions: List[dict] = []     # every grow/shrink/recarve
+        self.reactions: List[float] = []  # burst reaction samples (s)
+        self.num_refusals = 0
+        self.num_holds = 0
+
+    # -- observation -----------------------------------------------------
+    def observe(self) -> AutoscaleObservation:
+        """One snapshot of the three control inputs plus fleet health,
+        from plain router state (no telemetry dependency: the loop
+        must steer even with recording off)."""
+        r = self.router
+        now = self._clock()
+        dt = (now - self._last_obs_t) \
+            if self._last_obs_t is not None else 0.0
+        submits = r.num_submit_attempts
+        arrival = ((submits - self._seen_submits) / dt) \
+            if dt > 0 else 0.0
+        self._seen_submits = submits
+        self._last_obs_t = now
+        serving_states = (ReplicaState.HEALTHY, ReplicaState.DEGRADED)
+        serving = [h for h in r.replicas if h.state in serving_states]
+        depths = [h.outstanding() for h in serving]
+        accepting = [h.outstanding() for h in serving
+                     if h.role in ReplicaRole.PREFILL_CAPABLE]
+        quarantined = sum(1 for h in r.replicas
+                          if h.state == ReplicaState.QUARANTINED)
+        failures = r.journal_append_failures
+        journal_failing = failures > self._seen_journal_failures
+        self._seen_journal_failures = failures
+        return AutoscaleObservation(
+            t=now, arrival_qps=arrival,
+            queue_depth=sum(depths) / max(1, len(serving)),
+            queue_min=min(accepting, default=0),
+            burn=r._burn_hint(),
+            replicas=len(r.replicas), serving=len(serving),
+            quarantined=quarantined,
+            journal_failing=journal_failing)
+
+    def cooldown_for(self, obs: AutoscaleObservation) -> float:
+        """Post-action hold time. By construction never below the
+        retry-after hint shed clients were handed under the same
+        pressure (`derive_retry_after` on the router's own base cost
+        and the same depth/burn — the satellite-3 invariant,
+        tests/test_admission.py), so capacity the autoscaler just
+        changed cannot flap away before told-to-retry clients return."""
+        return max(self.policy.cooldown_s,
+                   derive_retry_after(self.router._retry_cost,
+                                      queue_depth=obs.queue_min,
+                                      burn_rate=obs.burn))
+
+    # -- the control loop ------------------------------------------------
+    def _pressure(self, obs: AutoscaleObservation) -> int:
+        """+1 = scale-up pressure, -1 = scale-down room, 0 = in the
+        hysteresis band."""
+        p = self.policy
+        high = (obs.queue_depth >= p.scale_up_depth
+                or obs.burn >= p.burn_up
+                or (p.replica_qps is not None
+                    and obs.arrival_qps
+                    > p.replica_qps * max(1, obs.serving)))
+        if high:
+            return 1
+        low = (obs.queue_depth <= p.scale_down_depth
+               and obs.burn < p.burn_up
+               and (p.replica_qps is None
+                    or obs.arrival_qps
+                    <= p.replica_qps * max(1, obs.serving - 1)))
+        return -1 if low else 0
+
+    def _roles_for(self, n: int):
+        """The roles spec a resize should carry: the policy's target
+        prefill share when one is set (single-replica fleets colocate
+        — a decode-only or prefill-only fleet cannot serve), else None
+        (resize keeps existing roles)."""
+        frac = self.policy.prefill_fraction
+        if frac is None or n < 2:
+            return None
+        p = min(n - 1, max(1, round(frac * n)))
+        return ([ReplicaRole.PREFILL] * p
+                + [ReplicaRole.DECODE] * (n - p))
+
+    def tick(self) -> Optional[dict]:
+        """Evaluate once per `interval_s`: observe, vote, and act
+        through `router.resize()` (every action a journaled two-phase
+        transaction). Returns the action/hold/refusal dict when an
+        evaluation ran, None between evaluations."""
+        now = self._clock()
+        if now < self._next_eval:
+            return None
+        self._next_eval = now + self.interval_s
+        obs = self.observe()
+        pressure = self._pressure(obs)
+        if pressure > 0:
+            if self._hi_streak == 0:
+                self._hi_since = obs.t  # the burst-reaction stopwatch
+            self._hi_streak += 1
+            self._lo_streak = 0
+        elif pressure < 0:
+            self._lo_streak += 1
+            self._hi_streak = 0
+            self._hi_since = None
+        else:
+            self._hi_streak = 0
+            self._lo_streak = 0
+            self._hi_since = None
+        p = self.policy
+        n = len(self.router.replicas)
+        cur_tp = (None if self.router._tp_cfg is None
+                  else self.router._tp_cfg.tp)
+        up_due = self._hi_streak >= p.up_ticks
+        down_due = self._lo_streak >= p.down_ticks
+        if self._cooldown_until is not None \
+                and now < self._cooldown_until and (up_due or down_due):
+            self.num_holds += 1
+            _M_DECISIONS.inc(action="hold")
+            return {"action": "hold", "reason": "cooldown",
+                    "until": self._cooldown_until}
+        # -- scale-up lane (count growth, or recarve back to the
+        # throughput carve when sitting on the wide one)
+        if up_due:
+            if obs.quarantined or obs.journal_failing:
+                return self._refuse(
+                    "quarantined" if obs.quarantined
+                    else "journal_failing", obs)
+            if p.wide_tp is not None and cur_tp == p.wide_tp \
+                    and cur_tp != self._base_tp:
+                return self._act("recarve", obs,
+                                 num_replicas=n, tp=self._base_tp
+                                 if self._base_tp is not None else 1)
+            target = min(p.max_replicas, n + p.max_step)
+            if target > n:
+                return self._act("grow", obs, num_replicas=target)
+            self.num_holds += 1
+            _M_DECISIONS.inc(action="hold")
+            return {"action": "hold", "reason": "at_max_replicas"}
+        # -- scale-down lane (count shrink, then the wide recarve once
+        # the floor is reached and the fleet stays idle)
+        if down_due:
+            target = max(p.min_replicas, n - p.max_step)
+            if target < n:
+                return self._act("shrink", obs, num_replicas=target)
+            if p.wide_tp is not None and cur_tp != p.wide_tp:
+                return self._act("recarve", obs,
+                                 num_replicas=n, tp=p.wide_tp)
+            self.num_holds += 1
+            _M_DECISIONS.inc(action="hold")
+            return {"action": "hold", "reason": "at_min_replicas"}
+        _M_DECISIONS.inc(action="hold")
+        return {"action": "hold", "reason": "hysteresis",
+                "pressure": pressure}
+
+    def _refuse(self, reason: str, obs: AutoscaleObservation) -> dict:
+        """Degraded mode: the scale-up does NOT happen, visibly."""
+        self.num_refusals += 1
+        _M_REFUSALS.inc(reason=reason)
+        telemetry.event("autoscale.refused", reason=reason,
+                        replicas=obs.replicas,
+                        quarantined=obs.quarantined,
+                        queue_depth=round(obs.queue_depth, 3))
+        # the streak stays: the moment the fleet heals, the pent-up
+        # pressure acts without re-accumulating hysteresis
+        return {"action": "refused", "reason": reason}
+
+    def _act(self, action: str, obs: AutoscaleObservation,
+             **resize_kw) -> dict:
+        n_target = resize_kw.get("num_replicas",
+                                 len(self.router.replicas))
+        roles = self._roles_for(n_target)
+        if roles is not None:
+            resize_kw["roles"] = roles
+            resize_kw.pop("num_replicas", None)
+        try:
+            result = self.router.resize(reason="autoscaler",
+                                        **resize_kw)
+        except Exception as e:
+            # a refused/failed resize (journal intent append fault,
+            # impossible carve) is a degraded-mode event, not a crash
+            # of the control loop
+            self.num_refusals += 1
+            _M_REFUSALS.inc(reason="resize_failed")
+            telemetry.event("autoscale.refused",
+                            reason="resize_failed",
+                            error=f"{type(e).__name__}: {e}")
+            return {"action": "refused", "reason": "resize_failed",
+                    "error": str(e)}
+        now = self._clock()
+        self._cooldown_until = now + self.cooldown_for(obs)
+        reaction = None
+        if action in ("grow", "recarve") and self._hi_since is not None:
+            reaction = now - self._hi_since
+            self.reactions.append(reaction)
+            _M_REACTION.observe(reaction)
+        self._hi_streak = 0
+        self._lo_streak = 0
+        self._hi_since = None
+        _M_DECISIONS.inc(action=action)
+        _M_TARGET.set(len(self.router.replicas))
+        entry = {"action": action, "t": now,
+                 "replicas": len(self.router.replicas),
+                 "topology": result.get("topology"),
+                 "changed": result.get("changed", False),
+                 "reaction_s": reaction,
+                 "arrival_qps": round(obs.arrival_qps, 3),
+                 "queue_depth": round(obs.queue_depth, 3),
+                 "burn": round(obs.burn, 3)}
+        self.actions.append(entry)
+        telemetry.event("autoscale.decision", action=action,
+                        replicas=len(self.router.replicas),
+                        queue_depth=round(obs.queue_depth, 3),
+                        arrival_qps=round(obs.arrival_qps, 3),
+                        burn=round(obs.burn, 3),
+                        reaction_s=reaction)
+        return entry
+
+    # -- introspection ---------------------------------------------------
+    def stats(self) -> dict:
+        return {"replicas": len(self.router.replicas),
+                "actions": len(self.actions),
+                "refusals": self.num_refusals,
+                "holds": self.num_holds,
+                "resizes": self.router.num_resizes,
+                "reaction_max_s": max(self.reactions, default=None),
+                "cooldown_until": self._cooldown_until}

@@ -24,6 +24,17 @@ write-ahead journal of the exact state the router already mirrors:
   WITHOUT re-execution (idempotent-per-request_id, the transfer-plane
   contract) so a finished response is redeliverable until
   `release_request` appends the `release` that lets compaction drop it.
+* **resize_intent / resize_commit** — the two-phase fleet-topology
+  records behind `ServingRouter.resize()` (ISSUE 16): the INTENT
+  (full target topology: replica count, roles mix, tp carve) is
+  durable BEFORE any fleet mutation, the COMMIT after the last one.
+  Replay resolves deterministically: an open INTENT without its
+  COMMIT rolls FORWARD (recovery rebuilds the fleet on the intended
+  topology and appends the closing COMMIT), so a SIGKILL at any
+  instant mid-resize recovers into exactly the old topology (killed
+  before the INTENT reached disk) or the new one (any later instant)
+  — never a half-resized fleet. Compaction preserves the resolved
+  state in one ``topology`` record.
 * **rewind** — the ONE exception to the append-only mirror contract
   (ISSUE 14, docs/serving.md "Gray failures"): a gray-failure
   quarantine dropped a request's TAINTED token suffix (streamed since
@@ -115,7 +126,8 @@ FSYNC_MODES = ("step", "terminal", "off")
 # record kinds whose loss breaks a durability contract — under
 # fsync="terminal" only these pay the disk round-trip
 _DURABLE_KINDS = frozenset({"submit", "terminal", "rejected",
-                            "rewind"})
+                            "rewind", "resize_intent",
+                            "resize_commit"})
 
 _M_RECORDS = telemetry.counter(
     "pdt_journal_records_total",
@@ -277,6 +289,13 @@ class JournalReplay:
     segments: int = 0
     corrupt_dropped: int = 0
     rejected: int = 0
+    # resolved two-phase resize state: `topology` is the fleet shape
+    # recovery must rebuild (None = whatever the caller constructs),
+    # `resize_rolled_forward` marks an INTENT whose COMMIT never
+    # landed — recovery applies it and appends the closing COMMIT
+    topology: Optional[dict] = None
+    resize_seq: int = 0
+    resize_rolled_forward: bool = False
 
 
 class RouterJournal:
@@ -284,11 +303,23 @@ class RouterJournal:
     (module docstring). `path` is a DIRECTORY of segments; opening an
     existing path always starts a fresh segment (never appends after
     a possibly-torn tail) and leaves every earlier segment for
-    `replay()`. `clock` stamps records for operators only — replay
-    decisions compare journaled absolute deadlines against the
-    RECOVERING router's clock, so zero-loss deadline semantics need
-    the two incarnations to share a clock source (tests share a fake
-    clock; production passes the same monotonic source to both)."""
+    `replay()`.
+
+    Deadline clock semantics: journaled `deadline_abs` values are
+    meaningful only against the clock of the incarnation that wrote
+    them (`time.monotonic` epochs are per-process). `replay()`
+    therefore RE-ANCHORS every live deadline as
+    remaining-time-at-last-journal-write: each incarnation's records
+    form one "boot run" (the first `open` a journal instance writes
+    carries a ``boot`` marker), the replayer tracks the latest clock
+    stamp inside each run, computes ``remaining = deadline_abs -
+    last_stamp_of_that_run`` and rewrites ``deadline_abs =
+    recovering_clock() + remaining``. A slow restart can no longer
+    mass-expire live requests (dead time between incarnations burns
+    no deadline budget), while a deadline that had already expired at
+    the crash (negative remaining) still finalizes as an honest
+    TIMEOUT — and the two incarnations no longer need to share a
+    clock source."""
 
     def __init__(self, path: str, *, fsync: str = "terminal",
                  segment_bytes: int = 1 << 20,
@@ -312,6 +343,12 @@ class RouterJournal:
         self._state: Dict[str, ReplayedRequest] = {}
         self._finalized_since_compact = 0
         self._file = None
+        # two-phase resize state: each is {"seq": int, "topology":
+        # dict} or None; an open intent without its commit rolls
+        # FORWARD at replay (class docstring)
+        self._resize_intent: Optional[dict] = None
+        self._resize_committed: Optional[dict] = None
+        self._booted = False
         self._seg_index = self._max_segment_index()
         self._open_segment()
 
@@ -344,8 +381,16 @@ class RouterJournal:
             # crash could drop the whole file, fsync'd submits included
             _fsync_dir(self.path)
         self._seg_written = 0
-        self._write({"kind": "open", "v": 1, "segment": self._seg_index,
-                     "t": self._clock()})
+        rec = {"kind": "open", "v": 1, "segment": self._seg_index,
+               "t": self._clock()}
+        if not self._booted:
+            # the FIRST open of this journal instance marks a fresh
+            # process incarnation: replay partitions records into
+            # boot runs at these markers so deadlines re-anchor
+            # against the right clock epoch (class docstring)
+            rec["boot"] = True
+            self._booted = True
+        self._write(rec)
 
     # -- the append path -------------------------------------------------
     def _write(self, obj: dict):
@@ -425,7 +470,11 @@ class RouterJournal:
                 delta[str(rid)] = [int(t) for t in tokens[have:]]
         if not delta:
             return 0
-        self._append({"kind": "progress", "d": delta})
+        # the stamp tightens deadline re-anchoring to one-tick
+        # granularity: time the router spent ALIVE burns deadline
+        # budget even when no durable record landed in between
+        self._append({"kind": "progress", "d": delta,
+                      "t": self._clock()})
         for rid, toks in delta.items():
             st = self._state.get(rid)
             if st is not None:
@@ -484,6 +533,30 @@ class RouterJournal:
             else:
                 st.released = True
 
+    # -- two-phase fleet resize ------------------------------------------
+    def append_resize_intent(self, seq: int, topology: dict) -> None:
+        """Durable INTENT for one `ServingRouter.resize()` — appended
+        BEFORE any fleet mutation (module docstring). `topology` is
+        the full target: ``{"num_replicas": int, "roles": [...] |
+        None, "tp": int | None}``. Raises on failure — a resize the
+        journal cannot record must not start."""
+        self._append({"kind": "resize_intent", "seq": int(seq),
+                      "topology": dict(topology),
+                      "t": self._clock()})
+        self._resize_intent = {"seq": int(seq),
+                               "topology": dict(topology)}
+
+    def append_resize_commit(self, seq: int) -> None:
+        """Durable COMMIT closing the matching INTENT — appended after
+        the last fleet mutation of the resize (or by recovery after
+        rolling an open intent forward)."""
+        self._append({"kind": "resize_commit", "seq": int(seq),
+                      "t": self._clock()})
+        if self._resize_intent is not None \
+                and self._resize_intent["seq"] == int(seq):
+            self._resize_committed = self._resize_intent
+        self._resize_intent = None
+
     # -- compaction ------------------------------------------------------
     def compact(self) -> int:
         """Condense the journal: one ``snap`` record per retained
@@ -509,6 +582,14 @@ class RouterJournal:
                 "tokens": st.tokens, "status": st.status,
                 "error": st.error})
             retained += 1
+        topo_snapped = (self._resize_intent is not None
+                        or self._resize_committed is not None)
+        if topo_snapped:
+            # resolved resize state must survive segment deletion
+            blob += _encode({"kind": "topology",
+                             "committed": self._resize_committed,
+                             "intent": self._resize_intent,
+                             "t": self._clock()})
         old = self._segments()
         self._seg_index += 1
         commit_bytes(self._seg_path(self._seg_index), bytes(blob),
@@ -516,6 +597,8 @@ class RouterJournal:
         _M_RECORDS.inc(kind="open")
         if retained:
             _M_RECORDS.inc(retained, kind="snap")
+        if topo_snapped:
+            _M_RECORDS.inc(kind="topology")
         _M_BYTES.inc(len(blob))
         if self.fsync != "off":
             _M_FSYNCS.inc()
@@ -547,6 +630,17 @@ class RouterJournal:
         fault_point("journal.replay")
         table: Dict[str, ReplayedRequest] = {}
         records = corrupt = rejected = 0
+        # deadline re-anchoring (class docstring): `boot` counts boot
+        # runs, `last_t` the latest clock stamp seen inside each, and
+        # `deadline_boot` the run whose clock defined each request's
+        # current deadline_abs (its submit — or snap, which a
+        # compacting incarnation rewrote into its own epoch)
+        boot = 0
+        last_t: Dict[int, float] = {}
+        deadline_boot: Dict[str, int] = {}
+        intent: Optional[dict] = None
+        committed: Optional[dict] = None
+        resize_seq = 0
         segments = self._segments()
         for fn in segments:
             with open(os.path.join(self.path, fn), "rb") as f:
@@ -559,6 +653,11 @@ class RouterJournal:
             for rec in recs:
                 records += 1
                 kind = rec.get("kind")
+                if kind == "open" and rec.get("boot"):
+                    boot += 1
+                t = rec.get("t")
+                if t is not None:
+                    last_t[boot] = float(t)  # appends are clock-ordered
                 if kind == "open":
                     if rec.get("v") != 1:
                         raise ValueError(
@@ -579,6 +678,7 @@ class RouterJournal:
                         st.status = rec.get("status")
                         st.error = rec.get("error")
                     table[st.request_id] = st
+                    deadline_boot[st.request_id] = boot
                 elif kind == "progress":
                     for rid, toks in rec.get("d", {}).items():
                         st = table.get(rid)
@@ -609,15 +709,49 @@ class RouterJournal:
                             table.pop(rec["rid"], None)
                         else:
                             st.released = True
+                elif kind == "resize_intent":
+                    intent = {"seq": int(rec.get("seq") or 0),
+                              "topology": rec.get("topology")}
+                    resize_seq = max(resize_seq, intent["seq"])
+                elif kind == "resize_commit":
+                    seq = int(rec.get("seq") or 0)
+                    if intent is not None and intent["seq"] == seq:
+                        committed = intent
+                    intent = None
+                    resize_seq = max(resize_seq, seq)
+                elif kind == "topology":
+                    committed = rec.get("committed")
+                    intent = rec.get("intent")
+                    for s in (committed, intent):
+                        if s is not None:
+                            resize_seq = max(resize_seq,
+                                             int(s.get("seq") or 0))
+        # re-anchor live deadlines onto the recovering clock: the
+        # remaining budget at the writing incarnation's last journal
+        # write carries over; dead time between incarnations burns
+        # nothing (class docstring)
+        now = self._clock()
+        for rid, st in table.items():
+            if st.status is None and st.deadline_abs is not None:
+                t_ref = last_t.get(deadline_boot.get(rid, boot))
+                if t_ref is not None:
+                    st.deadline_abs = now + (st.deadline_abs - t_ref)
         live = {rid: st for rid, st in table.items() if st.live}
         finished = {rid: st for rid, st in table.items()
                     if not st.live}
         self._state = table
         self._finalized_since_compact = 0
+        self._resize_intent = intent
+        self._resize_committed = committed
+        target = intent if intent is not None else committed
         return JournalReplay(live=live, finished=finished,
                              records=records, segments=len(segments),
                              corrupt_dropped=corrupt,
-                             rejected=rejected)
+                             rejected=rejected,
+                             topology=(None if target is None
+                                       else target.get("topology")),
+                             resize_seq=resize_seq,
+                             resize_rolled_forward=intent is not None)
 
     # -- introspection / lifecycle ---------------------------------------
     def stats(self) -> Dict[str, object]:

@@ -67,7 +67,18 @@ from ..distributed.launch import restart_backoff
 from ..models.serving import ContinuousBatchingEngine, Request
 from ..utils.faults import fault_point
 
-__all__ = ["ReplicaHandle", "ReplicaState", "ReplicaRole"]
+__all__ = ["ReplicaHandle", "ReplicaState", "ReplicaRole",
+           "ReplicaOpRefused"]
+
+
+class ReplicaOpRefused(RuntimeError):
+    """A manual scaling primitive (`drain`/`restore`) was refused
+    because the replica's current state makes the operation ambiguous
+    — e.g. restoring a replica that is still draining, or draining one
+    whose canary verdict is unresolved. Typed so operators (and the
+    autoscaler, which drives these primitives in a loop) can tell a
+    refusal from a crash; plain repeats of an already-applied
+    operation are idempotent no-ops instead (ISSUE 16)."""
 
 
 class ReplicaRole:
@@ -423,16 +434,38 @@ class ReplicaHandle:
                 and now - self.last_progress > self.wedge_timeout:
             self.die("wedged", now)
 
-    def drain(self):
+    def drain(self) -> bool:
         """Stop dispatching to this replica; in-flight work completes,
         then the replica parks DEAD (reason `drained`) without
         auto-restart — `ServingRouter.restore_replica` brings it back.
         auto_restart drops immediately: a replica that dies MID-drain
         (wedge, failure storm) must stay decommissioned too, not
-        restart itself back into traffic."""
+        restart itself back into traffic.
+
+        Idempotence contract (ISSUE 16): draining a DRAINING replica
+        is a no-op (returns False); draining a DOWN replica cancels
+        any pending auto-restart — "drained" means "stay out" — and
+        returns False; draining a SUSPECT/PROBATION replica raises
+        :class:`ReplicaOpRefused` (the canary must rule first: a
+        drain would let a possibly-tainted stream finalize as a
+        normal drain-out). Returns True only when this call started
+        the drain."""
         if self.state in (ReplicaState.HEALTHY, ReplicaState.DEGRADED):
             self.auto_restart = False
             self._transition(ReplicaState.DRAINING, "drain requested")
+            return True
+        if self.state == ReplicaState.DRAINING:
+            return False                       # idempotent repeat
+        if self.state in ReplicaState.DOWN:
+            # decommission: a dead replica told to drain must not
+            # restart itself back into traffic
+            self.auto_restart = False
+            self.next_restart_time = None
+            return False
+        raise ReplicaOpRefused(
+            f"replica {self.index} is {self.state}: the canary must "
+            "rule before it can drain (quarantine or restore it "
+            "instead)")
 
     def finish_drain_if_empty(self, now: float):
         if self.state == ReplicaState.DRAINING and self.outstanding() == 0:
@@ -516,17 +549,39 @@ class ReplicaHandle:
                         restarts=self.restarts)
         return True
 
-    def restore(self, now: float):
+    def restore(self, now: float) -> bool:
         """Manually bring back a drained (or permanently dead) replica:
         immediate fresh engine, no backoff — an operator action, not a
         crash recovery. Canary-gated fleets still route the fresh
-        engine through PROBATION — operators cannot waive the proof."""
+        engine through PROBATION — operators cannot waive the proof.
+
+        Idempotence contract (ISSUE 16): restoring a replica that is
+        already live is a no-op (returns False); restoring one that is
+        still DRAINING raises :class:`ReplicaOpRefused` — the two
+        intents conflict, and silently un-draining would race the
+        drain's completion. Wait for the drain to park it DEAD, or
+        kill it, then restore. Returns True when a fresh engine came
+        up."""
+        if self.state == ReplicaState.DRAINING:
+            raise ReplicaOpRefused(
+                f"replica {self.index} is still draining: wait for "
+                "the drain to finish (or kill it) before restoring")
         if self.state not in ReplicaState.DOWN:
-            return
+            return False                       # already live: no-op
         self.auto_restart = True
         self.restart_attempt = 0
         self.next_restart_time = now
         self.maybe_restart(now)
+        return True
+
+    def start_in_probation(self, reason: str = "scale_up"):
+        """Canary-gated fleets route a freshly ADDED replica (scale-up,
+        ISSUE 16) through PROBATION exactly like a restarted one: no
+        real traffic until its canary reproduces the golden stream.
+        No-op on fleets without a canary (nothing to gate with)."""
+        if self.probation_gate and self.state == ReplicaState.HEALTHY:
+            self._stabilizing = True
+            self._transition(ReplicaState.PROBATION, reason)
 
     def update_gauges(self):
         _M_QDEPTH.set(self.outstanding(), replica=str(self.index))

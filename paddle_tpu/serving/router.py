@@ -127,6 +127,7 @@ from .. import observability as telemetry
 from ..observability import trace as tracing
 from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
                               PoolExhausted, Request, RequestStatus)
+from ..utils.faults import fault_point
 from . import transfer
 from . import journal as journal_mod
 from .admission import (Lane, QosAdmission, derive_retry_after,
@@ -204,6 +205,11 @@ _M_AFF_RATE = telemetry.gauge(
     "Warm-placement fraction of prefix-affinity decisions so far.")
 _M_STEPS = telemetry.counter(
     "pdt_router_steps_total", "Router step ticks.")
+_M_RESIZES = telemetry.counter(
+    "pdt_router_resizes_total",
+    "Completed fleet resizes by kind (grow | shrink | recarve | "
+    "roles), each a two-phase INTENT/COMMIT journal transaction on "
+    "journal-attached fleets.", ("kind",))
 
 
 class FleetOverloaded(EngineOverloaded):
@@ -367,11 +373,12 @@ class ServingRouter:
         # device set at construction — one per replica slot, kept
         # across restarts — and the factory must take (index, submesh)
         self.submeshes = None
+        self._tp_cfg = None
         if tp is not None:
             from .submesh import TpConfig, carve_submeshes
-            tp_cfg = tp if isinstance(tp, TpConfig) \
+            self._tp_cfg = tp if isinstance(tp, TpConfig) \
                 else TpConfig(tp=int(tp))
-            self.submeshes = carve_submeshes(num_replicas, tp_cfg)
+            self.submeshes = carve_submeshes(num_replicas, self._tp_cfg)
         # gray-failure defense (serving/sentry.py, docs/serving.md
         # "Gray failures"): sentry trips need a canary to clear or
         # condemn them — a SUSPECT replica with no probe would park
@@ -390,22 +397,23 @@ class ServingRouter:
         if canary is not None:
             self._canary_golden = self._compute_canary_golden(
                 engine_factory)
-        rng = random.Random(seed)
+        # everything _make_handle needs to build a replica slot again
+        # later: the resize API (ISSUE 16) grows/shrinks/recarves the
+        # fleet after construction with handles identical to these
+        self._engine_factory = engine_factory
+        self._page_size = page_size
+        self._fleet_rng = random.Random(seed)
+        self._handle_kw = dict(
+            degraded_after=degraded_after, dead_after=dead_after,
+            wedge_timeout=wedge_timeout,
+            max_outstanding=max_replica_outstanding,
+            restart_backoff_base=restart_backoff_base,
+            restart_backoff_max=restart_backoff_max,
+            max_restarts=max_restarts)
         self.replicas: List[ReplicaHandle] = [
-            ReplicaHandle(i, engine_factory, clock=self._clock,
-                          submesh=None if self.submeshes is None
-                          else self.submeshes[i],
-                          degraded_after=degraded_after,
-                          dead_after=dead_after,
-                          wedge_timeout=wedge_timeout,
-                          max_outstanding=max_replica_outstanding,
-                          restart_backoff_base=restart_backoff_base,
-                          restart_backoff_max=restart_backoff_max,
-                          max_restarts=max_restarts,
-                          rng=random.Random(rng.random()),
-                          role=role_list[i],
-                          sentry_config=sentry,
-                          probation_gate=canary is not None)
+            self._make_handle(i, role_list[i],
+                              None if self.submeshes is None
+                              else self.submeshes[i])
             for i in range(num_replicas)]
         self.num_quarantines = 0
         self.num_tainted_tokens = 0
@@ -417,11 +425,48 @@ class ServingRouter:
         self._next_id = 0
         self.num_failovers = 0
         self.num_restarts = 0
+        self.num_resizes = 0
+        # monotone two-phase resize sequence (recovery resumes it past
+        # the highest journaled seq)
+        self._resize_seq = 0
+        # observation counters for the autoscaler (serving/
+        # autoscaler.py): submit ATTEMPTS (refusals included — arrival
+        # rate must see the load the fleet is shedding) and survived
+        # journal append failures (degraded mode refuses scale-up
+        # while the journal is failing)
+        self.num_submit_attempts = 0
+        self.journal_append_failures = 0
         # requests finalized OUTSIDE the step tick (e.g. a deadline that
         # expires during a submit-time failover) are delivered by the
         # next step() — same never-lose-a-terminal shape as the engine's
         # _finished_backlog
         self._terminal_backlog: List[FleetRequest] = []
+
+    def _make_handle(self, index: int, role: str, submesh,
+                     generation: int = 0) -> ReplicaHandle:
+        """Build one replica slot (construction and every resize use
+        the same recipe). A non-zero `generation` seeds a REPLACEMENT
+        slot (tp recarve) past its predecessor's, so requests
+        dispatched to the old incarnation read as stranded and fail
+        over — the fresh engine never heard of them."""
+        h = ReplicaHandle(index, self._engine_factory,
+                          clock=self._clock, submesh=submesh,
+                          rng=random.Random(self._fleet_rng.random()),
+                          role=role, sentry_config=self.sentry_cfg,
+                          probation_gate=self.canary_cfg is not None,
+                          **self._handle_kw)
+        if generation:
+            h.generation = generation
+        return h
+
+    def _note_append_failure(self, error: BaseException,
+                             where: str) -> None:
+        """Counted-but-survived journal append failure — the shared
+        module counter/event plus a router-local tally the autoscaler
+        reads: a journal that is failing fsync puts the fleet in
+        degraded mode (scale-up refused, serving/autoscaler.py)."""
+        self.journal_append_failures += 1
+        journal_mod.note_append_failure(error, where=where)
 
     # -- admission -------------------------------------------------------
     def submit(self, prompt, max_new_tokens: int = 32,
@@ -444,6 +489,10 @@ class ServingRouter:
         if lane not in Lane.ALL:
             raise ValueError(f"unknown lane {lane!r}: "
                              f"{sorted(Lane.ALL)}")
+        # arrival-rate observation (refusals INCLUDED: the autoscaler
+        # must see the demand the fleet is shedding, not just what it
+        # admitted)
+        self.num_submit_attempts += 1
         toks = [int(t) for t in prompt]
         decision = None
         if self.admission is not None:
@@ -511,7 +560,7 @@ class ServingRouter:
                 try:
                     self.journal.append_rejected(request_id)
                 except Exception as e:
-                    journal_mod.note_append_failure(
+                    self._note_append_failure(
                         e, where="router.submit_rejected")
             tracing.end_trace(request_id)   # refused: nothing to trace
             raise
@@ -1014,7 +1063,7 @@ class ServingRouter:
             self.journal.append_terminal(rec.request_id, rec.status,
                                          rec.tokens, rec.error)
         except Exception as e:
-            journal_mod.note_append_failure(e, where="router.terminal")
+            self._note_append_failure(e, where="router.terminal")
 
     def _journal_mirror(self):
         """One batched progress record per step tick: the journal
@@ -1028,7 +1077,7 @@ class ServingRouter:
                 {rec.request_id: rec.tokens
                  for rec in self._live.values() if rec.tokens})
         except Exception as e:
-            journal_mod.note_append_failure(e, where="router.step")
+            self._note_append_failure(e, where="router.step")
 
     def _finalize(self, rec: FleetRequest, req: Request,
                   finished: List[FleetRequest]):
@@ -1278,7 +1327,7 @@ class ServingRouter:
                         self.journal.rewind(rec.request_id,
                                             rec.verified_len)
                     except Exception as e:
-                        journal_mod.note_append_failure(
+                        self._note_append_failure(
                             e, where="router.quarantine")
             rec.engine_req = None
         self.num_quarantines += 1
@@ -1290,23 +1339,41 @@ class ServingRouter:
         self._forget_caches(h.index)   # its warm pages are condemned
 
     # -- operator surface ------------------------------------------------
+    def _replica_at(self, index: int) -> ReplicaHandle:
+        """Typed index validation for the manual scaling primitives:
+        an out-of-range index is an operator error, reported as such —
+        never a bare IndexError from fleet internals (and after a
+        scale-down, yesterday's valid index may be gone)."""
+        if not 0 <= int(index) < len(self.replicas):
+            raise ValueError(
+                f"no replica {index}: fleet has "
+                f"{len(self.replicas)} replicas (0.."
+                f"{len(self.replicas) - 1})")
+        return self.replicas[int(index)]
+
     def kill_replica(self, index: int, reason: str = "killed"):
         """SIGKILL-style drill switch: the replica dies NOW (engine
         discarded), restart is scheduled with backoff, and the next
         step() re-routes its in-flight work. `tests/test_chaos.py` and
         the llama_serve drill use this for deterministic mid-decode
         kills."""
-        h = self.replicas[index]
+        h = self._replica_at(index)
         h.die(reason, self._clock())
-        self._forget_caches(index)
+        self._forget_caches(h.index)
 
-    def drain_replica(self, index: int):
+    def drain_replica(self, index: int) -> bool:
         """Graceful decommission: no new traffic, in-flight completes,
-        then the replica parks dead until `restore_replica`."""
-        self.replicas[index].drain()
+        then the replica parks dead until `restore_replica`. Repeats
+        are idempotent no-ops and conflicting states raise
+        `ReplicaOpRefused` — `ReplicaHandle.drain` has the contract."""
+        return self._replica_at(index).drain()
 
-    def restore_replica(self, index: int):
-        self.replicas[index].restore(self._clock())
+    def restore_replica(self, index: int) -> bool:
+        """Bring a drained/dead replica back (fresh engine, no
+        backoff). Restoring a live replica is an idempotent no-op;
+        restoring one still draining raises `ReplicaOpRefused` —
+        `ReplicaHandle.restore` has the contract."""
+        return self._replica_at(index).restore(self._clock())
 
     def release_request(self, request_id: str):
         """Drop a TERMINAL request's record once its result has been
@@ -1326,8 +1393,321 @@ class ServingRouter:
             try:
                 self.journal.append_release(request_id)
             except Exception as e:
-                journal_mod.note_append_failure(e,
-                                                where="router.release")
+                self._note_append_failure(e,
+                                          where="router.release")
+
+    # -- elastic resize (ISSUE 16) ---------------------------------------
+    def _current_topology(self) -> dict:
+        return {"num_replicas": len(self.replicas),
+                "roles": [h.role for h in self.replicas],
+                "tp": None if self._tp_cfg is None
+                else self._tp_cfg.tp}
+
+    def resize(self, num_replicas: Optional[int] = None,
+               roles=None, tp=None, *,
+               reason: str = "operator") -> dict:
+        """Change the fleet's topology — replica count, roles mix,
+        and/or tp carve — as ONE crash-durable transaction
+        (docs/serving.md "Autoscaling"). On journal-attached fleets
+        the full target topology is journaled as a ``resize_intent``
+        BEFORE any fleet mutation and a ``resize_commit`` lands after
+        the last one, so a router SIGKILL at any instant recovers via
+        `recover()` into exactly the old topology (killed before the
+        intent reached disk) or the new one (any later instant) with
+        zero lost tokens.
+
+        * **grow** — new replica slots append at the top indices; on
+          canary fleets they land in PROBATION and take no real
+          traffic until their canary passes.
+        * **shrink** — the top slots drain via MIGRATION: running
+          work moves to survivors through the transfer plane (prefix
+          payloads spill warm), anything unmovable re-prefills on a
+          survivor with its mirrored stream folded in (zero loss,
+          greedy bit-identical either way).
+        * **tp change** — a full recarve: every slot gets a fresh
+          engine on the new submesh carve and every live request
+          re-enters through the ordinary failover fold-in.
+
+        An impossible target (no prefill-capable replica, a carve
+        that does not fit the device mesh) refuses BEFORE the intent
+        is journaled. Returns a summary dict; ``changed=False`` means
+        the target equals the current topology and nothing was done.
+
+        The ``autoscale.resize`` fault site fires at every journal
+        record boundary (before/after INTENT, mid-mutation,
+        before/after COMMIT) so chaos drills can kill the router at
+        each of them."""
+        from .submesh import TpConfig, carve_submeshes
+        role_list = parse_roles(roles)
+        if role_list is not None:
+            num_replicas = len(role_list)
+        n_new = len(self.replicas) if num_replicas is None \
+            else int(num_replicas)
+        if n_new < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {n_new}")
+        if role_list is None:
+            # surviving slots keep their roles; added slots colocate
+            cur = [h.role for h in self.replicas]
+            role_list = (cur + [ReplicaRole.COLOCATED]
+                         * max(0, n_new - len(cur)))[:n_new]
+        if not any(r in ReplicaRole.PREFILL_CAPABLE
+                   for r in role_list):
+            raise ValueError(
+                "a fleet needs at least one prefill-capable replica "
+                "(prefill or colocated) — decode-only fleets can "
+                "never admit")
+        if tp is None:
+            tp_cfg = self._tp_cfg
+        else:
+            tp_cfg = tp if isinstance(tp, TpConfig) \
+                else TpConfig(tp=int(tp))
+        tp_changed = ((None if tp_cfg is None else tp_cfg.tp)
+                      != (None if self._tp_cfg is None
+                          else self._tp_cfg.tp))
+        if tp_cfg is not None:
+            # validate the carve BEFORE journaling: an intent the
+            # mutation could never honor must not reach the journal
+            carve_submeshes(n_new, tp_cfg)
+        target = {"num_replicas": n_new, "roles": list(role_list),
+                  "tp": None if tp_cfg is None else tp_cfg.tp}
+        if target == self._current_topology():
+            return {"changed": False, "topology": target}
+        n_old = len(self.replicas)
+        kind = ("recarve" if tp_changed
+                else "grow" if n_new > n_old
+                else "shrink" if n_new < n_old else "roles")
+        seq = self._resize_seq + 1
+        fault_point("autoscale.resize")   # kill: before the INTENT
+        if self.journal is not None:
+            # raises on failure: a resize the journal cannot record
+            # must not start (the submit-append rule, one level up)
+            self.journal.append_resize_intent(seq, target)
+        self._resize_seq = seq
+        telemetry.event("router.resize", phase="intent", seq=seq,
+                        kind=kind, reason=reason,
+                        num_replicas=n_new, tp=target["tp"])
+        fault_point("autoscale.resize")   # kill: INTENT durable,
+        #                                   fleet untouched
+        self._apply_topology(n_new, role_list, tp_cfg, tp_changed)
+        fault_point("autoscale.resize")   # kill: mutated, no COMMIT
+        if self.journal is not None:
+            try:
+                self.journal.append_resize_commit(seq)
+            except Exception as e:
+                # counted-but-survived: recovery rolls the open
+                # intent forward into the SAME topology the live
+                # fleet is already running
+                self._note_append_failure(
+                    e, where="router.resize_commit")
+        self.num_resizes += 1
+        _M_RESIZES.inc(kind=kind)
+        telemetry.event("router.resize", phase="commit", seq=seq,
+                        kind=kind, reason=reason,
+                        num_replicas=n_new, tp=target["tp"])
+        fault_point("autoscale.resize")   # kill: after the COMMIT
+        return {"changed": True, "seq": seq, "kind": kind,
+                "topology": target}
+
+    def _apply_topology(self, n_new: int, role_list: List[str],
+                        tp_cfg, tp_changed: bool) -> None:
+        """The mutation half of a resize — only ever reached through
+        an intent: `resize()` journals the ``resize_intent`` first,
+        and `_topology_recover` replays one (pdt-lint PDT009 pins
+        this dominance for every topology-mutation call site)."""
+        if tp_changed:
+            self._topology_recarve(n_new, role_list, tp_cfg)
+        else:
+            if n_new < len(self.replicas):
+                self._topology_shrink(n_new)
+            elif n_new > len(self.replicas):
+                self._topology_grow(n_new, role_list)
+            self._topology_set_roles(role_list)
+        fault_point("autoscale.resize")   # kill: fleet mutated,
+        #                                   stranded work not re-routed
+        self._reroute_stranded()
+
+    def _topology_shrink(self, n_new: int) -> None:
+        """Retire the top `len - n_new` slots: drain-via-migration
+        (running work moves warm through the transfer plane), then
+        the slot dies decommissioned and its handle is removed. Work
+        that could not migrate is re-routed by `_reroute_stranded`
+        through the zero-loss failover fold-in."""
+        now = self._clock()
+        survivors = self.replicas[:n_new]
+        victims = self.replicas[n_new:]
+        for v in victims:
+            self._evacuate(v, survivors)
+        for v in victims:
+            v.auto_restart = False     # removed slots must stay gone
+            if v.state not in ReplicaState.DOWN:
+                v.die("scale_down", now)
+            self._forget_caches(v.index)
+        del self.replicas[n_new:]
+        if self.submeshes is not None:
+            # the carve is deterministic contiguous slices, so the
+            # surviving prefix is exactly the old slots' submeshes
+            self.submeshes = self.submeshes[:n_new]
+
+    def _evacuate(self, victim: ReplicaHandle,
+                  survivors: List[ReplicaHandle]) -> None:
+        """Scale-down drain: move the victim's RUNNING requests to
+        survivors through the transfer plane — pages + state, no
+        recompute — spilling each prefix payload warm into the fleet
+        store. Best-effort: a refusal (capacity, transfer fault,
+        not-yet-prefilled) leaves the request for the failover
+        fold-in, which re-prefills it bit-identically."""
+        if victim.engine is None \
+                or victim.state == ReplicaState.SUSPECT:
+            return      # nothing to donate / taint must not spread
+        for rec in list(self._live.values()):
+            if rec.done or rec.replica != victim.index \
+                    or rec.generation != victim.generation \
+                    or rec.engine_req is None:
+                continue
+            req = rec.engine_req
+            if req.status != RequestStatus.RUNNING or not req.output:
+                continue   # not prefilled: re-dispatch costs nothing
+            avail = [t for t in survivors
+                     if t.alive() and t.can_accept()
+                     and t.state != ReplicaState.SUSPECT]
+            if not avail:
+                return     # no survivor capacity: failover handles it
+            dst = min(avail, key=lambda t: (t.outstanding(), t.index))
+            try:
+                with telemetry.span("router.migrate",
+                                    request_id=rec.request_id,
+                                    from_replica=victim.index,
+                                    to_replica=dst.index,
+                                    tokens=len(rec.tokens)):
+                    new_req, payload = transfer.migrate_request(
+                        victim.engine, dst.engine, req.rid,
+                        deadline=self._remaining_deadline(rec),
+                        clock=self._clock,
+                        stage_deadline=self.transfer_stage_deadline)
+            # pdt-lint: disable=PDT006 transfer.migrate_request already
+            # counted pdt_transfer_failures_total{stage=} and emitted
+            # transfer.failed before re-raising — a second count here
+            # would double-book the same fault
+            except Exception:
+                # both engines stay consistent on any refusal/fault;
+                # the stranded request re-prefills on a survivor
+                continue
+            rec.replica, rec.generation = dst.index, dst.generation
+            rec.engine_req = new_req
+            rec.verified_len = len(rec.tokens)
+            rec.dispatches += 1
+            self.num_migrations += 1
+            victim.migrations_out += 1
+            dst.migrations_in += 1
+            if self.prefix_store is not None:
+                self.prefix_store.spill_payload(payload)
+                self.prefix_store.record(dst.index, payload["prompt"])
+
+    def _topology_grow(self, n_new: int,
+                       role_list: List[str]) -> None:
+        """Append fresh slots at the top indices. Under tp the carve
+        re-derives for the larger fleet — deterministic contiguous
+        slices, so existing slots keep their exact device sets. On
+        canary fleets every added slot lands in PROBATION."""
+        n_old = len(self.replicas)
+        if self._tp_cfg is not None:
+            from .submesh import carve_submeshes
+            self.submeshes = carve_submeshes(n_new, self._tp_cfg)
+        for i in range(n_old, n_new):
+            h = self._make_handle(i, role_list[i],
+                                  None if self.submeshes is None
+                                  else self.submeshes[i])
+            h.start_in_probation("scale_up")
+            self.replicas.append(h)
+
+    def _topology_recarve(self, n_new: int, role_list: List[str],
+                          tp_cfg) -> None:
+        """Change the tp width: every engine's sharding changes, so
+        every slot is rebuilt on the new carve (the GSPMD
+        re-partitioning shape). Replacement slots seed their
+        generation PAST the old one, so every live request reads as
+        stranded and re-enters through the failover fold-in — greedy
+        keeps the streams bit-identical. The canary golden recomputes
+        for the new carve (a different sharding is a different
+        numeric regime)."""
+        from .submesh import carve_submeshes
+        now = self._clock()
+        self._tp_cfg = tp_cfg
+        self.submeshes = None if tp_cfg is None \
+            else carve_submeshes(n_new, tp_cfg)
+        old = self.replicas
+        fresh: List[ReplicaHandle] = []
+        for i in range(n_new):
+            gen = old[i].generation + 1 if i < len(old) else 0
+            fresh.append(self._make_handle(
+                i, role_list[i],
+                None if self.submeshes is None else self.submeshes[i],
+                generation=gen))
+        for h in old:
+            h.auto_restart = False
+            if h.state not in ReplicaState.DOWN:
+                h.die("recarve", now)
+            self._forget_caches(h.index)
+        self.replicas = fresh
+        if self.canary_cfg is not None:
+            self._canary_golden = self._compute_canary_golden(
+                self._engine_factory)
+
+    def _topology_set_roles(self, role_list: List[str]) -> None:
+        """Re-role the (already right-sized) fleet: roles steer
+        scheduling only, so this is pure relabeling — plus the
+        fleet-wide prefix store coming up if roles just turned on."""
+        for h, role in zip(self.replicas, role_list):
+            if role not in ReplicaRole.ALL:
+                raise ValueError(f"unknown replica role {role!r}: "
+                                 f"{sorted(ReplicaRole.ALL)}")
+            h.role = role
+        self.roles_enabled = any(r != ReplicaRole.COLOCATED
+                                 for r in role_list)
+        if self.roles_enabled and self.prefix_store is None:
+            self.prefix_store = FleetPrefixStore(
+                page_size=self._page_size)
+            if isinstance(self.policy, PrefixAffinityPolicy) \
+                    and getattr(self.policy, "store", None) is None:
+                self.policy.store = self.prefix_store
+
+    def _reroute_stranded(self) -> None:
+        """Post-mutation failover pass: anything mirrored onto a slot
+        that no longer exists, died, or changed generation re-enters
+        NOW through the zero-loss fold-in — a resize is
+        zero-downtime, not wait-for-the-next-tick."""
+        n = len(self.replicas)
+        for rec in list(self._live.values()):
+            if rec.done:
+                continue
+            h = (self.replicas[rec.replica]
+                 if rec.replica is not None and rec.replica < n
+                 else None)
+            if h is None or not h.alive() \
+                    or rec.generation != h.generation:
+                self._failover_one(rec)
+
+    def _topology_recover(self, target: dict) -> None:
+        """Rebuild this (fresh, empty) incarnation onto a
+        journal-resolved topology during `recover()` — the replayed
+        ``resize_intent``/``resize_commit`` records are the
+        dominating intent here (`journal.replay()` precedes this on
+        every path, which is how PDT009 reads it)."""
+        from .submesh import TpConfig
+        n_new = int(target["num_replicas"])
+        roles = list(target.get("roles")
+                     or [ReplicaRole.COLOCATED] * n_new)
+        tp = target.get("tp")
+        if tp is None:
+            tp_cfg = None
+        elif self._tp_cfg is not None and self._tp_cfg.tp == int(tp):
+            tp_cfg = self._tp_cfg    # keep the constructor's config
+        else:
+            tp_cfg = TpConfig(tp=int(tp))
+        tp_changed = ((None if tp_cfg is None else tp_cfg.tp)
+                      != (None if self._tp_cfg is None
+                          else self._tp_cfg.tp))
+        self._apply_topology(n_new, roles, tp_cfg, tp_changed)
 
     # -- crash recovery (serving/journal.py) -----------------------------
     @classmethod
@@ -1365,6 +1745,25 @@ class ServingRouter:
         with telemetry.span("journal.replay", path=self.journal.path):
             replay = self.journal.replay()
         now = self._clock()
+        # journaled topology rules over the constructor's: rebuild the
+        # fleet BEFORE rehydrating work so live requests land on the
+        # resolved shape. An intent without its commit rolls FORWARD —
+        # the closing commit is appended here, so the transaction is
+        # settled for every later recovery (counted-but-survived on
+        # failure: the next recovery simply rolls forward again)
+        self._resize_seq = max(self._resize_seq, replay.resize_seq)
+        if replay.topology is not None \
+                and replay.topology != self._current_topology():
+            self._topology_recover(replay.topology)
+        if replay.resize_rolled_forward:
+            telemetry.event("router.resize", phase="rollforward",
+                            seq=replay.resize_seq,
+                            num_replicas=len(self.replicas))
+            try:
+                self.journal.append_resize_commit(replay.resize_seq)
+            except Exception as e:
+                self._note_append_failure(
+                    e, where="router.resize_commit")
         for st in replay.finished.values():
             if st.request_id in self.requests:
                 continue
@@ -1485,6 +1884,8 @@ class ServingRouter:
             "submitted": len(self.requests),
             "failovers": self.num_failovers,
             "restarts": self.num_restarts,
+            "resizes": self.num_resizes,
+            "resize_seq": self._resize_seq,
             "migrations": self.num_migrations,
             "prefix_hits": sum(h.prefix_hits() for h in self.replicas),
             "prefix_tokens_reused": sum(h.prefix_tokens_reused()

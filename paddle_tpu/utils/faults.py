@@ -96,6 +96,13 @@ Instrumented sites (grep ``fault_point(`` for the live list):
   (serving/transfer.py, the disaggregated prefill/decode page transfer
   plane — either fault leaves BOTH engines consistent, and the router
   degrades to failover re-prefill);
+* ``autoscale.resize`` — at every journal record boundary inside
+  ``ServingRouter.resize()`` (serving/router.py): before the
+  resize_intent append, after it, mid-mutation (fleet reshaped but
+  stranded work not yet re-routed), before the resize_commit append,
+  and after it — so chaos drills can SIGKILL the router at each
+  two-phase boundary and prove recovery lands in exactly the old or
+  the new topology with zero lost tokens;
 * ``journal.append`` — before any record lands in the router
   write-ahead journal (serving/journal.py): the router treats a fault
   on the SUBMIT append as a failed submit (the durability point —

@@ -37,9 +37,18 @@ from the journal, the remaining arrivals land on it, and the drill
 grades ZERO lost soak sessions + outputs identical to an unkilled
 fleet, printing the `pdt_journal_*` Prometheus dump.
 
+`--autoscale` adds a fourth leg (ISSUE 16, docs/serving.md
+"Autoscaling"): the same diurnal trace replays twice — once against a
+static peak-provisioned fleet, once against a journaled fleet scaled
+from a 1-replica floor by `FleetAutoscaler` — and the drill grades
+zero lost sessions, autoscaled p95 TTFT within the objective,
+replica-step (chip-time) savings > 0, at least one grow AND one
+shrink, and burst reaction time <= 2 virtual seconds.
+
     python recipes/fleet_soak.py                   # search + 2x soak
     python recipes/fleet_soak.py --qps 6 --overload 3
     python recipes/fleet_soak.py --duration 120 --replicas 4  # heavier
+    python recipes/fleet_soak.py --autoscale       # + the elastic leg
 """
 import argparse
 import json
@@ -70,6 +79,13 @@ def main(argv=None):
     p.add_argument("--free-budget", type=int, default=400,
                    help="sliding-window token budget for the 'free' "
                         "tenant (deliberately tight)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the elastic-fleet leg: the same diurnal "
+                        "trace against a STATIC peak-size fleet and an "
+                        "AUTOSCALED one (journal-attached, min 1 .. max "
+                        "--replicas), grading p95 TTFT parity, "
+                        "replica-step savings, burst reaction time, "
+                        "and zero lost sessions")
     p.add_argument("--quant", action="store_true",
                    help="serve the whole fleet quantized (int8 weights"
                         " + int8 KV pages, QuantServingConfig) — the "
@@ -352,6 +368,135 @@ def main(argv=None):
         print("--- end journal telemetry ---")
     finally:
         shutil.rmtree(wal_root, ignore_errors=True)
+
+    # -- phase 4 (--autoscale): the elastic fleet ------------------------
+    # the same pronounced-diurnal trace twice: a STATIC fleet pinned at
+    # peak size, then an AUTOSCALED one (journal-attached so every
+    # resize is a two-phase INTENT/COMMIT transaction) starting at one
+    # replica under a FleetAutoscaler. Grades: zero lost sessions,
+    # interactive p95 TTFT holds the objective, measurably fewer
+    # replica-steps (the chip-time proxy), bounded burst reaction, and
+    # at least one journaled grow + shrink (docs/serving.md
+    # "Autoscaling").
+    if args.autoscale:
+        from paddle_tpu.loadgen import TraceConfig as _TC
+        from paddle_tpu.serving import (AutoscalePolicy, FleetAutoscaler,
+                                        RouterJournal)
+
+        def diurnal_cfg():
+            base = max_qps * 0.6
+            return _TC(
+                seed=args.seed + 1, duration_s=2 * args.duration,
+                base_qps=base,
+                # one pronounced cycle: the trough needs ~a third of
+                # the peak's capacity — exactly the gap elasticity
+                # harvests
+                diurnal_amplitude=0.6,
+                diurnal_period_s=2 * args.duration,
+                burst_start_prob=0.0, burst_mean_s=1.0,
+                burst_multiplier=1.0,
+                prompt_len_median=10.0, prompt_len_max=prompt_max,
+                output_len_median=6.0, output_len_max=out_max,
+                tenants=(("acme", 3.0), ("bidco", 2.0), ("free", 1.0)),
+                interactive_fraction=0.4, num_system_prompts=4,
+                system_prompt_len=page, shared_prefix_prob=0.4,
+                vocab_size=cfg.vocab_size)
+
+        def elastic_soak(autoscaled, journal=None):
+            telemetry.reset()
+            router, clock, mon = build_fleet(with_qos=False,
+                                             journal=journal)
+            scaler = None
+            if autoscaled:
+                # shrink to one replica first — the drill starts at
+                # the trough-shaped fleet the policy would converge to
+                while len(router.replicas) > 1:
+                    router.resize(
+                        num_replicas=len(router.replicas) - 1,
+                        reason="autoscale-drill-floor")
+                scaler = FleetAutoscaler(
+                    router,
+                    AutoscalePolicy(
+                        min_replicas=1, max_replicas=args.replicas,
+                        scale_up_depth=2.0 * args.slots,
+                        scale_down_depth=0.75,
+                        # the capacity model: phase 1 measured the
+                        # peak fleet's sustainable rate, so one
+                        # replica's share is the per-replica capacity
+                        replica_qps=max_qps / args.replicas,
+                        up_ticks=2, down_ticks=6,
+                        cooldown_s=2.0, max_step=1),
+                    interval_s=1.0, clock=clock)
+            driver = SoakDriver(router, generate_trace(diurnal_cfg()),
+                                clock=clock, step_dt=args.step_dt,
+                                max_wall_s=1800, autoscaler=scaler)
+            return driver.run(), router, scaler
+
+        print(f"\nautoscale: diurnal drill at {max_qps * 0.6:.2f} qps "
+              f"base (static peak fleet = {args.replicas} replicas "
+              "vs autoscaled 1.." f"{args.replicas})")
+        static_res, _, _ = elastic_soak(autoscaled=False)
+        static_sum = static_res.summary()
+        wal_root2 = tempfile.mkdtemp(prefix="fleet_soak_autoscale_")
+        try:
+            auto_res, auto_router, scaler = elastic_soak(
+                autoscaled=True,
+                journal=RouterJournal(os.path.join(wal_root2, "wal"),
+                                      fsync="off"))
+            auto_sum = auto_res.summary()
+            journaled_resizes = auto_router.fleet_info()["resizes"]
+        finally:
+            shutil.rmtree(wal_root2, ignore_errors=True)
+
+        lost_auto = auto_sum["sessions"] \
+            - auto_sum["outcomes"].get("finished", 0)
+        p95_static = static_sum["lanes"].get(
+            "interactive", {}).get("ttft_p95_s")
+        p95_auto = auto_sum["lanes"].get(
+            "interactive", {}).get("ttft_p95_s")
+        savings_pct = 100.0 * (1.0 - auto_res.replica_steps
+                               / max(1, static_res.replica_steps))
+        grows = sum(1 for a in scaler.actions if a["action"] == "grow")
+        shrinks = sum(1 for a in scaler.actions
+                      if a["action"] == "shrink")
+        reaction = max(scaler.reactions, default=None)
+        autoscale_metrics = {
+            "ttft_p95_static_s": p95_static,
+            "ttft_p95_autoscaled_s": p95_auto,
+            "replica_steps_static": static_res.replica_steps,
+            "replica_steps_autoscaled": auto_res.replica_steps,
+            "replica_step_savings_pct": round(savings_pct, 2),
+            "burst_reaction_s": reaction,
+            "grows": grows, "shrinks": shrinks,
+            "journaled_resizes": journaled_resizes,
+            "lost_sessions": lost_auto,
+        }
+        print(json.dumps({"autoscale": autoscale_metrics}, indent=1))
+        if lost_auto:
+            failures.append(
+                f"autoscaled soak lost {lost_auto} session(s) — "
+                "elasticity must never cost work")
+        if p95_auto is None:
+            failures.append("autoscaled soak produced no interactive "
+                            "TTFT samples")
+        elif p95_auto > objective:
+            failures.append(
+                f"autoscaled interactive p95 TTFT {p95_auto:.3f}s "
+                f"exceeds the {objective:g}s objective (static peak "
+                f"fleet held {p95_static})")
+        if savings_pct <= 0:
+            failures.append(
+                f"autoscaling saved no replica-steps "
+                f"({auto_res.replica_steps} vs "
+                f"{static_res.replica_steps} static)")
+        if grows < 1 or shrinks < 1:
+            failures.append(
+                f"diurnal cycle should force both directions: "
+                f"{grows} grows, {shrinks} shrinks")
+        if reaction is not None and reaction > 2.0:
+            failures.append(
+                f"burst reaction {reaction:.2f}s exceeds the 2.0s "
+                "bound (hysteresis + cooldown mistuned)")
 
     print()
     if failures:

@@ -87,6 +87,80 @@ class TestDeriveRetryAfter:
     def test_cap(self):
         assert derive_retry_after(0.05, burn_rate=1e12, cap=60.0) == 60.0
 
+    # -- property sweeps (ISSUE 16 satellite): the hint is a sane
+    # backoff function over its whole input range, not just the
+    # point cases above
+    _DEPTHS = (0, 1, 2, 5, 17, 100, 10_000)
+    _BURNS = (0.0, 0.3, 1.0, 2.5, 20.0, 1e6)
+    _BASES = (0.01, 0.05, 1.0)
+
+    def test_monotone_in_queue_depth(self):
+        for base in self._BASES:
+            for burn in self._BURNS:
+                hints = [derive_retry_after(base, queue_depth=d,
+                                            burn_rate=burn)
+                         for d in self._DEPTHS]
+                assert hints == sorted(hints), \
+                    f"depth-monotonicity broke at base={base} " \
+                    f"burn={burn}: {hints}"
+
+    def test_monotone_in_burn_rate(self):
+        for base in self._BASES:
+            for depth in self._DEPTHS:
+                hints = [derive_retry_after(base, queue_depth=depth,
+                                            burn_rate=b)
+                         for b in self._BURNS]
+                assert hints == sorted(hints), \
+                    f"burn-monotonicity broke at base={base} " \
+                    f"depth={depth}: {hints}"
+
+    def test_floored_at_base_capped_at_cap_everywhere(self):
+        for base in self._BASES:
+            for depth in self._DEPTHS:
+                for burn in self._BURNS:
+                    h = derive_retry_after(base, queue_depth=depth,
+                                           burn_rate=burn)
+                    assert base <= h <= 60.0
+                    assert derive_retry_after(
+                        base, queue_depth=depth, burn_rate=burn,
+                        cap=2.0) <= 2.0
+
+    def test_negative_burn_never_undercuts_the_floor(self):
+        assert derive_retry_after(0.05, burn_rate=-5.0) == 0.05
+
+    def test_autoscaler_cooldown_never_undercuts_retry_after(
+            self, model):
+        """The flapping-guard invariant (serving/autoscaler.py
+        `cooldown_for`): whatever retry-after hint the fleet handed
+        its shed clients under some (depth, burn) pressure, the
+        autoscaler's post-action cooldown under the SAME pressure is
+        at least as long — capacity cannot flap away before the
+        clients it turned away were told to come back."""
+        from paddle_tpu.serving import (AutoscaleObservation,
+                                        AutoscalePolicy,
+                                        FleetAutoscaler)
+        clock = FakeClock()
+        router = _qos_router(model, clock, None, None)
+        scaler = FleetAutoscaler(
+            router, AutoscalePolicy(cooldown_s=0.0), clock=clock)
+        for depth in self._DEPTHS:
+            for burn in self._BURNS:
+                obs = AutoscaleObservation(
+                    t=clock(), arrival_qps=0.0, queue_depth=depth,
+                    queue_min=depth, burn=burn, replicas=2,
+                    serving=2, quarantined=0, journal_failing=False)
+                hint = derive_retry_after(router._retry_cost,
+                                          queue_depth=depth,
+                                          burn_rate=burn)
+                assert scaler.cooldown_for(obs) >= hint
+        # and the policy floor still rules when it is the larger term
+        slow = FleetAutoscaler(
+            router, AutoscalePolicy(cooldown_s=45.0), clock=clock)
+        assert slow.cooldown_for(AutoscaleObservation(
+            t=0.0, arrival_qps=0.0, queue_depth=0, queue_min=0,
+            burn=0.0, replicas=2, serving=2, quarantined=0,
+            journal_failing=False)) == 45.0
+
 
 class TestTenantBudget:
     def test_sliding_window_refill(self):

@@ -1,0 +1,556 @@
+"""Elastic autoscaling control plane (ISSUE 16):
+`ServingRouter.resize()` two-phase crash-durable fleet resizing and
+the `FleetAutoscaler` control loop (`serving/autoscaler.py`).
+
+The acceptance drill threaded through this file: a router SIGKILL at
+EVERY journal record boundary inside a scale-up AND a scale-down
+(before/after INTENT, mid-mutation, before/after COMMIT — the
+``autoscale.resize`` fault site), at tp=1 and tp=2, followed by
+`recover()`, yields the fleet in exactly the old topology (killed
+before the intent reached disk) or the new one (any later instant),
+with zero lost or duplicated requests and greedy streams BIT-IDENTICAL
+to an undisturbed fleet. conftest runs this file with PDT_TELEMETRY=1
+and PDT_CHECK_INVARIANTS=1."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as telemetry
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import (ContinuousBatchingEngine,
+                                       RequestStatus)
+from paddle_tpu.serving import (AutoscalePolicy, FleetAutoscaler,
+                                ReplicaRole, ReplicaState,
+                                RouterJournal, ServingRouter)
+from paddle_tpu.utils.faults import FaultError, FaultInjector
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def model():
+    # head counts divisible by the tp=2 carve the drills use
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      max_position_embeddings=64)
+    paddle.seed(7)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _factory(model, clock=None, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", 4)
+
+    def make(index, submesh=None):
+        return ContinuousBatchingEngine(model, clock=clock,
+                                        submesh=submesh, **kw)
+
+    return make
+
+
+def _jobs(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, 64, int(rng.integers(4, 8))).tolist()
+            for _ in range(n)]
+
+
+JOBS = _jobs()
+N_TOKS = [4, 10, 8, 14]      # staggered so finished + live coexist
+
+
+def _fleet(model, num_replicas=2, clock=None, **kw):
+    clock = clock if clock is not None else FakeClock()
+    kw.setdefault("page_size", 4)   # match the engines' page size so
+    #                                 prefix spill stays live
+    router = ServingRouter(_factory(model, clock),
+                           num_replicas=num_replicas, clock=clock,
+                           sleep=clock.advance, **kw)
+    return router, clock
+
+
+def _submit_jobs(router):
+    return [router.submit(p, n) for p, n in zip(JOBS, N_TOKS)]
+
+
+@pytest.fixture(scope="module")
+def oracle(model):
+    """The undisturbed fleet's streams for JOBS — greedy decoding is
+    batching-invariant AND tp-invariant (exact mode), so every resize
+    drill below must reproduce these exactly."""
+    router, _ = _fleet(model)
+    ids = _submit_jobs(router)
+    out = router.run()
+    return [out[i] for i in ids]
+
+
+# -- resize(): the operator surface ------------------------------------
+class TestResize:
+    def test_noop_resize_reports_unchanged(self, model):
+        router, _ = _fleet(model, num_replicas=2)
+        res = router.resize(num_replicas=2)
+        assert res == {"changed": False,
+                       "topology": {"num_replicas": 2,
+                                    "roles": ["colocated"] * 2,
+                                    "tp": None}}
+        assert router.num_resizes == 0
+
+    def test_grow_and_shrink_mid_flight_bit_identical(self, model,
+                                                      oracle):
+        router, _ = _fleet(model, num_replicas=2)
+        ids = _submit_jobs(router)
+        router.step()
+        grew = router.resize(num_replicas=4)
+        assert grew["kind"] == "grow" and len(router.replicas) == 4
+        router.step()
+        shrunk = router.resize(num_replicas=1)
+        assert shrunk["kind"] == "shrink"
+        assert len(router.replicas) == 1
+        out = router.run()
+        assert [out[i] for i in ids] == oracle
+        assert router.num_resizes == 2
+        info = router.fleet_info()
+        assert info["resizes"] == 2 and info["resize_seq"] == 2
+
+    def test_shrink_drains_via_migration_and_spills_prefixes(
+            self, model):
+        """Scale-down is a DRAIN, not a kill: running requests with
+        output move warm through the transfer plane, and on
+        role-managed fleets their prefix payloads spill into the
+        fleet store."""
+        router, _ = _fleet(model, num_replicas=2,
+                           roles="prefill:1,decode:1")
+        assert router.prefix_store is not None
+        ids = _submit_jobs(router)
+        # run until decode work actually lives on replica 1 (the
+        # doomed top slot of the shrink below)
+        for _ in range(40):
+            router.step()
+            if any(not rec.done and rec.replica == 1
+                   and rec.engine_req is not None
+                   and rec.engine_req.output
+                   for rec in router._live.values()):
+                break
+        else:
+            pytest.fail("no running request landed on replica 1")
+        res = router.resize(roles="colocated:1")
+        assert res["kind"] == "shrink"
+        # num_migrations increments ONLY in the scale-down drain (the
+        # disagg prefill->decode handoff has its own counter)
+        assert router.num_migrations >= 1
+        store = router.prefix_store.stats()
+        assert store["spilled_chains"] >= 1 \
+            and store["spilled_bytes"] > 0
+        assert int(telemetry.value(
+            "pdt_prefix_store_spilled_bytes")) > 0
+        out = router.run()
+        assert all(len(out[i]) == n for i, n in zip(ids, N_TOKS))
+
+    def test_recarve_tp_mid_flight_bit_identical(self, model, oracle):
+        """A tp change rebuilds every slot on the new carve; live
+        requests re-enter through the failover fold-in and the greedy
+        streams never fork."""
+        router, _ = _fleet(model, num_replicas=2)
+        ids = _submit_jobs(router)
+        router.step()
+        res = router.resize(tp=2)
+        assert res["kind"] == "recarve"
+        assert router._tp_cfg is not None and router._tp_cfg.tp == 2
+        assert all(h.submesh is not None for h in router.replicas)
+        out = router.run()
+        assert [out[i] for i in ids] == oracle
+
+    def test_roles_only_resize_relabels(self, model):
+        router, _ = _fleet(model, num_replicas=2)
+        res = router.resize(roles="prefill:1,decode:1")
+        assert res["kind"] == "roles" and res["changed"]
+        assert [h.role for h in router.replicas] \
+            == [ReplicaRole.PREFILL, ReplicaRole.DECODE]
+        assert router.roles_enabled and router.prefix_store is not None
+
+    def test_impossible_targets_refuse_before_intent(self, model,
+                                                     tmp_path):
+        jr = RouterJournal(tmp_path / "wal", fsync="off")
+        router, _ = _fleet(model, num_replicas=2, journal=jr)
+        with pytest.raises(ValueError):
+            router.resize(num_replicas=0)
+        with pytest.raises(ValueError):
+            router.resize(roles="decode:2")     # nothing can prefill
+        with pytest.raises(ValueError):
+            router.resize(num_replicas=8, tp=2)  # 16 devices > 8
+        # none of the refusals journaled an intent
+        assert telemetry.value("pdt_journal_records_total",
+                               kind="resize_intent") == 0
+
+    def test_grow_lands_in_probation_on_canary_fleets(self, model):
+        from paddle_tpu.serving import CanaryConfig
+        router, _ = _fleet(model, num_replicas=1,
+                           canary=CanaryConfig(interval=1000.0,
+                                               max_new_tokens=4))
+        router.resize(num_replicas=2)
+        assert router.replicas[1].state == ReplicaState.PROBATION
+        assert router.replicas[0].state == ReplicaState.HEALTHY
+        # probation clears through the ordinary canary machinery
+        ids = _submit_jobs(router)
+        out = router.run()
+        assert all(len(out[i]) == n for i, n in zip(ids, N_TOKS))
+
+
+# -- the acceptance chaos drill ----------------------------------------
+# the 5 sequential autoscale.resize fault boundaries inside resize():
+#   1 before INTENT | 2 after INTENT | 3 mid-mutation (fleet reshaped,
+#   stranded work not yet re-routed) | 4 mutated, before COMMIT |
+#   5 after COMMIT
+_BOUNDARIES = (1, 2, 3, 4, 5)
+
+
+class TestResizeCrashMatrix:
+    def _journaled(self, model, tmp_path, n, tp=None):
+        clock = FakeClock()
+        jr = RouterJournal(os.path.join(str(tmp_path), "wal"),
+                           fsync="off", clock=clock)
+        router = ServingRouter(_factory(model, clock),
+                               num_replicas=n, tp=tp, clock=clock,
+                               sleep=clock.advance, journal=jr)
+        return router, jr, clock
+
+    @pytest.mark.parametrize("tp", [None, 2])
+    @pytest.mark.parametrize("direction", ["up", "down"])
+    @pytest.mark.parametrize("boundary", _BOUNDARIES)
+    def test_sigkill_at_every_resize_boundary(self, model, tmp_path,
+                                              oracle, boundary,
+                                              direction, tp):
+        """SIGKILL the router at each journal record boundary inside a
+        scale-up and a scale-down, tp=1 and tp=2: recover() lands on
+        the OLD topology iff the kill preceded the durable INTENT
+        (boundary 1) and the NEW topology anywhere later (roll
+        forward), with no lost or duplicated requests and streams
+        bit-identical to the undisturbed fleet."""
+        n_old = 1 if direction == "up" else 2
+        n_new = 2 if direction == "up" else 1
+        router, jr, clock = self._journaled(model, tmp_path, n_old,
+                                            tp=tp)
+        ids = _submit_jobs(router)
+        router.step()                      # mid-flight: tokens mirrored
+        router.step()
+        with FaultInjector(seed=0) as fi:
+            fi.arm("autoscale.resize", nth=boundary)
+            with pytest.raises(FaultError):
+                router.resize(num_replicas=n_new, reason="drill")
+        del router                         # SIGKILL-shaped teardown
+        del jr                             # flush the dead buffers
+        jr2 = RouterJournal(os.path.join(str(tmp_path), "wal"),
+                            fsync="off", clock=clock)
+        recovered = ServingRouter.recover(
+            jr2, _factory(model, clock), num_replicas=n_old, tp=tp,
+            clock=clock, sleep=clock.advance)
+        expect = n_old if boundary == 1 else n_new
+        assert len(recovered.replicas) == expect, \
+            f"boundary {boundary}: recovered into {direction} " \
+            f"topology of {len(recovered.replicas)} != {expect}"
+        if tp is not None:
+            assert recovered._tp_cfg.tp == tp
+            assert all(h.submesh is not None
+                       for h in recovered.replicas)
+        out = recovered.run()
+        # zero lost, zero duplicated: exactly the submitted ids are
+        # terminal, each FINISHED exactly once, bit-identical
+        assert sorted(out) == sorted(ids)
+        assert [out[i] for i in ids] == oracle
+        assert all(recovered.requests[i].status
+                   == RequestStatus.FINISHED for i in ids)
+        # an interrupted transaction (boundaries 2-4) rolled FORWARD:
+        # recovery appended the closing commit itself
+        replay_again = RouterJournal(
+            os.path.join(str(tmp_path), "wal"), fsync="off",
+            clock=clock).replay()
+        assert replay_again.resize_rolled_forward is False
+        if boundary == 1:
+            assert replay_again.topology is None
+        else:
+            assert replay_again.topology["num_replicas"] == n_new
+
+    def test_second_recovery_is_stable(self, model, tmp_path, oracle):
+        """Recover, kill again WITHOUT completing the work, recover
+        again: the rolled-forward topology and the streams hold."""
+        router, jr, clock = self._journaled(model, tmp_path, 1)
+        ids = _submit_jobs(router)
+        router.step()
+        with FaultInjector(seed=0) as fi:
+            fi.arm("autoscale.resize", nth=3)
+            with pytest.raises(FaultError):
+                router.resize(num_replicas=2, reason="drill")
+        del router
+        del jr
+        jr2 = RouterJournal(os.path.join(str(tmp_path), "wal"),
+                            fsync="off", clock=clock)
+        rec1 = ServingRouter.recover(jr2, _factory(model, clock),
+                                     num_replicas=1, clock=clock,
+                                     sleep=clock.advance)
+        assert len(rec1.replicas) == 2
+        rec1.step()                        # partial progress only
+        del rec1
+        del jr2
+        jr3 = RouterJournal(os.path.join(str(tmp_path), "wal"),
+                            fsync="off", clock=clock)
+        rec2 = ServingRouter.recover(jr3, _factory(model, clock),
+                                     num_replicas=1, clock=clock,
+                                     sleep=clock.advance)
+        assert len(rec2.replicas) == 2
+        out = rec2.run()
+        assert [out[i] for i in ids] == oracle
+
+
+# -- the control loop --------------------------------------------------
+class TestAutoscalePolicy:
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=0)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(max_step=0)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(scale_up_depth=1.0, scale_down_depth=2.0)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(up_ticks=0)
+
+
+class TestFleetAutoscaler:
+    def _scaler(self, model, *, policy=None, n=1, interval=1.0,
+                **fleet_kw):
+        router, clock = _fleet(model, num_replicas=n, **fleet_kw)
+        policy = policy or AutoscalePolicy(
+            min_replicas=1, max_replicas=3, scale_up_depth=2.0,
+            scale_down_depth=0.5, up_ticks=2, down_ticks=3,
+            cooldown_s=2.0, max_step=1)
+        return FleetAutoscaler(router, policy, interval_s=interval,
+                               clock=clock), router, clock
+
+    def _tick(self, scaler, router, clock, n, step=True):
+        out = []
+        for _ in range(n):
+            if step:
+                router.step()
+            clock.advance(1.0)
+            res = scaler.tick()
+            if res is not None:
+                out.append(res)
+        return out
+
+    def test_hysteresis_needs_consecutive_pressure(self, model):
+        scaler, router, clock = self._scaler(model)
+        _submit_jobs(router)               # 4 outstanding on 1 replica
+        clock.advance(1.0)
+        first = scaler.tick()              # first high observation
+        assert first["action"] == "hold" and len(router.replicas) == 1
+        clock.advance(1.0)
+        second = scaler.tick()             # streak reaches up_ticks
+        assert second["action"] == "grow"
+        assert len(router.replicas) == 2
+        assert second["reaction_s"] == pytest.approx(1.0)
+        router.run()
+
+    def test_scale_down_at_sustained_idle_and_floor(self, model):
+        scaler, router, clock = self._scaler(model, n=3)
+        acts = [r["action"] for r in
+                self._tick(scaler, router, clock, 30, step=False)]
+        assert acts.count("shrink") == 2   # 3 -> 2 -> 1, then floored
+        assert len(router.replicas) == 1
+        assert {"action": "hold", "reason": "at_min_replicas"} in [
+            {k: r[k] for k in ("action", "reason")}
+            for r in self._tick(scaler, router, clock, 5, step=False)]
+
+    def test_cooldown_blocks_flapping(self, model):
+        policy = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                 scale_up_depth=2.0,
+                                 scale_down_depth=0.5, up_ticks=1,
+                                 down_ticks=1, cooldown_s=30.0,
+                                 max_step=1)
+        scaler, router, clock = self._scaler(model, policy=policy)
+        _submit_jobs(router)
+        self._tick(scaler, router, clock, 1)
+        assert len(router.replicas) == 2   # grew once...
+        held = self._tick(scaler, router, clock, 5)
+        assert len(router.replicas) == 2   # ...then cooldown holds
+        assert not any(r["action"] in ("grow", "shrink") for r in held)
+        assert any(r == {"action": "hold", "reason": "cooldown",
+                         "until": r.get("until")} and r["until"] >= 30.0
+                   for r in held)
+        router.run()
+
+    def test_max_step_and_max_replicas_clamp(self, model):
+        policy = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                 scale_up_depth=1.0,
+                                 scale_down_depth=0.0, up_ticks=1,
+                                 down_ticks=99, cooldown_s=0.0,
+                                 max_step=1)
+        scaler, router, clock = self._scaler(model, policy=policy)
+        _submit_jobs(router)
+        acts = self._tick(scaler, router, clock, 6)
+        assert len(router.replicas) == 2   # one step at a time, capped
+        assert [a["action"] for a in acts].count("grow") == 1
+        assert any(a.get("reason") == "at_max_replicas" for a in acts)
+        router.run()
+
+    def test_degraded_mode_refuses_scale_up_while_quarantined(
+            self, model):
+        scaler, router, clock = self._scaler(model, n=2)
+        router.replicas[1].state = ReplicaState.QUARANTINED
+        _submit_jobs(router)
+        # step=False: the queue must stay deep through the drill so
+        # the only thing standing between pressure and a grow is the
+        # quarantined replica
+        refusals = [r for r in
+                    self._tick(scaler, router, clock, 4, step=False)
+                    if r["action"] == "refused"]
+        assert refusals and all(r["reason"] == "quarantined"
+                                for r in refusals)
+        assert len(router.replicas) == 2   # the fleet did NOT grow
+        assert scaler.num_refusals == len(refusals)
+        assert telemetry.value("pdt_autoscaler_refusals_total",
+                               reason="quarantined") \
+            == len(refusals)
+        # the fleet heals -> the pent-up streak acts immediately
+        router.replicas[1].state = ReplicaState.HEALTHY
+        clock.advance(1.0)
+        assert scaler.tick()["action"] == "grow"
+        router.run()
+
+    def test_degraded_mode_refuses_scale_up_on_journal_failures(
+            self, model):
+        scaler, router, clock = self._scaler(model)
+        _submit_jobs(router)
+        clock.advance(1.0)
+        scaler.tick()                          # high streak = 1
+        router.journal_append_failures += 1    # fsync trouble tick
+        clock.advance(1.0)
+        res = scaler.tick()                    # streak due -> refused
+        assert res == {"action": "refused", "reason": "journal_failing"}
+        assert len(router.replicas) == 1
+        # failures stopped advancing -> the next due tick proceeds
+        clock.advance(1.0)
+        assert scaler.tick()["action"] == "grow"
+        router.run()
+
+    def test_roles_mix_policy_applies_on_resize(self, model):
+        policy = AutoscalePolicy(min_replicas=1, max_replicas=4,
+                                 scale_up_depth=1.0,
+                                 scale_down_depth=0.0, up_ticks=1,
+                                 down_ticks=99, cooldown_s=0.0,
+                                 max_step=3, prefill_fraction=0.5)
+        scaler, router, clock = self._scaler(model, policy=policy)
+        _submit_jobs(router)
+        clock.advance(1.0)
+        router.step()
+        res = scaler.tick()
+        assert res["action"] == "grow"
+        assert [h.role for h in router.replicas] \
+            == [ReplicaRole.PREFILL, ReplicaRole.PREFILL,
+                ReplicaRole.DECODE, ReplicaRole.DECODE]
+        router.run()
+
+    def test_wide_tp_recarve_at_idle_and_back_under_pressure(
+            self, model):
+        policy = AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                 scale_up_depth=2.0,
+                                 scale_down_depth=0.5, up_ticks=2,
+                                 down_ticks=2, cooldown_s=0.0,
+                                 max_step=1, wide_tp=2)
+        router, clock = _fleet(model, num_replicas=1, tp=1)
+        scaler = FleetAutoscaler(router, policy, interval_s=1.0,
+                                 clock=clock)
+        # sustained idle at the floor: trade the narrow carve for the
+        # wide latency-optimized one
+        acts = []
+        for _ in range(4):
+            clock.advance(1.0)
+            r = scaler.tick()
+            if r:
+                acts.append(r)
+        assert any(a["action"] == "recarve" for a in acts)
+        assert router._tp_cfg.tp == 2
+        # pressure: recarve BACK to the base tp before count-growth
+        _submit_jobs(router)
+        back = []
+        for _ in range(4):
+            router.step()
+            clock.advance(1.0)
+            r = scaler.tick()
+            if r:
+                back.append(r)
+        kinds = [a["action"] for a in back]
+        assert "recarve" in kinds
+        assert router._tp_cfg.tp == 1
+        assert "grow" in kinds[kinds.index("recarve"):] \
+            or len(router.replicas) == 2
+        router.run()
+
+    def test_journaled_autoscaler_actions_are_resize_transactions(
+            self, model, tmp_path):
+        clock = FakeClock()
+        jr = RouterJournal(tmp_path / "wal", fsync="off", clock=clock)
+        router = ServingRouter(_factory(model, clock), num_replicas=1,
+                               clock=clock, sleep=clock.advance,
+                               journal=jr)
+        scaler = FleetAutoscaler(
+            router, AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                    scale_up_depth=2.0,
+                                    scale_down_depth=0.5, up_ticks=1,
+                                    down_ticks=99, cooldown_s=0.0),
+            interval_s=1.0, clock=clock)
+        ids = _submit_jobs(router)
+        clock.advance(1.0)
+        router.step()
+        assert scaler.tick()["action"] == "grow"
+        assert telemetry.value("pdt_journal_records_total",
+                               kind="resize_intent") == 1
+        assert telemetry.value("pdt_journal_records_total",
+                               kind="resize_commit") == 1
+        out = router.run()
+        assert all(len(out[i]) == n for i, n in zip(ids, N_TOKS))
+
+    def test_resize_failure_is_a_visible_refusal_not_a_crash(
+            self, model, tmp_path):
+        """A journal that cannot append the INTENT fails the resize;
+        the control loop records a degraded-mode refusal and keeps
+        running instead of dying."""
+        clock = FakeClock()
+        jr = RouterJournal(tmp_path / "wal", fsync="off", clock=clock)
+        router = ServingRouter(_factory(model, clock), num_replicas=1,
+                               clock=clock, sleep=clock.advance,
+                               journal=jr)
+        scaler = FleetAutoscaler(
+            router, AutoscalePolicy(min_replicas=1, max_replicas=2,
+                                    scale_up_depth=2.0,
+                                    scale_down_depth=0.5, up_ticks=1,
+                                    down_ticks=99, cooldown_s=0.0),
+            interval_s=1.0, clock=clock)
+        _submit_jobs(router)
+        clock.advance(1.0)
+        with FaultInjector(seed=0) as fi:
+            fi.arm("journal.append", nth=1)
+            res = scaler.tick()
+        assert res["action"] == "refused" \
+            and res["reason"] == "resize_failed"
+        assert len(router.replicas) == 1
+        # next tick, healthy journal: the grow goes through
+        clock.advance(1.0)
+        assert scaler.tick()["action"] == "grow"
+        router.run()

@@ -113,7 +113,12 @@ def _record_spans(blob):
 # -- the record format + replay ----------------------------------------
 class TestRecordFormat:
     def test_roundtrip_submit_progress_terminal(self, tmp_path):
-        with RouterJournal(tmp_path / "wal", fsync="off") as jr:
+        # both instances share one frozen clock: replay re-anchors
+        # deadlines to remaining-time-at-last-stamp (satellite drill
+        # below), so only zero elapsed time keeps them literal
+        clock = FakeClock()
+        with RouterJournal(tmp_path / "wal", fsync="off",
+                           clock=clock) as jr:
             jr.append_submit(request_id="a", prompt=[1, 2, 3],
                              max_new_tokens=8, lane="batch",
                              tenant="acme", priority=1,
@@ -123,7 +128,8 @@ class TestRecordFormat:
             assert jr.step_mirror({"a": [7, 8, 10], "b": [9]}) == 1
             jr.append_terminal("b", RequestStatus.FINISHED,
                                [9, 11, 12, 13])
-        rep = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        rep = RouterJournal(tmp_path / "wal", fsync="off",
+                            clock=clock).replay()
         assert set(rep.live) == {"a"} and set(rep.finished) == {"b"}
         a = rep.live["a"]
         assert (a.prompt, a.tokens, a.lane, a.tenant, a.priority,
@@ -258,6 +264,51 @@ class TestRecordFormat:
             RouterJournal(tmp_path / "a", segment_bytes=0)
         with pytest.raises(ValueError):
             RouterJournal(tmp_path / "b", compact_finalized=0)
+
+
+# -- two-phase resize records (ISSUE 16) --------------------------------
+class TestResizeRecords:
+    TOPO = {"num_replicas": 3, "roles": ["colocated"] * 3, "tp": None}
+
+    def test_intent_without_commit_rolls_forward(self, tmp_path):
+        with RouterJournal(tmp_path / "wal", fsync="off") as jr:
+            jr.append_resize_intent(1, self.TOPO)
+        replay = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert replay.topology == self.TOPO
+        assert replay.resize_seq == 1
+        assert replay.resize_rolled_forward is True
+
+    def test_commit_settles_the_transaction(self, tmp_path):
+        with RouterJournal(tmp_path / "wal", fsync="off") as jr:
+            jr.append_resize_intent(1, self.TOPO)
+            jr.append_resize_commit(1)
+        replay = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert replay.topology == self.TOPO
+        assert replay.resize_seq == 1
+        assert replay.resize_rolled_forward is False
+
+    def test_latest_resize_wins(self, tmp_path):
+        smaller = {"num_replicas": 1, "roles": ["colocated"], "tp": None}
+        with RouterJournal(tmp_path / "wal", fsync="off") as jr:
+            jr.append_resize_intent(1, self.TOPO)
+            jr.append_resize_commit(1)
+            jr.append_resize_intent(2, smaller)    # open: rolls forward
+        replay = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert replay.topology == smaller
+        assert replay.resize_seq == 2
+        assert replay.resize_rolled_forward is True
+
+    def test_topology_survives_compaction(self, tmp_path):
+        with RouterJournal(tmp_path / "wal", fsync="off") as jr:
+            jr.append_resize_intent(1, self.TOPO)
+            jr.append_resize_commit(1)
+            jr.compact()                # supersedes the resize segment
+        replay = RouterJournal(tmp_path / "wal", fsync="off").replay()
+        assert replay.topology == self.TOPO
+        assert replay.resize_seq == 1
+        assert replay.resize_rolled_forward is False
+        assert telemetry.value("pdt_journal_records_total",
+                               kind="topology") >= 1
 
 
 # -- torn-tail tolerance (the parse_done tradition) --------------------
@@ -601,9 +652,16 @@ class TestRouterRecovery:
 
     def test_recover_finalizes_expired_deadlines_honestly(
             self, model, tmp_path):
+        """A deadline that expired while the router was ALIVE (proved
+        by journaled clock stamps past it) finalizes as an honest
+        TIMEOUT at recovery — dead time after the kill doesn't matter
+        (the re-anchoring drill below proves it burns no budget)."""
         router, jr, clock = _journaled_router(model, tmp_path)
         rid = router.submit(JOBS[0], N_TOK, deadline=5.0)
-        router.step()
+        clock.advance(6.0)                    # alive past the deadline
+        # a second submit stamps the journal's clock at t=6 WITHOUT
+        # stepping (the live router never got to finalize the expiry)
+        rid2 = router.submit(JOBS[1], N_TOK)
         del router
         clock.advance(60.0)                   # the router was dead
         recovered, jr2 = self._recover(model, tmp_path, clock)
@@ -611,11 +669,47 @@ class TestRouterRecovery:
         assert rec.status == RequestStatus.TIMEOUT
         delivered = recovered.step()          # backlog delivery
         assert [r.request_id for r in delivered] == [rid]
+        out = recovered.run()                 # the fresh one completes
+        assert len(out[rid2]) == N_TOK
         # the honest timeout was journaled: a SECOND recovery dedupes
         recovered2, _ = self._recover(model, tmp_path, clock,
                                       name="wal")
         assert recovered2.requests[rid].status == RequestStatus.TIMEOUT
         assert recovered2.requests[rid].dispatches == 0
+
+    def test_recover_reanchors_deadlines_as_remaining_time(
+            self, model, tmp_path):
+        """The satellite-1 regression drill: a SLOW RESTART must not
+        mass-expire live requests. Deadlines replay as remaining-time-
+        at-last-journaled-stamp, so only time the router provably
+        spent alive burns budget — the 300s dead gap here would have
+        expired every request under absolute-deadline replay."""
+        router, jr, clock = _journaled_router(model, tmp_path)
+        rids = [router.submit(p, n, deadline=50.0)
+                for p, n in zip(JOBS, N_TOKS)]
+        router.step()                         # stamped progress at ~t0
+        alive_t = clock()
+        del router
+        clock.advance(300.0)                  # crash + slow restart
+        recovered, jr2 = self._recover(model, tmp_path, clock)
+        for rid in rids:
+            rec = recovered.requests[rid]
+            assert rec.status != RequestStatus.TIMEOUT
+            # the full ~50s budget survived, minus only alive time
+            assert rec.deadline_abs == pytest.approx(
+                clock() + 50.0 - alive_t, abs=1.0)
+        # the re-anchored deadlines survive a COMPACTION (snapshots
+        # re-stamp in the compacting incarnation's epoch) + a SECOND
+        # 300s dead gap: budgets never double-burn, work completes
+        jr2.compact()
+        del recovered
+        clock.advance(300.0)
+        recovered2, _ = self._recover(model, tmp_path, clock)
+        for rid in rids:
+            assert recovered2.requests[rid].status \
+                != RequestStatus.TIMEOUT
+        out = recovered2.run()
+        assert sorted(len(out[r]) for r in rids) == sorted(N_TOKS)
 
     def test_recover_restores_qos_budget_context(self, model, tmp_path):
         clock = FakeClock()

@@ -28,6 +28,7 @@ from paddle_tpu.analysis.checkers import (CatalogDriftChecker,
                                           FaultSiteDriftChecker,
                                           InjectableClockChecker,
                                           PinPairingChecker,
+                                          ResizeIntentChecker,
                                           SwallowedErrorChecker,
                                           TracedHostSyncChecker)
 
@@ -488,6 +489,55 @@ class TestDurableWrite:
         assert res.new == []
 
 
+# -- PDT009 resize-intent ----------------------------------------------
+class TestResizeIntent:
+    def test_undominated_mutation_flagged(self, tmp_path):
+        res = run_one(tmp_path, ResizeIntentChecker(), {
+            "paddle_tpu/serving/router.py": """\
+                def hot_scale(self, n):
+                    self._topology_grow(n, [])       # finding: no intent
+                    self._note_resize(n)
+            """})
+        assert [(f.code, f.detail) for f in res.new] == [
+            ("PDT009", "hot_scale:_topology_grow")]
+
+    def test_intent_dominated_mutation_passes(self, tmp_path):
+        res = run_one(tmp_path, ResizeIntentChecker(), {
+            "paddle_tpu/serving/router.py": """\
+                def resize(self, n):
+                    self.journal.append_resize_intent(1, {})
+                    self._apply_topology(n, [], None, False)
+                    self.journal.append_resize_commit(1)
+
+                def _rehydrate(self):
+                    replay = self.journal.replay()
+                    self._topology_recover(replay.topology)
+            """})
+        assert res.new == []
+
+    def test_mutator_internals_and_late_intent_split(self, tmp_path):
+        res = run_one(tmp_path, ResizeIntentChecker(), {
+            "paddle_tpu/serving/router.py": """\
+                def _apply_topology(self, n):
+                    self._topology_shrink(n)     # inside the family: ok
+                    self._topology_set_roles([])
+
+                def backwards(self, n):
+                    self._topology_recarve(n, [], None)  # finding:
+                    self.journal.append_resize_intent(1, {})  # too late
+            """})
+        assert [(f.code, f.detail) for f in res.new] == [
+            ("PDT009", "backwards:_topology_recarve")]
+
+    def test_scope_is_serving_only(self, tmp_path):
+        res = run_one(tmp_path, ResizeIntentChecker(), {
+            "paddle_tpu/loadgen/driver.py": """\
+                def helper(router, n):
+                    router._topology_grow(n, [])     # not serving/: fine
+            """})
+        assert res.new == []
+
+
 # -- suppressions -------------------------------------------------------
 class TestSuppressions:
     FILES = {
@@ -833,7 +883,7 @@ class TestRepoGate:
     def test_registry_is_complete(self):
         assert sorted(by_code()) == ["PDT001", "PDT002", "PDT003",
                                      "PDT004", "PDT005", "PDT006",
-                                     "PDT007", "PDT008"]
+                                     "PDT007", "PDT008", "PDT009"]
         assert len(default_checkers(["PDT003", "PDT004"])) == 2
         with pytest.raises(ValueError):
             default_checkers(["PDT777"])

@@ -15,8 +15,9 @@ from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
 from paddle_tpu.models.serving import (ContinuousBatchingEngine,
                                        EngineOverloaded, RequestStatus)
 from paddle_tpu.serving import (DispatchPolicy, FleetOverloaded,
-                                PrefixAffinityPolicy, ReplicaState,
-                                ServingRouter, make_policy)
+                                PrefixAffinityPolicy, ReplicaOpRefused,
+                                ReplicaState, ServingRouter,
+                                make_policy)
 from paddle_tpu.utils.faults import FaultError, FaultInjector
 
 pytestmark = pytest.mark.chaos
@@ -382,6 +383,53 @@ class TestDrainAndBackpressure:
         clock.advance(120.0)
         router.run()
         assert router.replicas[0].state == ReplicaState.DEAD
+
+    def test_drain_and_restore_idempotence(self, model):
+        """ISSUE 16 hardening: the manual scaling primitives are safe
+        to drive from a retrying control loop — repeats are no-ops,
+        conflicting intents are TYPED refusals, nothing crashes."""
+        router, _ = _router(model, n=2)
+        router.submit(*JOBS[0])
+        router.step()
+        assert router.drain_replica(0) is True
+        assert router.drain_replica(0) is False   # idempotent repeat
+        assert router.replicas[0].state == ReplicaState.DRAINING
+        # restore-while-draining: conflicting intents, typed refusal
+        with pytest.raises(ReplicaOpRefused, match="still draining"):
+            router.restore_replica(0)
+        assert router.replicas[0].state == ReplicaState.DRAINING
+        router.run()                              # drain completes
+        assert router.replicas[0].state == ReplicaState.DEAD
+        assert router.drain_replica(0) is False   # drain-of-DEAD no-op
+        assert router.restore_replica(0) is True
+        assert router.restore_replica(0) is False  # already live
+        assert router.replicas[0].state == ReplicaState.HEALTHY
+        router.run()
+
+    def test_drain_of_quarantined_decommissions_without_crash(
+            self, model):
+        """Draining a QUARANTINED replica is a no-op decommission (it
+        is already out of traffic) that cancels any pending restart;
+        draining one whose canary verdict is unresolved is refused —
+        the canary must rule first."""
+        router, _ = _router(model, n=2)
+        router.replicas[0].state = ReplicaState.QUARANTINED
+        assert router.drain_replica(0) is False
+        assert router.replicas[0].auto_restart is False
+        assert router.replicas[0].next_restart_time is None
+        for pending in (ReplicaState.SUSPECT, ReplicaState.PROBATION):
+            router.replicas[1].state = pending
+            with pytest.raises(ReplicaOpRefused, match="canary"):
+                router.drain_replica(1)
+        router.replicas[1].state = ReplicaState.HEALTHY
+
+    def test_scaling_primitives_validate_replica_index(self, model):
+        router, _ = _router(model, n=2)
+        for bad in (-1, 2, 99):
+            with pytest.raises(ValueError, match="no replica"):
+                router.drain_replica(bad)
+            with pytest.raises(ValueError, match="no replica"):
+                router.restore_replica(bad)
 
     def test_release_request_evicts_terminal_only(self, model):
         router, _ = _router(model, n=1)
