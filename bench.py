@@ -253,6 +253,11 @@ REGRESSION_METRICS = (
     # saved vs a static peak fleet on the same diurnal trace at the
     # same served work — the whole point of elasticity, as a gate
     "detail.autoscale.replica_step_savings_pct",
+    # multi-model serving (ISSUE 17): mixed-adapter decode — three
+    # hosted models sharing every step's one ragged dispatch via the
+    # lora_epilogue row-gather; must beat adapter-serial decode
+    # (detail.multimodel.mixed_over_serial_speedup) and not regress
+    "detail.multimodel.multimodel_decode_tokens_per_sec",
 )
 
 # latency-family regression gates: LOWER is better, a rise past the
@@ -969,6 +974,101 @@ def bench_autoscale(model, cfg, on_tpu: bool) -> dict:
         "journaled_resizes": journaled_resizes,
         "lost_sessions": (auto_sum["sessions"]
                           - auto_sum["outcomes"].get("finished", 0)),
+    }}
+
+
+def bench_multimodel(model, cfg, on_tpu: bool) -> dict:
+    """Batched multi-LoRA decode A/B (ISSUE 17): the same requests —
+    three hosted models (the base + two LoRA fine-tunes over it) —
+    served MIXED in one engine's single ragged dispatch per step vs
+    ADAPTER-SERIAL (one model's requests at a time on an identically
+    shaped engine — the fragmented-fleet cost model). Greedy streams
+    must be bit-identical between the two shapes (the lora_epilogue
+    row-gather is exact: row 0 is an all-zeros no-adapter row, ranks
+    pad with exact-zero columns). Returns a detail sub-dict;
+    `multimodel_decode_tokens_per_sec` (the mixed row) is wired into
+    REGRESSION_METRICS."""
+    import numpy as np
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+    from paddle_tpu.serving import FleetModelStore, split_model_id
+
+    model.eval()
+    if on_tpu:
+        per, p_len, warm, steps, max_seq = 4, 128, 8, 64, 1024
+    else:
+        per, p_len, warm, steps, max_seq = 2, 8, 2, 6, 64
+    rng = np.random.default_rng(0)
+    sd = dict(model.state_dict())
+    targets = ("model.layers.0.self_attn.q_proj.weight",
+               "model.layers.1.mlp.gate_proj.weight")
+
+    def deltas():
+        out = {}
+        for nm in targets:
+            k, n = sd[nm].shape
+            out[nm] = (
+                rng.normal(size=(k, 4)).astype(np.float32) * 0.05,
+                rng.normal(size=(4, n)).astype(np.float32) * 0.05)
+        return out
+
+    store = FleetModelStore(base_model="base", max_rank=8)
+    mids = ["base",
+            store.register_adapter("a1", deltas()),
+            store.register_adapter("a2", deltas())]
+    prompts = {mid: [list(rng.integers(1, cfg.vocab_size, p_len))
+                     for _ in range(per)] for mid in mids}
+
+    def build(tag):
+        # identical engine shape for both arms: the serial arm pays
+        # fragmentation (empty slots), not a smaller compiled batch
+        eng = ContinuousBatchingEngine(
+            model, max_batch_size=3 * per, max_seq_len=max_seq)
+        for mid in mids:
+            store.ensure(tag, eng, mid)
+        return eng
+
+    def run(eng, model_ids):
+        # per-engine request_ids collide across arms, so key the
+        # harvested streams by (model, prompt index) instead
+        key = {}
+        for mid in model_ids:
+            for j, p in enumerate(prompts[mid]):
+                rid = eng.add_request(
+                    list(p), max_new_tokens=max_seq - p_len - 1,
+                    adapter=split_model_id(mid)[1])
+                key[str(rid)] = (mid, j)
+        for _ in range(warm):
+            eng.step()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            eng.step()
+        dt = time.perf_counter() - t0
+        streams = {}
+        for r in eng._slot_req:
+            if r is not None:
+                streams[key[str(r.request_id)]] = list(r.output)
+        return dt, streams
+
+    # mixed: all three models share every decode step's one ragged
+    # dispatch
+    mixed_dt, mixed_streams = run(build("mixed"), mids)
+    mixed_tps = 3 * per * steps / mixed_dt
+    # adapter-serial: one model's requests at a time, fresh engine each
+    serial_dt, serial_streams = 0.0, {}
+    for mid in mids:
+        dt, streams = run(build(f"serial-{mid}"), [mid])
+        serial_dt += dt
+        serial_streams.update(streams)
+    serial_tps = 3 * per * steps / serial_dt
+
+    bit_identical = mixed_streams == serial_streams \
+        and len(mixed_streams) == 3 * per
+    return {"multimodel": {
+        "models": len(mids), "requests": 3 * per,
+        "multimodel_decode_tokens_per_sec": round(mixed_tps, 1),
+        "adapter_serial_decode_tokens_per_sec": round(serial_tps, 1),
+        "mixed_over_serial_speedup": round(mixed_tps / serial_tps, 3),
+        "bit_identical": bit_identical,
     }}
 
 
@@ -1729,6 +1829,11 @@ def run_bench(on_tpu: bool) -> dict:
         detail.update(bench_autoscale(model, cfg, on_tpu))
     except Exception:
         detail["autoscale_error"] = \
+            traceback.format_exc(limit=3)[-400:]
+    try:
+        detail.update(bench_multimodel(model, cfg, on_tpu))
+    except Exception:
+        detail["multimodel_error"] = \
             traceback.format_exc(limit=3)[-400:]
 
     return {

@@ -11,6 +11,7 @@ from .clocks import InjectableClockChecker
 from .coverage import FaultCoverageChecker
 from .durablewrites import DurableWriteChecker
 from .faultsites import FaultSiteDriftChecker
+from .modelkeys import ModelKeyChecker
 from .pins import PinPairingChecker
 from .resizeintent import ResizeIntentChecker
 from .supervision import SwallowedErrorChecker
@@ -19,9 +20,9 @@ from .tracedsync import TracedHostSyncChecker
 __all__ = ["ALL_CHECKER_CLASSES", "default_checkers", "by_code",
            "CatalogDriftChecker", "InjectableClockChecker",
            "DurableWriteChecker", "FaultCoverageChecker",
-           "FaultSiteDriftChecker", "PinPairingChecker",
-           "ResizeIntentChecker", "SwallowedErrorChecker",
-           "TracedHostSyncChecker"]
+           "FaultSiteDriftChecker", "ModelKeyChecker",
+           "PinPairingChecker", "ResizeIntentChecker",
+           "SwallowedErrorChecker", "TracedHostSyncChecker"]
 
 ALL_CHECKER_CLASSES = (
     InjectableClockChecker,      # PDT001
@@ -33,6 +34,7 @@ ALL_CHECKER_CLASSES = (
     DurableWriteChecker,         # PDT007
     FaultCoverageChecker,        # PDT008
     ResizeIntentChecker,         # PDT009
+    ModelKeyChecker,             # PDT010
 )
 
 

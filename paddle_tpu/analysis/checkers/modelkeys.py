@@ -1,0 +1,137 @@
+"""PDT010 — model-key discipline.
+
+Repo law (ISSUE 17, the multi-model serving plane): model identity has
+ONE canonical spelling — ``serving/model_store.py``'s ``model_id(base,
+adapter)`` / ``split_model_id(mid)`` pair (and ``admission.budget_key``
+for the (tenant, model) budget axis built on top of it). Every cache,
+golden, budget, journal record, and telemetry label keyed on a model
+must key on that spelling, because a second ad-hoc spelling of the
+same identity is a split-brain key: the canary golden lands under
+``"base/a1"`` while the store's resident set says ``"base+a1"``, the
+quarantine arm grades the replica against the WRONG model's stream,
+and the per-model terminal ledger silently forks.
+
+The check: inside ``paddle_tpu/serving/`` (minus the two helper
+modules that DEFINE the spelling), flag any expression that re-derives
+a model-identity string by hand instead of calling the helpers:
+
+* f-strings joining two dynamic parts with the model separator ``+``
+  or the budget separator ``@`` — ``f"{base}+{adapter}"``,
+  ``f"{tenant}@{model}"``;
+* string concatenation through a bare ``"+"`` / ``"@"`` literal —
+  ``base + "+" + adapter``;
+* hand-splitting a model id — ``mid.split("+")`` /
+  ``mid.partition("+")`` — instead of ``split_model_id``.
+
+Constant strings (``"base+a1"`` in a test fixture or a docstring) are
+NOT flagged: the rule targets key *derivation*, not key *values*.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, Optional, Tuple
+
+from ..core import Checker, Finding, Project
+
+__all__ = ["ModelKeyChecker"]
+
+# the identity separators with one canonical spelling each:
+# model_store._SEP ("+", base+adapter) and admission.budget_key's "@"
+# (tenant@model)
+_SEPARATORS = ("+", "@")
+_SPLITTERS = frozenset({"split", "rsplit", "partition", "rpartition"})
+
+
+def _sep_const(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and node.value in _SEPARATORS):
+        return node.value
+    return None
+
+
+def _enclosing_names(tree: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    """Yield ``(scope_name, node)`` for every node, where scope_name is
+    the innermost enclosing function (or ``<module>``)."""
+    def visit(node: ast.AST, scope: str) -> Iterator[Tuple[str, ast.AST]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield (scope, child)
+                yield from visit(child, child.name)
+            else:
+                yield (scope, child)
+                yield from visit(child, scope)
+    yield from visit(tree, "<module>")
+
+
+class ModelKeyChecker(Checker):
+    code = "PDT010"
+    name = "model-key"
+    rationale = ("model identity has one canonical spelling — "
+                 "model_id()/split_model_id()/budget_key() (ISSUE 17 "
+                 "— an ad-hoc re-spelling forks every cache, golden, "
+                 "and budget keyed on it)")
+
+    DEFAULT_SCOPE = ("paddle_tpu/serving/*.py",)
+    # the helpers' home modules define the spelling; everyone else
+    # calls them
+    DEFAULT_ALLOW: Tuple[str, ...] = (
+        "paddle_tpu/serving/model_store.py",
+        "paddle_tpu/serving/admission.py",
+    )
+
+    def __init__(self, scope: Tuple[str, ...] = DEFAULT_SCOPE,
+                 allow: Tuple[str, ...] = DEFAULT_ALLOW):
+        self.scope = scope
+        self.allow = allow
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.match(self.scope, exclude=self.allow):
+            if sf.tree is None:
+                continue
+            for scope_name, node in _enclosing_names(sf.tree):
+                hit = self._classify(node)
+                if hit is None:
+                    continue
+                kind, sep = hit
+                helper = ("budget_key()" if sep == "@"
+                          else "model_id()/split_model_id()")
+                yield self.finding(
+                    sf, node,
+                    f"ad-hoc model-identity {kind} through {sep!r} — "
+                    f"key caches/goldens/budgets via the canonical "
+                    f"{helper} helper (PDT010: a second spelling of "
+                    "the same model id forks every structure keyed "
+                    "on it)",
+                    detail=f"{scope_name}:{kind}{sep}",
+                    project=project)
+
+    @staticmethod
+    def _classify(node: ast.AST) -> Optional[Tuple[str, str]]:
+        # f"{a}+{b}" — a separator Constant sandwiched between two
+        # FormattedValues
+        if isinstance(node, ast.JoinedStr):
+            vals = node.values
+            for i in range(1, len(vals) - 1):
+                sep = _sep_const(vals[i])
+                if (sep is not None
+                        and isinstance(vals[i - 1], ast.FormattedValue)
+                        and isinstance(vals[i + 1], ast.FormattedValue)):
+                    return ("join", sep)
+            return None
+        # a + "+" + b — a separator literal as either operand of a
+        # string Add (``a + "+"`` is the inner BinOp of the chain)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            sep = _sep_const(node.left) or _sep_const(node.right)
+            if sep is not None:
+                return ("concat", sep)
+            return None
+        # mid.split("+") / mid.partition("+") — hand-splitting the id
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SPLITTERS
+                and node.args):
+            sep = _sep_const(node.args[0])
+            if sep is not None:
+                return ("split", sep)
+        return None

@@ -90,6 +90,8 @@ class SessionRecord:
     tokens: int = 0
     retry_after: Optional[float] = None
     shed_reason: Optional[str] = None
+    model: Optional[str] = None     # the arrival's model id (None =
+    #                                 the fleet base / model-less)
 
 
 # refusal outcomes never entered the fleet; everything else is a
@@ -202,12 +204,16 @@ class SoakDriver:
     def _submit(self, evt: ArrivalEvent) -> SessionRecord:
         _M_ARRIVALS.inc(lane=evt.lane)
         rec = SessionRecord(evt.request_id, evt.tenant, evt.lane,
-                            evt.t, outcome="open")
+                            evt.t, outcome="open",
+                            model=getattr(evt, "model", None))
         try:
+            kw = {}
+            if rec.model is not None:
+                kw["model"] = rec.model
             self.router.submit(list(evt.prompt),
                                max_new_tokens=evt.max_new_tokens,
                                request_id=evt.request_id,
-                               lane=evt.lane, tenant=evt.tenant)
+                               lane=evt.lane, tenant=evt.tenant, **kw)
         except QosShed as e:
             rec.outcome = "shed"
             rec.retry_after = e.retry_after

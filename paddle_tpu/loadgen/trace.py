@@ -22,6 +22,13 @@ that grading, deterministically:
 * **Tenant / lane mix.** Each arrival carries a tenant (weighted
   choice) and a QoS lane (`interactive` with `interactive_fraction`,
   else `batch`) — the axes the admission controller arbitrates on.
+* **Model mix.** With `model_mix` set (per-tenant weighted model-id
+  pools, ISSUE 17), each arrival from a listed tenant also carries a
+  `model` drawn from that tenant's pool — the multi-model soak's
+  traffic shape (one tenant's fine-tune mix differs from another's).
+  Tenants without an entry submit `model=None` (the fleet's base),
+  and an empty `model_mix` makes ZERO extra RNG draws, so every
+  pre-existing config replays its exact historical event sequence.
 * **Shared prefixes.** With `num_system_prompts` > 0, a fraction of
   prompts (`shared_prefix_prob`) prepend one of a fixed pool of
   system prompts, giving the fleet prefix store something real to do.
@@ -38,7 +45,7 @@ from __future__ import annotations
 import math
 import random
 from dataclasses import dataclass
-from typing import Iterator, List, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
 
 __all__ = ["TraceConfig", "ArrivalEvent", "iter_trace",
            "generate_trace"]
@@ -77,6 +84,11 @@ class TraceConfig:
     tenants: Tuple[Tuple[str, float], ...] = (("acme", 3.0),
                                               ("bidco", 1.0))
     interactive_fraction: float = 0.7
+    # per-tenant model mix (ISSUE 17): (tenant, ((model_id, weight),
+    # ...)) pairs — model ids are the store's canonical spelling
+    # (serving.model_id). Empty = model-less trace (no extra draws).
+    model_mix: Tuple[Tuple[str, Tuple[Tuple[str, float], ...]],
+                     ...] = ()
     # shared system prompts (fleet prefix-store realism)
     num_system_prompts: int = 0
     system_prompt_len: int = 16
@@ -94,6 +106,10 @@ class TraceConfig:
             raise ValueError("tenants must be non-empty")
         if self.prompt_len_min < 1 or self.output_len_min < 1:
             raise ValueError("length minima must be >= 1")
+        for tenant, pool in self.model_mix:
+            if not pool:
+                raise ValueError(f"model_mix for tenant {tenant!r} "
+                                 "must be non-empty")
 
 
 @dataclass(frozen=True)
@@ -107,6 +123,9 @@ class ArrivalEvent:
     lane: str
     prompt: Tuple[int, ...]
     max_new_tokens: int
+    # the model id to serve this session with (None = the fleet base);
+    # drawn from the tenant's `model_mix` pool when one is configured
+    model: Optional[str] = None
 
 
 def _rate(cfg: TraceConfig, t: float, bursting: bool) -> float:
@@ -136,6 +155,9 @@ def iter_trace(cfg: TraceConfig) -> Iterator[ArrivalEvent]:
         for _ in range(cfg.num_system_prompts)]
     names = [n for n, _ in cfg.tenants]
     weights = [w for _, w in cfg.tenants]
+    model_pools: Dict[str, Tuple[List[str], List[float]]] = {
+        tenant: ([m for m, _ in pool], [w for _, w in pool])
+        for tenant, pool in cfg.model_mix}
     t = 0.0
     burst_until = -1.0
     i = 0
@@ -148,6 +170,10 @@ def iter_trace(cfg: TraceConfig) -> Iterator[ArrivalEvent]:
                 and rng.random() < cfg.burst_start_prob:
             burst_until = t + rng.expovariate(1.0 / cfg.burst_mean_s)
         tenant = rng.choices(names, weights)[0]
+        model: Optional[str] = None
+        pool = model_pools.get(tenant)
+        if pool is not None:
+            model = rng.choices(pool[0], pool[1])[0]
         lane = LANE_INTERACTIVE \
             if rng.random() < cfg.interactive_fraction else LANE_BATCH
         p_len = _length(rng, cfg.prompt_len_median,
@@ -162,7 +188,7 @@ def iter_trace(cfg: TraceConfig) -> Iterator[ArrivalEvent]:
         tail = tuple(rng.randrange(1, cfg.vocab_size)
                      for _ in range(p_len))
         yield ArrivalEvent(t, f"{cfg.request_id_prefix}-{i}", tenant,
-                           lane, prefix + tail, o_len)
+                           lane, prefix + tail, o_len, model)
         i += 1
 
 

@@ -280,6 +280,30 @@ _M_QUANT_MISMATCH = telemetry.counter(
     "Cross-quant-mode installs refused with QuantMismatch, by entry "
     "path (import = migration payload, prefix = spill-chain restore).",
     ("kind",))
+# -- multi-model serving (ISSUE 17, serving/model_store.py) ------------
+_M_MODEL_MISMATCH = telemetry.counter(
+    "pdt_model_mismatch_total",
+    "Cross-model installs refused with ModelMismatch, by entry path "
+    "(import = migration payload, adapter = unknown/non-resident "
+    "adapter id at add_request or import).", ("kind",))
+_M_LORA_RESIDENT = telemetry.gauge(
+    "pdt_lora_adapters_resident",
+    "LoRA adapter rows resident in the most recently mutated engine's "
+    "stacked A/B tensors (row 0 — the all-zeros no-adapter row — "
+    "excluded).")
+_M_LORA_BYTES = telemetry.gauge(
+    "pdt_lora_adapter_bytes",
+    "Bytes held by the resident LoRA adapter stacks (A + B + per-row "
+    "scales) across all adapted matmuls of the most recently mutated "
+    "engine.")
+_M_LORA_INSTALLS = telemetry.counter(
+    "pdt_lora_installs_total",
+    "Adapter rows installed into an engine's stacks (install_adapter "
+    "commits).")
+_M_LORA_EVICTIONS = telemetry.counter(
+    "pdt_lora_evictions_total",
+    "Adapter rows evicted from an engine's stacks (evict_adapter "
+    "commits; refusals for in-flight use do not count).")
 
 
 class EngineOverloaded(RuntimeError):
@@ -316,6 +340,20 @@ class QuantMismatch(ValueError):
     BEFORE any target mutation and counted
     ``pdt_quant_mode_mismatch_total{kind=}``; fleets must be
     quant-homogeneous (docs/serving.md "Quantized serving")."""
+
+
+class ModelMismatch(ValueError):
+    """A request or KV install crossed MODEL identity (ISSUE 17): a
+    migration payload produced under one hosted model (``model_tag``
+    and adapter) offered to an engine serving another — its pages
+    encode a different function of the weights, so installing them
+    would be silent cross-model corruption — or a request names a LoRA
+    adapter that is not resident in this engine's stacks. Raised
+    BEFORE any target mutation and counted
+    ``pdt_model_mismatch_total{kind=}``; the fleet store
+    (`serving/model_store.py`) installs the right artifact before
+    dispatch, so a counted refusal here means routing skipped the
+    store (docs/serving.md "Multi-model serving")."""
 
 
 @dataclass
@@ -428,6 +466,11 @@ class Request:
     # admits first; FIFO within a priority class. 0 = interactive,
     # 1 = batch for router-submitted work
     priority: int = 0
+    # multi-model serving (ISSUE 17): the resident LoRA adapter this
+    # request decodes under (None = the bare hosted base). Validated
+    # against the engine's stacks at add_request / import_pages and
+    # threaded into every ragged dispatch as the slot's adapter row.
+    adapter: Optional[str] = None
 
 
 class ContinuousBatchingEngine:
@@ -688,6 +731,23 @@ class ContinuousBatchingEngine:
         self._sentry = None
         self._decode_logits = False
         self.fault_tag: Optional[str] = None
+        # -- multi-model serving (ISSUE 17, serving/model_store.py) ----
+        # hosted-model identity: model_tag is None for the build-time
+        # weights; install_weights() swaps the whole dispatch value
+        # list (same pytree structure — no retrace) and stamps the tag
+        # migration payloads are matched on (ModelMismatch otherwise).
+        self.model_tag: Optional[str] = None
+        self._mpv = None                 # install_weights override
+        self._mpv_nbytes = 0
+        # batched multi-LoRA decode (ops/lora_epilogue.py): per adapted
+        # matmul a stacked (R, K, r)/(R, r, N) pair whose row 0 is the
+        # all-zeros no-adapter row; _slot_adapter maps each slot to its
+        # request's row and rides every ragged dispatch as the
+        # per-token gather vector
+        self._lora = None
+        self._adapter_rows: Dict[str, int] = {}
+        self._lora_free_rows: List[int] = []
+        self._slot_adapter = np.zeros(self.B, np.int32)
         self._prefill_jits: "OrderedDict[int, object]" = OrderedDict()
         # ragged path: ONE program family keyed only on the padded
         # token count of the admission batch (the decode program lives
@@ -844,12 +904,353 @@ class ContinuousBatchingEngine:
         _M_QUANT_WEIGHT_BYTES.set(n_bytes)
         return out
 
+    # -- multi-model serving (ISSUE 17, serving/model_store.py) --------
+    def _place_replicated(self, arr):
+        if self._tp is None:
+            return arr
+        return jax.device_put(arr, self._tp.replicated())
+
+    def install_adapter(self, adapter_id: str, deltas: dict,
+                        scale: float = 1.0) -> None:
+        """Install one LoRA adapter into the engine's stacked adapter
+        tensors (batched multi-LoRA decode, ops/lora_epilogue.py).
+        ``deltas`` maps adapted parameter names (named_parameters keys
+        of 2D matmul weights) to ``(A, B)`` pairs — A (K, r), B (r, N)
+        over the (K, N) base — applied as ``x @ W + scale·(x@A)@B``.
+
+        Safe MID-FLIGHT: appending a stack row never changes existing
+        rows, and a live token's per-row gather reads only its own row
+        — running streams stay bit-identical through a neighbour's
+        cold install (the router's cold-install fallback leans on
+        this). Every adapter in an engine must adapt the SAME
+        parameter set at the SAME rank (the fleet store pads ranks to
+        its ``max_rank`` constant at registration, which is also what
+        keeps streams bit-identical across fleets hosting different
+        adapter subsets). Transactional: all stacks are rebuilt before
+        any engine state changes. Requires the ragged paged dispatch
+        family; refuses to compose with prefix caching (cached KV is a
+        function of the weights — a shared trie would silently alias
+        KV across adapters), spec decode, and chunked prefill."""
+        if self.layout != "paged" or self.attn_impl != "ragged":
+            raise ValueError(
+                "install_adapter requires kv_layout='paged' with "
+                "attention_impl='ragged' — the per-token adapter-row "
+                "vector threads through the ragged dispatch family "
+                "only")
+        if self._prefix_enabled:
+            raise ValueError(
+                "install_adapter refuses to compose with prefix "
+                "caching: cached KV pages are a function of the "
+                "weights, so a shared trie would alias KV across "
+                "adapters — build the engine with "
+                "enable_prefix_caching=False to serve multi-LoRA")
+        if self._spec is not None:
+            raise ValueError(
+                "install_adapter does not compose with spec_decode "
+                "(the draft cache's rewind bookkeeping has no "
+                "per-adapter dimension)")
+        if self._chunk is not None:
+            raise ValueError(
+                "install_adapter does not compose with prefill_chunk "
+                "(the chunk program does not thread the per-token "
+                "adapter-row vector)")
+        if adapter_id in self._adapter_rows:
+            raise ValueError(f"adapter {adapter_id!r} already resident")
+        if not deltas:
+            raise ValueError("install_adapter with empty deltas")
+        names = {nm: p for nm, p in self.model.named_parameters()}
+        idx = {nm: i for i, (nm, _) in
+               enumerate(self.model.named_parameters())}
+        rank = None
+        prepared = {}
+        for nm, (a, b) in sorted(deltas.items()):
+            p = names.get(nm)
+            if p is None:
+                raise ValueError(f"adapter {adapter_id!r} targets "
+                                 f"unknown parameter {nm!r}")
+            if p._value.ndim != 2:
+                raise ValueError(
+                    f"adapter {adapter_id!r} targets non-matmul "
+                    f"parameter {nm!r} (ndim {p._value.ndim})")
+            a = np.asarray(a)
+            b = np.asarray(b)
+            k, n = p._value.shape
+            if a.ndim != 2 or b.ndim != 2 or a.shape[0] != k \
+                    or b.shape[1] != n or a.shape[1] != b.shape[0]:
+                raise ValueError(
+                    f"adapter {adapter_id!r} delta for {nm!r}: A "
+                    f"{a.shape} / B {b.shape} do not factor the "
+                    f"({k}, {n}) base")
+            if rank is None:
+                rank = int(a.shape[1])
+            elif int(a.shape[1]) != rank:
+                raise ValueError(
+                    f"adapter {adapter_id!r} mixes ranks "
+                    f"({rank} vs {a.shape[1]} at {nm!r}) — one rank "
+                    "per adapter (the store pads to max_rank)")
+            prepared[nm] = (a, b)
+        lo = self._lora
+        if lo is not None:
+            if tuple(sorted(prepared)) != lo["names"]:
+                raise ValueError(
+                    f"adapter {adapter_id!r} adapts "
+                    f"{sorted(prepared)} but resident adapters adapt "
+                    f"{list(lo['names'])} — every adapter in an "
+                    "engine must adapt the same parameter set (pad "
+                    "missing targets with zero deltas)")
+            if rank != lo["rank"]:
+                raise ValueError(
+                    f"adapter {adapter_id!r} rank {rank} != resident "
+                    f"rank {lo['rank']} — the store pads every "
+                    "adapter to one fixed max_rank")
+        dt = names[next(iter(prepared))]._value.dtype
+        # build the new stacks FULLY before committing any state
+        if lo is None:
+            row = 1
+            new_a, new_b = {}, {}
+            for nm, (a, b) in prepared.items():
+                za = np.zeros((2,) + a.shape, np.float32)
+                zb = np.zeros((2,) + b.shape, np.float32)
+                za[1], zb[1] = a, b
+                new_a[nm] = self._place_replicated(jnp.asarray(za, dt))
+                new_b[nm] = self._place_replicated(jnp.asarray(zb, dt))
+            sc = np.zeros(2, np.float32)
+            sc[1] = float(scale)
+            new_scale = self._place_replicated(jnp.asarray(sc))
+            committed = {"rank": rank,
+                         "names": tuple(sorted(prepared)),
+                         "param_idx": {nm: idx[nm] for nm in prepared},
+                         "a": new_a, "b": new_b, "scale": new_scale}
+        else:
+            grow = not self._lora_free_rows
+            row = int(lo["scale"].shape[0]) if grow \
+                else self._lora_free_rows[-1]
+            new_a, new_b = {}, {}
+            for nm in lo["names"]:
+                a, b = prepared[nm]
+                sa, sb = lo["a"][nm], lo["b"][nm]
+                if grow:
+                    sa = jnp.concatenate(
+                        [sa, jnp.asarray(a, sa.dtype)[None]], 0)
+                    sb = jnp.concatenate(
+                        [sb, jnp.asarray(b, sb.dtype)[None]], 0)
+                else:
+                    sa = sa.at[row].set(jnp.asarray(a, sa.dtype))
+                    sb = sb.at[row].set(jnp.asarray(b, sb.dtype))
+                new_a[nm] = self._place_replicated(sa)
+                new_b[nm] = self._place_replicated(sb)
+            ssc = lo["scale"]
+            if grow:
+                ssc = jnp.concatenate(
+                    [ssc, jnp.full((1,), float(scale), ssc.dtype)])
+            else:
+                ssc = ssc.at[row].set(float(scale))
+            new_scale = self._place_replicated(ssc)
+            committed = dict(lo, a=new_a, b=new_b, scale=new_scale)
+        # commit
+        if lo is not None and self._lora_free_rows:
+            self._lora_free_rows.pop()
+        self._lora = committed
+        self._adapter_rows[adapter_id] = row
+        _M_LORA_INSTALLS.inc()
+        _M_LORA_RESIDENT.set(len(self._adapter_rows))
+        _M_LORA_BYTES.set(self._lora_nbytes())
+        if self._invariants_enabled():
+            self.check_invariants()
+
+    def evict_adapter(self, adapter_id: str) -> None:
+        """Evict a resident adapter: its stack row zeroes and returns
+        to the free-row list (stacks never shrink — shrinking would
+        retrace every ragged program; the zeroed row is inert by the
+        row-0 argument). REFUSES while any queued or in-flight request
+        decodes under the adapter — evictions never strand a request —
+        so the store evicts only unpinned entries. Dropping the last
+        adapter drops the stacks entirely (dispatches return to the
+        unwrapped value list)."""
+        row = self._adapter_rows.get(adapter_id)
+        if row is None:
+            raise ValueError(f"adapter {adapter_id!r} is not resident")
+        live = [r.request_id for r in
+                list(self._queue) + [q for q in self._slot_req
+                                     if q is not None]
+                if r.adapter == adapter_id]
+        if live:
+            raise ValueError(
+                f"adapter {adapter_id!r} is in flight (requests "
+                f"{live}) — evicting it would strand them; drain or "
+                "migrate first")
+        del self._adapter_rows[adapter_id]
+        if not self._adapter_rows:
+            self._lora = None
+            self._lora_free_rows = []
+        else:
+            lo = self._lora
+            new_a = {nm: self._place_replicated(
+                         lo["a"][nm].at[row].set(0.0))
+                     for nm in lo["names"]}
+            new_b = {nm: self._place_replicated(
+                         lo["b"][nm].at[row].set(0.0))
+                     for nm in lo["names"]}
+            new_scale = self._place_replicated(
+                lo["scale"].at[row].set(0.0))
+            self._lora = dict(lo, a=new_a, b=new_b, scale=new_scale)
+            self._lora_free_rows.append(row)
+        _M_LORA_EVICTIONS.inc()
+        _M_LORA_RESIDENT.set(len(self._adapter_rows))
+        _M_LORA_BYTES.set(self._lora_nbytes())
+        if self._invariants_enabled():
+            self.check_invariants()
+
+    def _lora_nbytes(self) -> int:
+        lo = self._lora
+        if lo is None:
+            return 0
+        n = int(lo["scale"].nbytes)
+        for nm in lo["names"]:
+            n += int(lo["a"][nm].nbytes) + int(lo["b"][nm].nbytes)
+        return n
+
+    def install_weights(self, values: dict, tag: str) -> None:
+        """Hot-swap the engine's FULL dispatch weights to another
+        registered checkpoint (fleet store cold install): ``values``
+        maps every named parameter to its new value — a plain array
+        (cast to the build dtype; quantized on the fly when the engine
+        runs quantized weights) or a pre-quantized
+        `ops.quant_matmul.QuantizedWeight` (the store's halved-
+        footprint storage). The swap replaces the dispatch VALUE list
+        only — same pytree structure, so every compiled program is
+        reused without retrace — and stamps ``model_tag``, the
+        identity migration payloads are matched on. IDLE-ONLY: every
+        resident KV page is a function of the weights, so swapping
+        under in-flight or queued requests would corrupt their
+        streams; refuses to compose with prefix caching for the same
+        reason (the trie outlives requests). Resident adapters drop
+        with the base they adapted."""
+        if self._queue or any(r is not None for r in self._slot_req):
+            raise ValueError(
+                "install_weights on a busy engine: resident KV pages "
+                "are a function of the weights — drain or migrate "
+                "in-flight requests first")
+        if self._prefix_enabled:
+            raise ValueError(
+                "install_weights refuses to compose with prefix "
+                "caching: the trie's cached KV pages were produced "
+                "under the OLD weights and would silently poison "
+                "future prefills")
+        from ..ops.quant_matmul import (QuantizedWeight,
+                                        quantize_weight_values)
+        named = list(self.model.named_parameters())
+        missing = [nm for nm, _ in named if nm not in values]
+        if missing:
+            raise ValueError(
+                f"install_weights({tag!r}): checkpoint is missing "
+                f"{len(missing)} parameters (first: {missing[:3]}) — "
+                "full checkpoints only; use install_adapter for "
+                "deltas")
+        out, n_bytes = [], 0
+        for nm, p in named:
+            v = values[nm]
+            if isinstance(v, QuantizedWeight):
+                if tuple(v.qw.shape) != tuple(p._value.shape):
+                    raise ValueError(
+                        f"install_weights({tag!r}): {nm!r} shape "
+                        f"{tuple(v.qw.shape)} != engine "
+                        f"{tuple(p._value.shape)}")
+                qw, sc = jnp.asarray(v.qw), jnp.asarray(v.scale)
+            else:
+                v = jnp.asarray(v)
+                if tuple(v.shape) != tuple(p._value.shape):
+                    raise ValueError(
+                        f"install_weights({tag!r}): {nm!r} shape "
+                        f"{tuple(v.shape)} != engine "
+                        f"{tuple(p._value.shape)}")
+                lnm = nm.lower()
+                if self._qw_mode is not None and v.ndim == 2 \
+                        and any(k in lnm for k in QUANT_MATMULS):
+                    qw, sc = quantize_weight_values(
+                        v.astype(p._value.dtype), self._qw_mode)
+                else:
+                    w = v.astype(p._value.dtype)
+                    if self._tp is not None:
+                        spec = self._tp._param_spec(nm, w.shape)
+                        w = jax.device_put(w, self._tp.sharding(*spec))
+                    n_bytes += int(w.nbytes)
+                    out.append(w)
+                    continue
+            if self._tp is not None:
+                spec = self._tp._param_spec(nm, p._value.shape)
+                qw = jax.device_put(qw, self._tp.sharding(*spec))
+                out_ax = spec[1] if len(spec) > 1 else None
+                sc = jax.device_put(sc, self._tp.sharding(out_ax))
+            w = QuantizedWeight(qw, sc)
+            n_bytes += int(w.nbytes)
+            out.append(w)
+        # commit: the value list swaps atomically; adapters over the
+        # old base die with it
+        self._mpv = out
+        self._mpv_nbytes = n_bytes
+        self.model_tag = str(tag)
+        self._lora = None
+        self._adapter_rows = {}
+        self._lora_free_rows = []
+        self._slot_adapter[:] = 0
+        _M_LORA_RESIDENT.set(0)
+        _M_LORA_BYTES.set(0)
+
+    def reset_weights(self) -> None:
+        """Drop an install_weights override: dispatches return to the
+        build-time weights (`model_tag` None). Idle-only, like
+        install_weights, and for the same KV-coupling reason."""
+        if self._queue or any(r is not None for r in self._slot_req):
+            raise ValueError(
+                "reset_weights on a busy engine: drain or migrate "
+                "in-flight requests first")
+        self._mpv = None
+        self._mpv_nbytes = 0
+        self.model_tag = None
+        self._lora = None
+        self._adapter_rows = {}
+        self._lora_free_rows = []
+        self._slot_adapter[:] = 0
+        _M_LORA_RESIDENT.set(0)
+        _M_LORA_BYTES.set(0)
+
+    def _adapter_row(self, req: "Request") -> int:
+        if req.adapter is None:
+            return 0
+        row = self._adapter_rows.get(req.adapter)
+        if row is None:       # evict_adapter refuses while referenced
+            raise ModelMismatch(
+                f"request {req.request_id!r} decodes under adapter "
+                f"{req.adapter!r} which is no longer resident")
+        return row
+
+    def _lora_pv(self, pv, ids):
+        """Wrap each adapted matmul's dispatch value in a `LoraWeight`
+        carrying THIS dispatch's per-token adapter-row vector (`ids`,
+        one int32 row per packed token; rows of inactive/padding
+        tokens may be anything — the epilogue has no cross-token
+        reduction, so garbage rows never touch live rows). Identity
+        when no adapter is resident."""
+        if self._lora is None:
+            return pv
+        from ..ops.lora_epilogue import LoraWeight
+        lo = self._lora
+        idv = jnp.asarray(np.asarray(ids, np.int32))
+        out = list(pv)
+        for nm in lo["names"]:
+            i = lo["param_idx"][nm]
+            out[i] = LoraWeight(out[i], lo["a"][nm], lo["b"][nm],
+                                lo["scale"], idv)
+        return out
+
     # -- public API ----------------------------------------------------
     def add_request(self, prompt, max_new_tokens: int = 32,
                     deadline: Optional[float] = None,
                     max_queue_time: Optional[float] = None,
                     request_id: Optional[str] = None,
-                    priority: int = 0) -> int:
+                    priority: int = 0,
+                    adapter: Optional[str] = None) -> int:
         """Queue a request. `deadline` is a completion budget in seconds
         from now on the engine's monotonic clock (overrides the engine
         `request_timeout` default); `max_queue_time` bounds time spent
@@ -860,13 +1261,24 @@ class ContinuousBatchingEngine:
         replicas. `priority` is the QoS lane's queue class (lower
         admits first, FIFO within a class — serving/admission.py maps
         interactive=0, batch=1), so queued batch work can never starve
-        interactive admissions. Expired requests finalize with status
-        `timeout` at the next step tick. Raises EngineOverloaded when
-        the bounded queue is full (`max_waiting`) or the admission
-        policy rejects the request."""
+        interactive admissions. `adapter` decodes the request under a
+        resident LoRA adapter (install_adapter) — the batched
+        multi-LoRA path; an unknown/non-resident adapter is refused
+        with ModelMismatch BEFORE enqueue, so the queue never holds a
+        request no dispatch could serve. Expired requests finalize
+        with status `timeout` at the next step tick. Raises
+        EngineOverloaded when the bounded queue is full (`max_waiting`)
+        or the admission policy rejects the request."""
         toks = [int(t) for t in np.asarray(prompt).ravel()]
         if not toks:
             raise ValueError("empty prompt")
+        if adapter is not None and adapter not in self._adapter_rows:
+            _M_MODEL_MISMATCH.inc(kind="adapter")
+            raise ModelMismatch(
+                f"adapter {adapter!r} is not resident in this engine "
+                f"(resident: {sorted(self._adapter_rows)}) — "
+                "install_adapter it first (the fleet model store does "
+                "this before dispatch)")
         if int(max_new_tokens) < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -889,7 +1301,7 @@ class ContinuousBatchingEngine:
                     if max_queue_time is not None else self.max_queue_time,
                     request_id=request_id if request_id is not None
                     else str(self._next_rid),
-                    priority=int(priority))
+                    priority=int(priority), adapter=adapter)
         if self.layout == "paged":
             usable = self.num_pages - 1
             need = self._worst_pages(r)
@@ -1136,6 +1548,11 @@ class ContinuousBatchingEngine:
             "prompt": list(req.prompt),
             "output": list(req.output),
             "max_new_tokens": req.max_new_tokens,
+            # multi-model serving: the hosted-model identity these KV
+            # bytes are a function of — import_pages refuses a
+            # cross-model install with ModelMismatch
+            "model_tag": self.model_tag,
+            "adapter": req.adapter,
             "deadline_remaining": None if req.deadline is None
             else req.deadline - now,
             # ages, not absolutes: the target rebases them on ITS clock
@@ -1196,6 +1613,25 @@ class ContinuousBatchingEngine:
                 f"{pq or 'full-width'}, this engine serves "
                 f"{self._qkv or 'full-width'} pages — fleets must be "
                 "quant-homogeneous")
+        # cross-MODEL install refusal (ISSUE 17): the payload's pages
+        # are a function of its source's hosted weights — a different
+        # model_tag (or a non-resident adapter) here would be silent
+        # corruption, not a migration. BEFORE any target mutation.
+        ptag = payload.get("model_tag")
+        if ptag != self.model_tag:
+            _M_MODEL_MISMATCH.inc(kind="import")
+            raise ModelMismatch(
+                f"cross-model migration refused: payload KV was "
+                f"produced under model {ptag or 'base'!r}, this "
+                f"engine hosts {self.model_tag or 'base'!r} — the "
+                "fleet store installs the model before routing here")
+        pad = payload.get("adapter")
+        if pad is not None and pad not in self._adapter_rows:
+            _M_MODEL_MISMATCH.inc(kind="adapter")
+            raise ModelMismatch(
+                f"migration payload decodes under adapter {pad!r} "
+                "which is not resident in this engine — the fleet "
+                "store installs adapters before routing here")
         L, hk, hd, dt = self._kv_shape
         pool_dt = jnp.int8 if self._qkv else dt
         spec = tuple(payload["kv_spec"])
@@ -1236,7 +1672,8 @@ class ContinuousBatchingEngine:
                       if payload.get("first_token_age") is None
                       else now - payload["first_token_age"],
                       request_id=payload["request_id"],
-                      priority=int(payload.get("priority", 0)))
+                      priority=int(payload.get("priority", 0)),
+                      adapter=payload.get("adapter"))
         freed = int(payload["freed"])
         shared = None
         if self._prefix_enabled and not freed:
@@ -1263,6 +1700,7 @@ class ContinuousBatchingEngine:
                 "retry after running requests release")
         slot = free[0]
         self._slot_req[slot] = req
+        self._slot_adapter[slot] = self._adapter_row(req)
         self._slot_seq[slot] = self._admit_seq
         self._admit_seq += 1
         self._next_rid += 1
@@ -1598,6 +2036,38 @@ class ContinuousBatchingEngine:
                         f"slot {i} block-table[{j}] = {p} outside the "
                         f"live window [{lo}, {hi}) must trash-route "
                         "to 0")
+        # multi-model (ISSUE 17): the slot -> adapter-row map must
+        # mirror slot ownership exactly — a stale row would gather
+        # ANOTHER adapter's delta into this slot's stream, silent
+        # cross-model corruption
+        for i, r in enumerate(self._slot_req):
+            want = 0
+            if r is not None and r.adapter is not None:
+                want = self._adapter_rows.get(r.adapter, -1)
+            if int(self._slot_adapter[i]) != want:
+                errs.append(
+                    f"slot {i} adapter row "
+                    f"{int(self._slot_adapter[i])} != expected {want} "
+                    f"(request "
+                    f"{r.request_id if r is not None else None!r})")
+        rows = list(self._adapter_rows.values())
+        if len(set(rows)) != len(rows) or 0 in rows:
+            errs.append(
+                f"adapter row map corrupt (duplicate or reserved row "
+                f"0): {self._adapter_rows}")
+        if self._lora is not None:
+            cap = int(self._lora["scale"].shape[0])
+            for aid, row in self._adapter_rows.items():
+                if not 1 <= row < cap:
+                    errs.append(f"adapter {aid!r} row {row} outside "
+                                f"the stacks [1, {cap})")
+            taken = set(rows) & set(self._lora_free_rows)
+            if taken:
+                errs.append(f"adapter rows {sorted(taken)} both "
+                            "assigned and on the free-row list")
+        elif self._adapter_rows:
+            errs.append(f"adapter rows {self._adapter_rows} registered "
+                        "but no stacks resident")
         if self._spec is not None:
             self._check_invariants_draft(errs)
         if self._tp is not None:
@@ -1746,6 +2216,7 @@ class ContinuousBatchingEngine:
         # the shared cache
         req = self._slot_req[slot]
         self._slot_req[slot] = None
+        self._slot_adapter[slot] = 0
         if self.layout == "paged":
             if self._prefix_enabled and req is not None and register:
                 # register BEFORE the decrefs so the prompt pages never
@@ -1872,6 +2343,7 @@ class ContinuousBatchingEngine:
         # prefill can release partially-built slot state uniformly
         self._slot_req[slot] = req
         req.status = RequestStatus.RUNNING
+        self._slot_adapter[slot] = self._adapter_row(req)
         self._slot_seq[slot] = self._admit_seq
         self._admit_seq += 1
         return slot, req, prompt, shared
@@ -2150,8 +2622,16 @@ class ContinuousBatchingEngine:
                             t_pad=int(t_pad), rids=rids), \
                 self._tp_scope():
             jit = self._get_ragged_prefill(t_pad, bound)
+            # multi-LoRA: each packed row gathers its OWNING slot's
+            # adapter row (padding rows gather slot 0's — inert, their
+            # outputs are never read and the epilogue has no
+            # cross-token reduction)
+            pv = self._lora_pv(
+                self._pv(),
+                self._slot_adapter[np.asarray(pk["token_seq"],
+                                              np.int32)])
             nxt, self._kv = jit(
-                self._pv(), self._bv(),
+                pv, self._bv(),
                 self._kv, jnp.asarray(pk["ids"]),
                 jnp.asarray(pk["token_seq"]),
                 jnp.asarray(pk["positions"]),
@@ -2192,11 +2672,15 @@ class ContinuousBatchingEngine:
 
     # -- tensor parallelism plumbing (serving/submesh.py) --------------
     def _pv(self):
-        """Target param VALUES for a dispatch: the quantized list when
-        the engine runs quantized weights (converted matmuls carry
-        `QuantizedWeight` values the model's linears dequantize in the
-        matmul epilogue), else the submesh-placed copies under TP,
-        else the live model values."""
+        """Target param VALUES for a dispatch: the install_weights
+        override when another checkpoint is hosted (already placed and
+        quantized — `model_tag` names it), else the quantized list
+        when the engine runs quantized weights (converted matmuls
+        carry `QuantizedWeight` values the model's linears dequantize
+        in the matmul epilogue), else the submesh-placed copies under
+        TP, else the live model values."""
+        if self._mpv is not None:
+            return self._mpv
         if self._qpv is not None:
             return self._qpv
         if self._tp is not None:
@@ -2818,8 +3302,12 @@ class ContinuousBatchingEngine:
             if self.layout == "paged" and self.attn_impl == "ragged":
                 bidx = self._decode_idx
                 with self._tp_scope():
+                    # multi-LoRA: decode packs one row per slot in
+                    # slot order, so the gather vector IS the
+                    # slot-adapter map
                     out = self._decode_jit(
-                        self._pv(), self._bv(),
+                        self._lora_pv(self._pv(), self._slot_adapter),
+                        self._bv(),
                         kv, jnp.asarray(self._tok), bidx,
                         jnp.asarray(pos.astype(np.int32)), bidx,
                         self._decode_ones,

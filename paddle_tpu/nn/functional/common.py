@@ -30,6 +30,26 @@ def linear(x, weight, bias=None, name=None):
     instead (int8/fp8 storage, per-out-channel scale on the
     accumulator); the model code calling this never forks."""
     wv = getattr(weight, "_value", None)
+    if wv is not None and type(wv).__name__ == "LoraWeight":
+        # multi-LoRA serving (docs/serving.md "Multi-model serving"):
+        # the engine bound a LoraWeight — shared base matmul (array or
+        # QuantizedWeight) plus this dispatch's per-token low-rank
+        # adapter gathers. Same name-pre-filter discipline as the
+        # quantized branch below.
+        from paddle_tpu.ops.lora_epilogue import (LoraWeight,
+                                                  lora_matmul_values)
+        if not isinstance(wv, LoraWeight):
+            raise TypeError(
+                "weight value is named LoraWeight but is not "
+                "ops.lora_epilogue.LoraWeight — refusing to guess an "
+                "adapter layout")
+        if bias is not None:
+            return apply(
+                "lora_linear",
+                lambda v, b: lora_matmul_values(v, wv) + b,
+                (_t(x), _t(bias)))
+        return apply("lora_linear",
+                     lambda v: lora_matmul_values(v, wv), (_t(x),))
     if wv is not None and type(wv).__name__ == "QuantizedWeight":
         # cheap name pre-filter keeps the lazy import off the ordinary
         # (unquantized) path; the isinstance makes the dispatch exact

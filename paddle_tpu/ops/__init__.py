@@ -37,6 +37,7 @@ def mxu_dot(a, b, dims, preferred_element_type=None):
 from . import flash_attention  # noqa: F401,E402
 from . import flash_varlen  # noqa: F401,E402
 from . import grouped_matmul  # noqa: F401,E402
+from . import lora_epilogue  # noqa: F401,E402
 from . import norm_kernels  # noqa: F401,E402
 from . import paged_attention  # noqa: F401,E402
 from . import quant_matmul  # noqa: F401,E402

@@ -50,6 +50,20 @@ arXiv:2605.25645):
   token suffixes and re-serves them from healthy replicas, and gates
   every restart through canary PROBATION.
 
+* `model_store.py` — the multi-model serving plane (ISSUE 17):
+  `FleetModelStore` makes model identity a first-class fleet
+  dimension — registered full checkpoints and LoRA adapters over a
+  shared base, per-replica resident sets with byte-budgeted LRU
+  install/evict through the engine's `install_weights` /
+  `install_adapter` seam, `model_id`/`split_model_id` as THE
+  canonical model-identity spelling (pdt-lint PDT010).
+  `ServingRouter(model_store=...)` + `submit(model=)` route by model
+  (the `model_affinity` policy prefers warm replicas, cold installs
+  fall back through the store), requests for different LoRA
+  fine-tunes batch into ONE ragged dispatch (`ops/lora_epilogue.py`),
+  and per-hosted-model canary goldens keep the gray-failure arm
+  grading every replica against ITS model's stream.
+
 * `autoscaler.py` — the elastic control plane (ISSUE 16):
   `FleetAutoscaler`, a deterministic step-driven loop observing
   arrival rate / queue depth / SLO burn and steering replica count,
@@ -83,9 +97,13 @@ this one.
     outputs = router.run()          # {request_id: tokens}
 """
 from .admission import (AdmissionDecision, Lane,  # noqa: F401
-                        QosAdmission, TenantBudget, derive_retry_after)
+                        QosAdmission, TenantBudget, budget_key,
+                        derive_retry_after)
+from .model_store import (FleetModelStore, model_id,  # noqa: F401
+                          split_model_id)
 from .policy import (DispatchPolicy, LeastOutstandingPolicy,  # noqa: F401
-                     POLICIES, PrefixAffinityPolicy, RoundRobinPolicy,
+                     ModelAffinityPolicy, POLICIES,
+                     PrefixAffinityPolicy, RoundRobinPolicy,
                      make_policy)
 from .prefix_store import FleetPrefixStore, chain_hashes  # noqa: F401
 from .replica import (ReplicaHandle, ReplicaOpRefused,  # noqa: F401
@@ -108,12 +126,14 @@ __all__ = [
     "ServingRouter", "FleetRequest", "FleetOverloaded", "QosShed",
     "parse_roles",
     "Lane", "QosAdmission", "TenantBudget", "AdmissionDecision",
-    "derive_retry_after",
+    "budget_key", "derive_retry_after",
     "ReplicaHandle", "ReplicaState", "ReplicaRole", "ReplicaOpRefused",
     "FleetAutoscaler", "AutoscalePolicy", "AutoscaleObservation",
     "DispatchPolicy", "RoundRobinPolicy", "LeastOutstandingPolicy",
-    "PrefixAffinityPolicy", "POLICIES", "make_policy",
+    "PrefixAffinityPolicy", "ModelAffinityPolicy", "POLICIES",
+    "make_policy",
     "FleetPrefixStore", "chain_hashes",
+    "FleetModelStore", "model_id", "split_model_id",
     "RouterJournal", "JournalReplay", "ReplayedRequest",
     "commit_bytes",
     "serialize_request", "install_request", "migrate_request",

@@ -62,7 +62,7 @@ from .. import observability as telemetry
 from ..utils.faults import fault_point
 
 __all__ = ["Lane", "TenantBudget", "AdmissionDecision", "QosAdmission",
-           "derive_retry_after", "note_failopen"]
+           "budget_key", "derive_retry_after", "note_failopen"]
 
 
 class Lane:
@@ -135,6 +135,18 @@ def note_failopen(error: BaseException, where: str) -> None:
                     error=f"{type(error).__name__}: {error}")
 
 
+def budget_key(tenant: str, model: "Optional[str]" = None) -> str:
+    """The tenant-budget map key: the tenant alone, or
+    ``tenant@model`` on multi-model fleets — so QoS budgets meter per
+    (tenant, model) and one tenant's burst on one fine-tune cannot
+    starve its traffic on another. `model` must already be a CANONICAL
+    model id (`serving.model_store.model_id` — pdt-lint PDT010), so
+    this key can never fork from routing."""
+    if model is None:
+        return str(tenant)
+    return f"{tenant}@{model}"
+
+
 class TenantBudget:
     """Sliding-window token meter for one tenant: `charge()` records
     admitted tokens at a clock tick, charges expire `window_s` later
@@ -184,6 +196,9 @@ class AdmissionDecision:
     retry_after: float = 0.0
     burn_rate: float = 0.0
     cost_tokens: int = 0
+    # canonical model id (multi-model fleets): commit() charges the
+    # (tenant, model) budget this decision was arbitrated against
+    model: Optional[str] = None
 
 
 class QosAdmission:
@@ -330,6 +345,7 @@ class QosAdmission:
     def decide(self, *, prompt_tokens: int, max_new_tokens: int,
                lane: str = Lane.INTERACTIVE,
                tenant: Optional[str] = None,
+               model: Optional[str] = None,
                queue_depth: int = 0) -> AdmissionDecision:
         """Arbitrate one submission. Never raises on the healthy path
         (shed is a RETURNED verdict, not an exception — the caller
@@ -344,7 +360,9 @@ class QosAdmission:
         now = self._clock()
         cost = int(prompt_tokens) + int(max_new_tokens)
         burn = self.current_burn(now)
-        over = self.over_budget(tenant, now)
+        # per-(tenant, model) metering on multi-model fleets: the
+        # budget consulted here is the one commit() later charges
+        over = self.over_budget(budget_key(tenant, model), now)
         self._refresh_over_gauge(now)
         reason = None
         if burn >= self.shed_burn:
@@ -360,7 +378,8 @@ class QosAdmission:
             # — that is what keeps the admit ledger reconciling
             # EXACTLY with the router's terminal counters
             return AdmissionDecision(True, lane, tenant,
-                                     burn_rate=burn, cost_tokens=cost)
+                                     burn_rate=burn, cost_tokens=cost,
+                                     model=model)
         retry_after = derive_retry_after(
             self.retry_after_base, queue_depth=queue_depth,
             burn_rate=burn, cap=self.retry_after_cap)
@@ -373,7 +392,8 @@ class QosAdmission:
                         retry_after=retry_after)
         return AdmissionDecision(False, lane, tenant, reason=reason,
                                  retry_after=retry_after,
-                                 burn_rate=burn, cost_tokens=cost)
+                                 burn_rate=burn, cost_tokens=cost,
+                                 model=model)
 
     def commit(self, decision: AdmissionDecision,
                now: Optional[float] = None):
@@ -390,7 +410,8 @@ class QosAdmission:
         _M_DECISIONS.inc(lane=decision.lane, decision="admit")
         self.admitted[decision.lane] = \
             self.admitted.get(decision.lane, 0) + 1
-        b = self.budget_for(decision.tenant)
+        b = self.budget_for(budget_key(decision.tenant,
+                                       decision.model))
         if b is not None:
             b.charge(decision.cost_tokens, now)
 

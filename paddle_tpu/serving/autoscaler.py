@@ -54,7 +54,7 @@ is reproducible in tests.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from .. import observability as telemetry
 from .admission import derive_retry_after
@@ -132,6 +132,11 @@ class AutoscaleObservation:
     serving: int              # slots in a traffic-taking state
     quarantined: int
     journal_failing: bool
+    # multi-model fleets (router.model_store): per canonical model id
+    # {"arrival_qps", "pending", "pressure"} — pressure is pending
+    # work per serving replica, the per-model vote a model-aware
+    # operator reads off fleet_info()["autoscale"] too
+    per_model: Optional[Dict[str, dict]] = None
 
 
 class FleetAutoscaler:
@@ -157,7 +162,11 @@ class FleetAutoscaler:
         self._lo_streak = 0
         self._hi_since: Optional[float] = None
         self._seen_submits = router.num_submit_attempts
+        self._seen_submits_by_model: Dict[str, int] = dict(
+            router.num_submit_attempts_by_model)
         self._seen_journal_failures = router.journal_append_failures
+        # the last per-model observation, surfaced through stats()
+        self.last_per_model: Optional[Dict[str, dict]] = None
         self._last_obs_t: Optional[float] = None
         self.actions: List[dict] = []     # every grow/shrink/recarve
         self.reactions: List[float] = []  # burst reaction samples (s)
@@ -188,6 +197,28 @@ class FleetAutoscaler:
         failures = r.journal_append_failures
         journal_failing = failures > self._seen_journal_failures
         self._seen_journal_failures = failures
+        per_model = None
+        if r.model_store is not None:
+            # per-model control inputs: arrival rate from the same
+            # delta-over-dt the fleet aggregate uses, queue depth from
+            # the live mirrors' model tags
+            pending: Dict[str, int] = {}
+            for rec in r._live.values():
+                if rec.model is not None and not rec.done:
+                    pending[rec.model] = pending.get(rec.model, 0) + 1
+            per_model = {}
+            for mid in r.model_store.models():
+                subs = r.num_submit_attempts_by_model.get(mid, 0)
+                seen = self._seen_submits_by_model.get(mid, 0)
+                self._seen_submits_by_model[mid] = subs
+                per_model[mid] = {
+                    "arrival_qps": ((subs - seen) / dt) if dt > 0
+                    else 0.0,
+                    "pending": pending.get(mid, 0),
+                    "pressure": pending.get(mid, 0)
+                    / max(1, len(serving)),
+                }
+            self.last_per_model = per_model
         return AutoscaleObservation(
             t=now, arrival_qps=arrival,
             queue_depth=sum(depths) / max(1, len(serving)),
@@ -195,7 +226,8 @@ class FleetAutoscaler:
             burn=r._burn_hint(),
             replicas=len(r.replicas), serving=len(serving),
             quarantined=quarantined,
-            journal_failing=journal_failing)
+            journal_failing=journal_failing,
+            per_model=per_model)
 
     def cooldown_for(self, obs: AutoscaleObservation) -> float:
         """Post-action hold time. By construction never below the
@@ -375,10 +407,13 @@ class FleetAutoscaler:
 
     # -- introspection ---------------------------------------------------
     def stats(self) -> dict:
-        return {"replicas": len(self.router.replicas),
-                "actions": len(self.actions),
-                "refusals": self.num_refusals,
-                "holds": self.num_holds,
-                "resizes": self.router.num_resizes,
-                "reaction_max_s": max(self.reactions, default=None),
-                "cooldown_until": self._cooldown_until}
+        out = {"replicas": len(self.router.replicas),
+               "actions": len(self.actions),
+               "refusals": self.num_refusals,
+               "holds": self.num_holds,
+               "resizes": self.router.num_resizes,
+               "reaction_max_s": max(self.reactions, default=None),
+               "cooldown_until": self._cooldown_until}
+        if self.last_per_model is not None:
+            out["per_model"] = self.last_per_model
+        return out

@@ -265,6 +265,7 @@ class ReplayedRequest:
     lane: str = "interactive"
     tenant: Optional[str] = None
     priority: int = 0
+    model: Optional[str] = None            # canonical model_id
     deadline_abs: Optional[float] = None   # journal/router clock
     max_queue_time: Optional[float] = None
     tokens: List[int] = field(default_factory=list)
@@ -431,23 +432,28 @@ class RouterJournal:
     def append_submit(self, *, request_id: str, prompt: List[int],
                       max_new_tokens: int, lane: str = "interactive",
                       tenant: Optional[str] = None, priority: int = 0,
+                      model: Optional[str] = None,
                       deadline_abs: Optional[float] = None,
                       max_queue_time: Optional[float] = None) -> None:
         """The durability point: called by `ServingRouter.submit()`
         BEFORE dispatch. Raises on failure — work the journal cannot
-        record must not be accepted."""
+        record must not be accepted. `model` is the canonical model_id
+        (multi-model fleets): durable at submit so recovery restores
+        the request onto the RIGHT weights."""
         self._append({"kind": "submit", "rid": str(request_id),
                       "prompt": [int(t) for t in prompt],
                       "max_new_tokens": int(max_new_tokens),
                       "lane": lane, "tenant": tenant,
                       "priority": int(priority),
+                      "model": model,
                       "deadline_abs": deadline_abs,
                       "max_queue_time": max_queue_time,
                       "t": self._clock()})
         self._state[str(request_id)] = ReplayedRequest(
             str(request_id), [int(t) for t in prompt],
             int(max_new_tokens), lane=lane, tenant=tenant,
-            priority=int(priority), deadline_abs=deadline_abs,
+            priority=int(priority), model=model,
+            deadline_abs=deadline_abs,
             max_queue_time=max_queue_time)
 
     def append_rejected(self, request_id: str) -> None:
@@ -577,6 +583,7 @@ class RouterJournal:
                 "kind": "snap", "rid": rid, "prompt": st.prompt,
                 "max_new_tokens": st.max_new_tokens, "lane": st.lane,
                 "tenant": st.tenant, "priority": st.priority,
+                "model": st.model,
                 "deadline_abs": st.deadline_abs,
                 "max_queue_time": st.max_queue_time,
                 "tokens": st.tokens, "status": st.status,
@@ -671,6 +678,7 @@ class RouterJournal:
                         lane=rec.get("lane") or "interactive",
                         tenant=rec.get("tenant"),
                         priority=int(rec.get("priority") or 0),
+                        model=rec.get("model"),
                         deadline_abs=rec.get("deadline_abs"),
                         max_queue_time=rec.get("max_queue_time"))
                     if kind == "snap":

@@ -6,6 +6,15 @@ accept traffic, where should this prompt go? Three built-ins:
 * ``round_robin`` — rotate through accepting replicas; the baseline.
 * ``least_outstanding`` — fewest waiting+running requests wins (ties
   break to the lowest index); the classic load balancer.
+* ``model_affinity`` — the multi-model one (ISSUE 17). With a
+  `FleetModelStore` attached the fleet hosts several models/LoRA
+  fine-tunes over shared replicas, and which replica a request lands
+  on decides whether its model's weights are already RESIDENT (warm —
+  dispatch is just a row-id tag) or must be cold-installed through the
+  store's byte-budgeted LRU first. The policy prefers the
+  least-loaded replica where `store.is_resident(replica, model)`,
+  falling back to least-outstanding when nothing is warm (the router
+  then cold-installs on that replica before dispatch).
 * ``prefix_affinity`` — the TPU-serving-shaped one. The engines run
   vLLM-style automatic prefix caching keyed on PAGE-ALIGNED token
   prefixes (models/serving.py), so which replica a prompt lands on
@@ -32,7 +41,8 @@ from .prefix_store import FleetPrefixStore, chain_hashes
 from .replica import ReplicaHandle
 
 __all__ = ["DispatchPolicy", "RoundRobinPolicy", "LeastOutstandingPolicy",
-           "PrefixAffinityPolicy", "POLICIES", "make_policy"]
+           "PrefixAffinityPolicy", "ModelAffinityPolicy", "POLICIES",
+           "make_policy"]
 
 
 class DispatchPolicy:
@@ -45,7 +55,8 @@ class DispatchPolicy:
     name = "base"
 
     def select(self, candidates: Sequence[ReplicaHandle],
-               prompt: List[int]) -> ReplicaHandle:
+               prompt: List[int],
+               model: Optional[str] = None) -> ReplicaHandle:
         raise NotImplementedError
 
     def on_dispatch(self, replica: ReplicaHandle, prompt: List[int]):
@@ -61,7 +72,7 @@ class RoundRobinPolicy(DispatchPolicy):
     def __init__(self):
         self._next = 0
 
-    def select(self, candidates, prompt):
+    def select(self, candidates, prompt, model=None):
         # rotate over replica INDICES, not the candidate list: with a
         # replica missing from the candidates the remaining ones must
         # still alternate instead of collapsing onto one
@@ -76,7 +87,7 @@ class RoundRobinPolicy(DispatchPolicy):
 class LeastOutstandingPolicy(DispatchPolicy):
     name = "least_outstanding"
 
-    def select(self, candidates, prompt):
+    def select(self, candidates, prompt, model=None):
         return min(candidates, key=lambda h: (h.outstanding(), h.index))
 
 
@@ -121,7 +132,7 @@ class PrefixAffinityPolicy(DispatchPolicy):
             depth += 1
         return depth
 
-    def select(self, candidates, prompt):
+    def select(self, candidates, prompt, model=None):
         hashes = self._chain_hashes(prompt)
         best: Optional[ReplicaHandle] = None
         best_depth = 0
@@ -154,31 +165,73 @@ class PrefixAffinityPolicy(DispatchPolicy):
         self._warm.pop(replica_index, None)
 
 
+class ModelAffinityPolicy(DispatchPolicy):
+    """Prefer replicas where the request's model is already resident
+    in the attached `FleetModelStore` (module docstring). Warmth is
+    read straight from the store's per-replica resident sets — the
+    policy keeps NO shadow state, so install/evict churn (the store's
+    byte-budgeted LRU) is reflected on the next `select` without a
+    coherence protocol. `last_warm` reports whether the last pick was
+    warm (the router feeds the cold-install counter from it)."""
+
+    name = "model_affinity"
+
+    def __init__(self, model_store=None):
+        self.model_store = model_store
+        self.last_warm = False
+
+    def select(self, candidates, prompt, model=None):
+        store = self.model_store
+        if store is not None and model is not None:
+            warm = [h for h in candidates
+                    if store.is_resident(h.index, model)]
+            if warm:
+                self.last_warm = True
+                return min(warm,
+                           key=lambda h: (h.outstanding(), h.index))
+        self.last_warm = False
+        # nothing warm (or no store/model): least-outstanding — the
+        # cold install lands where there's slack to absorb it
+        return min(candidates, key=lambda h: (h.outstanding(), h.index))
+
+    def forget(self, replica_index: int):
+        if self.model_store is not None:
+            self.model_store.forget_replica(replica_index)
+
+
 POLICIES = {
     RoundRobinPolicy.name: RoundRobinPolicy,
     LeastOutstandingPolicy.name: LeastOutstandingPolicy,
     PrefixAffinityPolicy.name: PrefixAffinityPolicy,
+    ModelAffinityPolicy.name: ModelAffinityPolicy,
 }
 
 
 def make_policy(policy, page_size: int = 16,
-                store: Optional[FleetPrefixStore] = None
-                ) -> DispatchPolicy:
+                store: Optional[FleetPrefixStore] = None,
+                model_store=None) -> DispatchPolicy:
     """Accepts a policy NAME (see `POLICIES`) or an instance.
     `page_size` seeds prefix-affinity hashing and must match the
     engines' page size for warmth tracking to mirror their tries.
     `store` (role-aware fleets) attaches the fleet-wide prefix store
-    to prefix-affinity warmth tracking."""
+    to prefix-affinity warmth tracking; `model_store` (multi-model
+    fleets) attaches the fleet model store to model-affinity."""
     if isinstance(policy, DispatchPolicy):
         if store is not None and isinstance(policy,
                                             PrefixAffinityPolicy) \
                 and policy.store is None:
             policy.store = store
+        if model_store is not None \
+                and isinstance(policy, ModelAffinityPolicy) \
+                and policy.model_store is None:
+            policy.model_store = model_store
         return policy
     if policy in POLICIES:
         if policy == PrefixAffinityPolicy.name:
             return PrefixAffinityPolicy(page_size=page_size,
                                         store=store)
+        if policy == ModelAffinityPolicy.name:
+            return ModelAffinityPolicy(model_store=model_store)
         return POLICIES[policy]()
     raise ValueError(f"unknown dispatch policy {policy!r}: "
                      f"{sorted(POLICIES)} or a DispatchPolicy instance")

@@ -324,11 +324,14 @@ class ReplicaHandle:
                  request_id: str,
                  deadline: Optional[float] = None,
                  max_queue_time: Optional[float] = None,
-                 priority: int = 0) -> Request:
+                 priority: int = 0,
+                 adapter: Optional[str] = None) -> Request:
         """Hand one request to this replica's engine; returns the live
         engine Request so the router can mirror its token stream.
         `priority` is the QoS lane's engine queue priority (lane-aware
-        ordering, models/serving.py)."""
+        ordering, models/serving.py); `adapter` is the LoRA adapter
+        the request decodes under (multi-model fleets — the router
+        made it resident via the model store before dispatching)."""
         fault_point("router.dispatch")
         assert self.engine is not None, f"dispatch to dead replica " \
                                         f"{self.index}"
@@ -336,7 +339,8 @@ class ReplicaHandle:
                                       deadline=deadline,
                                       max_queue_time=max_queue_time,
                                       request_id=request_id,
-                                      priority=priority)
+                                      priority=priority,
+                                      adapter=adapter)
         req = self.engine.get_request(rid)
         assert req is not None
         return req

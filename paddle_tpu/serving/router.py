@@ -130,10 +130,12 @@ from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
 from ..utils.faults import fault_point
 from . import transfer
 from . import journal as journal_mod
-from .admission import (Lane, QosAdmission, derive_retry_after,
-                        note_failopen)
+from .admission import (Lane, QosAdmission, budget_key,
+                        derive_retry_after, note_failopen)
 from .journal import RouterJournal
-from .policy import DispatchPolicy, PrefixAffinityPolicy, make_policy
+from .model_store import FleetModelStore, split_model_id
+from .policy import (DispatchPolicy, ModelAffinityPolicy,
+                     PrefixAffinityPolicy, make_policy)
 from .prefix_store import FleetPrefixStore
 from .replica import ReplicaHandle, ReplicaRole, ReplicaState
 from . import sentry as sentry_mod
@@ -205,6 +207,11 @@ _M_AFF_RATE = telemetry.gauge(
     "Warm-placement fraction of prefix-affinity decisions so far.")
 _M_STEPS = telemetry.counter(
     "pdt_router_steps_total", "Router step ticks.")
+_M_MODEL_COLD = telemetry.counter(
+    "pdt_router_model_cold_installs_total",
+    "Placements that had to cold-install the request's model on the "
+    "chosen replica through the fleet model store (the model-affinity "
+    "miss path), by canonical model id.", ("model",))
 _M_RESIZES = telemetry.counter(
     "pdt_router_resizes_total",
     "Completed fleet resizes by kind (grow | shrink | recarve | "
@@ -255,6 +262,11 @@ class FleetRequest:
     lane: str = Lane.INTERACTIVE
     tenant: Optional[str] = None
     priority: int = 0
+    # canonical model id (serving/model_store.py) on multi-model
+    # fleets; None on fleets without a model store. Durable at submit,
+    # re-ensured on every (re-)dispatch — failover, recovery, and
+    # quarantine re-serve all land the request back on ITS weights
+    model: Optional[str] = None
     # gray-failure taint frontier (docs/serving.md "Gray failures"):
     # tokens[:verified_len] are trusted — folded at dispatch onto the
     # current replica, or mirrored before that replica's last CLEAN
@@ -308,6 +320,7 @@ class ServingRouter:
                  roles=None,
                  tp=None,
                  prefix_store: Optional[FleetPrefixStore] = None,
+                 model_store: Optional[FleetModelStore] = None,
                  max_replica_outstanding: Optional[int] = None,
                  degraded_after: int = 1,
                  dead_after: int = 3,
@@ -364,8 +377,14 @@ class ServingRouter:
         if prefix_store is None and self.roles_enabled:
             prefix_store = FleetPrefixStore(page_size=page_size)
         self.prefix_store = prefix_store
+        # the fleet model store (serving/model_store.py, ISSUE 17):
+        # model identity becomes a routing dimension — submit(model=)
+        # validates against it, _dispatch ensures residency through
+        # it, and the model_affinity policy reads its resident sets
+        self.model_store = model_store
         self.policy: DispatchPolicy = make_policy(
-            policy, page_size=page_size, store=prefix_store)
+            policy, page_size=page_size, store=prefix_store,
+            model_store=model_store)
         self._retry_cost = float(retry_after_per_request)
         # tensor parallelism (serving/submesh.py, docs/serving.md
         # "Tensor parallelism"): `tp=` (an int or a TpConfig) carves
@@ -394,9 +413,16 @@ class ServingRouter:
         # slow endpoint's health instead of silently eaten
         self.transfer_stage_deadline = transfer_stage_deadline
         self._canary_golden: Optional[List[int]] = None
+        # per-hosted-BASE canary goldens on multi-model fleets: a
+        # replica whose base was swapped is graded against ITS model's
+        # golden stream, lazily computed per base (`_golden_for`)
+        self._canary_goldens: Dict[str, List[int]] = {}
         if canary is not None:
             self._canary_golden = self._compute_canary_golden(
                 engine_factory)
+            if model_store is not None:
+                self._canary_goldens[model_store.base_model] = \
+                    self._canary_golden
         # everything _make_handle needs to build a replica slot again
         # later: the resize API (ISSUE 16) grows/shrinks/recarves the
         # fleet after construction with handles identical to these
@@ -436,6 +462,13 @@ class ServingRouter:
         # while the journal is failing)
         self.num_submit_attempts = 0
         self.journal_append_failures = 0
+        # per-model accounting (multi-model fleets, fleet_info
+        # "models"/"autoscale"): submit attempts and cold installs by
+        # canonical model id, terminals by (model id, final status) —
+        # the exact-reconciliation ledger the soak recipe checks
+        self.num_submit_attempts_by_model: Dict[str, int] = {}
+        self.num_cold_installs_by_model: Dict[str, int] = {}
+        self.num_terminal_by_model: Dict[str, Dict[str, int]] = {}
         # requests finalized OUTSIDE the step tick (e.g. a deadline that
         # expires during a submit-time failover) are delivered by the
         # next step() — same never-lose-a-terminal shape as the engine's
@@ -474,7 +507,8 @@ class ServingRouter:
                deadline: Optional[float] = None,
                max_queue_time: Optional[float] = None,
                lane: str = Lane.INTERACTIVE,
-               tenant: Optional[str] = None) -> str:
+               tenant: Optional[str] = None,
+               model: Optional[str] = None) -> str:
         """Admit one request into the fleet; returns its stable
         request_id. Re-submitting an id already known to the router is
         a no-op returning the same id (idempotent retries: a client
@@ -483,16 +517,40 @@ class ServingRouter:
         (`admission=`): a QoS refusal raises `QosShed`, hard
         backpressure raises `FleetOverloaded` — both 429-shaped with
         one `retry_after` semantics. Raises FleetOverloaded when no
-        replica can accept."""
+        replica can accept.
+
+        `model` (multi-model fleets, `model_store=`) is the canonical
+        model id the request must decode under — a registered full
+        checkpoint or ``base+adapter`` LoRA fine-tune. Unregistered
+        ids refuse HERE (typed, before any journal/dispatch work);
+        omitting it on a multi-model fleet pins the store's builtin
+        base, so a replica whose base was swapped away still serves
+        the base-model stream."""
         if request_id is not None and request_id in self.requests:
             return request_id
         if lane not in Lane.ALL:
             raise ValueError(f"unknown lane {lane!r}: "
                              f"{sorted(Lane.ALL)}")
+        if model is not None:
+            if self.model_store is None:
+                raise ValueError(
+                    "submit(model=) needs a model_store= attached to "
+                    "the router (serving.model_store.FleetModelStore)")
+            if not self.model_store.known(model):
+                _M_REJECTIONS.inc(reason="unknown_model")
+                raise ValueError(
+                    f"unknown model {model!r}: the fleet store hosts "
+                    f"{self.model_store.models()} — register_model/"
+                    "register_adapter it first")
+        elif self.model_store is not None:
+            model = self.model_store.base_model
         # arrival-rate observation (refusals INCLUDED: the autoscaler
         # must see the demand the fleet is shedding, not just what it
         # admitted)
         self.num_submit_attempts += 1
+        if model is not None:
+            self.num_submit_attempts_by_model[model] = \
+                self.num_submit_attempts_by_model.get(model, 0) + 1
         toks = [int(t) for t in prompt]
         decision = None
         if self.admission is not None:
@@ -500,7 +558,7 @@ class ServingRouter:
                 decision = self.admission.decide(
                     prompt_tokens=len(toks),
                     max_new_tokens=int(max_new_tokens),
-                    lane=lane, tenant=tenant,
+                    lane=lane, tenant=tenant, model=model,
                     queue_depth=min(
                         (h.outstanding() for h in self.replicas
                          if h.alive()), default=0))
@@ -530,7 +588,8 @@ class ServingRouter:
             request_id, toks, int(max_new_tokens),
             deadline_abs=None if deadline is None else now + deadline,
             max_queue_time=max_queue_time, submit_time=now,
-            lane=lane, tenant=tenant, priority=Lane.PRIORITY[lane])
+            lane=lane, tenant=tenant, priority=Lane.PRIORITY[lane],
+            model=model)
         if self.journal is not None:
             # the DURABILITY point (docs/serving.md "Durability"): the
             # submit record lands BEFORE any dispatch, so a router
@@ -540,7 +599,7 @@ class ServingRouter:
             self.journal.append_submit(
                 request_id=request_id, prompt=toks,
                 max_new_tokens=int(max_new_tokens), lane=lane,
-                tenant=tenant, priority=rec.priority,
+                tenant=tenant, priority=rec.priority, model=model,
                 deadline_abs=rec.deadline_abs,
                 max_queue_time=max_queue_time)
         # one distributed trace per request, keyed by the stable id:
@@ -676,7 +735,15 @@ class ServingRouter:
                     rec.status = RequestStatus.QUEUED
                     return
                 raise self._overloaded()
-            h = self.policy.select(cands, self._effective_prompt(rec))
+            if rec.model is not None \
+                    or isinstance(self.policy, ModelAffinityPolicy):
+                h = self.policy.select(cands,
+                                       self._effective_prompt(rec),
+                                       model=rec.model)
+            else:
+                # legacy two-arg call: user-supplied policies predating
+                # the model dimension keep working on model-less fleets
+                h = self.policy.select(cands, self._effective_prompt(rec))
             if isinstance(self.policy, PrefixAffinityPolicy):
                 _M_AFF_LOOKUPS.inc()
                 if self.policy.last_match_pages > 0:
@@ -702,6 +769,31 @@ class ServingRouter:
                         "replica" if self.policy.last_match_pages > 0
                         else "spill" if spilled else "miss")
             tried.add(h.index)
+            if self.model_store is not None and rec.model is not None:
+                # make the request's model resident BEFORE the engine
+                # sees the request: warm replicas are a move-to-end,
+                # cold ones install through the store's byte-budgeted
+                # LRU (full-checkpoint swaps need an idle engine — a
+                # busy replica's refusal is a capacity event, not a
+                # health event: try the next candidate, shed if none)
+                try:
+                    with telemetry.span("router.model_install",
+                                        request_id=rec.request_id,
+                                        replica=h.index,
+                                        model=rec.model):
+                        cold = self.model_store.ensure(
+                            h.index, h.engine, rec.model)
+                except Exception as e:
+                    telemetry.event("router.model_install_failed",
+                                    request_id=rec.request_id,
+                                    replica=h.index, model=rec.model,
+                                    error=f"{type(e).__name__}: {e}")
+                    continue
+                if cold:
+                    _M_MODEL_COLD.inc(model=rec.model)
+                    self.num_cold_installs_by_model[rec.model] = \
+                        self.num_cold_installs_by_model.get(
+                            rec.model, 0) + 1
             try:
                 # one span per ATTEMPT: failed candidates stay in the
                 # trace with their error, so a failover's path across
@@ -720,7 +812,8 @@ class ServingRouter:
                         self._remaining_budget(rec), rec.request_id,
                         deadline=self._remaining_deadline(rec),
                         max_queue_time=rec.max_queue_time,
-                        priority=rec.priority)
+                        priority=rec.priority,
+                        adapter=self._adapter_of(rec))
             except EngineOverloaded:
                 # the engine's OWN admission bound refused (a factory
                 # that set max_waiting): not a health event — try the
@@ -741,6 +834,7 @@ class ServingRouter:
                 self._live.pop(rec.request_id, None)
                 self._journal_terminal(rec)
                 _M_TERMINAL.inc(status=rec.status)
+                self._count_model_terminal(rec)
                 telemetry.event("router.terminal",
                                 request_id=rec.request_id,
                                 status=rec.status, replica=None,
@@ -763,6 +857,12 @@ class ServingRouter:
             rec.verified_len = len(rec.tokens)
             rec.status = RequestStatus.QUEUED
             rec.dispatches += 1
+            if self.model_store is not None and rec.model is not None:
+                # in-flight pin: the store's LRU may not evict this
+                # model off this replica until the matching unpin
+                # (_finalize / migration hand-off; replica death
+                # clears pins wholesale via forget_replica)
+                self.model_store.pin(h.index, rec.model)
             self.policy.on_dispatch(h, self._effective_prompt(rec))
             _M_DISPATCH.inc(policy=self.policy.name,
                             replica=str(h.index))
@@ -773,6 +873,30 @@ class ServingRouter:
         every token the fleet already streamed (the engine-preemption
         fold-in shape, one level up)."""
         return rec.prompt + rec.tokens if rec.tokens else rec.prompt
+
+    def _adapter_of(self, rec: FleetRequest) -> Optional[str]:
+        """The engine-side adapter name for this request's model id
+        (None for a bare checkpoint or a model-less fleet)."""
+        if rec.model is None:
+            return None
+        return split_model_id(rec.model)[1]
+
+    def _unpin_model(self, rec: FleetRequest):
+        """Release the in-flight residency pin taken at dispatch (a
+        dead replica's pins were already cleared wholesale by
+        `forget_replica`, where unpin is a no-op)."""
+        if self.model_store is not None and rec.model is not None \
+                and rec.replica is not None:
+            self.model_store.unpin(rec.replica, rec.model)
+
+    def _count_model_terminal(self, rec: FleetRequest):
+        """Per-(model, status) terminal ledger — reconciles EXACTLY
+        with per-model submits once the fleet drains (the multimodel
+        soak's check), alongside `pdt_router_requests_terminal_total`."""
+        if rec.model is None:
+            return
+        row = self.num_terminal_by_model.setdefault(rec.model, {})
+        row[rec.status] = row.get(rec.status, 0) + 1
 
     def _remaining_budget(self, rec: FleetRequest) -> int:
         return rec.max_new_tokens - len(rec.tokens)
@@ -908,6 +1032,11 @@ class ServingRouter:
         self.policy.forget(index)
         if self.prefix_store is not None:
             self.prefix_store.forget_replica(index)
+        if self.model_store is not None:
+            # residency (and every in-flight pin) was device state —
+            # it died with the engine; artifacts are host state and
+            # survive for the next cold install
+            self.model_store.forget_replica(index)
 
     def _restore_spill(self, h: ReplicaHandle, prompt) -> int:
         """Re-install a host-RAM-spilled prefix chain into the chosen
@@ -980,6 +1109,22 @@ class ServingRouter:
             if not avail:
                 return             # no decode capacity this tick
             dst = min(avail, key=lambda t: (t.outstanding(), t.index))
+            if self.model_store is not None and rec.model is not None:
+                # the target must host this request's model BEFORE the
+                # pages move — `import_pages` refuses a cross-model
+                # import with a typed ModelMismatch (pages are a
+                # function of the weights), so a target the store
+                # cannot prepare right now (busy base swap) simply
+                # defers the migration to a later tick
+                try:
+                    self.model_store.ensure(dst.index, dst.engine,
+                                            rec.model)
+                except Exception as e:
+                    telemetry.event("router.model_install_failed",
+                                    request_id=rec.request_id,
+                                    replica=dst.index, model=rec.model,
+                                    error=f"{type(e).__name__}: {e}")
+                    continue
             try:
                 # the span joins the request's distributed trace via
                 # request_id — migration shows up between the source's
@@ -1020,6 +1165,11 @@ class ServingRouter:
                 # the health/failover machinery's job — leave the
                 # request where it is
                 continue
+            if self.model_store is not None and rec.model is not None:
+                # the residency pin follows the request across the
+                # hand-off
+                self.model_store.unpin(src.index, rec.model)
+                self.model_store.pin(dst.index, rec.model)
             rec.replica, rec.generation = dst.index, dst.generation
             rec.engine_req = new_req    # rec.folded is unchanged: the
             #                             target holds the same output
@@ -1087,10 +1237,12 @@ class ServingRouter:
         rec.status = req.status
         rec.error = req.error
         rec.engine_req = None
+        self._unpin_model(rec)
         self._live.pop(rec.request_id, None)
         finished.append(rec)
         self._journal_terminal(rec)
         _M_TERMINAL.inc(status=rec.status)
+        self._count_model_terminal(rec)
         telemetry.event("router.terminal", request_id=rec.request_id,
                         status=rec.status, replica=rec.replica,
                         tokens=len(rec.tokens),
@@ -1121,6 +1273,7 @@ class ServingRouter:
             self._terminal_backlog.append(rec)
             self._journal_terminal(rec)
             _M_TERMINAL.inc(status=rec.status)
+            self._count_model_terminal(rec)
             telemetry.event("router.terminal",
                             request_id=rec.request_id,
                             status=rec.status, replica=from_replica,
@@ -1169,7 +1322,9 @@ class ServingRouter:
             mon.observe(f"ttft.{rec.lane}", ttft, replica=replica)
 
     # -- gray-failure defense (serving/sentry.py, ISSUE 14) --------------
-    def _compute_canary_golden(self, engine_factory) -> List[int]:
+    def _compute_canary_golden(self, engine_factory,
+                               base_mid: Optional[str] = None
+                               ) -> List[int]:
         """The canary's golden greedy stream, computed ONCE per
         (model, tp, quant) at fleet build on a SCRATCH engine from the
         same factory (replica-0 signature, same submesh under TP, same
@@ -1187,10 +1342,36 @@ class ServingRouter:
             eng = engine_factory(0, self.submeshes[0])
         else:
             eng = engine_factory(0)
+        if base_mid is not None and self.model_store is not None \
+                and base_mid != self.model_store.base_model:
+            # per-hosted-model goldens (multi-model fleets): host the
+            # checkpoint on the scratch engine through the store's own
+            # install path, then drop the scratch replica's residency
+            # accounting — the golden must come from the SAME install
+            # seam the fleet's replicas use
+            self.model_store.ensure("__golden__", eng, base_mid)
+            self.model_store.forget_replica("__golden__")
         rid = eng.add_request(list(cfg.prompt),
                               int(cfg.max_new_tokens))
         out = eng.run()[rid]
         return [int(t) for t in out]
+
+    def _golden_for(self, h: ReplicaHandle) -> Optional[List[int]]:
+        """The canary golden for the base checkpoint `h` currently
+        HOSTS: on multi-model fleets a swapped replica is graded
+        against ITS model's stream (grading it against any other
+        base's golden would false-quarantine a healthy replica — the
+        PR-14 arm must fire on the right stream), lazily computed per
+        base on a scratch engine. The canary probe itself carries no
+        adapter, so its stream is a pure function of the base."""
+        if self.model_store is None:
+            return self._canary_golden
+        base = self.model_store.replica_base(h.index)
+        g = self._canary_goldens.get(base)
+        if g is None:
+            g = self._compute_canary_golden(self._engine_factory, base)
+            self._canary_goldens[base] = g
+        return g
 
     def _launch_canaries(self, now: float):
         """Start canary probes where due: immediately on SUSPECT and
@@ -1257,7 +1438,7 @@ class ServingRouter:
             if h.sentry is not None else 0
         if req.status != RequestStatus.FINISHED:
             result = "aborted"
-        elif [int(t) for t in req.output] != self._canary_golden:
+        elif [int(t) for t in req.output] != self._golden_for(h):
             result = "fail"
         elif trips > 0:
             result = "dirty"
@@ -1573,6 +1754,21 @@ class ServingRouter:
             if not avail:
                 return     # no survivor capacity: failover handles it
             dst = min(avail, key=lambda t: (t.outstanding(), t.index))
+            if self.model_store is not None and rec.model is not None:
+                # same discipline as the disagg hand-off: the survivor
+                # must host this request's model BEFORE the pages move
+                # (`import_pages` refuses cross-model payloads typed);
+                # a survivor the store cannot prepare leaves the
+                # request for the failover fold-in
+                try:
+                    self.model_store.ensure(dst.index, dst.engine,
+                                            rec.model)
+                except Exception as e:
+                    telemetry.event("router.model_install_failed",
+                                    request_id=rec.request_id,
+                                    replica=dst.index, model=rec.model,
+                                    error=f"{type(e).__name__}: {e}")
+                    continue
             try:
                 with telemetry.span("router.migrate",
                                     request_id=rec.request_id,
@@ -1592,6 +1788,11 @@ class ServingRouter:
                 # both engines stay consistent on any refusal/fault;
                 # the stranded request re-prefills on a survivor
                 continue
+            if self.model_store is not None and rec.model is not None:
+                # the residency pin follows the request across the
+                # hand-off
+                self.model_store.unpin(victim.index, rec.model)
+                self.model_store.pin(dst.index, rec.model)
             rec.replica, rec.generation = dst.index, dst.generation
             rec.engine_req = new_req
             rec.verified_len = len(rec.tokens)
@@ -1773,11 +1974,17 @@ class ServingRouter:
             rec = FleetRequest(st.request_id, list(st.prompt),
                                st.max_new_tokens, lane=st.lane,
                                tenant=st.tenant, priority=st.priority,
-                               submit_time=now)
+                               model=st.model, submit_time=now)
             rec.status = st.status
             rec.tokens = list(st.tokens)
             rec.error = st.error
             self.requests[st.request_id] = rec
+            # the restored terminal re-enters the per-model ledger:
+            # num_terminal_by_model must reconcile EXACTLY with
+            # per-model submits ACROSS incarnations (the multimodel
+            # soak's check), and the old incarnation's ledger died
+            # with its process
+            self._count_model_terminal(rec)
         journal_mod.note_deduped(len(replay.finished))
         for st in replay.live.values():           # journal/submit order
             if st.request_id in self.requests:
@@ -1787,7 +1994,8 @@ class ServingRouter:
                                deadline_abs=st.deadline_abs,
                                max_queue_time=st.max_queue_time,
                                lane=st.lane, tenant=st.tenant,
-                               priority=st.priority, submit_time=now)
+                               priority=st.priority, model=st.model,
+                               submit_time=now)
             rec.tokens = list(st.tokens)
             self.requests[st.request_id] = rec
             self._live[st.request_id] = rec
@@ -1801,9 +2009,9 @@ class ServingRouter:
                 # admission surface — recovery never wedges on
                 # bookkeeping
                 try:
-                    budget = self.admission.budget_for(
+                    budget = self.admission.budget_for(budget_key(
                         st.tenant if st.tenant is not None
-                        else self.admission.default_tenant)
+                        else self.admission.default_tenant, st.model))
                     if budget is not None:
                         budget.charge(len(st.prompt)
                                       + st.max_new_tokens)
@@ -1912,6 +2120,40 @@ class ServingRouter:
             info["roles"] = agg
         if self.prefix_store is not None:
             info["prefix_store"] = self.prefix_store.stats()
+        if self.model_store is not None:
+            # multi-model surface: store accounting, per-model
+            # request ledgers (submits/pending/cold installs/terminal
+            # by status — the exact-reconciliation set), and per-model
+            # autoscaling pressure (pending work per serving replica
+            # — what a per-model FleetAutoscaler votes on)
+            serving = sum(1 for h in self.replicas
+                          if h.state in (ReplicaState.HEALTHY,
+                                         ReplicaState.DEGRADED))
+            per_model: Dict[str, dict] = {}
+            for mid in self.model_store.models():
+                per_model[mid] = {
+                    "submitted":
+                        self.num_submit_attempts_by_model.get(mid, 0),
+                    "pending": 0,
+                    "cold_installs":
+                        self.num_cold_installs_by_model.get(mid, 0),
+                    "resident_replicas": sum(
+                        1 for h in self.replicas
+                        if self.model_store.is_resident(h.index, mid)),
+                    "terminal": dict(
+                        self.num_terminal_by_model.get(mid, {})),
+                }
+            for rec in self._live.values():
+                if rec.model in per_model:
+                    per_model[rec.model]["pending"] += 1
+            info["model_store"] = self.model_store.stats()
+            info["models"] = per_model
+            info["autoscale"] = {
+                "per_model": {
+                    mid: {"pending": row["pending"],
+                          "submitted": row["submitted"],
+                          "pressure": row["pending"] / max(1, serving)}
+                    for mid, row in per_model.items()}}
         if self.journal is not None:
             # durability surface: segment/byte footprint + how much
             # request state the journal is currently carrying

@@ -45,10 +45,24 @@ zero lost sessions, autoscaled p95 TTFT within the objective,
 replica-step (chip-time) savings > 0, at least one grow AND one
 shrink, and burst reaction time <= 2 virtual seconds.
 
+`--multimodel` adds the consolidation leg (ISSUE 17, docs/serving.md
+"Multi-model serving"): a per-tenant model mix (two LoRA fine-tunes
+over the shared base) soaks ONE `model_affinity` fleet behind a
+`FleetModelStore`, then each model's arrivals replay against a
+DEDICATED single-model fleet of the same size. The drill grades zero
+ADMITTED sessions lost (backpressure refusals are visible and
+reconciled — the mix rides a different arrival realization than the
+one phase 1 certified), mixed-fleet interactive p95 TTFT meeting the
+same objective the dedicated baselines meet (latency parity at 1/N
+the chips), and EXACT per-model terminal-counter reconciliation
+(driver-side per-model outcomes == `fleet_info()["models"]` ==
+`num_terminal_by_model`).
+
     python recipes/fleet_soak.py                   # search + 2x soak
     python recipes/fleet_soak.py --qps 6 --overload 3
     python recipes/fleet_soak.py --duration 120 --replicas 4  # heavier
     python recipes/fleet_soak.py --autoscale       # + the elastic leg
+    python recipes/fleet_soak.py --multimodel      # + the model-mix leg
 """
 import argparse
 import json
@@ -86,6 +100,12 @@ def main(argv=None):
                         "--replicas), grading p95 TTFT parity, "
                         "replica-step savings, burst reaction time, "
                         "and zero lost sessions")
+    p.add_argument("--multimodel", action="store_true",
+                   help="run the multi-model leg: a per-tenant LoRA "
+                        "model mix against ONE model_affinity fleet vs "
+                        "per-model DEDICATED fleets, grading TTFT "
+                        "parity and exact per-model terminal-counter "
+                        "reconciliation")
     p.add_argument("--quant", action="store_true",
                    help="serve the whole fleet quantized (int8 weights"
                         " + int8 KV pages, QuantServingConfig) — the "
@@ -497,6 +517,191 @@ def main(argv=None):
             failures.append(
                 f"burst reaction {reaction:.2f}s exceeds the 2.0s "
                 "bound (hysteresis + cooldown mistuned)")
+
+    # -- phase 5 (--multimodel): the consolidation leg --------------------
+    # a per-tenant model mix (two LoRA fine-tunes over the shared base)
+    # soaks ONE model_affinity fleet behind a FleetModelStore, then
+    # each model's arrivals replay against a DEDICATED single-model
+    # fleet of the SAME size — the baseline a consolidation must match
+    # while using 1/N the chips (docs/serving.md "Multi-model serving").
+    if args.multimodel:
+        import dataclasses
+
+        import numpy as np
+        from paddle_tpu.serving import FleetModelStore
+
+        # small LoRA deltas over two of the tiny model's matmuls; the
+        # shapes come from the live state dict so the recipe tracks
+        # the config
+        sd = {k: v for k, v in model.state_dict().items()}
+        targets = ("model.layers.0.self_attn.q_proj.weight",
+                   "model.layers.1.mlp.gate_proj.weight")
+        drng = np.random.default_rng(args.seed)
+
+        def lora_deltas():
+            out = {}
+            for nm in targets:
+                K, N = sd[nm].shape
+                out[nm] = (
+                    drng.normal(size=(K, 4)).astype(np.float32) * 0.05,
+                    drng.normal(size=(4, N)).astype(np.float32) * 0.05)
+            return out
+
+        def fresh_store():
+            # fresh per fleet (resident sets are per-router state);
+            # re-seeding regenerates identical deltas, so every fleet
+            # hosts the same artifacts
+            nonlocal drng
+            drng = np.random.default_rng(args.seed)
+            store = FleetModelStore(base_model="base", max_rank=8)
+            mids = [store.register_adapter("tuna", lora_deltas()),
+                    store.register_adapter("salmon", lora_deltas())]
+            return store, mids
+
+        def build_mm_fleet(store):
+            clock = VirtualClock()
+            mon = SloMonitor(
+                [SloObjective("interactive_ttft_p95",
+                              "ttft.interactive", "latency", objective,
+                              quantile=0.95,
+                              window_s=min(10.0, args.duration / 3))],
+                clock=clock)
+
+            def engine(i):
+                return ContinuousBatchingEngine(
+                    model, max_batch_size=args.slots, page_size=page,
+                    max_seq_len=prompt_max + page + out_max + 2 * page,
+                    clock=clock)
+
+            router = ServingRouter(
+                engine, num_replicas=args.replicas,
+                policy="model_affinity", page_size=page,
+                max_replica_outstanding=4 * args.slots,
+                clock=clock, sleep=clock.advance, slo_monitor=mon,
+                model_store=store)
+            return router, clock
+
+        store, (m_tuna, m_salmon) = fresh_store()
+        mm_rate = max_qps
+        mm_cfg = dataclasses.replace(
+            trace_cfg(mm_rate),
+            seed=args.seed + 2,
+            request_id_prefix="mm",
+            model_mix=(("acme", ((m_tuna, 3.0), ("base", 1.0))),
+                       ("bidco", ((m_salmon, 1.0),)),
+                       ("free", (("base", 1.0),))))
+        mm_events = generate_trace(mm_cfg)
+        mix_counts = {}
+        for ev in mm_events:
+            mix_counts[ev.model] = mix_counts.get(ev.model, 0) + 1
+        print(f"\nmultimodel: {len(mm_events)} arrivals at "
+              f"{mm_rate:.2f} qps, mix {mix_counts} -> one "
+              f"{args.replicas}-replica model_affinity fleet vs "
+              "dedicated per-model fleets")
+
+        telemetry.reset()
+        mm_router, mm_clock = build_mm_fleet(store)
+        mm_res = SoakDriver(mm_router, mm_events, clock=mm_clock,
+                            step_dt=args.step_dt, max_wall_s=1800).run()
+        mm_sum = mm_res.summary()
+        mm_info = mm_router.fleet_info()
+        # snapshot NOW: the dedicated baselines below tick the same
+        # process-wide counters
+        mm_terminals_total = int(sum(
+            telemetry.snapshot()["counters"]
+            .get("pdt_router_requests_terminal_total", {}).values()))
+        # phase 1 certified max_qps on a DIFFERENT arrival realization
+        # (the model draws shift the trace RNG stream), so backpressure
+        # refusals are legitimate here — visible and reconciled below.
+        # What may NEVER happen is an ADMITTED session going missing.
+        refused_mm = sum(mm_sum["outcomes"].get(o, 0)
+                         for o in ("shed", "overloaded", "invalid"))
+        lost_mm = mm_sum["sessions"] - refused_mm \
+            - mm_sum["outcomes"].get("finished", 0)
+        p95_mm = mm_sum["lanes"].get("interactive", {}) \
+            .get("ttft_p95_s")
+
+        # the dedicated baseline: each model's arrivals alone against a
+        # fleet of the same size hosting only that model
+        dedicated_p95 = {}
+        for mid in sorted(mix_counts):
+            d_store, _ = fresh_store()
+            d_router, d_clock = build_mm_fleet(d_store)
+            d_events = [ev for ev in mm_events if ev.model == mid]
+            d_res = SoakDriver(d_router, d_events, clock=d_clock,
+                               step_dt=args.step_dt,
+                               max_wall_s=1800).run()
+            d_sum = d_res.summary()
+            d_lost = d_sum["sessions"] \
+                - sum(d_sum["outcomes"].get(o, 0)
+                      for o in ("shed", "overloaded", "invalid")) \
+                - d_sum["outcomes"].get("finished", 0)
+            if d_lost:
+                failures.append(f"dedicated {mid} fleet lost "
+                                f"{d_lost} admitted session(s)")
+            dedicated_p95[mid] = d_sum["lanes"].get(
+                "interactive", {}).get("ttft_p95_s")
+
+        # exact per-model terminal reconciliation, three ways: the
+        # driver's per-session ledger, the router's python-side
+        # num_terminal_by_model, and fleet_info()["models"]
+        driver_by_model = {}
+        for s in mm_res.sessions:
+            if s.outcome in ("shed", "overloaded", "invalid"):
+                continue
+            mid = s.model if s.model is not None else "base"
+            d = driver_by_model.setdefault(mid, {})
+            d[s.outcome] = d.get(s.outcome, 0) + 1
+        router_by_model = {
+            mid: dict(c)
+            for mid, c in mm_router.num_terminal_by_model.items()}
+        info_by_model = {
+            mid: dict(rec["terminal"])
+            for mid, rec in mm_info["models"].items()
+            if rec["terminal"]}
+        if not (driver_by_model == router_by_model == info_by_model):
+            failures.append(
+                "per-model terminal reconciliation failed: "
+                f"driver={driver_by_model} "
+                f"router={router_by_model} fleet_info={info_by_model}")
+        by_model_sum = sum(sum(c.values())
+                           for c in router_by_model.values())
+        if mm_terminals_total != by_model_sum:
+            failures.append(
+                f"per-model terminals {by_model_sum} != fleet total "
+                f"{mm_terminals_total}")
+
+        mm_metrics = {
+            "arrivals": len(mm_events), "mix": mix_counts,
+            "ttft_p95_mixed_s": p95_mm,
+            "ttft_p95_dedicated_s": dedicated_p95,
+            "cold_installs": dict(mm_router.num_cold_installs_by_model),
+            "model_store": mm_info["model_store"],
+            "refusals": refused_mm,
+            "lost_admitted_sessions": lost_mm,
+            "replicas_mixed": args.replicas,
+            "replicas_dedicated_total":
+                args.replicas * len(mix_counts),
+        }
+        print(json.dumps({"multimodel": mm_metrics}, indent=1))
+        if lost_mm:
+            failures.append(f"multi-model soak lost {lost_mm} "
+                            "admitted session(s)")
+        if p95_mm is None:
+            failures.append("multi-model soak produced no interactive "
+                            "TTFT samples")
+        elif p95_mm > objective:
+            failures.append(
+                f"mixed-model interactive p95 TTFT {p95_mm:.3f}s "
+                f"exceeds the {objective:g}s objective "
+                f"(dedicated baselines: {dedicated_p95}) — "
+                "consolidation broke latency parity")
+        for mid, p in dedicated_p95.items():
+            if p is not None and p > objective:
+                failures.append(
+                    f"dedicated {mid} baseline p95 TTFT {p:.3f}s "
+                    f"missed the {objective:g}s objective — the "
+                    "parity grade has no valid baseline")
 
     print()
     if failures:

@@ -67,7 +67,8 @@ _TELEMETRY_FILES = ("test_serving.py", "test_chaos.py",
                     "test_spec_decode.py", "test_admission.py",
                     "test_loadgen.py", "test_tp_serving.py",
                     "test_journal.py", "test_sentry.py",
-                    "test_quant_serving.py", "test_autoscaler.py")
+                    "test_quant_serving.py", "test_autoscaler.py",
+                    "test_multimodel.py")
 
 # failing fleet-drill tests additionally attach a Chrome-trace export
 # of the telemetry ring: the failover timeline that produced the
@@ -125,7 +126,8 @@ def _serving_invariant_checks(request, monkeypatch):
             "test_spec_decode.py", "test_admission.py",
             "test_loadgen.py", "test_tp_serving.py",
             "test_journal.py", "test_sentry.py",
-            "test_quant_serving.py", "test_autoscaler.py"):
+            "test_quant_serving.py", "test_autoscaler.py",
+            "test_multimodel.py"):
         monkeypatch.setenv("PDT_CHECK_INVARIANTS", "1")
     yield
 

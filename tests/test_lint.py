@@ -27,6 +27,7 @@ from paddle_tpu.analysis.checkers import (CatalogDriftChecker,
                                           FaultCoverageChecker,
                                           FaultSiteDriftChecker,
                                           InjectableClockChecker,
+                                          ModelKeyChecker,
                                           PinPairingChecker,
                                           ResizeIntentChecker,
                                           SwallowedErrorChecker,
@@ -538,6 +539,67 @@ class TestResizeIntent:
         assert res.new == []
 
 
+# -- PDT010 model-key ---------------------------------------------------
+class TestModelKey:
+    def test_adhoc_join_concat_split_flagged(self, tmp_path):
+        res = run_one(tmp_path, ModelKeyChecker(), {
+            "paddle_tpu/serving/router.py": """\
+                def golden_key(self, base, adapter):
+                    return f"{base}+{adapter}"       # finding: join
+
+                def budget(self, tenant, model):
+                    return tenant + "@" + model      # finding: concat
+
+                def adapter_of(self, mid):
+                    return mid.split("+")[1]         # finding: split
+            """})
+        assert [(f.code, f.detail) for f in res.new] == [
+            ("PDT010", "golden_key:join+"),
+            ("PDT010", "budget:concat@"),
+            ("PDT010", "adapter_of:split+")]
+
+    def test_canonical_helpers_and_constants_pass(self, tmp_path):
+        res = run_one(tmp_path, ModelKeyChecker(), {
+            "paddle_tpu/serving/router.py": """\
+                from .model_store import model_id, split_model_id
+                from .admission import budget_key
+
+                DEFAULT = "base+a1"                # constant: not a
+                                                   # derivation
+
+                def golden_key(self, base, adapter):
+                    return model_id(base, adapter)
+
+                def budget(self, tenant, model):
+                    return budget_key(tenant, model)
+
+                def adapter_of(self, mid):
+                    return split_model_id(mid)[1]
+
+                def unrelated(self, a, b):
+                    return a + b                   # no separator lit
+            """})
+        assert res.new == []
+
+    def test_helper_homes_exempt_scope_is_serving(self, tmp_path):
+        res = run_one(tmp_path, ModelKeyChecker(), {
+            # the modules that DEFINE the spelling may spell it
+            "paddle_tpu/serving/model_store.py": """\
+                def model_id(base, adapter):
+                    return f"{base}+{adapter}"
+            """,
+            "paddle_tpu/serving/admission.py": """\
+                def budget_key(tenant, model):
+                    return f"{tenant}@{model}"
+            """,
+            # outside serving/: not this rule's scope
+            "paddle_tpu/loadgen/trace.py": """\
+                def pick(self, base, adapter):
+                    return f"{base}+{adapter}"
+            """})
+        assert res.new == []
+
+
 # -- suppressions -------------------------------------------------------
 class TestSuppressions:
     FILES = {
@@ -883,7 +945,8 @@ class TestRepoGate:
     def test_registry_is_complete(self):
         assert sorted(by_code()) == ["PDT001", "PDT002", "PDT003",
                                      "PDT004", "PDT005", "PDT006",
-                                     "PDT007", "PDT008", "PDT009"]
+                                     "PDT007", "PDT008", "PDT009",
+                                     "PDT010"]
         assert len(default_checkers(["PDT003", "PDT004"])) == 2
         with pytest.raises(ValueError):
             default_checkers(["PDT777"])

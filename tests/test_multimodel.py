@@ -1,0 +1,664 @@
+"""Multi-model serving plane under chaos (ISSUE 17).
+
+Coverage for the fleet weight store + model-keyed routing + batched
+multi-LoRA decode:
+
+* the `ops/lora_epilogue.py` Pallas kernel (interpret mode) held
+  against an independent NumPy oracle, plus the two exactness
+  arguments the whole plane leans on (row 0 = exact-zero delta,
+  rank padding = exact-zero columns);
+* mixed-adapter batches bit-identical to serving each adapter alone,
+  span-asserted to ride ONE ragged dispatch;
+* store install/evict transactionality, byte-budget LRU, pin
+  discipline, and cold-install liveness when the budget cannot be
+  met;
+* cross-model import refusal (`ModelMismatch`, typed + counted);
+* per-hosted-model canary goldens: no false quarantine on a healthy
+  swapped replica, and a corrupted swapped replica quarantines with
+  its streams re-served bit-identically;
+* SIGKILL-the-router recovery restoring model assignments with exact
+  per-model terminal reconciliation.
+
+conftest enables PDT_TELEMETRY=1 and PDT_CHECK_INVARIANTS=1 for this
+file."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as telemetry
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import (ContinuousBatchingEngine,
+                                       ModelMismatch)
+from paddle_tpu.ops.lora_epilogue import lora_epilogue_values
+from paddle_tpu.serving import (CanaryConfig, FleetModelStore,
+                                ReplicaState, RouterJournal,
+                                SentryConfig, ServingRouter, model_id)
+from paddle_tpu.utils.faults import FaultInjector
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32,
+                      intermediate_size=64, num_hidden_layers=2,
+                      num_attention_heads=2, num_key_value_heads=1,
+                      max_position_embeddings=64)
+    paddle.seed(7)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def v2_values(model):
+    """A second FULL checkpoint (different seed, same config) for the
+    hot-swap / per-model-canary drills."""
+    paddle.seed(11)
+    m2 = LlamaForCausalLM(model.config)
+    m2.eval()
+    return {k: np.asarray(v) for k, v in m2.state_dict().items()}
+
+
+TARGETS = ("model.layers.0.self_attn.q_proj.weight",
+           "model.layers.1.mlp.gate_proj.weight")
+
+
+def _deltas(model, seed, rank=4, scale=0.5):
+    """Rank-`rank` LoRA deltas over TARGETS, big enough to actually
+    change greedy streams (the bit-identity drills must compare
+    DIFFERENT per-model streams, not six copies of the base's)."""
+    sd = model.state_dict()
+    rng = np.random.default_rng(seed)
+    out = {}
+    for nm in TARGETS:
+        k, n = np.asarray(sd[nm]).shape
+        out[nm] = (rng.normal(size=(k, rank)).astype(np.float32)
+                   * scale,
+                   rng.normal(size=(rank, n)).astype(np.float32)
+                   * scale)
+    return out
+
+
+def _store(model, budget=None, adapters=("a1", "a2")):
+    """A fresh fleet store hosting base + rank-4 adapters (padded to
+    max_rank 8 by registration). Re-calling builds IDENTICAL
+    artifacts — every fleet in a drill hosts the same weights."""
+    store = FleetModelStore(base_model="base",
+                            byte_budget_per_replica=budget, max_rank=8)
+    mids = [store.register_adapter(a, _deltas(model, seed=i + 1))
+            for i, a in enumerate(adapters)]
+    return store, mids
+
+
+JOBS = [([5, 4, 3, 2, 6, 7], 10), ([9, 1, 2], 10), ([7, 7, 1, 2], 10),
+        ([3, 3, 9], 10)]
+
+
+def _fleet(model, n=2, clock=None, engine_kw=None, **kw):
+    clock = clock if clock is not None else FakeClock()
+    ekw = dict(max_batch_size=3, max_seq_len=64, page_size=4)
+    ekw.update(engine_kw or {})
+    kw.setdefault("policy", "model_affinity")
+    kw.setdefault("sleep", clock.advance)
+    router = ServingRouter(
+        lambda i: ContinuousBatchingEngine(model, clock=clock, **ekw),
+        num_replicas=n, clock=clock, **kw)
+    return router, clock
+
+
+def _dedicated_streams(model, jobs_by_model, n=2):
+    """Oracle: each model's jobs on its own single-model fleet (fresh
+    unbudgeted store, same replica count). The multi-model plane's
+    acceptance bar is bit-identity against THESE streams."""
+    out = {}
+    for mid, jobs in jobs_by_model.items():
+        store, _ = _store(model)
+        router, _ = _fleet(model, n=n, model_store=store)
+        ids = [router.submit(p, m, model=mid) for p, m in jobs]
+        res = router.run()
+        out[mid] = [res[i] for i in ids]
+    return out
+
+
+# ---------------------------------------------------------------------
+class TestLoraEpilogueKernelOracle:
+    """ops/lora_epilogue.py parity: the Pallas BGMV kernel (interpret
+    mode on CPU) against an independent NumPy oracle, plus the two
+    exactness properties the bit-identity argument rests on."""
+
+    def _operands(self, t=16, k=128, n=128, r=8, stacks=4, seed=0):
+        rng = np.random.default_rng(seed)
+        x = rng.normal(size=(t, k)).astype(np.float32)
+        a = rng.normal(size=(stacks, k, r)).astype(np.float32) * 0.2
+        b = rng.normal(size=(stacks, r, n)).astype(np.float32) * 0.2
+        a[0] = 0.0
+        b[0] = 0.0
+        scale = np.linspace(0.0, 1.5, stacks).astype(np.float32)
+        ids = rng.integers(0, stacks, t).astype(np.int32)
+        return x, a, b, scale, ids
+
+    @staticmethod
+    def _numpy_oracle(x, a, b, scale, ids):
+        out = np.zeros((x.shape[0], b.shape[2]), np.float64)
+        for t in range(x.shape[0]):
+            i = int(ids[t])
+            h = x[t].astype(np.float64) @ a[i].astype(np.float64)
+            out[t] = (h @ b[i].astype(np.float64)) * float(scale[i])
+        return out
+
+    def test_interpret_kernel_matches_numpy_oracle(self):
+        # K/N on the 128-lane grid, rank on the 8-grid: the Pallas
+        # path is taken (use_kernel=True -> interpret mode off-TPU)
+        x, a, b, scale, ids = self._operands()
+        oracle = self._numpy_oracle(x, a, b, scale, ids)
+        got = np.asarray(lora_epilogue_values(x, a, b, scale, ids,
+                                              use_kernel=True))
+        assert got.shape == oracle.shape
+        np.testing.assert_allclose(got, oracle, rtol=1e-4, atol=1e-4)
+        # and the XLA fallback (the CPU serving path) agrees with the
+        # kernel — both reduce in f32
+        xla = np.asarray(lora_epilogue_values(x, a, b, scale, ids,
+                                              use_kernel=False))
+        np.testing.assert_allclose(got, xla, rtol=1e-5, atol=1e-5)
+
+    def test_off_grid_shapes_route_to_xla_and_match_oracle(self):
+        # K=32/N=64 (the test model's real shapes) are off the MXU
+        # lane grid: use_kernel=True must still be correct (the
+        # routing guard falls back rather than miscompiling)
+        x, a, b, scale, ids = self._operands(t=9, k=32, n=64, r=8)
+        oracle = self._numpy_oracle(x, a, b, scale, ids)
+        got = np.asarray(lora_epilogue_values(x, a, b, scale, ids,
+                                              use_kernel=True))
+        np.testing.assert_allclose(got, oracle, rtol=1e-4, atol=1e-4)
+
+    def test_row_zero_is_exact_zero_delta(self):
+        """Base-model tokens ride the mixed dispatch through row 0:
+        their delta must be EXACTLY zero (bitwise), not merely small —
+        that is the whole mixed==dedicated bit-identity argument."""
+        x, a, b, scale, ids = self._operands()
+        zeros = np.zeros_like(ids)
+        for kernel in (False, True):
+            d = np.asarray(lora_epilogue_values(x, a, b, scale, zeros,
+                                                use_kernel=kernel))
+            assert np.all(d == 0.0)
+
+    def test_rank_padding_columns_are_exact(self):
+        """Registration pads rank r -> max_rank with zero columns;
+        the padded stack must produce the BIT-IDENTICAL delta, so
+        fleets hosting different adapter subsets still agree."""
+        x, a, b, scale, ids = self._operands(r=4)
+        pad_a = np.concatenate(
+            [a, np.zeros(a.shape[:2] + (4,), np.float32)], axis=2)
+        pad_b = np.concatenate(
+            [b, np.zeros((b.shape[0], 4, b.shape[2]), np.float32)],
+            axis=1)
+        d0 = np.asarray(lora_epilogue_values(x, a, b, scale, ids,
+                                             use_kernel=False))
+        d1 = np.asarray(lora_epilogue_values(x, pad_a, pad_b, scale,
+                                             ids, use_kernel=False))
+        assert np.array_equal(d0, d1)
+
+
+# ---------------------------------------------------------------------
+class TestMixedBatchBitIdentity:
+    """The tentpole acceptance bar at the engine seam: one engine
+    serving base + two adapters in ONE ragged dispatch produces
+    streams bit-identical to three dedicated engines."""
+
+    def _engine(self, model, slots=6):
+        return ContinuousBatchingEngine(model, max_batch_size=slots,
+                                        max_seq_len=64, page_size=4)
+
+    def test_mixed_batch_bit_identical_single_dispatch(self, model):
+        prompts = {"base": [[5, 4, 3, 2], [9, 1, 2]],
+                   "a1": [[7, 7, 1, 2], [3, 3, 9]],
+                   "a2": [[2, 8, 8], [6, 1, 4, 4]]}
+        mixed = self._engine(model)
+        mixed.install_adapter("a1", _deltas(model, seed=1))
+        mixed.install_adapter("a2", _deltas(model, seed=2))
+        telemetry.clear_events()
+        rids = {}
+        for tag, ps in prompts.items():
+            for i, p in enumerate(ps):
+                rids[f"{tag}-{i}"] = mixed.add_request(
+                    p, 8, request_id=f"{tag}-{i}",
+                    adapter=None if tag == "base" else tag)
+        out = mixed.run()
+        mixed_streams = {key: out[rid] for key, rid in rids.items()}
+
+        # span-asserted single dispatch: at least one decode step's
+        # span carries live requests of all THREE models at once
+        spans = [e for e in telemetry.events()
+                 if e["name"] == "serving.decode_step"]
+        assert spans
+        tags = [{str(r).split("-")[0] for r in e["attrs"]["rids"]}
+                for e in spans]
+        assert any(t >= {"base", "a1", "a2"} for t in tags), \
+            "no decode step batched all three models together"
+
+        # dedicated engines: base-only (no adapter machinery AT ALL),
+        # and one engine per adapter — note a2 sits in stack row 1
+        # there vs row 2 in the mixed engine
+        for tag in prompts:
+            eng = self._engine(model)
+            if tag != "base":
+                eng.install_adapter(tag, _deltas(
+                    model, seed=1 if tag == "a1" else 2))
+            ids = [eng.add_request(p, 8, request_id=f"{tag}-{i}",
+                                   adapter=None if tag == "base"
+                                   else tag)
+                   for i, p in enumerate(prompts[tag])]
+            res = eng.run()
+            for i, rid in enumerate(ids):
+                assert res[rid] == mixed_streams[f"{tag}-{i}"], \
+                    f"{tag}-{i} diverged between mixed and dedicated"
+
+        # and the adapters genuinely steer the stream (the identity
+        # above must compare three DIFFERENT streams, not one)
+        assert mixed_streams["a1-0"] != mixed_streams["base-0"] \
+            or mixed_streams["a1-1"] != mixed_streams["base-1"]
+
+
+# ---------------------------------------------------------------------
+class TestStoreInstallEvict:
+    """FleetModelStore transactionality: failed installs leave no
+    residue, the LRU honors pins, and refused evictions never strand
+    in-flight work."""
+
+    def _engine(self, model):
+        return ContinuousBatchingEngine(model, max_batch_size=3,
+                                        max_seq_len=64, page_size=4)
+
+    def test_failed_install_leaves_no_residue(self, model):
+        """An adapter whose deltas target an unknown parameter passes
+        registration (the store cannot see the model) but the ENGINE
+        install raises — ensure() must propagate with the store's
+        accounting untouched, and the replica must keep serving."""
+        bad = FleetModelStore(base_model="base", max_rank=8)
+        mid_bad = bad.register_adapter(
+            "bad", {"nope.weight": (np.zeros((8, 4), np.float32),
+                                    np.zeros((4, 8), np.float32))})
+        eng = self._engine(model)
+        with pytest.raises(ValueError, match="unknown parameter"):
+            bad.ensure(0, eng, mid_bad)
+        assert not bad.is_resident(0, mid_bad)
+        assert bad.installs == 0
+        assert bad.resident(0) == ("base",)
+        # a good store still installs onto the SAME engine afterwards
+        good, (m1, _) = _store(model)
+        assert good.ensure(0, eng, m1) is True
+        rid = eng.add_request([5, 4, 3], 4, adapter="a1")
+        assert len(eng.run()[rid]) == 4
+
+    def test_byte_budget_lru_evicts_cold_adapter(self, model):
+        store, (m1, m2) = _store(model, budget=6_000)
+        eng = self._engine(model)
+        assert store.ensure("r0", eng, m1) is True
+        assert store.ensure("r0", eng, m1) is False     # warm hit
+        assert store.ensure("r0", eng, m2) is True      # evicts a1
+        assert store.is_resident("r0", m2)
+        assert not store.is_resident("r0", m1)
+        assert store.evictions == 1
+        assert store.resident_bytes("r0") \
+            <= store.byte_budget_per_replica
+        # the engine agrees: a1's row is gone
+        with pytest.raises(ModelMismatch):
+            eng.add_request([5, 4], 4, adapter="a1")
+
+    def test_pinned_adapter_survives_make_room(self, model):
+        """Pins outrank the budget: with a1 pinned, installing a2
+        refuses the eviction and legally runs over budget."""
+        store, (m1, m2) = _store(model, budget=6_000)
+        eng = self._engine(model)
+        store.ensure("r0", eng, m1)
+        store.pin("r0", m1)
+        store.ensure("r0", eng, m2)
+        assert store.is_resident("r0", m1)      # pinned: not evicted
+        assert store.is_resident("r0", m2)
+        assert store.evict_refusals >= 1
+        assert store.resident_bytes("r0") \
+            > store.byte_budget_per_replica     # over budget is legal
+        store.unpin("r0", m1)
+
+    def test_engine_refuses_evicting_inflight_adapter(self, model):
+        """The engine's own backstop under the store's refusal path:
+        evict_adapter refuses while a request decodes under it."""
+        eng = self._engine(model)
+        eng.install_adapter("a1", _deltas(model, seed=1))
+        rid = eng.add_request([5, 4, 3], 6, adapter="a1")
+        with pytest.raises(ValueError, match="in flight|in-flight"):
+            eng.evict_adapter("a1")
+        assert len(eng.run()[rid]) == 6
+        eng.evict_adapter("a1")                 # drained: now fine
+
+    def test_budget_below_one_adapter_still_installs(self, model):
+        """Cold-install liveness under pressure: a budget smaller
+        than a single adapter has nothing evictable — the install
+        must proceed (advisory budget), not deadlock."""
+        store, (m1, _) = _store(model, budget=1_000)
+        eng = self._engine(model)
+        assert store.ensure("r0", eng, m1) is True
+        assert store.is_resident("r0", m1)
+        rid = eng.add_request([5, 4, 3], 4, adapter="a1")
+        assert len(eng.run()[rid]) == 4
+
+    def test_full_checkpoint_swap_drops_adapters(self, model,
+                                                 v2_values):
+        store, (m1, _) = _store(model)
+        mid_v2 = store.register_model("v2", v2_values)
+        eng = self._engine(model)
+        store.ensure("r0", eng, m1)
+        store.ensure("r0", eng, mid_v2)
+        assert store.replica_base("r0") == "v2"
+        assert not store.is_resident("r0", m1)  # died with its base
+        assert eng.model_tag == "v2"
+        with pytest.raises(ModelMismatch):
+            eng.add_request([5, 4], 4, adapter="a1")
+
+
+# ---------------------------------------------------------------------
+class TestRouterMultiModel:
+    """Model-keyed routing: typed refusals, cold-install accounting,
+    eviction churn under a tight budget, and bit-identity of every
+    model's streams against dedicated single-model fleets."""
+
+    def test_unknown_model_refused_typed(self, model):
+        store, _ = _store(model)
+        router, _ = _fleet(model, model_store=store)
+        with pytest.raises(ValueError, match="base\\+nope"):
+            router.submit([5, 4, 3], 4, model="base+nope")
+        assert not router.requests      # refused before any state
+
+    def test_submit_model_needs_a_store(self, model):
+        router, _ = _fleet(model, policy="round_robin")
+        with pytest.raises(ValueError, match="model_store"):
+            router.submit([5, 4, 3], 4, model="base+a1")
+
+    def test_mixed_fleet_bit_identical_to_dedicated(self, model):
+        jobs_by_model = {"base": JOBS[:2], "base+a1": JOBS[2:],
+                         "base+a2": JOBS[:2]}
+        want = _dedicated_streams(model, jobs_by_model)
+        store, _ = _store(model)
+        router, _ = _fleet(model, model_store=store)
+        ids = {mid: [router.submit(p, m, model=mid) for p, m in jobs]
+               for mid, jobs in jobs_by_model.items()}
+        out = router.run()
+        for mid, rids in ids.items():
+            assert [out[r] for r in rids] == want[mid], \
+                f"{mid} streams diverged from its dedicated fleet"
+        # accounting: every submit and terminal is model-keyed
+        info = router.fleet_info()
+        for mid, jobs in jobs_by_model.items():
+            assert info["models"][mid]["submitted"] == len(jobs)
+            assert sum(info["models"][mid]["terminal"].values()) \
+                == len(jobs)
+            assert info["models"][mid]["pending"] == 0
+        assert sum(router.num_cold_installs_by_model.values()) >= 2
+        assert telemetry.value("pdt_router_model_cold_installs_total",
+                               model="base+a1") >= 1
+        spans = [e for e in telemetry.events()
+                 if e["name"] == "router.model_install"]
+        assert spans and all("model" in e["attrs"] for e in spans)
+
+    def test_budget_churn_evicts_and_stays_bit_identical(self, model):
+        """Serial single-adapter phases under a one-adapter budget:
+        each phase must evict the previous adapter, reinstall, and
+        still reproduce the dedicated fleet's streams exactly."""
+        phases = [("base+a1", JOBS[:2]), ("base+a2", JOBS[2:]),
+                  ("base+a1", JOBS[2:])]
+        want = _dedicated_streams(
+            model, {"base+a1": JOBS[:2] + JOBS[2:],
+                    "base+a2": JOBS[2:]}, n=1)
+        store, _ = _store(model, budget=6_000)
+        router, _ = _fleet(model, n=1, model_store=store)
+        got = {"base+a1": [], "base+a2": []}
+        for mid, jobs in phases:
+            rids = [router.submit(p, m, model=mid) for p, m in jobs]
+            out = router.run()
+            got[mid] += [out[r] for r in rids]
+        assert got == want
+        assert store.evictions >= 2             # a1 out, then a2 out
+        assert router.num_cold_installs_by_model["base+a1"] == 2
+        assert telemetry.value("pdt_model_store_evictions_total",
+                               kind="adapter") >= 2
+
+
+# ---------------------------------------------------------------------
+class TestModelKeyedMigration:
+    """Scale-down evacuation on a multi-model fleet: the survivor
+    must cold-install the victim's model BEFORE the pages move (a
+    cross-model import is a typed refusal), and the migrated streams
+    stay bit-identical to dedicated single-model fleets."""
+
+    def test_shrink_migrates_adapter_requests_bit_identical(
+            self, model):
+        jobs_by_model = {"base+a1": JOBS[:2], "base+a2": JOBS[2:]}
+        want = _dedicated_streams(model, jobs_by_model)
+        store, (m1, m2) = _store(model)
+        router, _ = _fleet(model, model_store=store,
+                           engine_kw=dict(max_batch_size=4))
+        ids = {mid: [router.submit(p, m, model=mid) for p, m in jobs]
+               for mid, jobs in jobs_by_model.items()}
+        for _ in range(3):
+            router.step()   # prefilled + decoding: pages are warm
+        victim_models = {router.requests[r].model
+                         for rids in ids.values() for r in rids
+                         if router.requests[r].replica == 1}
+        assert victim_models, "affinity left replica 1 empty"
+        router.resize(num_replicas=1, reason="evacuation drill")
+        # the warm hand-off happened, and the survivor cold-installed
+        # the victim's model first (import_pages would have refused)
+        assert router.num_migrations >= 1
+        for mid in victim_models:
+            assert store.is_resident(0, mid)
+        out = router.run()
+        for mid, rids in ids.items():
+            assert [out[r] for r in rids] == want[mid], \
+                f"{mid} streams diverged through the shrink"
+
+
+# ---------------------------------------------------------------------
+class TestCrossModelImport:
+    """Migration payloads carry the hosted model's identity: KV pages
+    produced under one checkpoint must refuse to land under another
+    (silent cross-model KV corruption is the failure mode)."""
+
+    def _engine(self, model):
+        return ContinuousBatchingEngine(model, max_batch_size=3,
+                                        max_seq_len=64, page_size=4)
+
+    def test_import_pages_refuses_cross_model(self, model, v2_values):
+        src = self._engine(model)
+        src.install_weights(v2_values, tag="v2")
+        rid = src.add_request([5, 4, 3, 2], 8)
+        src.step()                      # running: pages resident
+        payload = src.export_pages(rid)
+        dst = self._engine(model)       # hosts the build-time base
+        before = telemetry.value("pdt_model_mismatch_total",
+                                 kind="import")
+        with pytest.raises(ModelMismatch, match="v2"):
+            dst.import_pages(payload)
+        assert telemetry.value("pdt_model_mismatch_total",
+                               kind="import") == before + 1
+        # the source is untouched (export is read-only): it finishes
+        assert len(src.run()[rid]) == 8
+
+    def test_nonresident_adapter_refused_before_enqueue(self, model):
+        eng = self._engine(model)
+        before = telemetry.value("pdt_model_mismatch_total",
+                                 kind="adapter")
+        with pytest.raises(ModelMismatch, match="ghost"):
+            eng.add_request([5, 4], 4, adapter="ghost")
+        assert telemetry.value("pdt_model_mismatch_total",
+                               kind="adapter") == before + 1
+
+
+# ---------------------------------------------------------------------
+class TestPerModelCanary:
+    """Canary probes on multi-model fleets grade each replica against
+    the golden of the checkpoint it HOSTS — one shared golden would
+    false-quarantine every healthy swapped replica."""
+
+    def _mm_sentried(self, model, v2_values, n=2):
+        store, _ = _store(model)
+        mid_v2 = store.register_model("v2", v2_values)
+        router, clock = _fleet(
+            model, n=n, model_store=store,
+            sentry=SentryConfig(scan_every=2),
+            canary=CanaryConfig(interval=5.0, max_new_tokens=6),
+            restart_backoff_base=3.0, restart_backoff_max=3.0)
+        return router, clock, store, mid_v2
+
+    def test_swapped_replica_canary_passes_on_its_own_golden(
+            self, model, v2_values):
+        """The false-quarantine regression: a healthy replica hosting
+        the v2 checkpoint runs its canary and must PASS — graded
+        against v2's golden stream, not base's."""
+        router, clock, store, mid_v2 = self._mm_sentried(
+            model, v2_values)
+        ids = [router.submit(p, m, model=mid) for (p, m), mid
+               in zip(JOBS, ["base", mid_v2, "base", mid_v2])]
+        clock.advance(6.0)              # canary schedule due
+        router.run()
+        for _ in range(60):             # let in-flight canaries land
+            if all(h.canary is None and h.canary_runs >= 1
+                   for h in router.replicas):
+                break
+            router.step()
+        bases = {store.replica_base(h.index) for h in router.replicas}
+        assert "v2" in bases            # a replica really swapped
+        assert router.num_quarantines == 0
+        assert all(h.state == ReplicaState.HEALTHY
+                   for h in router.replicas)
+        # per-model goldens: lazily computed for v2, distinct streams
+        assert set(router._canary_goldens) >= {"base", "v2"}
+        assert router._canary_goldens["base"] \
+            != router._canary_goldens["v2"]
+        assert telemetry.value("pdt_sentry_canary_runs_total",
+                               result="pass") >= 2
+
+    def test_corrupt_swapped_replica_quarantines_and_reserves(
+            self, model, v2_values):
+        """A persistently NaN-poisoned v2 replica must quarantine —
+        graded against v2's golden — and its streams re-serve
+        bit-identically on the surviving replica (which cold-installs
+        v2 to take the work)."""
+        jobs = JOBS
+        # the uncorrupted oracle: same fleet shape, same submits
+        oracle_rt, _, _, mid_v2 = self._mm_sentried(model, v2_values)
+        oids = [oracle_rt.submit(p, m, model=mid_v2) for p, m in jobs]
+        oout = oracle_rt.run()
+        want = [oout[i] for i in oids]
+
+        router, clock, store, mid_v2 = self._mm_sentried(
+            model, v2_values)
+        ids = [router.submit(p, m, model=mid_v2) for p, m in jobs]
+        vidx = None
+        for _ in range(40):             # find the swapped replica
+            router.step()
+            hosts = [h.index for h in router.replicas
+                     if store.replica_base(h.index) == "v2"]
+            if hosts:
+                vidx = hosts[0]
+                break
+        assert vidx is not None, "v2 never installed"
+        with FaultInjector(seed=0) as fi:
+            fi.arm_corrupt("serving.logits", mode="nan", always=True,
+                           tag=str(vidx))
+            quarantined = False
+            for _ in range(120):
+                router.step()
+                if router.replicas[vidx].state \
+                        == ReplicaState.QUARANTINED:
+                    quarantined = True
+                    break
+            assert quarantined, "corrupt v2 replica never quarantined"
+            clock.advance(4.0)
+            out = router.run()
+        assert [out[i] for i in ids] == want
+        assert router.num_quarantines >= 1
+        assert "v2" in router._canary_goldens
+        ev = [e for e in telemetry.events()
+              if e["name"] == "replica.quarantine"]
+        assert ev and ev[0]["attrs"]["replica"] == vidx
+
+
+# ---------------------------------------------------------------------
+class TestJournalRecoveryModelAssignments:
+    """SIGKILL the router mid-decode on a multi-model fleet: recovery
+    must restore every request's MODEL assignment from the journal
+    (re-dispatch under the wrong weights would be silent corruption),
+    finish bit-identically, and reconcile per-model terminals."""
+
+    # staggered budgets: finished-and-live requests must coexist at
+    # the kill point
+    N_TOKS = [4, 10, 8, 14]
+
+    def _submits(self, router, mids):
+        return [router.submit(p, n, model=mid)
+                for (p, _), n, mid in zip(JOBS, self.N_TOKS, mids)]
+
+    def test_sigkill_recovery_restores_models_bit_identical(
+            self, model, tmp_path):
+        mids = ["base", "base+a1", "base+a2", "base+a1"]
+        # the uninterrupted oracle
+        store0, _ = _store(model)
+        oracle_rt, _ = _fleet(model, model_store=store0)
+        oids = self._submits(oracle_rt, mids)
+        oout = oracle_rt.run()
+        want = [oout[i] for i in oids]
+
+        clock = FakeClock()
+        store1, _ = _store(model)
+        jr = RouterJournal(os.path.join(str(tmp_path), "wal"),
+                           fsync="off", clock=clock)
+        router, _ = _fleet(model, clock=clock, model_store=store1,
+                           journal=jr)
+        ids = self._submits(router, mids)
+        finished = []
+        while not finished:
+            finished += [r.request_id for r in router.step()]
+        assert any(not router.requests[i].done for i in ids)
+        del router                      # SIGKILL-shaped: only the
+        #                                 journal directory survives
+        jr2 = RouterJournal(os.path.join(str(tmp_path), "wal"),
+                            fsync="off", clock=clock)
+        store2, _ = _store(model)       # artifacts re-registered at
+        #                                 boot; residency died with
+        #                                 the old process's engines
+        recovered = ServingRouter.recover(
+            jr2,
+            lambda i: ContinuousBatchingEngine(
+                model, clock=clock, max_batch_size=3, max_seq_len=64,
+                page_size=4),
+            num_replicas=2, clock=clock, sleep=clock.advance,
+            policy="model_affinity", model_store=store2)
+        # the journal restored every request's model assignment
+        for rid, mid in zip(ids, mids):
+            assert recovered.requests[rid].model == mid
+        out = recovered.run()
+        assert [out[i] for i in ids] == want
+        # exact per-model terminal reconciliation across BOTH
+        # incarnations (deduped restores count too)
+        for mid in set(mids):
+            n = sum(1 for m in mids if m == mid)
+            row = recovered.num_terminal_by_model[mid]
+            assert row.get("finished", 0) == n, (mid, row)
+        info = recovered.fleet_info()
+        for mid in set(mids):
+            n = sum(1 for m in mids if m == mid)
+            assert sum(info["models"][mid]["terminal"].values()) == n
