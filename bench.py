@@ -258,6 +258,12 @@ REGRESSION_METRICS = (
     # lora_epilogue row-gather; must beat adapter-serial decode
     # (detail.multimodel.mixed_over_serial_speedup) and not regress
     "detail.multimodel.multimodel_decode_tokens_per_sec",
+    # pipelined decode (ISSUE 18): the k=8 deferred-harvest fleet with
+    # journal AND sentry attached — group-commit + batched scans must
+    # keep the full stack >= 95% of bare-engine (the convergence gate,
+    # graded inside detail.async_pipeline), and this row keeps that
+    # converged throughput from regressing
+    "detail.async_pipeline.async_decode_tokens_per_sec",
 )
 
 # latency-family regression gates: LOWER is better, a rise past the
@@ -1708,6 +1714,128 @@ def bench_sentry(model, cfg, on_tpu: bool) -> dict:
     return {"sentry": detail}
 
 
+def bench_async_pipeline(model, cfg, on_tpu: bool) -> dict:
+    """Pipelined-decode overlap A/B (ISSUE 18): full-stack
+    (journal fsync="terminal" + every-Nth sentry) fleets at
+    harvest_every k in {1, 4, 8}, grading the convergence gate —
+    decode tokens/sec with everything ON converges to the bare-engine
+    number as k grows, because journal appends, sentry checks, and
+    mirror diffs all quantize to one batched harvest per window.
+
+    Measurement discipline = PR 13's, adapted to windows: per-step
+    medians would lie here (k-1 of every k steps skip the harvest
+    entirely — the spiky harvest step IS the design), so every number
+    is a TOTAL over the measured span. The overlap-stack cost is
+    clocked in situ (`_TimedJournal` wall + `NumericSentry.spent`)
+    and full_stack_pct = (wall - stack_seconds) / wall — the fraction
+    of the fleet's step wall that is pure decode. This also
+    re-measures `detail.journal`'s per-step journal cost at each k
+    (`journal_us_per_step`): group-commit shrinks it ~k-fold.
+    `async_decode_tokens_per_sec` (the k=8 full-stack row, committed
+    tokens over wall) is wired into REGRESSION_METRICS."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+    import paddle_tpu.observability as telemetry
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+    from paddle_tpu.serving import (CanaryConfig, RouterJournal,
+                                    SentryConfig, ServingRouter)
+
+    model.eval()
+    if on_tpu:
+        slots, p_len, warm, steps, max_seq, nth = 8, 128, 8, 64, 1024, 8
+    else:
+        # the measured span covers several whole windows at k=8;
+        # max_seq sized so every request outlasts it
+        slots, p_len, warm, steps, max_seq, nth = 4, 8, 4, 48, 256, 8
+    rng = np.random.default_rng(0)
+    jobs = [list(rng.integers(1, cfg.vocab_size, p_len))
+            for _ in range(slots)]
+    root = tempfile.mkdtemp(prefix="pdt_bench_async_")
+    telemetry.enable()
+    detail = {}
+
+    class _TimedJournal:
+        def __init__(self, inner):
+            self._inner = inner
+            self.spent = 0.0
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def step_mirror(self, mirrors):
+            t0 = time.perf_counter()
+            try:
+                return self._inner.step_mirror(mirrors)
+            finally:
+                self.spent += time.perf_counter() - t0
+
+        def append_terminal(self, *a, **kw):
+            t0 = time.perf_counter()
+            try:
+                return self._inner.append_terminal(*a, **kw)
+            finally:
+                self.spent += time.perf_counter() - t0
+
+    try:
+        for k in (1, 4, 8):
+            jr = _TimedJournal(RouterJournal(
+                os.path.join(root, f"wal-k{k}"), fsync="terminal"))
+            router = ServingRouter(
+                lambda i: ContinuousBatchingEngine(
+                    model, max_batch_size=slots + 1,
+                    max_seq_len=max_seq,
+                    attention_impl=ATTENTION_IMPL, harvest_every=k),
+                num_replicas=1, journal=jr,
+                sentry=SentryConfig(scan_every=nth),
+                canary=CanaryConfig(interval=3600.0))
+            for p in jobs:
+                router.submit(p, max_new_tokens=max_seq - p_len - 1)
+            for _ in range(warm):
+                router.step()
+            h = router.replicas[0]
+            h.engine.quiesce()           # every mode starts at a
+            jr.spent = 0.0               # window boundary
+            h.sentry.spent = 0.0
+            tok0 = telemetry.value("pdt_serving_decode_tokens_total")
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                router.step()
+            h.engine.quiesce()           # commit the tail window into
+            wall = time.perf_counter() - t0   # the measured span
+            committed = telemetry.value(
+                "pdt_serving_decode_tokens_total") - tok0
+            stack = jr.spent + h.sentry.spent
+            detail[f"k{k}"] = {
+                "full_stack_decode_tokens_per_sec": round(
+                    committed / wall, 1),
+                "journal_us_per_step": round(
+                    jr.spent / steps * 1e6, 1),
+                "sentry_us_per_step": round(
+                    h.sentry.spent / steps * 1e6, 1),
+                "stack_overhead_pct": round(stack / wall * 100, 2),
+                "full_stack_pct": round(
+                    (wall - stack) / wall * 100, 2),
+            }
+            jr.close()
+        # the convergence gate (acceptance bar): at k=8 the
+        # journal+sentry stack costs <= 5% of the step wall, i.e.
+        # full-stack throughput >= 95% of bare-engine
+        detail["convergence"] = {
+            "k8_full_stack_pct": detail["k8"]["full_stack_pct"],
+            "gate_pct": 95.0,
+            "pass": bool(detail["k8"]["full_stack_pct"] >= 95.0),
+        }
+        detail["async_decode_tokens_per_sec"] = \
+            detail["k8"]["full_stack_decode_tokens_per_sec"]
+    finally:
+        telemetry.disable(clear_override=True)
+        model.train()
+        shutil.rmtree(root, ignore_errors=True)
+    return {"async_pipeline": detail}
+
+
 def run_bench(on_tpu: bool) -> dict:
     import jax
     import paddle_tpu as paddle
@@ -1834,6 +1962,11 @@ def run_bench(on_tpu: bool) -> dict:
         detail.update(bench_multimodel(model, cfg, on_tpu))
     except Exception:
         detail["multimodel_error"] = \
+            traceback.format_exc(limit=3)[-400:]
+    try:
+        detail.update(bench_async_pipeline(model, cfg, on_tpu))
+    except Exception:
+        detail["async_pipeline_error"] = \
             traceback.format_exc(limit=3)[-400:]
 
     return {

@@ -11,6 +11,7 @@ from .clocks import InjectableClockChecker
 from .coverage import FaultCoverageChecker
 from .durablewrites import DurableWriteChecker
 from .faultsites import FaultSiteDriftChecker
+from .harvestseam import HarvestSeamChecker
 from .modelkeys import ModelKeyChecker
 from .pins import PinPairingChecker
 from .resizeintent import ResizeIntentChecker
@@ -20,9 +21,10 @@ from .tracedsync import TracedHostSyncChecker
 __all__ = ["ALL_CHECKER_CLASSES", "default_checkers", "by_code",
            "CatalogDriftChecker", "InjectableClockChecker",
            "DurableWriteChecker", "FaultCoverageChecker",
-           "FaultSiteDriftChecker", "ModelKeyChecker",
-           "PinPairingChecker", "ResizeIntentChecker",
-           "SwallowedErrorChecker", "TracedHostSyncChecker"]
+           "FaultSiteDriftChecker", "HarvestSeamChecker",
+           "ModelKeyChecker", "PinPairingChecker",
+           "ResizeIntentChecker", "SwallowedErrorChecker",
+           "TracedHostSyncChecker"]
 
 ALL_CHECKER_CLASSES = (
     InjectableClockChecker,      # PDT001
@@ -35,6 +37,7 @@ ALL_CHECKER_CLASSES = (
     FaultCoverageChecker,        # PDT008
     ResizeIntentChecker,         # PDT009
     ModelKeyChecker,             # PDT010
+    HarvestSeamChecker,          # PDT011
 )
 
 

@@ -1,0 +1,128 @@
+"""PDT011 — harvest-seam discipline in the serving hot loop.
+
+Repo law (ISSUE 18, the pipelined decode loop): the engine/router
+decode path must stay free of host synchronization so the deferred-
+harvest window actually overlaps — one stray ``np.asarray`` on the
+device token ring re-serializes every dispatch and silently turns
+``harvest_every=8`` back into the synchronous loop, with none of the
+tests noticing (the streams stay bit-identical; only the overlap
+dies). Host syncs belong in the DESIGNATED harvest functions
+(``_harvest*`` / ``quiesce*``), which are the one seam where the
+window closes: D2H pull, token commits, journal/mirror/sentry work.
+
+The forbidden set is PDT002's (``np.asarray``/``np.array``,
+``jax.device_get``, argless ``.item()``, ``float()``/``int()`` of a
+bare parameter) — the same syncs, policed in a different place: PDT002
+bans them INSIDE traced functions, PDT011 bans them in the HOST-side
+decode path outside the harvest seam. Subscript reads like
+``int(self._tok[i])`` stay legal: by the time the commit loop runs
+they index a harvested host array.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set, Tuple
+
+from .._astutil import body_calls, call_name, import_aliases
+from ..core import Checker, Finding, Project
+
+__all__ = ["HarvestSeamChecker"]
+
+
+class HarvestSeamChecker(Checker):
+    code = "PDT011"
+    name = "harvest-seam"
+    rationale = ("no host sync in the engine/router decode path "
+                 "outside the designated _harvest*/quiesce* functions "
+                 "(ISSUE 18 pipelined-loop discipline)")
+
+    # the serving hot loop: the engine's step/_decode pair and the
+    # router's step-driven supervision around it
+    DEFAULT_SCOPE = ("paddle_tpu/models/serving.py",
+                     "paddle_tpu/serving/router.py")
+    # host-side decode-path functions under the discipline. Deliberately
+    # a closed list: most of serving.py (prefill, export, bench plumbing)
+    # legitimately syncs — only the per-token hot loop must not
+    DECODE_PATH = ("step", "_decode")
+    # designated harvest seam: functions with these name prefixes may
+    # sync (and nested defs inside them inherit the exemption)
+    SEAM_PREFIXES = ("_harvest", "quiesce")
+
+    def __init__(self, scope: Tuple[str, ...] = DEFAULT_SCOPE):
+        self.scope = scope
+
+    def _decode_path_functions(self, tree: ast.AST):
+        """Top-level walk that respects the seam: a DECODE_PATH
+        function yields with the set of nested seam-function call
+        nodes excluded from its scan."""
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in self.DECODE_PATH:
+                yield node
+
+    def _seam_calls(self, fn: ast.AST) -> Set[int]:
+        """Call nodes living inside a nested seam function (a local
+        ``def _harvest_x()`` helper) — exempt."""
+        out: Set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn \
+                    and node.name.startswith(self.SEAM_PREFIXES):
+                for call in ast.walk(node):
+                    if isinstance(call, ast.Call):
+                        out.add(id(call))
+        return out
+
+    def _forbidden(self, call: ast.Call, aliases, params: Set[str]):
+        name = call_name(call, aliases)
+        if name is not None:
+            tail = name.split(".")
+            # numpy.asarray/array is the D2H pull; jax.numpy.asarray
+            # is the opposite direction (host->device upload feeding
+            # the dispatch) and stays legal on the hot path
+            if len(tail) >= 2 and tail[-2] in ("numpy", "np") \
+                    and tail[-1] in ("asarray", "array") \
+                    and tail[0] != "jax":
+                return (f"{tail[-2]}.{tail[-1]}",
+                        "pulls a device value to host mid-window")
+            if name == "jax.device_get" \
+                    or name.endswith(".device_get"):
+                return ("jax.device_get", "explicit device->host sync")
+        if isinstance(call.func, ast.Attribute) \
+                and call.func.attr == "item" and not call.args:
+            return (".item()", "scalar host sync")
+        if isinstance(call.func, ast.Name) \
+                and call.func.id in ("float", "int") and call.args:
+            a = call.args[0]
+            if isinstance(a, ast.Name) and a.id in params:
+                return (f"{call.func.id}()",
+                        "concretizes a possibly-device value")
+        return None
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.match(self.scope):
+            if sf.tree is None:
+                continue
+            aliases = import_aliases(sf.tree)
+            seen: Set[int] = set()
+            for fn in self._decode_path_functions(sf.tree):
+                params = {a.arg for a in (fn.args.args
+                                          + fn.args.posonlyargs
+                                          + fn.args.kwonlyargs)
+                          if a.arg != "self"}
+                exempt = self._seam_calls(fn)
+                for call in body_calls(fn):
+                    key = id(call)
+                    if key in seen or key in exempt:
+                        continue
+                    hit = self._forbidden(call, aliases, params)
+                    if hit is None:
+                        continue
+                    seen.add(key)
+                    what, why = hit
+                    yield self.finding(
+                        sf, call,
+                        f"{what} in decode-path function `{fn.name}` "
+                        f"— {why}; host syncs belong in a designated "
+                        f"_harvest*/quiesce* seam function",
+                        detail=f"{fn.name}:{what}", project=project)

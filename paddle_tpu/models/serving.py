@@ -216,7 +216,19 @@ _M_TPOT = telemetry.histogram(
              1.0, 2.5))
 _M_DECODE_STEP = telemetry.histogram(
     "pdt_serving_decode_step_seconds",
-    "Wall time of one batched decode dispatch.")
+    "Wall time of one batched decode dispatch incl. its D2H sync "
+    "(the synchronous harvest_every=1 path).")
+# pipelined decode (harvest_every=k, ISSUE 18): dispatch wall and
+# harvest/D2H wall are SEPARATE histograms — the single step histogram
+# conflates exactly the two costs the overlap window trades off
+_M_DECODE_DISPATCH = telemetry.histogram(
+    "pdt_serving_decode_dispatch_seconds",
+    "Wall time of one batched decode dispatch WITHOUT its D2H sync "
+    "(the device-feedback half of the pipelined hot loop).")
+_M_HARVEST = telemetry.histogram(
+    "pdt_serving_harvest_seconds",
+    "Wall time of one batched harvest: the D2H sync over a whole "
+    "deferred window (harvest_every dispatches) plus token commits.")
 _M_DECODE_TOKENS = telemetry.counter(
     "pdt_serving_decode_tokens_total",
     "Tokens emitted by decode steps (excludes prefill first tokens).")
@@ -471,6 +483,14 @@ class Request:
     # against the engine's stacks at add_request / import_pages and
     # threaded into every ragged dispatch as the slot's adapter row.
     adapter: Optional[str] = None
+    # pipelined decode staleness contract (harvest_every=k, ISSUE 18):
+    # tokens the DEVICE has produced, counting deferred dispatches the
+    # host has not harvested yet — always >= len(output), resynced to
+    # it at every harvest (an EOS inside the window clamps the
+    # overshoot away). The synchronous k=1 path leaves it at 0; read
+    # it as max(device_len, len(output)) like FleetRequest.device_len
+    # does.
+    device_len: int = 0
 
 
 class ContinuousBatchingEngine:
@@ -506,9 +526,37 @@ class ContinuousBatchingEngine:
                  clock: Optional[Callable[[], float]] = None,
                  spec_decode: Optional[SpecConfig] = None,
                  submesh=None,
-                 quant: Optional[QuantServingConfig] = None):
+                 quant: Optional[QuantServingConfig] = None,
+                 harvest_every: int = 1):
         cfg = model.config
         self.model = model
+        # -- pipelined decode (ISSUE 18, docs/serving.md "Pipelined
+        # decode"): harvest_every=k defers the D2H token sync — the
+        # greedy-sampled token stays ON DEVICE and feeds step N+1's
+        # dispatch, with one batched harvest (sync + commits + sentry
+        # checks) every k dispatches. k=1 IS today's synchronous loop.
+        self.harvest_every = int(harvest_every)
+        if self.harvest_every < 1:
+            raise ValueError(
+                f"harvest_every must be >= 1, got {harvest_every}")
+        if self.harvest_every > 1:
+            if kv_layout != "paged" or attention_impl != "ragged":
+                raise ValueError(
+                    "harvest_every > 1 requires kv_layout='paged' with "
+                    "attention_impl='ragged' — the deferred-harvest "
+                    "window feeds the device token ring back through "
+                    "the ragged dispatch only")
+            if do_sample:
+                raise ValueError(
+                    "harvest_every > 1 is greedy-only: a window "
+                    "dispatched past another slot's EOS consumes PRNG "
+                    "keys the synchronous loop never drew, desyncing "
+                    "the sampling stream from the k=1 oracle")
+            if spec_decode is not None:
+                raise ValueError(
+                    "harvest_every > 1 does not compose with "
+                    "spec_decode — a speculative round's verify pass "
+                    "IS its synchronous harvest")
         # -- quantized serving (QuantServingConfig docstring) ----------
         self._quant = quant
         self._qw_mode = quant.weights if quant is not None else None
@@ -721,6 +769,15 @@ class ContinuousBatchingEngine:
         self._slot_seq = np.zeros(self.B, np.int64)
         self._decode_jit = None
         self._insert_jit = None
+        # deferred-harvest window (harvest_every > 1): one entry per
+        # un-harvested dispatch {nxt (device), lg (device|None), scan,
+        # act (active slots — constant within a window), pos (host
+        # position snapshot AFTER the dispatch)}; _tok_dev is the last
+        # dispatch's on-device token vector, the ring that feeds the
+        # next dispatch without a host round-trip
+        self._pending: List[dict] = []
+        self._tok_dev = None
+        self._window_wall = 0.0             # dispatch walls this window
         # gray-failure defense (ISSUE 14, serving/sentry.py): an
         # attached numeric sentry observes every token harvest (and,
         # every Nth step, the ragged decode program's sampled-row
@@ -1344,10 +1401,19 @@ class ContinuousBatchingEngine:
         every active slot, release finished slots. Returns the requests
         that reached a TERMINAL state this step (finished / timeout /
         failed / preempted-out — check `.status`). One monotonic-clock
-        tick per step drives deadline and queue-time expiry."""
-        finished = self._finished_backlog + self._expire()
+        tick per step drives deadline and queue-time expiry.
+
+        Pipelined mode (harvest_every=k > 1): a due deferred window is
+        harvested FIRST — before expiry, admission, and the next
+        dispatch — so every host-visible transition (deadline
+        finalization, slot release, re-admission) acts on committed
+        token state exactly like the synchronous loop would."""
+        finished = self._finished_backlog
         self._finished_backlog = []
         try:
+            if self._pending and self._harvest_due():
+                self._harvest_pending(finished)
+            finished += self._expire()
             finished += self._admit()
             active = [i for i, r in enumerate(self._slot_req)
                       if r is not None]
@@ -1447,6 +1513,7 @@ class ContinuousBatchingEngine:
         attaches a fresh one on every (re)build. A sentry trip never
         raises — the step completes and the router reads
         ``sentry.trips`` to drive SUSPECT -> canary -> quarantine."""
+        self.quiesce()    # pending logit rows belong to the OLD sentry
         self._sentry = sentry
         self._decode_jit = None       # rebuild with/without logits out
 
@@ -1506,6 +1573,11 @@ class ContinuousBatchingEngine:
         plane's serialize cost."""
         if self.layout != "paged":
             raise ValueError("export_pages requires the paged layout")
+        # pipelined decode: the payload serializes host slot state
+        # (ctx/last_token/output) — drain the deferred window first so
+        # it reflects every token the device produced (quiesce seam,
+        # docs/serving.md "Pipelined decode")
+        self.quiesce()
         slot = self._resident_slot(rid)
         req = self._slot_req[slot]
         freed = int(self._slot_freed[slot])
@@ -1602,6 +1674,11 @@ class ContinuousBatchingEngine:
         capacity deferrals, distinct from transfer failures."""
         if self.layout != "paged":
             raise ValueError("import_pages requires the paged layout")
+        # pipelined decode: the active set must be CONSTANT within a
+        # deferred window (the device token ring carries no entry for
+        # a slot installed mid-window) — drain the window before the
+        # install changes slot occupancy
+        self.quiesce()
         pq = payload.get("kv_quant")
         if pq != self._qkv:
             # cross-mode pages are not interpretable on the other
@@ -1761,6 +1838,7 @@ class ContinuousBatchingEngine:
         stays warm HERE for future prefills); a queued request just
         leaves the queue. Terminal counters are untouched: the request
         finishes, exactly once, wherever it lands."""
+        self.quiesce()          # hand off COMMITTED state only
         for i, r in enumerate(self._slot_req):
             if r is not None and r.rid == rid:
                 self._release_slot(i)
@@ -3210,6 +3288,16 @@ class ContinuousBatchingEngine:
             try:
                 self._alloc_page(slot)
             except PoolExhausted:
+                if self._pending:
+                    # pipelined window: commit the in-flight dispatches
+                    # FIRST so the preemption victim keeps every token
+                    # the device actually produced (zero loss under
+                    # pressure at k>1) — and an EOS hiding in the
+                    # window may free the pages without any victim
+                    self._harvest_pending(finished)
+                    if self._slot_req[slot] is None:
+                        return False    # slot finalized at harvest
+                    continue
                 victim = self._preempt_youngest(finished)
                 if victim is None:
                     raise
@@ -3301,6 +3389,11 @@ class ContinuousBatchingEngine:
             lg_rows = None
             if self.layout == "paged" and self.attn_impl == "ragged":
                 bidx = self._decode_idx
+                # pipelined mode: mid-window the token input is the
+                # PREVIOUS dispatch's on-device output — the greedy
+                # feedback needs no host round-trip (the whole point)
+                tok_in = (self._tok_dev if self._tok_dev is not None
+                          else jnp.asarray(self._tok))
                 with self._tp_scope():
                     # multi-LoRA: decode packs one row per slot in
                     # slot order, so the gather vector IS the
@@ -3308,7 +3401,7 @@ class ContinuousBatchingEngine:
                     out = self._decode_jit(
                         self._lora_pv(self._pv(), self._slot_adapter),
                         self._bv(),
-                        kv, jnp.asarray(self._tok), bidx,
+                        kv, tok_in, bidx,
                         jnp.asarray(pos.astype(np.int32)), bidx,
                         self._decode_ones,
                         jnp.asarray((pos + 1).astype(np.int32)), bt,
@@ -3326,12 +3419,47 @@ class ContinuousBatchingEngine:
                 self._kv = new_kv
             else:
                 self._caches = new_kv
-            # the D2H copy is the step's sync point — dispatch alone
-            # returns before the device finishes, so time through it
-            nxt = np.asarray(nxt)
             # pdt-lint: disable=PDT001 same real-wall measurement as t0
+            t1 = time.perf_counter()
+            if telemetry.enabled():
+                _M_DECODE_DISPATCH.observe(t1 - t0)
+            if self.harvest_every > 1:
+                # deferred-harvest path: the token vector stays on
+                # device; defer the sync, commits, and sentry checks to
+                # the window's one batched harvest. The stride tick
+                # happens NOW (per dispatch) so the scan schedule
+                # matches the synchronous loop step for step.
+                scan = False
+                if self._sentry is not None:
+                    # pdt-lint: disable=PDT001 sentry cost is REAL wall
+                    s0 = time.perf_counter()
+                    scan = self._sentry.step_tick()
+                    # pdt-lint: disable=PDT001 same measurement
+                    self._sentry.note_cost(time.perf_counter() - s0)
+                self._corrupt_kv_site()
+                act = tuple(i for i, r in enumerate(self._slot_req)
+                            if r is not None)
+                for i in act:
+                    r = self._slot_req[i]
+                    r.device_len = max(r.device_len,
+                                       len(r.output)) + 1
+                    self._pos[i] += 1
+                self._pending.append({
+                    "nxt": nxt,
+                    "lg": lg_rows if scan else None,
+                    "scan": scan, "act": act,
+                    "pos": self._pos.copy()})
+                self._tok_dev = nxt
+                self._window_wall += t1 - t0
+                return True
+            # synchronous path (harvest_every=1, today's loop): the
+            # D2H copy is the step's sync point — dispatch alone
+            # returns before the device finishes, so time through it
+            nxt = self._harvest_sync(nxt)
+            # pdt-lint: disable=PDT001 same real-wall measurement
             dt = time.perf_counter() - t0
         if telemetry.enabled():
+            _M_HARVEST.observe(dt - (t1 - t0))
             _M_DECODE_STEP.observe(dt)
             _M_DECODE_TOKENS.inc(n_active)
             if dt > 0:
@@ -3349,26 +3477,159 @@ class ContinuousBatchingEngine:
             scan = self._sentry.step_tick()
             act = [i for i, r in enumerate(self._slot_req)
                    if r is not None]
-            lg_np = None
-            if scan and lg_rows is not None:
-                # the logit harvest — and its VALUE fault site: the
-                # ACTIVE rows are what the scan inspects, so a
-                # corrupt-armed rule poisons exactly that view (the
-                # NaN-poisoned-logits drill; an inactive slot's
-                # garbage row is not a harvest)
-                lg_np = fault_value("serving.logits",
-                                    np.asarray(lg_rows)[act],
-                                    tag=self.fault_tag)
             # pdt-lint: disable=PDT001 same real-wall measurement
             self._sentry.note_cost(time.perf_counter() - s0)
-            self._sentry.observe_tokens(nxt[act])
-            if lg_np is not None:
-                self._sentry.observe_logits(lg_np)
+            self._harvest_sentry(nxt, lg_rows if scan else None, act,
+                                 lag=0)
         for i, r in enumerate(self._slot_req):
             if r is not None:
                 self._tok[i] = nxt[i]
                 self._pos[i] += 1
         return False
+
+    # -- pipelined harvest seam (harvest_every=k, ISSUE 18) -------------
+    # The _harvest_* family are the DESIGNATED host-sync functions of
+    # the decode path: pdt-lint PDT011 bans D2H syncs (np.asarray,
+    # .item(), jax.device_get, float()-of-operand) in step()/_decode()
+    # outside them, so the overlap window cannot silently regrow a
+    # per-step sync.
+    def _harvest_sync(self, nxt):
+        """The k=1 synchronous harvest: ONE dispatch's D2H token sync."""
+        return np.asarray(nxt)
+
+    def _harvest_sentry(self, nxt, lg_rows, act, lag: int):
+        """Sentry checks over one harvested dispatch: the in-vocab
+        token check, the every-Nth logit scan (pulled HERE — at k>1
+        the pull rides the harvest, bounding detection latency at k
+        steps, which `note_lag` meters), and the `serving.logits`
+        VALUE fault site over the ACTIVE rows the scan inspects (the
+        NaN-poisoned-logits drill; an inactive slot's garbage row is
+        not a harvest)."""
+        # pdt-lint: disable=PDT001 sentry cost is REAL wall (bench bar)
+        s0 = time.perf_counter()
+        lg_np = None
+        if lg_rows is not None:
+            lg_np = fault_value("serving.logits",
+                                np.asarray(lg_rows)[act],
+                                tag=self.fault_tag)
+        # pdt-lint: disable=PDT001 same real-wall measurement
+        self._sentry.note_cost(time.perf_counter() - s0)
+        self._sentry.observe_tokens(nxt[act])
+        # lag metering is optional on the sentry protocol — custom
+        # sentries (test recorders, canary probes) predate it
+        note_lag = getattr(self._sentry, "note_lag", None)
+        if note_lag is not None:
+            note_lag(lag)
+        if lg_np is not None:
+            self._sentry.observe_logits(lg_np)
+
+    def _harvest_due(self) -> bool:
+        """Must the deferred window be harvested BEFORE this step's
+        expiry/admission/dispatch? True when the window is full, when
+        host work needs committed token state (waiting admissions, a
+        running deadline that has passed), or when the NEXT dispatch
+        could overrun a request's token budget or the sequence cap —
+        the synchronous loop would have finalized the slot by now."""
+        if len(self._pending) >= self.harvest_every:
+            return True
+        if self._queue:
+            # admission needs free slots + host _tok; harvesting on a
+            # non-empty queue keeps admission timing aligned with the
+            # synchronous loop (pipelining pays off on settled batches)
+            return True
+        now = self._clock()
+        depth = len(self._pending)
+        for i, r in enumerate(self._slot_req):
+            if r is None:
+                continue
+            if r.deadline is not None and now >= r.deadline:
+                return True         # _expire must see committed tokens
+            if len(r.output) + depth >= r.max_new_tokens:
+                return True         # the window holds the final token
+            if int(self._pos[i]) >= self.S - 1:
+                return True         # sequence cap: slot must finalize
+        return False
+
+    def _harvest_pending(self, finished: List[Request]):
+        """Drain the deferred-harvest window: ONE batched D2H sync
+        over every pending dispatch, then per-dispatch (in dispatch
+        order) sentry checks and token commits — exactly the commits
+        the synchronous loop would have made, including EOS/budget/
+        cap finalization at the dispatch where it fired (later
+        in-window tokens for a finalized slot are DISCARDED: the
+        device over-ran the EOS it could not see, by construction at
+        most k-1 tokens)."""
+        entries, self._pending = self._pending, []
+        self._tok_dev = None
+        if not entries:
+            self._window_wall = 0.0
+            return
+        with telemetry.span("serving.harvest",
+                            window=len(entries)):
+            # pdt-lint: disable=PDT001 harvest_seconds is REAL wall,
+            # like decode_step_seconds (hardware-honesty throughput)
+            t0 = time.perf_counter()
+            stacked = np.asarray(jnp.stack([e["nxt"] for e in entries]))
+            # pdt-lint: disable=PDT001 same real-wall measurement
+            harvest_dt = time.perf_counter() - t0
+        if telemetry.enabled():
+            _M_HARVEST.observe(harvest_dt)
+        n = len(entries)
+        n_committed = 0
+        done_slots: set = set()
+        live_last: Dict[int, int] = {}
+        for j, e in enumerate(entries):
+            nxt = stacked[j]
+            if self._sentry is not None:
+                act = [i for i in e["act"] if i not in done_slots]
+                self._harvest_sentry(nxt,
+                                     e["lg"] if e["scan"] else None,
+                                     act, lag=n - 1 - j)
+            for i in e["act"]:
+                if i in done_slots:
+                    continue        # finalized earlier in this window
+                r = self._slot_req[i]
+                if r is None:
+                    continue
+                tok = int(nxt[i])
+                r.output.append(tok)
+                n_committed += 1
+                live_last[i] = tok
+                hit_eos = self.eos is not None and tok == self.eos
+                if hit_eos or len(r.output) >= r.max_new_tokens \
+                        or int(e["pos"][i]) >= self.S - 1:
+                    r.device_len = len(r.output)
+                    self._finalize(r, RequestStatus.FINISHED, None,
+                                   finished)
+                    self._release_slot(i)
+                    done_slots.add(i)
+                    live_last.pop(i, None)
+        for i, tok in live_last.items():
+            self._tok[i] = tok
+        for r in self._slot_req:
+            if r is not None:
+                r.device_len = len(r.output)    # staleness resync
+        if telemetry.enabled():
+            _M_DECODE_TOKENS.inc(n_committed)
+            wall = self._window_wall + harvest_dt
+            if wall > 0:
+                _M_TOKENS_PER_SEC.set(n_committed / wall)
+        self._window_wall = 0.0
+
+    def quiesce(self) -> int:
+        """Drain the pipelined-decode window NOW: harvest every
+        deferred dispatch so host-visible request state (`output`,
+        `_tok`, `_pos`) is committed and consistent. The quiesce seam
+        every state-export path crosses first — migration
+        (`export_pages`), eviction, page install, sentry attach, and
+        mid-decode preemption all call this before touching slot
+        state. A no-op (returns 0) when the window is empty, including
+        always at harvest_every=1. Finalizations land in the finished
+        backlog the next step() delivers."""
+        n = len(self._pending)
+        if n:
+            self._harvest_pending(self._finished_backlog)
+        return n
 
     # -- speculative decoding (spec_decode=SpecConfig, ISSUE 10) -------
     def _spec_decode(self, finished: List[Request]) -> bool:

@@ -463,11 +463,16 @@ class RouterJournal:
         self._state.pop(str(request_id), None)
 
     def step_mirror(self, mirrors: Dict[str, List[int]]) -> int:
-        """One batched progress record per router step: `mirrors` maps
-        request_id -> the FULL token stream mirrored so far; the
-        journal records only each stream's new suffix (token mirrors
-        are append-only by the router's fold-in contract). Returns the
-        number of requests with new tokens (0 = nothing appended)."""
+        """One batched progress record per router step — which on the
+        pipelined decode loop (engine `harvest_every=k`, ISSUE 18)
+        means one GROUP-COMMIT per harvest window: mirrors only move
+        at harvest ticks, every step in between diffs empty and
+        appends NOTHING, so the per-record encode/fsync cost amortizes
+        over the whole window's tokens. `mirrors` maps request_id ->
+        the FULL token stream mirrored so far; the journal records
+        only each stream's new suffix (token mirrors are append-only
+        by the router's fold-in contract). Returns the number of
+        requests with new tokens (0 = nothing appended)."""
         delta: Dict[str, List[int]] = {}
         for rid, tokens in mirrors.items():
             st = self._state.get(str(rid))

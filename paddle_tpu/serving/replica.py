@@ -264,6 +264,15 @@ class ReplicaHandle:
         info = self.engine.lifecycle_info()
         return info["waiting"] + info["running"]
 
+    def pending_harvest(self) -> int:
+        """Dispatches in the engine's deferred-harvest window that no
+        host state has seen yet (0 when dead or on the synchronous
+        harvest_every=1 loop) — the operator-visible depth of the
+        bounded-staleness window (ISSUE 18)."""
+        if self.engine is None:
+            return 0
+        return len(getattr(self.engine, "_pending", ()))
+
     def real_outstanding(self) -> int:
         """`outstanding()` minus an in-flight canary probe: the
         did-work ledger (restart-budget resets, busy-step accounting)

@@ -273,6 +273,14 @@ class FleetRequest:
     # canary. On quarantine the suffix past it is dropped and
     # re-generated on a healthy replica
     verified_len: int = 0
+    # bounded-staleness durability frontier (ISSUE 18): tokens
+    # [:durable_len] are journaled (group-commit at harvest ticks) —
+    # a router SIGKILL loses at most the suffix past it, and replay
+    # re-generates that suffix bit-identically. Monotone except at
+    # quarantine, which clamps it to verified_len with the taint
+    # rewind. Always <= len(tokens) <= device_len: the engine may be
+    # up to harvest_every-1 dispatches ahead of everything mirrored
+    durable_len: int = 0
     # router-clock request timeline: TTFT for SLO purposes is measured
     # HERE (first mirrored token minus submit), not on any one engine's
     # clock — an engine's arrival_time resets on every failover
@@ -293,6 +301,20 @@ class FleetRequest:
     @property
     def done(self) -> bool:
         return self.status in RequestStatus.TERMINAL
+
+    @property
+    def device_len(self) -> int:
+        """Tokens the serving engine has COMMITTED ON DEVICE for this
+        request — the top of the staleness contract
+        ``durable_len <= verified_len/len(tokens) <= device_len``.
+        On the pipelined loop (harvest_every>1) this runs up to k-1
+        ahead of ``tokens``; those tokens are discardable (a crash
+        mid-window re-generates them bit-identically from the
+        harvested prefix)."""
+        if self.engine_req is None:
+            return len(self.tokens)
+        return len(self.folded) + max(self.engine_req.device_len,
+                                      len(self.engine_req.output))
 
 
 class ServingRouter:
@@ -1212,6 +1234,7 @@ class ServingRouter:
         try:
             self.journal.append_terminal(rec.request_id, rec.status,
                                          rec.tokens, rec.error)
+            rec.durable_len = len(rec.tokens)
         except Exception as e:
             self._note_append_failure(e, where="router.terminal")
 
@@ -1226,6 +1249,13 @@ class ServingRouter:
             self.journal.step_mirror(
                 {rec.request_id: rec.tokens
                  for rec in self._live.values() if rec.tokens})
+            # the whole mirrored prefix is now journaled: advance each
+            # live request's durability frontier to it. On pipelined
+            # replicas mirrors only change at harvest ticks, so this
+            # is naturally one group-commit per window
+            for rec in self._live.values():
+                if rec.tokens:
+                    rec.durable_len = len(rec.tokens)
         except Exception as e:
             self._note_append_failure(e, where="router.step")
 
@@ -1494,6 +1524,12 @@ class ServingRouter:
                                 replica=h.index, dropped=dropped,
                                 kept=rec.verified_len)
                 rec.tokens = rec.tokens[:rec.verified_len]
+                # the taint rewind is the ONE sanctioned retreat of
+                # the durability frontier: journaled-but-tainted
+                # tokens are no longer durable once the rewind record
+                # supersedes them
+                rec.durable_len = min(rec.durable_len,
+                                      rec.verified_len)
                 if self.journal is not None:
                     # the journal mirrored the tainted suffix as
                     # progress records — it must forget it too, or a
@@ -1977,6 +2013,7 @@ class ServingRouter:
                                model=st.model, submit_time=now)
             rec.status = st.status
             rec.tokens = list(st.tokens)
+            rec.durable_len = len(rec.tokens)  # it CAME from the journal
             rec.error = st.error
             self.requests[st.request_id] = rec
             # the restored terminal re-enters the per-model ledger:
@@ -1997,6 +2034,7 @@ class ServingRouter:
                                priority=st.priority, model=st.model,
                                submit_time=now)
             rec.tokens = list(st.tokens)
+            rec.durable_len = len(rec.tokens)  # replayed = durable
             self.requests[st.request_id] = rec
             self._live[st.request_id] = rec
             if self.admission is not None:
@@ -2078,6 +2116,7 @@ class ServingRouter:
             "replicas": [
                 {"index": h.index, "role": h.role, "state": h.state,
                  "outstanding": h.outstanding(),
+                 "pending_harvest": h.pending_harvest(),
                  "consecutive_failures": h.consecutive_failures,
                  "restarts": h.restarts,
                  "migrations_in": h.migrations_in,

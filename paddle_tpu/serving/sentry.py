@@ -72,6 +72,13 @@ _M_TRIPS = telemetry.counter(
 _M_SCAN_SECONDS = telemetry.histogram(
     "pdt_sentry_scan_seconds",
     "Wall time of one every-Nth-step logit scan (host pull + checks).")
+_M_DETECTION_LAG = telemetry.histogram(
+    "pdt_sentry_detection_lag_steps",
+    "Decode steps between a dispatch and the harvest that sentry-"
+    "checked it — 0 on the synchronous loop, <= harvest_every-1 on "
+    "the pipelined one (the bounded-staleness detection window, "
+    "ISSUE 18).",
+    buckets=(0, 1, 2, 3, 4, 7, 8, 15, 16, 31, 32))
 _M_CANARY_RUNS = telemetry.counter(
     "pdt_sentry_canary_runs_total",
     "Canary probe completions, by result (pass | dirty | fail | "
@@ -236,6 +243,15 @@ class NumericSentry:
         D2H pull) — folded into `spent` so the bench's in-situ
         overhead number covers the WHOLE sentry cost."""
         self.spent += seconds
+
+    def note_lag(self, steps: int) -> None:
+        """Book the detection lag of one dispatch: how many decode
+        steps elapsed between that dispatch and the harvest that ran
+        its sentry checks. 0 on the synchronous loop; bounded at
+        ``harvest_every - 1`` on the pipelined one. Pure metering —
+        no `spent` charge (it is not sentry WORK, it is staleness)."""
+        if telemetry.enabled():
+            _M_DETECTION_LAG.observe(int(steps))
 
     # -- internals ----------------------------------------------------
     def _trip(self, kind: str, detail: str):

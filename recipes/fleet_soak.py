@@ -111,6 +111,13 @@ def main(argv=None):
                         " + int8 KV pages, QuantServingConfig) — the "
                         "soak grades the same objectives against the "
                         "half-width-page engine")
+    p.add_argument("--harvest-every", type=int, default=1,
+                   help="pipelined decode: every engine defers its "
+                        "D2H token harvest to one batched pull per K "
+                        "steps (docs/serving.md 'Pipelined decode'); "
+                        "1 = the synchronous loop. The soak grades "
+                        "the SAME objectives — chaos, recovery, and "
+                        "SLOs must hold at any window size")
     args = p.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -187,7 +194,8 @@ def main(argv=None):
             return ContinuousBatchingEngine(
                 model, max_batch_size=args.slots, page_size=page,
                 max_seq_len=prompt_max + page + out_max + 2 * page,
-                clock=clock, quant=quant_cfg)
+                clock=clock, quant=quant_cfg,
+                harvest_every=args.harvest_every)
 
         kw = dict(
             num_replicas=args.replicas, policy="least_outstanding",
@@ -214,6 +222,10 @@ def main(argv=None):
     if args.quant:
         print("mode: QUANTIZED fleet (weights=int8, kv=int8 — "
               "half-width KV pages, fused dequant matmuls)")
+    if args.harvest_every > 1:
+        print(f"mode: PIPELINED decode (harvest_every="
+              f"{args.harvest_every} — one batched D2H harvest per "
+              f"window, bounded-staleness durability)")
 
     # -- phase 1: capacity ---------------------------------------------
     if args.qps > 0:

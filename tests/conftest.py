@@ -68,7 +68,7 @@ _TELEMETRY_FILES = ("test_serving.py", "test_chaos.py",
                     "test_loadgen.py", "test_tp_serving.py",
                     "test_journal.py", "test_sentry.py",
                     "test_quant_serving.py", "test_autoscaler.py",
-                    "test_multimodel.py")
+                    "test_multimodel.py", "test_async_pipeline.py")
 
 # failing fleet-drill tests additionally attach a Chrome-trace export
 # of the telemetry ring: the failover timeline that produced the
@@ -127,7 +127,7 @@ def _serving_invariant_checks(request, monkeypatch):
             "test_loadgen.py", "test_tp_serving.py",
             "test_journal.py", "test_sentry.py",
             "test_quant_serving.py", "test_autoscaler.py",
-            "test_multimodel.py"):
+            "test_multimodel.py", "test_async_pipeline.py"):
         monkeypatch.setenv("PDT_CHECK_INVARIANTS", "1")
     yield
 

@@ -26,6 +26,7 @@ from paddle_tpu.analysis.checkers import (CatalogDriftChecker,
                                           DurableWriteChecker,
                                           FaultCoverageChecker,
                                           FaultSiteDriftChecker,
+                                          HarvestSeamChecker,
                                           InjectableClockChecker,
                                           ModelKeyChecker,
                                           PinPairingChecker,
@@ -600,6 +601,83 @@ class TestModelKey:
         assert res.new == []
 
 
+# -- PDT011 harvest-seam ------------------------------------------------
+class TestHarvestSeam:
+    def test_host_sync_in_decode_path_flagged(self, tmp_path):
+        res = run_one(tmp_path, HarvestSeamChecker(), {
+            "paddle_tpu/models/serving.py": """\
+                import numpy as np
+                import jax
+
+                def _decode(self, finished):
+                    nxt = self._decode_jit(self._tok)
+                    toks = np.asarray(nxt)            # finding: D2H
+                    return toks
+
+                def step(self):
+                    v = jax.device_get(self._flags)   # finding
+                    s = self._count.item()            # finding
+                    return v, s
+            """})
+        assert [(f.code, f.detail) for f in res.new] == [
+            ("PDT011", "_decode:numpy.asarray"),
+            ("PDT011", "step:jax.device_get"),
+            ("PDT011", "step:.item()")]
+
+    def test_seam_functions_and_uploads_pass(self, tmp_path):
+        res = run_one(tmp_path, HarvestSeamChecker(), {
+            "paddle_tpu/models/serving.py": """\
+                import numpy as np
+                import jax.numpy as jnp
+
+                def _harvest_pending(self, finished):
+                    stacked = np.asarray(self._ring)  # seam: legal
+
+                def quiesce(self):
+                    return np.asarray(self._ring)     # seam: legal
+
+                def _decode(self, finished):
+                    tok_in = jnp.asarray(self._tok)   # H2D: legal
+                    nxt = self._decode_jit(tok_in)
+                    i = int(self._tok[0])             # Subscript: legal
+                    return nxt, i
+            """})
+        assert res.new == []
+
+    def test_nested_seam_def_inherits_exemption(self, tmp_path):
+        res = run_one(tmp_path, HarvestSeamChecker(), {
+            "paddle_tpu/serving/router.py": """\
+                import numpy as np
+
+                def step(self):
+                    def _harvest_local(h):
+                        return np.asarray(h.nxt)      # nested seam: ok
+                    out = _harvest_local(self._h)
+                    bad = np.asarray(self._dev)       # finding
+                    return out, bad
+            """})
+        assert [(f.code, f.detail) for f in res.new] == [
+            ("PDT011", "step:numpy.asarray")]
+
+    def test_scope_is_the_two_hot_loop_files(self, tmp_path):
+        res = run_one(tmp_path, HarvestSeamChecker(), {
+            # same sync, not a hot-loop file: not this rule's scope
+            "paddle_tpu/serving/journal.py": """\
+                import numpy as np
+
+                def step(self):
+                    return np.asarray(self._dev)
+            """,
+            # hot-loop file, but not a decode-path function
+            "paddle_tpu/models/serving.py": """\
+                import numpy as np
+
+                def export_pages(self, rid):
+                    return np.asarray(self._kv)
+            """})
+        assert res.new == []
+
+
 # -- suppressions -------------------------------------------------------
 class TestSuppressions:
     FILES = {
@@ -946,7 +1024,7 @@ class TestRepoGate:
         assert sorted(by_code()) == ["PDT001", "PDT002", "PDT003",
                                      "PDT004", "PDT005", "PDT006",
                                      "PDT007", "PDT008", "PDT009",
-                                     "PDT010"]
+                                     "PDT010", "PDT011"]
         assert len(default_checkers(["PDT003", "PDT004"])) == 2
         with pytest.raises(ValueError):
             default_checkers(["PDT777"])
