@@ -216,6 +216,59 @@ def _hist_diff(cur, warm):
     }
 
 
+def _compile_delta(snap, warm_snap=None):
+    """Per-family `pdt_jit_compiles_total` delta across a timed window
+    (ISSUE 20). `warm_snap=None` means the registry was reset at the
+    window boundary, so the final counters ARE the delta. Families
+    with a zero delta are dropped."""
+    cur = snap.get("counters", {}).get("pdt_jit_compiles_total", {})
+    warm = (warm_snap or {}).get("counters", {}).get(
+        "pdt_jit_compiles_total", {})
+    out = {}
+    for labels, v in cur.items():
+        fam = labels.split('"')[1] if '"' in labels else labels
+        d = int(v - warm.get(labels, 0.0))
+        if d:
+            out[fam] = d
+    return out
+
+
+def _assert_steady_state(where, snap, warm_snap=None):
+    """The warm-window contract, finally VERIFIED instead of assumed
+    (ISSUE 20): a timed block whose numbers feed REGRESSION_METRICS
+    must contain zero jit compiles — one recompile inside the window
+    swamps the measurement and grades the wrong thing. A trip means
+    the warm phase is too short or a program key is churning
+    (the retrace-storm failure mode)."""
+    delta = _compile_delta(snap, warm_snap)
+    assert not delta, (
+        f"{where}: {sum(delta.values())} jit compile(s) inside the "
+        f"timed window ({delta}) — warm-up did not reach steady state")
+
+
+def _profile_detail(snap, warm_snap, gaps=None):
+    """`detail.profile`: decode-round decomposition medians over the
+    timed window (warm-phase buckets diffed out) + the top-3 dispatch
+    gaps from a sampled round, straight off `pdt_profile_*`."""
+    comp = {}
+    cur = snap.get("histograms", {}).get(
+        "pdt_profile_round_seconds", {})
+    warm = (warm_snap or {}).get("histograms", {}).get(
+        "pdt_profile_round_seconds", {})
+    for labels, series in cur.items():
+        name = labels.split('"')[1] if '"' in labels else labels
+        q = _hist_quantiles(_hist_diff(series, warm.get(labels)),
+                            qs=(0.5,))
+        if q:
+            comp[name] = q["p50"]
+    out = {"component_median_s": comp}
+    if gaps:
+        out["top_gaps"] = [
+            {"op_pair": g["op_pair"], "gap_s": round(g["gap_s"], 6)}
+            for g in gaps[:3]]
+    return out
+
+
 # dotted paths into the bench JSON that gate regressions (tokens/sec
 # family: higher is better)
 REGRESSION_METRICS = (
@@ -350,6 +403,11 @@ def bench_decode(model, cfg, on_tpu: bool) -> dict:
             eng.step()
         dt = time.perf_counter() - t0
         snap = telemetry.snapshot()
+        # ISSUE 20: the steady-state claim is now checked, not assumed
+        _assert_steady_state("bench_decode", snap, warm_snap)
+        # dispatch-gap sample of one round (observation only: streams
+        # and PRNG state are untouched — see profile_round docstring)
+        gaps = eng.profile_round()
     finally:
         telemetry.disable(clear_override=True)
         model.train()
@@ -371,6 +429,9 @@ def bench_decode(model, cfg, on_tpu: bool) -> dict:
         "decode_batch_slots": slots,
         "decode_step_ms": round(dt / steps * 1e3, 3),
         "attention_impl": eng.attn_impl,
+        # ISSUE 20: where the decode round's wall actually goes (the
+        # fusion ladder's shopping list rides the bench JSON)
+        "profile": _profile_detail(snap, warm_snap, gaps),
         "engine_telemetry": {
             "ttft_cold_avg_s": round(ttft["sum"] / ttft["count"], 4)
             if ttft.get("count") else None,
@@ -626,7 +687,15 @@ def bench_speculative(model, cfg, on_tpu: bool) -> dict:
                 if toks / dt > best[0] / best[1]:
                     best = (toks, dt)
             toks, dt = best
-            hists = telemetry.snapshot()["histograms"]
+            snap = telemetry.snapshot()
+            # ISSUE 20: the two warm passes must have minted every
+            # admission/verify shape — a compile inside a timed pass
+            # is exactly what would swamp the A/B
+            _assert_steady_state(
+                "bench_speculative"
+                + ("[plain]" if spec is None else f"[k{spec.k}]"),
+                snap)
+            hists = snap["histograms"]
         finally:
             telemetry.disable(clear_override=True)
         stats = {"tokens_per_sec": round(toks / dt, 1)}
@@ -705,14 +774,26 @@ def bench_tp(on_tpu: bool) -> dict:
         # program (jit caches are per-engine), the timed pass then
         # measures steady-state admission + decode walls
         eng = engine(sm)
-        for phase in ("warm", "timed"):
-            rids = [eng.add_request(p, new_toks) for p in jobs]
-            t0 = time.perf_counter()
-            eng.step()                       # the admission dispatch
-            prefill_dt = time.perf_counter() - t0
-            t1 = time.perf_counter()
-            out = eng.run()
-            decode_dt = time.perf_counter() - t1
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            warm_snap = None
+            for phase in ("warm", "timed"):
+                if phase == "timed":
+                    warm_snap = telemetry.snapshot()
+                rids = [eng.add_request(p, new_toks) for p in jobs]
+                t0 = time.perf_counter()
+                eng.step()                   # the admission dispatch
+                prefill_dt = time.perf_counter() - t0
+                t1 = time.perf_counter()
+                out = eng.run()
+                decode_dt = time.perf_counter() - t1
+            # ISSUE 20: the warm pass really did compile every program
+            _assert_steady_state(
+                f"bench_tp[tp{1 if sm is None else getattr(sm, 'tp', '?')}]",
+                telemetry.snapshot(), warm_snap)
+        finally:
+            telemetry.disable(clear_override=True)
         toks = sum(len(out[r]) for r in rids)
         return {
             "decode_tokens_per_sec": round(
@@ -995,6 +1076,7 @@ def bench_multimodel(model, cfg, on_tpu: bool) -> dict:
     `multimodel_decode_tokens_per_sec` (the mixed row) is wired into
     REGRESSION_METRICS."""
     import numpy as np
+    import paddle_tpu.observability as telemetry
     from paddle_tpu.models.serving import ContinuousBatchingEngine
     from paddle_tpu.serving import FleetModelStore, split_model_id
 
@@ -1033,7 +1115,7 @@ def bench_multimodel(model, cfg, on_tpu: bool) -> dict:
             store.ensure(tag, eng, mid)
         return eng
 
-    def run(eng, model_ids):
+    def run(tag, eng, model_ids):
         # per-engine request_ids collide across arms, so key the
         # harvested streams by (model, prompt index) instead
         key = {}
@@ -1045,10 +1127,20 @@ def bench_multimodel(model, cfg, on_tpu: bool) -> dict:
                 key[str(rid)] = (mid, j)
         for _ in range(warm):
             eng.step()
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            eng.step()
-        dt = time.perf_counter() - t0
+        # ISSUE 20: telemetry goes on at the window boundary — warm-
+        # minted programs flipped their first-call flag already, so
+        # only an in-window compile can trip the steady-state gate
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                eng.step()
+            dt = time.perf_counter() - t0
+            _assert_steady_state(f"bench_multimodel[{tag}]",
+                                 telemetry.snapshot())
+        finally:
+            telemetry.disable(clear_override=True)
         streams = {}
         for r in eng._slot_req:
             if r is not None:
@@ -1057,12 +1149,13 @@ def bench_multimodel(model, cfg, on_tpu: bool) -> dict:
 
     # mixed: all three models share every decode step's one ragged
     # dispatch
-    mixed_dt, mixed_streams = run(build("mixed"), mids)
+    mixed_dt, mixed_streams = run("mixed", build("mixed"), mids)
     mixed_tps = 3 * per * steps / mixed_dt
     # adapter-serial: one model's requests at a time, fresh engine each
     serial_dt, serial_streams = 0.0, {}
     for mid in mids:
-        dt, streams = run(build(f"serial-{mid}"), [mid])
+        dt, streams = run(f"serial-{mid}", build(f"serial-{mid}"),
+                          [mid])
         serial_dt += dt
         serial_streams.update(streams)
     serial_tps = 3 * per * steps / serial_dt
@@ -1272,6 +1365,7 @@ def bench_quant(model, cfg, on_tpu: bool) -> dict:
     being comparable). Returns a detail sub-dict;
     `quant_decode_tokens_per_sec` is gated by REGRESSION_METRICS."""
     import numpy as np
+    import paddle_tpu.observability as telemetry
     from paddle_tpu.models.serving import (ContinuousBatchingEngine,
                                            QuantServingConfig)
     from paddle_tpu.serving.transfer import payload_nbytes
@@ -1324,10 +1418,19 @@ def bench_quant(model, cfg, on_tpu: bool) -> dict:
             eng.add_request(list(p), max_new_tokens=max_seq - p_len - 1)
         for _ in range(warm):
             eng.step()
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            eng.step()
-        dt = time.perf_counter() - t0
+        # ISSUE 20: verified-compile-free timed window (see
+        # bench_multimodel's run() for the boundary semantics)
+        telemetry.enable()
+        telemetry.reset()
+        try:
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                eng.step()
+            dt = time.perf_counter() - t0
+            _assert_steady_state(f"bench_quant[{name}]",
+                                 telemetry.snapshot())
+        finally:
+            telemetry.disable(clear_override=True)
         toks_per_sec[name] = round(slots * steps / dt, 1)
         recorders[name] = rec
         streams[name] = [list(r.output) for r in eng._slot_req
@@ -1496,6 +1599,7 @@ def bench_journal(model, cfg, on_tpu: bool) -> dict:
             jrs[mode] = _TimedJournal(jr)
         for _ in range(warm):
             router.step()
+        warm_snap = telemetry.snapshot()  # ISSUE 20 steady-state gate
         cycle = (None, "off", "terminal")
         block = max(4, steps // 10)
         step_times = {m: [] for m in cycle + ("step",)}
@@ -1518,6 +1622,8 @@ def bench_journal(model, cfg, on_tpu: bool) -> dict:
             router.step()
             step_times["step"].append(time.perf_counter() - t0)
             journal_times["step"].append(jrs["step"].spent)
+        _assert_steady_state("bench_journal", telemetry.snapshot(),
+                             warm_snap)
         router.journal = None
         for tj in jrs.values():
             if tj is not None:
@@ -1662,6 +1768,7 @@ def bench_sentry(model, cfg, on_tpu: bool) -> dict:
                 router.submit(p, max_new_tokens=max_seq - p_len - 1)
             for _ in range(warm):
                 router.step()
+            warm_snap = telemetry.snapshot()  # ISSUE 20
             h = router.replicas[0]
             st, sp = [], []
             for _ in range(steps):
@@ -1672,6 +1779,8 @@ def bench_sentry(model, cfg, on_tpu: bool) -> dict:
                 st.append(time.perf_counter() - t0)
                 if h.sentry is not None:
                     sp.append(h.sentry.spent)
+            _assert_steady_state(f"bench_sentry[{mode}]",
+                                 telemetry.snapshot(), warm_snap)
             step_med[mode] = sorted(st)[len(st) // 2]
             spent_med[mode] = (sorted(sp)[len(sp) // 2] if sp else 0.0)
         bare = step_med["off"]
@@ -1798,12 +1907,15 @@ def bench_async_pipeline(model, cfg, on_tpu: bool) -> dict:
             h.engine.quiesce()           # every mode starts at a
             jr.spent = 0.0               # window boundary
             h.sentry.spent = 0.0
+            warm_snap = telemetry.snapshot()  # ISSUE 20
             tok0 = telemetry.value("pdt_serving_decode_tokens_total")
             t0 = time.perf_counter()
             for _ in range(steps):
                 router.step()
             h.engine.quiesce()           # commit the tail window into
             wall = time.perf_counter() - t0   # the measured span
+            _assert_steady_state(f"bench_async_pipeline[k{k}]",
+                                 telemetry.snapshot(), warm_snap)
             committed = telemetry.value(
                 "pdt_serving_decode_tokens_total") - tok0
             stack = jr.spent + h.sentry.spent

@@ -8,6 +8,7 @@ from typing import Dict, List, Optional, Sequence
 from ..core import Checker
 from .catalog import CatalogDriftChecker
 from .clocks import InjectableClockChecker
+from .compileseam import CompileSeamChecker
 from .coverage import FaultCoverageChecker
 from .durablewrites import DurableWriteChecker
 from .faultsites import FaultSiteDriftChecker
@@ -19,7 +20,8 @@ from .supervision import SwallowedErrorChecker
 from .tracedsync import TracedHostSyncChecker
 
 __all__ = ["ALL_CHECKER_CLASSES", "default_checkers", "by_code",
-           "CatalogDriftChecker", "InjectableClockChecker",
+           "CatalogDriftChecker", "CompileSeamChecker",
+           "InjectableClockChecker",
            "DurableWriteChecker", "FaultCoverageChecker",
            "FaultSiteDriftChecker", "HarvestSeamChecker",
            "ModelKeyChecker", "PinPairingChecker",
@@ -38,6 +40,7 @@ ALL_CHECKER_CLASSES = (
     ResizeIntentChecker,         # PDT009
     ModelKeyChecker,             # PDT010
     HarvestSeamChecker,          # PDT011
+    CompileSeamChecker,          # PDT012
 )
 
 

@@ -1,0 +1,158 @@
+"""PDT012 — compile-seam discipline in the serving engine.
+
+Repo law (ISSUE 20, the performance attribution plane): every jitted
+program the engine creates must flow through the ONE metered seam —
+``_jit_lru`` for keyed caches, ``_jit_singleton`` for one-off
+programs — because that seam is where compile observability lives:
+``pdt_jit_compiles_total{family}``, the compile-seconds histogram, the
+``jit.compile`` span, cache entry/eviction gauges, and the
+retrace-storm detector. A ``jax.jit`` (or ``pallas_call``) result
+stashed on ``self`` directly, or a hand-rolled ``self._foo_jits[key] =
+...`` store, is a compile the profiler never sees — the warm-window
+zero-compile assertion in bench.py and the retrace-storm alarm both go
+blind to it.
+
+Three shapes are flagged, all scoped to the engine file:
+
+* a ``jax.jit(...)`` / ``pallas_call(...)`` call outside a ``_build*``
+  builder method (builders RETURN the jitted program; the seam calls
+  them and meters the result — jitting anywhere else bypasses it);
+* a subscript store into a ``*_jits`` cache outside ``_jit_lru``
+  (keyed caches are the seam's property);
+* an assignment to a ``self.*_jit`` attribute whose RHS is neither
+  ``self._jit_singleton(...)`` nor ``None`` (the reset idiom).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, Tuple
+
+from .._astutil import call_name, import_aliases
+from ..core import Checker, Finding, Project
+
+__all__ = ["CompileSeamChecker"]
+
+
+class CompileSeamChecker(Checker):
+    code = "PDT012"
+    name = "compile-seam"
+    rationale = ("every engine jit must flow through the metered "
+                 "_jit_lru/_jit_singleton seam so compile counters, "
+                 "the jit.compile span, and the retrace-storm detector "
+                 "see it (ISSUE 20 compile observability)")
+
+    # the engine file: the only place the repo creates decode/prefill
+    # programs. models/llama.py holds pure module code (no jit), and
+    # generate()-style scripts outside the engine are not under the
+    # warm-window zero-compile contract
+    DEFAULT_SCOPE = ("paddle_tpu/models/serving.py",)
+    # builder methods whose RETURN VALUE is the jitted program — the
+    # seam calls these and meters the result
+    BUILDER_PREFIX = "_build"
+    # the seam itself
+    SEAM_FUNCS = ("_jit_lru", "_jit_singleton")
+
+    def __init__(self, scope: Tuple[str, ...] = DEFAULT_SCOPE):
+        self.scope = scope
+
+    def _functions(self, tree: ast.AST
+                   ) -> Iterator[ast.FunctionDef]:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                yield node
+
+    @staticmethod
+    def _innermost(tree: ast.AST, node: ast.AST) -> str:
+        """Name of the innermost enclosing function of `node` (by
+        line span — fixtures and the engine file never overlap defs on
+        one line), or ``<module>``."""
+        best, best_span = "<module>", None
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            end = fn.end_lineno or fn.lineno
+            if fn.lineno <= node.lineno <= end:
+                span = end - fn.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = fn.name, span
+        return best
+
+    def _is_jit_call(self, call: ast.Call, aliases) -> str:
+        name = call_name(call, aliases)
+        if name == "jax.jit" or (name is not None
+                                 and name.endswith(".jit")
+                                 and name.split(".")[0] == "jax"):
+            return "jax.jit"
+        if name is not None and (name == "pallas_call"
+                                 or name.endswith(".pallas_call")):
+            return "pallas_call"
+        return ""
+
+    @staticmethod
+    def _is_seam_rhs(value: ast.AST) -> bool:
+        """``self._jit_singleton(...)`` or ``None`` — the two legal
+        right-hand sides for a ``self.*_jit`` slot."""
+        if isinstance(value, ast.Constant) and value.value is None:
+            return True
+        if isinstance(value, ast.Call) \
+                and isinstance(value.func, ast.Attribute) \
+                and value.func.attr == "_jit_singleton":
+            return True
+        return False
+
+    def check(self, project: Project) -> Iterable[Finding]:
+        for sf in project.match(self.scope):
+            if sf.tree is None:
+                continue
+            aliases = import_aliases(sf.tree)
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call):
+                    what = self._is_jit_call(node, aliases)
+                    if not what:
+                        continue
+                    fn = self._innermost(sf.tree, node)
+                    if fn.startswith(self.BUILDER_PREFIX) \
+                            or fn in self.SEAM_FUNCS:
+                        continue
+                    yield self.finding(
+                        sf, node,
+                        f"{what} in `{fn}` — compiles outside the "
+                        f"metered seam; return the program from a "
+                        f"_build* method and route it through "
+                        f"_jit_lru/_jit_singleton",
+                        detail=f"{fn}:{what}", project=project)
+                elif isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Subscript) \
+                                and isinstance(tgt.value,
+                                               ast.Attribute) \
+                                and tgt.value.attr.endswith("_jits"):
+                            fn = self._innermost(sf.tree, node)
+                            if fn in self.SEAM_FUNCS:
+                                continue
+                            yield self.finding(
+                                sf, node,
+                                f"direct store into "
+                                f"`{tgt.value.attr}` in `{fn}` — "
+                                f"keyed jit caches are _jit_lru's "
+                                f"property (evictions and entry "
+                                f"counts are metered there)",
+                                detail=f"{fn}:{tgt.value.attr}[]",
+                                project=project)
+                        elif isinstance(tgt, ast.Attribute) \
+                                and tgt.attr.endswith("_jit") \
+                                and not self._is_seam_rhs(node.value):
+                            fn = self._innermost(sf.tree, node)
+                            if fn in self.SEAM_FUNCS:
+                                continue
+                            yield self.finding(
+                                sf, node,
+                                f"`{tgt.attr}` assigned in `{fn}` "
+                                f"from something other than "
+                                f"self._jit_singleton(...) or None — "
+                                f"the compile is invisible to "
+                                f"pdt_jit_compiles_total",
+                                detail=f"{fn}:{tgt.attr}",
+                                project=project)

@@ -22,6 +22,7 @@ from paddle_tpu import nn
 from paddle_tpu.nn import functional as F
 from paddle_tpu.core.tensor import Tensor
 from paddle_tpu.models.generation import GenerationMixin
+from paddle_tpu.observability import profile as _pf
 
 
 @dataclass
@@ -489,12 +490,18 @@ class LlamaAttention(nn.Layer):
         seq = view.token_seq
         bt = view.block_tables
 
+        # profile.fence: op-family boundaries for the dispatch-gap
+        # sampler (engine.profile_round) — inert single None-check and
+        # identity unless a sampler is armed around an EAGER pass
+        q, k, v = _pf.fence("qkv", (q, k, v))
+
         def fn_rope(x, c, s_):
             cv = c[pos].astype(jnp.float32)[None, :, None, :]
             sv = s_[pos].astype(jnp.float32)[None, :, None, :]
             return rope_rotate_values(x, cv, sv)
         q = _apply("rope_ragged", fn_rope, (q, cos, sin))
         k = _apply("rope_ragged", fn_rope, (k, cos, sin))
+        q, k = _pf.fence("rope", (q, k))
 
         win = self.sliding_window
         quantized = view.k_scale is not None
@@ -511,6 +518,7 @@ class LlamaAttention(nn.Layer):
                 "ragged_kv_scatter_q", fn_scatter_q,
                 (view.k_pages, view.v_pages, view.k_scale,
                  view.v_scale, k, v), multi_output=True)
+            kp_new, vp_new = _pf.fence("kv_scatter", (kp_new, vp_new))
 
             def fn_attn_q(qq, kp, vp, ks, vs):
                 return ragged_paged_attention_values(
@@ -521,6 +529,7 @@ class LlamaAttention(nn.Layer):
                     k_scale=ks, v_scale=vs)[None]
             out = _apply("ragged_paged_attention", fn_attn_q,
                          (q, kp_new, vp_new, ks_new, vs_new))
+            out = _pf.fence("attention", out)
         else:
             def fn_scatter(kp, vp, kk, vv):
                 return ragged_scatter_values(kp, vp, kk[0], vv[0], bt,
@@ -528,6 +537,7 @@ class LlamaAttention(nn.Layer):
             kp_new, vp_new = _apply(
                 "ragged_kv_scatter", fn_scatter,
                 (view.k_pages, view.v_pages, k, v), multi_output=True)
+            kp_new, vp_new = _pf.fence("kv_scatter", (kp_new, vp_new))
             ks_new = vs_new = None
 
             def fn_attn(qq, kp, vp):
@@ -538,9 +548,11 @@ class LlamaAttention(nn.Layer):
                     pages_bound=view.pages_bound, tp=view.tp)[None]
             out = _apply("ragged_paged_attention", fn_attn,
                          (q, kp_new, vp_new))
+            out = _pf.fence("attention", out)
         # TP serving: each device computed ITS heads; gather them
         # before the o_proj row matmul (exact-mode fence)
         out = self.o_proj(_tp_repl(out.reshape([1, s, -1])))
+        out = _pf.fence("oproj", out)
         if use_cache:
             return out, RaggedKVCacheView(
                 kp_new, vp_new, bt, seq, pos, view.query_start,
@@ -578,16 +590,18 @@ class LlamaDecoderLayer(nn.Layer):
 
     def forward(self, x, cos, sin, attention_mask=None,
                 past_key_value=None, position_offset=0, use_cache=False):
-        attn = self.self_attn(self.input_layernorm(x), cos, sin,
-                              attention_mask,
-                              past_key_value=past_key_value,
-                              position_offset=position_offset,
-                              use_cache=use_cache)
+        attn = self.self_attn(
+            _pf.fence("rmsnorm", self.input_layernorm(x)), cos, sin,
+            attention_mask,
+            past_key_value=past_key_value,
+            position_offset=position_offset,
+            use_cache=use_cache)
         new_kv = None
         if use_cache and past_key_value is not None:
             attn, new_kv = attn
         x = x + attn
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        x = _pf.fence("mlp",
+                      x + self.mlp(self.post_attention_layernorm(x)))
         if use_cache and past_key_value is not None:
             return x, new_kv
         return x
@@ -609,7 +623,7 @@ class LlamaModel(nn.Layer):
 
     def forward(self, input_ids, attention_mask=None,
                 past_key_values=None, position_offset=0, use_cache=False):
-        x = self.embed_tokens(input_ids)
+        x = _pf.fence("embed", self.embed_tokens(input_ids))
         if past_key_values is not None:
             new_caches = []
             for layer, kv in zip(self.layers, past_key_values):

@@ -86,6 +86,7 @@ from ..autograd import no_grad
 from ..utils.faults import (FaultError, fault_point, fault_value,
                             value_armed)
 from .. import observability as telemetry
+from ..observability import profile as _profile
 from .generation import RequestStatus
 
 __all__ = ["ContinuousBatchingEngine", "Request", "RequestStatus",
@@ -778,6 +779,7 @@ class ContinuousBatchingEngine:
         self._pending: List[dict] = []
         self._tok_dev = None
         self._window_wall = 0.0             # dispatch walls this window
+        self._profile_raw = None            # profile_round's eager step
         # gray-failure defense (ISSUE 14, serving/sentry.py): an
         # attached numeric sentry observes every token harvest (and,
         # every Nth step, the ragged decode program's sampled-row
@@ -1410,13 +1412,22 @@ class ContinuousBatchingEngine:
         token state exactly like the synchronous loop would."""
         finished = self._finished_backlog
         self._finished_backlog = []
+        prof = telemetry.enabled()
         try:
             if self._pending and self._harvest_due():
                 self._harvest_pending(finished)
+            # pdt-lint: disable=PDT001 decode-round decomposition is
+            # REAL wall (profile.py reconciles the components against
+            # the measured round wall) — a fake clock would fabricate
+            # the dispatch-gap attribution
+            p0 = time.perf_counter() if prof else 0.0
             finished += self._expire()
             finished += self._admit()
             active = [i for i, r in enumerate(self._slot_req)
                       if r is not None]
+            if prof:
+                # pdt-lint: disable=PDT001 same real-wall measurement
+                _profile.note_round("host", time.perf_counter() - p0)
             if active:
                 try:
                     # _decode appends starvation-guard finalizations
@@ -1442,6 +1453,8 @@ class ContinuousBatchingEngine:
                     self._update_telemetry_gauges()
                     return finished
                 self._consec_decode_faults = 0
+                # pdt-lint: disable=PDT001 same real-wall decomposition
+                c0 = time.perf_counter() if prof else 0.0
                 for i in (() if handled else active):
                     r = self._slot_req[i]
                     if r is None:
@@ -1454,15 +1467,24 @@ class ContinuousBatchingEngine:
                         self._finalize(r, RequestStatus.FINISHED, None,
                                        finished)
                         self._release_slot(i)
+                if prof and not handled:
+                    # pdt-lint: disable=PDT001 same real-wall measure
+                    hv = time.perf_counter() - c0
+                    _profile.note_round("harvest", hv)
         except BaseException:
             # ANY escaping error: requests already finalized this step
             # must not be lost in the raise — the next step() (if the
             # caller keeps going) delivers them
             self._finished_backlog = finished
             raise
+        # pdt-lint: disable=PDT001 same real-wall decomposition
+        p1 = time.perf_counter() if prof else 0.0
         if self._invariants_enabled():
             self.check_invariants()
         self._update_telemetry_gauges()
+        if prof:
+            # pdt-lint: disable=PDT001 same real-wall measurement
+            _profile.note_round("host", time.perf_counter() - p1)
         return finished
 
     def _update_telemetry_gauges(self):
@@ -1935,27 +1957,8 @@ class ContinuousBatchingEngine:
         move verbatim, never re-quantized, which is what keeps
         migrated streams bit-identical."""
         n = len(page_ids)
-        quant = bool(self._qkv)
-        jit = self._install_jits.get(n)
-        if jit is None:
-            def _ins(kv, ids_, rows_, srows_):
-                if quant:
-                    return [
-                        (kp.at[:, ids_].set(rk.astype(kp.dtype)),
-                         vp.at[:, ids_].set(rv.astype(vp.dtype)),
-                         ks.at[ids_].set(sk.astype(ks.dtype)),
-                         vs.at[ids_].set(sv.astype(vs.dtype)))
-                        for (kp, vp, ks, vs), (rk, rv), (sk, sv)
-                        in zip(kv, rows_, srows_)]
-                return [(kp.at[:, ids_].set(rk.astype(kp.dtype)),
-                         vp.at[:, ids_].set(rv.astype(vp.dtype)))
-                        for (kp, vp), (rk, rv) in zip(kv, rows_)]
-            jit = jax.jit(_ins, donate_argnums=(0,))
-            self._install_jits[n] = jit
-            while len(self._install_jits) > self._max_prefill:
-                self._install_jits.popitem(last=False)      # LRU
-        else:
-            self._install_jits.move_to_end(n)
+        jit = self._jit_lru(self._install_jits, n,
+                            self._build_install, family="install")
         if self._tp is not None:
             # place the incoming rows with the pools' head sharding so
             # each device receives only ITS fragment of the transfer
@@ -1978,6 +1981,23 @@ class ContinuousBatchingEngine:
             self._kv = jit(self._kv,
                            jnp.asarray(np.asarray(page_ids, np.int32)),
                            rows_dev, srows_dev)
+
+    def _build_install(self):
+        quant = bool(self._qkv)
+
+        def _ins(kv, ids_, rows_, srows_):
+            if quant:
+                return [
+                    (kp.at[:, ids_].set(rk.astype(kp.dtype)),
+                     vp.at[:, ids_].set(rv.astype(vp.dtype)),
+                     ks.at[ids_].set(sk.astype(ks.dtype)),
+                     vs.at[ids_].set(sv.astype(vs.dtype)))
+                    for (kp, vp, ks, vs), (rk, rv), (sk, sv)
+                    in zip(kv, rows_, srows_)]
+            return [(kp.at[:, ids_].set(rk.astype(kp.dtype)),
+                     vp.at[:, ids_].set(rv.astype(vp.dtype)))
+                    for (kp, vp), (rk, rv) in zip(kv, rows_)]
+        return jax.jit(_ins, donate_argnums=(0,))
 
     def _expire(self) -> List[Request]:
         """Monotonic-clock tick: finalize queued/running requests whose
@@ -2330,16 +2350,10 @@ class ContinuousBatchingEngine:
         return min(int(-(-n // self.pad) * self.pad), self.S)
 
     def _get_prefill(self, bucket: int):
-        jit = self._prefill_jits.get(bucket)
-        if jit is None:
-            jit = self._build_prefill(bucket)
-            self._prefill_jits[bucket] = jit
-            while len(self._prefill_jits) > self._max_prefill:
-                self._prefill_jits.popitem(last=False)      # LRU
-                # scatter programs carry their own LRU cap (_get_scatter)
-        else:
-            self._prefill_jits.move_to_end(bucket)
-        return jit
+        # scatter programs carry their own LRU cap (_get_scatter)
+        return self._jit_lru(self._prefill_jits, bucket,
+                             lambda: self._build_prefill(bucket),
+                             family="prefill")
 
     def _build_prefill(self, p_len: int):
         """One compiled program per prompt bucket: causal pass over the
@@ -2534,7 +2548,7 @@ class ContinuousBatchingEngine:
                 # must not re-observe TTFT
                 req.first_token_time = self._clock()
                 ttft = req.first_token_time - req.arrival_time
-                _M_TTFT.observe(ttft)
+                _M_TTFT.observe(ttft, exemplar=req.request_id)
                 telemetry.event("serving.first_token", rid=req.rid,
                                 request_id=req.request_id,
                                 ttft_s=ttft)
@@ -2737,7 +2751,7 @@ class ContinuousBatchingEngine:
             if telemetry.enabled() and req.first_token_time is None:
                 req.first_token_time = self._clock()
                 ttft = req.first_token_time - req.arrival_time
-                _M_TTFT.observe(ttft)
+                _M_TTFT.observe(ttft, exemplar=req.request_id)
                 telemetry.event("serving.first_token", rid=req.rid,
                                 request_id=req.request_id, ttft_s=ttft)
             if (self.eos is not None and tok == self.eos) \
@@ -2802,20 +2816,38 @@ class ContinuousBatchingEngine:
             return None
         return (self._tp.jax_mesh, TP_AXIS)
 
-    def _jit_lru(self, cache: "OrderedDict", key, build, cap=None):
+    def _jit_lru(self, cache: "OrderedDict", key, build, cap=None,
+                 family: str = "misc"):
         """The one keyed-LRU program-cache discipline (build on miss,
-        evict oldest past the cap, MRU-bump on hit) behind the
-        ragged-admission, suffix-prefill, draft-backfill, and
-        spec-verify program families."""
+        evict oldest past the cap, MRU-bump on hit) behind every keyed
+        program family (prefill, scatter, install, ragged, suffix,
+        draft, verify). Every miss routes through
+        `profile.compile_timed`, so the program's first invocation is
+        metered as `pdt_jit_compiles_total{family}` + compile-seconds
+        + the retrace-storm window, and cache footprint/evictions ride
+        `pdt_jit_cache_entries`/`pdt_jit_cache_evictions_total` —
+        pdt-lint PDT012 pins all compile seams to this method (or
+        `_jit_singleton`), so compile observability cannot be
+        bypassed."""
         jit = cache.get(key)
         if jit is None:
-            jit = build()
+            jit = _profile.compile_timed(build(), family, key)
             cache[key] = jit
+            evicted = 0
             while len(cache) > (cap or self._max_prefill):
                 cache.popitem(last=False)                  # LRU
+                evicted += 1
+            _profile.note_cache(family, len(cache), evicted)
         else:
             cache.move_to_end(key)
         return jit
+
+    def _jit_singleton(self, family: str, build):
+        """The singleton-program arm of the compile-metering seam:
+        built once per engine lifetime (decode, chunk, sample, insert,
+        draft_scan), no key space, no cache — but the same
+        `compile_timed` first-call metering as `_jit_lru` misses."""
+        return _profile.compile_timed(build(), family)
 
     def _pages_bound(self, contexts) -> int:
         """Power-of-two-bucketed static gather trim for a dispatch
@@ -2833,12 +2865,14 @@ class ContinuousBatchingEngine:
         return self._jit_lru(
             self._ragged_jits, (t_pad, pages_bound),
             lambda: self._build_ragged_step(self._ragged_block_q,
-                                            pages_bound))
+                                            pages_bound),
+            family="ragged")
 
     def _build_ragged_step(self, block_q: int, pages_bound=None,
                            draft: bool = False,
                            select_rows: bool = True,
-                           return_logits: bool = False):
+                           return_logits: bool = False,
+                           jit: bool = True):
         """The one ragged program: packed ids -> per-token rope ->
         ONE KV scatter into the pages -> ragged paged attention with
         per-sequence descriptors -> sample each slot's designated row.
@@ -2889,6 +2923,13 @@ class ContinuousBatchingEngine:
                     return nxt, rows, kv_out
                 return nxt, kv_out
 
+        if not jit:
+            # raw op-by-op program for the dispatch-gap sampler
+            # (profile_round): eager execution is what lets the
+            # per-op-family `profile.fence` hooks in llama.py observe
+            # real dispatch boundaries; no donation, so the sampled
+            # round leaves the pools untouched
+            return run
         return jax.jit(run, donate_argnums=(2,))
 
     # -- dense layout --------------------------------------------------
@@ -2898,13 +2939,17 @@ class ContinuousBatchingEngine:
         # batch cache); rows are (bucket, hk, hd) — bucket <= S, written
         # from position 0
         if self._insert_jit is None:
-            def _insert(caches, rows_, s_):
-                return [(ck.at[s_, :rk.shape[0]].set(rk.astype(ck.dtype)),
-                         cv.at[s_, :rv.shape[0]].set(rv.astype(cv.dtype)))
-                        for (ck, cv), (rk, rv) in zip(caches, rows_)]
-            self._insert_jit = jax.jit(_insert, donate_argnums=(0,))
+            self._insert_jit = self._jit_singleton(
+                "insert", self._build_insert)
         self._caches = self._insert_jit(self._caches, rows,
                                         jnp.int32(slot))
+
+    def _build_insert(self):
+        def _insert(caches, rows_, s_):
+            return [(ck.at[s_, :rk.shape[0]].set(rk.astype(ck.dtype)),
+                     cv.at[s_, :rv.shape[0]].set(rv.astype(cv.dtype)))
+                    for (ck, cv), (rk, rv) in zip(caches, rows_)]
+        return jax.jit(_insert, donate_argnums=(0,))
 
     # -- paged layout --------------------------------------------------
     def _worst_pages(self, req: Request) -> int:
@@ -3039,26 +3084,22 @@ class ContinuousBatchingEngine:
                        jnp.int32(p_len))
 
     def _get_scatter(self, bucket: int):
-        jit = self._scatter_jits.get(bucket)
-        if jit is None:
-            from paddle_tpu.ops.paged_attention import \
-                paged_prefill_scatter
+        # own LRU cap: suffix-prefill admissions reach buckets that
+        # never enter _prefill_jits, so a coupled eviction would leak
+        return self._jit_lru(self._scatter_jits, bucket,
+                             self._build_scatter, family="scatter")
 
-            def _scatter(kv, rows_, bt_row, true_len):
-                return [
-                    paged_prefill_scatter(kp, vp, rk.astype(kp.dtype),
-                                          rv.astype(vp.dtype), bt_row,
-                                          true_len)
-                    for (kp, vp), (rk, rv) in zip(kv, rows_)]
-            jit = jax.jit(_scatter, donate_argnums=(0,))
-            self._scatter_jits[bucket] = jit
-            # own LRU cap: suffix-prefill admissions reach buckets that
-            # never enter _prefill_jits, so a coupled eviction would leak
-            while len(self._scatter_jits) > self._max_prefill:
-                self._scatter_jits.popitem(last=False)
-        else:
-            self._scatter_jits.move_to_end(bucket)
-        return jit
+    def _build_scatter(self):
+        from paddle_tpu.ops.paged_attention import \
+            paged_prefill_scatter
+
+        def _scatter(kv, rows_, bt_row, true_len):
+            return [
+                paged_prefill_scatter(kp, vp, rk.astype(kp.dtype),
+                                      rv.astype(vp.dtype), bt_row,
+                                      true_len)
+                for (kp, vp), (rk, rv) in zip(kv, rows_)]
+        return jax.jit(_scatter, donate_argnums=(0,))
 
     def _reserve_and_alloc(self, slot: int, req: Request, p_len: int):
         """Record the slot's worst-case reservation and allocate pages
@@ -3078,7 +3119,8 @@ class ContinuousBatchingEngine:
         C = self._chunk
         self._reserve_and_alloc(slot, req, p_len)
         if self._chunk_jit is None:
-            self._chunk_jit = self._build_chunk_prefill(C)
+            self._chunk_jit = self._jit_singleton(
+                "chunk", lambda: self._build_chunk_prefill(C))
         cfg = self.model.config
         hk, hd = cfg.num_key_value_heads, cfg.head_dim
         dt = self._params[0]._value.dtype
@@ -3103,15 +3145,19 @@ class ContinuousBatchingEngine:
             self._kv = sjit(self._kv, rows, jnp.asarray(sub_bt),
                             jnp.int32(min(C, p_len - off)))
         if self._sample_jit is None:
-            from .generation import _sample_token
-            strat, temp = self.strategy, self.temperature
-            tk, tp = self.top_k, self.top_p
-            self._sample_jit = jax.jit(
-                lambda row, key: _sample_token(row[None], key, strat,
-                                               temp, tk, tp)[0][0])
+            self._sample_jit = self._jit_singleton(
+                "sample", self._build_sample)
         last_local = p_len - (n_chunks - 1) * C
         return int(self._sample_jit(lg[last_local - 1],
                                     self._next_keys()))
+
+    def _build_sample(self):
+        from .generation import _sample_token
+        strat, temp = self.strategy, self.temperature
+        tk, tp = self.top_k, self.top_p
+        return jax.jit(
+            lambda row, key: _sample_token(row[None], key, strat,
+                                           temp, tk, tp)[0][0])
 
     def _build_chunk_prefill(self, C: int):
         """One program for EVERY chunk of EVERY long prompt: the offset
@@ -3143,7 +3189,7 @@ class ContinuousBatchingEngine:
         return self._jit_lru(
             self._suffix_jits, (shared_len, bucket),
             lambda: self._build_suffix_prefill(shared_len, bucket),
-            cap=2 * self._max_prefill)
+            cap=2 * self._max_prefill, family="suffix")
 
     def _build_suffix_prefill(self, shared_len: int, bucket: int):
         """Compiled program for prefix-hit admission: gather the shared
@@ -3318,6 +3364,10 @@ class ContinuousBatchingEngine:
         round still makes progress, the REQUEST never fails."""
         if self._spec is not None and self._spec_decode(finished):
             return True
+        # pdt-lint: disable=PDT001 decode-round decomposition is REAL
+        # wall — the pre-dispatch host prep (slot growth, window
+        # reclaim, block-table upload) is the "host" component
+        d0 = time.perf_counter() if telemetry.enabled() else 0.0
         if self._decode_jit is None:
             # ragged mode: decode is the SAME ragged program at
             # block_q=1 — B sequences of one query token each. The
@@ -3332,13 +3382,15 @@ class ContinuousBatchingEngine:
                 # _decode_jit so this rebuild happens)
                 self._decode_logits = (self._sentry is not None
                                        and self._sentry.wants_logits)
-                self._decode_jit = self._build_ragged_step(
-                    1, return_logits=self._decode_logits)
+                self._decode_jit = self._jit_singleton(
+                    "decode", lambda: self._build_ragged_step(
+                        1, return_logits=self._decode_logits))
                 self._decode_idx = jnp.arange(self.B, dtype=jnp.int32)
                 self._decode_ones = jnp.ones(self.B, jnp.int32)
             else:
                 self._decode_logits = False
-                self._decode_jit = self._build_decode()
+                self._decode_jit = self._jit_singleton(
+                    "decode", self._build_decode)
         # inactive slots decode garbage at a clamped position; their
         # outputs are never read. Paged: their block-table rows are all
         # trash-page, so their KV writes land in page 0 (never read);
@@ -3386,6 +3438,8 @@ class ContinuousBatchingEngine:
             # (tokens/sec derives from it) — a fake clock here would
             # fabricate hardware throughput, not make tests exact
             t0 = time.perf_counter()
+            if telemetry.enabled():
+                _profile.note_round("host", t0 - d0)
             lg_rows = None
             if self.layout == "paged" and self.attn_impl == "ragged":
                 bidx = self._decode_idx
@@ -3423,19 +3477,22 @@ class ContinuousBatchingEngine:
             t1 = time.perf_counter()
             if telemetry.enabled():
                 _M_DECODE_DISPATCH.observe(t1 - t0)
+                _profile.note_round("dispatch", t1 - t0)
             if self.harvest_every > 1:
                 # deferred-harvest path: the token vector stays on
                 # device; defer the sync, commits, and sentry checks to
                 # the window's one batched harvest. The stride tick
                 # happens NOW (per dispatch) so the scan schedule
                 # matches the synchronous loop step for step.
-                scan = False
+                scan, sc = False, 0.0
                 if self._sentry is not None:
                     # pdt-lint: disable=PDT001 sentry cost is REAL wall
                     s0 = time.perf_counter()
                     scan = self._sentry.step_tick()
                     # pdt-lint: disable=PDT001 same measurement
-                    self._sentry.note_cost(time.perf_counter() - s0)
+                    sc = time.perf_counter() - s0
+                    self._sentry.note_cost(sc)
+                    _profile.note_round("sentry", sc)
                 self._corrupt_kv_site()
                 act = tuple(i for i, r in enumerate(self._slot_req)
                             if r is not None)
@@ -3451,6 +3508,11 @@ class ContinuousBatchingEngine:
                     "pos": self._pos.copy()})
                 self._tok_dev = nxt
                 self._window_wall += t1 - t0
+                if telemetry.enabled():
+                    # pdt-lint: disable=PDT001 same real-wall
+                    # decomposition (sentry tick already attributed)
+                    tail = time.perf_counter() - t1 - sc
+                    _profile.note_round("host", tail)
                 return True
             # synchronous path (harvest_every=1, today's loop): the
             # D2H copy is the step's sync point — dispatch alone
@@ -3460,10 +3522,17 @@ class ContinuousBatchingEngine:
             dt = time.perf_counter() - t0
         if telemetry.enabled():
             _M_HARVEST.observe(dt - (t1 - t0))
+            # the D2H sync wait IS the device-side remainder of the
+            # round (dispatch returned before the device finished)
+            _profile.note_round("device", dt - (t1 - t0))
             _M_DECODE_STEP.observe(dt)
             _M_DECODE_TOKENS.inc(n_active)
             if dt > 0:
                 _M_TOKENS_PER_SEC.set(n_active / dt)
+            # pdt-lint: disable=PDT001 same real-wall decomposition:
+            # t0 + dt is the clock reading taken above, so this window
+            # also covers the decode_step span exit
+            _profile.note_round("host", time.perf_counter() - t0 - dt)
         # gray-failure corrupt site + sentry checks, AFTER the timed
         # window so decode_step_seconds stays comparable across
         # sentry-on/off engines (the sentry's own cost rides
@@ -3478,13 +3547,21 @@ class ContinuousBatchingEngine:
             act = [i for i, r in enumerate(self._slot_req)
                    if r is not None]
             # pdt-lint: disable=PDT001 same real-wall measurement
-            self._sentry.note_cost(time.perf_counter() - s0)
+            sc = time.perf_counter() - s0
+            self._sentry.note_cost(sc)
+            _profile.note_round("sentry", sc)
             self._harvest_sentry(nxt, lg_rows if scan else None, act,
                                  lag=0)
+        # pdt-lint: disable=PDT001 same real-wall decomposition (the
+        # sentry block above attributes itself to "sentry")
+        e0 = time.perf_counter() if telemetry.enabled() else 0.0
         for i, r in enumerate(self._slot_req):
             if r is not None:
                 self._tok[i] = nxt[i]
                 self._pos[i] += 1
+        if telemetry.enabled():
+            # pdt-lint: disable=PDT001 same real-wall measurement
+            _profile.note_round("host", time.perf_counter() - e0)
         return False
 
     # -- pipelined harvest seam (harvest_every=k, ISSUE 18) -------------
@@ -3497,14 +3574,15 @@ class ContinuousBatchingEngine:
         """The k=1 synchronous harvest: ONE dispatch's D2H token sync."""
         return np.asarray(nxt)
 
-    def _harvest_sentry(self, nxt, lg_rows, act, lag: int):
+    def _harvest_sentry(self, nxt, lg_rows, act, lag: int) -> float:
         """Sentry checks over one harvested dispatch: the in-vocab
         token check, the every-Nth logit scan (pulled HERE — at k>1
         the pull rides the harvest, bounding detection latency at k
         steps, which `note_lag` meters), and the `serving.logits`
         VALUE fault site over the ACTIVE rows the scan inspects (the
         NaN-poisoned-logits drill; an inactive slot's garbage row is
-        not a harvest)."""
+        not a harvest). Returns its total wall so the caller's
+        profiler window can attribute it to "sentry", not itself."""
         # pdt-lint: disable=PDT001 sentry cost is REAL wall (bench bar)
         s0 = time.perf_counter()
         lg_np = None
@@ -3513,7 +3591,8 @@ class ContinuousBatchingEngine:
                                 np.asarray(lg_rows)[act],
                                 tag=self.fault_tag)
         # pdt-lint: disable=PDT001 same real-wall measurement
-        self._sentry.note_cost(time.perf_counter() - s0)
+        sc = time.perf_counter() - s0
+        self._sentry.note_cost(sc)
         self._sentry.observe_tokens(nxt[act])
         # lag metering is optional on the sentry protocol — custom
         # sentries (test recorders, canary probes) predate it
@@ -3522,6 +3601,10 @@ class ContinuousBatchingEngine:
             note_lag(lag)
         if lg_np is not None:
             self._sentry.observe_logits(lg_np)
+        # pdt-lint: disable=PDT001 same real-wall measurement
+        elapsed = time.perf_counter() - s0
+        _profile.note_round("sentry", elapsed)
+        return elapsed
 
     def _harvest_due(self) -> bool:
         """Must the deferred window be harvested BEFORE this step's
@@ -3574,6 +3657,14 @@ class ContinuousBatchingEngine:
             harvest_dt = time.perf_counter() - t0
         if telemetry.enabled():
             _M_HARVEST.observe(harvest_dt)
+            # the window's one batched D2H sync is where the deferred
+            # rounds' device time surfaces on the host clock
+            _profile.note_round("device", harvest_dt)
+        # pdt-lint: disable=PDT001 decode-round decomposition is REAL
+        # wall (profile.py reconciles components against the measured
+        # round wall) — a fake clock would fabricate attribution
+        c0 = time.perf_counter() if telemetry.enabled() else 0.0
+        sentry_s = 0.0
         n = len(entries)
         n_committed = 0
         done_slots: set = set()
@@ -3582,9 +3673,9 @@ class ContinuousBatchingEngine:
             nxt = stacked[j]
             if self._sentry is not None:
                 act = [i for i in e["act"] if i not in done_slots]
-                self._harvest_sentry(nxt,
-                                     e["lg"] if e["scan"] else None,
-                                     act, lag=n - 1 - j)
+                sentry_s += self._harvest_sentry(
+                    nxt, e["lg"] if e["scan"] else None,
+                    act, lag=n - 1 - j)
             for i in e["act"]:
                 if i in done_slots:
                     continue        # finalized earlier in this window
@@ -3610,6 +3701,11 @@ class ContinuousBatchingEngine:
             if r is not None:
                 r.device_len = len(r.output)    # staleness resync
         if telemetry.enabled():
+            # pdt-lint: disable=PDT001 same real-wall decomposition
+            # (in-window sentry pulls are attributed to "sentry" by
+            # _harvest_sentry, so they are excluded here)
+            hv = time.perf_counter() - c0 - sentry_s
+            _profile.note_round("harvest", hv)
             _M_DECODE_TOKENS.inc(n_committed)
             wall = self._window_wall + harvest_dt
             if wall > 0:
@@ -3630,6 +3726,51 @@ class ContinuousBatchingEngine:
         if n:
             self._harvest_pending(self._finished_backlog)
         return n
+
+    def profile_round(self):
+        """Dispatch-gap sample of ONE decode round: run the decode
+        program op-by-op (un-jitted) with `profile.fence`
+        block_until_ready fences at every op-family boundary
+        (models/llama.py), attributing the host wall between fences as
+        the dispatch gap of that op pair. Returns the ranked gap table
+        (list of {op_pair, gap_s, device_s, count} rows, summed over
+        layers) and publishes `pdt_profile_gap_seconds{op_pair}` — the
+        megakernel fusion ladder's shopping list (ROADMAP item 1).
+
+        The sampled round is OBSERVATION ONLY: the window is quiesced
+        first, the eager pass donates nothing, its outputs are
+        discarded, and the sample key is a constant — engine state,
+        the PRNG stream, and the served tokens stay bit-identical
+        (test-pinned). The un-jitted pass is 10-100x slower than the
+        compiled step, so sample on demand, not per step."""
+        if self.layout != "paged" or self.attn_impl != "ragged":
+            raise RuntimeError(
+                "profile_round requires the paged+ragged decode path "
+                f"(layout={self.layout!r}, attn_impl={self.attn_impl!r})")
+        if self._tp is not None:
+            raise RuntimeError(
+                "profile_round is single-mesh only: the eager sampler "
+                "cannot drive the shard_map kernel path")
+        self.quiesce()
+        if not any(r is not None for r in self._slot_req):
+            raise RuntimeError("profile_round needs >= 1 active slot")
+        if self._profile_raw is None:
+            self._profile_raw = self._build_ragged_step(1, jit=False)
+        pos = np.clip(self._pos, 0, self.S - 1)
+        bidx = jnp.arange(self.B, dtype=jnp.int32)
+        ones = jnp.ones(self.B, jnp.int32)
+        args = (self._lora_pv(self._pv(), self._slot_adapter),
+                self._bv(), self._kv, jnp.asarray(self._tok), bidx,
+                jnp.asarray(pos.astype(np.int32)), bidx, ones,
+                jnp.asarray((pos + 1).astype(np.int32)),
+                jnp.asarray(self._bt), bidx, jax.random.PRNGKey(0))
+        # untimed warmup pass: per-op executables and lazy imports
+        # must not pollute the sampled gaps
+        jax.block_until_ready(
+            jax.tree_util.tree_leaves(self._profile_raw(*args)))
+        with _profile.gap_sampler() as sampler:
+            self._profile_raw(*args)
+        return sampler.table()
 
     # -- speculative decoding (spec_decode=SpecConfig, ISSUE 10) -------
     def _spec_decode(self, finished: List[Request]) -> bool:
@@ -3822,7 +3963,8 @@ class ContinuousBatchingEngine:
         return self._jit_lru(
             self._d_prefill_jits, (t_pad, pages_bound),
             lambda: self._build_ragged_step(self._ragged_block_q,
-                                            pages_bound, draft=True))
+                                            pages_bound, draft=True),
+            family="draft")
 
     def _spec_scan(self, kuse) -> np.ndarray:
         """K greedy draft tokens for every live slot in ONE dispatch:
@@ -3831,7 +3973,8 @@ class ContinuousBatchingEngine:
         between draft steps, which is where the speculative win over
         k+1 plain decode dispatches comes from."""
         if self._d_scan_jit is None:
-            self._d_scan_jit = self._build_draft_scan()
+            self._d_scan_jit = self._jit_singleton(
+                "draft_scan", self._build_draft_scan)
         live = np.array([r is not None and kuse[i] >= 1
                          and bool(self._d_valid[i])
                          for i, r in enumerate(self._slot_req)])
@@ -3999,7 +4142,8 @@ class ContinuousBatchingEngine:
             self._verify_jits, (t_pad, pages_bound),
             lambda: self._build_ragged_step(self._verify_block_q,
                                             pages_bound,
-                                            select_rows=False))
+                                            select_rows=False),
+            family="verify")
 
     @property
     def spec_enabled(self) -> bool:

@@ -31,6 +31,14 @@ Three modules:
   windowed reservoir) and the `SloMonitor` grading declarative
   objectives (TTFT/TPOT percentiles, error rate, availability) into
   pass/warn/breach with burn rates, exported as `pdt_slo_*` gauges.
+* `profile` — the performance attribution plane: decode-round
+  decomposition (`note_round`), the dispatch-gap sampler
+  (`gap_sampler`/`fence`, driven by `engine.profile_round()`),
+  compile-cache observability (`compile_timed` behind the engine's
+  `_jit_lru`/`_jit_singleton` seam + the retrace-storm detector), the
+  `pdt_mem_bytes{pool}` memory ledger, and
+  `render_profile_report(snapshot)` for the waterfall / top-gap /
+  compile-table / ledger text report.
 * `status` — `render_fleet_status()`: the human-readable fleet report.
 * `__main__` — the operator CLI (`python -m paddle_tpu.observability
   snapshot|slo|trace ...`, installed as `paddle-tpu-obs`).
@@ -61,6 +69,9 @@ from .slo import (Reservoir, SloMonitor, SloObjective,  # noqa: F401
                   evaluate_snapshot, format_slo_report,
                   objectives_from_spec, quantile_from_buckets)
 from .status import render_fleet_status  # noqa: F401
+from . import profile  # noqa: F401
+from .profile import (memory_ledger, note_round,  # noqa: F401
+                      render_profile_report, snapshot_report)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
@@ -75,4 +86,6 @@ __all__ = [
     "default_serving_objectives", "evaluate_snapshot",
     "format_slo_report", "objectives_from_spec",
     "quantile_from_buckets", "render_fleet_status",
+    "profile", "memory_ledger", "note_round",
+    "render_profile_report", "snapshot_report",
 ]

@@ -18,7 +18,7 @@ import json
 from typing import Dict, Optional
 
 from .registry import (REGISTRY, Counter, Gauge, Histogram, Registry,
-                       _fmt_float)
+                       _escape_label_value, _fmt_float)
 
 __all__ = ["to_prometheus", "render_prometheus", "to_json",
            "write_json", "parse_prometheus"]
@@ -65,10 +65,20 @@ def _render(snap: Dict[str, object], helps: Dict[str, str]) -> str:
             lines.append(f"# TYPE {name} {kind}")
             for labels, val in sorted(series.items()):
                 if kind == "histogram":
+                    exemplars = val.get("exemplars", {})
                     for le, c in val["buckets"].items():
-                        lines.append(_sample(
+                        line = _sample(
                             name + "_bucket",
-                            _merge_label(labels, f'le="{le}"'), c))
+                            _merge_label(labels, f'le="{le}"'), c)
+                        ex = exemplars.get(le)
+                        if ex is not None:
+                            # OpenMetrics exemplar syntax:
+                            #   ... 3 # {trace_id="abc"} 0.043
+                            tid = _escape_label_value(
+                                str(ex["trace_id"]))
+                            line += (f' # {{trace_id="{tid}"}} '
+                                     f'{_fmt_float(float(ex["value"]))}')
+                        lines.append(line)
                     lines.append(_sample(name + "_sum", labels,
                                          val["sum"]))
                     lines.append(_sample(name + "_count", labels,
@@ -89,17 +99,11 @@ def write_json(path: str, registry: Optional[Registry] = None):
         f.write("\n")
 
 
-def _split_sample(line: str):
-    """`name{a="x",le="1"} 3` -> (name, {"a": "x", "le": "1"}, 3.0).
-    Label values are parsed quote-aware (values may contain commas)."""
-    brace = line.find("{")
-    if brace < 0:
-        name, _, num = line.rpartition(" ")
-        return name.strip(), {}, float(num)
-    name = line[:brace]
-    endbrace = line.rfind("}")
-    body, num = line[brace + 1:endbrace], line[endbrace + 1:]
-    labels = {}
+def _parse_label_body(body: str, line: str) -> Dict[str, str]:
+    """Quote-aware `a="x",b="y"` parser (values may contain commas);
+    values are kept in their ESCAPED exposition form, matching the
+    canonical label-string snapshot keys."""
+    labels: Dict[str, str] = {}
     i = 0
     while i < len(body):
         eq = body.index("=", i)
@@ -110,7 +114,52 @@ def _split_sample(line: str):
             j += 2 if body[j] == "\\" else 1
         labels[key] = body[eq + 2:j]
         i = j + 1
-    return name, labels, float(num.strip())
+    return labels
+
+
+def _unescape_label_value(v: str) -> str:
+    """Inverse of `_escape_label_value` (one left-to-right scan, so
+    `\\\\n` decodes as backslash+n, not backslash+newline)."""
+    out, i = [], 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"n": "\n", '"': '"', "\\": "\\"}.get(
+                nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
+
+
+def _split_exemplar(line: str):
+    """Strip an OpenMetrics exemplar suffix:
+    `name_bucket{le="1"} 3 # {trace_id="abc"} 0.043` ->
+    (`name_bucket{le="1"} 3`, {"trace_id": "abc", "value": 0.043}).
+    Returns (line, None) when no exemplar is present."""
+    cut = line.find(" # {")
+    if cut < 0:
+        return line, None
+    tail = line[cut + 3:]                    # '{trace_id="..."} 0.043'
+    end = tail.rfind("}")
+    labels = _parse_label_body(tail[1:end], line)
+    return line[:cut], {
+        "trace_id": _unescape_label_value(labels.get("trace_id", "")),
+        "value": float(tail[end + 1:].strip())}
+
+
+def _split_sample(line: str):
+    """`name{a="x",le="1"} 3` -> (name, {"a": "x", "le": "1"}, 3.0).
+    Label values are parsed quote-aware (values may contain commas)."""
+    brace = line.find("{")
+    if brace < 0:
+        name, _, num = line.rpartition(" ")
+        return name.strip(), {}, float(num)
+    name = line[:brace]
+    endbrace = line.rfind("}")
+    body, num = line[brace + 1:endbrace], line[endbrace + 1:]
+    return name, _parse_label_body(body, line), float(num.strip())
 
 
 def parse_prometheus(text: str) -> Dict[str, object]:
@@ -130,6 +179,7 @@ def parse_prometheus(text: str) -> Dict[str, object]:
                 types[parts[2]] = parts[3].strip() if len(parts) > 3 \
                     else "untyped"
             continue
+        line, exemplar = _split_exemplar(line)
         name, labels, val = _split_sample(line)
         base, suffix = name, None
         for sfx in ("_bucket", "_sum", "_count"):
@@ -145,6 +195,8 @@ def parse_prometheus(text: str) -> Dict[str, object]:
                 lstr, {"count": 0, "sum": 0.0, "buckets": {}})
             if suffix == "_bucket":
                 series["buckets"][le] = int(val)
+                if exemplar is not None:
+                    series.setdefault("exemplars", {})[le] = exemplar
             elif suffix == "_sum":
                 series["sum"] = val
             elif suffix == "_count":

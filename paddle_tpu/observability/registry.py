@@ -208,7 +208,12 @@ class Histogram(_Instrument):
             raise ValueError(f"histogram {name}: needs >= 1 bucket")
         self.buckets = bs                     # +Inf implied
 
-    def observe(self, v: float, **labels):
+    def observe(self, v: float, exemplar: Optional[str] = None,
+                **labels):
+        """Record one observation. `exemplar` (e.g. a request/trace id)
+        is remembered as the MOST RECENT exemplar of whichever bucket
+        the value lands in — OpenMetrics exemplar semantics, so a p99
+        bucket in the export links straight to a concrete trace."""
         if not enabled():
             return
         v = float(v)
@@ -227,7 +232,14 @@ class Histogram(_Instrument):
                     s["counts"][i] += 1
                     break
             else:
+                i = len(self.buckets)
                 s["counts"][-1] += 1          # +Inf bucket
+            if exemplar is not None:
+                # keyed by bucket INDEX internally; snapshot renders
+                # the le-boundary string ("exemplars" key only when one
+                # was ever recorded, preserving export round-trip)
+                s.setdefault("exemplars", {})[i] = {
+                    "trace_id": str(exemplar), "value": v}
 
     def time(self, **labels) -> _Timer:
         """Context manager timing its body on the monotonic clock."""
@@ -319,9 +331,16 @@ class Registry:
                             cum += c
                             bmap[_fmt_float(b)] = cum
                         bmap["+Inf"] = s["count"]
-                        dst[_label_string(inst.labelnames, key)] = {
-                            "count": s["count"], "sum": s["sum"],
-                            "buckets": bmap}
+                        entry = {"count": s["count"], "sum": s["sum"],
+                                 "buckets": bmap}
+                        ex = s.get("exemplars")
+                        if ex:
+                            les = [_fmt_float(b)
+                                   for b in inst.buckets] + ["+Inf"]
+                            entry["exemplars"] = {
+                                les[i]: dict(e)
+                                for i, e in sorted(ex.items())}
+                        dst[_label_string(inst.labelnames, key)] = entry
                 elif isinstance(inst, (Counter, Gauge)):
                     dst = out["counters" if isinstance(inst, Counter)
                               else "gauges"].setdefault(name, {})

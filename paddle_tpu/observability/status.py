@@ -6,7 +6,9 @@ aggregate, role aggregates + prefix-store stats for disaggregated
 fleets, QoS admission state — lane admit/shed counts, tenant budget
 occupancy, the arbitration burn — when a `QosAdmission` is attached,
 and — when an `SloMonitor` is attached — per-replica and
-fleet-level SLO verdicts) into the fixed-width report
+fleet-level SLO verdicts, plus the performance-attribution surface of
+`fleet_info()["perf"]`: the `pdt_mem_bytes{pool}` memory ledger and the
+per-family jit compile-cache table) into the fixed-width report
 `recipes/llama_serve.py` prints after its drills; `paddle-tpu-obs
 status --from fleet.json` renders a saved snapshot. Pure formatting: no registry reads, no side effects,
 so it can render a `fleet_info()` dict captured anywhere (a log line, a
@@ -16,6 +18,17 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 __all__ = ["render_fleet_status"]
+
+
+def _fmt_bytes(n: float) -> str:
+    """`1536 -> 1.5KiB` — compact fixed-point byte counts for the
+    memory-ledger line (the raw integers live in `pdt_mem_bytes`)."""
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
 
 
 def _submesh_cell(sm: Optional[Dict[str, object]]) -> str:
@@ -123,6 +136,30 @@ def render_fleet_status(info: Dict[str, object]) -> str:
             f"{sentry.get('quarantines', 0)} quarantine(s), "
             f"{sentry.get('tainted_tokens_dropped', 0)} tainted "
             "token(s) dropped")
+    perf: Optional[Dict[str, object]] = info.get("perf")  # type: ignore
+    if perf:
+        mem: Dict[str, float] = perf.get("mem_bytes") or {}  # type: ignore
+        if mem:
+            lines.append("  memory: " + " ".join(
+                f"{pool}={_fmt_bytes(b)}"
+                for pool, b in sorted(mem.items())))
+        jit: Dict[str, dict] = perf.get("jit") or {}  # type: ignore
+        if jit:
+            parts = []
+            for fam, d in sorted(jit.items()):
+                cell = f"{fam}={d.get('compiles', 0)}"
+                extra = []
+                if d.get("entries"):
+                    extra.append(f"{d['entries']} cached")
+                if d.get("evictions"):
+                    extra.append(f"{d['evictions']} evicted")
+                if extra:
+                    cell += f" ({', '.join(extra)})"
+                parts.append(cell)
+            storms = perf.get("retrace_storms", 0)
+            lines.append(
+                "  jit compiles: " + " ".join(parts)
+                + (f"; RETRACE STORMS {storms}" if storms else ""))
     slo: Optional[Dict[str, dict]] = info.get("slo")  # type: ignore
     if slo:
         parts = []

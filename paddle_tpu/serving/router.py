@@ -124,6 +124,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
 from .. import observability as telemetry
+from ..observability import profile as _profile
 from ..observability import trace as tracing
 from ..models.serving import (ContinuousBatchingEngine, EngineOverloaded,
                               PoolExhausted, Request, RequestStatus)
@@ -1042,7 +1043,16 @@ class ServingRouter:
         # durability: mirror this tick's new tokens into the journal
         # AFTER harvests and failovers, so one batched progress record
         # reflects exactly what the router would have streamed
-        self._journal_mirror()
+        if self.journal is not None and telemetry.enabled():
+            # pdt-lint: disable=PDT001 the journal component of the
+            # decode-round decomposition is REAL wall (fsync cost) —
+            # a fake clock would fabricate the durability overhead
+            j0 = time.perf_counter()
+            self._journal_mirror()
+            # pdt-lint: disable=PDT001 same real-wall measurement
+            _profile.note_round("journal", time.perf_counter() - j0)
+        else:
+            self._journal_mirror()
         for h in self.replicas:
             h.update_gauges()
         return finished
@@ -2193,6 +2203,15 @@ class ServingRouter:
                           "submitted": row["submitted"],
                           "pressure": row["pending"] / max(1, serving)}
                     for mid, row in per_model.items()}}
+        # performance attribution surface (observability/profile.py):
+        # the pdt_mem_bytes{pool} memory ledger over every live
+        # engine + the compile-cache counters — render with
+        # render_fleet_status, drill down with `paddle-tpu-obs
+        # profile`
+        info["perf"] = _profile.perf_section(
+            (h.engine for h in self.replicas),
+            prefix_store=self.prefix_store,
+            model_store=self.model_store)
         if self.journal is not None:
             # durability surface: segment/byte footprint + how much
             # request state the journal is currently carrying

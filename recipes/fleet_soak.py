@@ -61,8 +61,16 @@ the chips), and EXACT per-model terminal-counter reconciliation
     python recipes/fleet_soak.py                   # search + 2x soak
     python recipes/fleet_soak.py --qps 6 --overload 3
     python recipes/fleet_soak.py --duration 120 --replicas 4  # heavier
+`--profile` prints the performance-attribution report after the soak
+(ISSUE 20, docs/observability.md "Performance attribution"):
+decode-round waterfall, compile-cache table, memory ledger.
+
+    python recipes/fleet_soak.py                   # search + 2x soak
+    python recipes/fleet_soak.py --qps 6 --overload 3
+    python recipes/fleet_soak.py --duration 120 --replicas 4  # heavier
     python recipes/fleet_soak.py --autoscale       # + the elastic leg
     python recipes/fleet_soak.py --multimodel      # + the model-mix leg
+    python recipes/fleet_soak.py --profile         # + attribution
 """
 import argparse
 import json
@@ -118,6 +126,11 @@ def main(argv=None):
                         "1 = the synchronous loop. The soak grades "
                         "the SAME objectives — chaos, recovery, and "
                         "SLOs must hold at any window size")
+    p.add_argument("--profile", action="store_true",
+                   help="print the performance-attribution report "
+                        "(decode-round waterfall, compile-cache table, "
+                        "memory ledger — docs/observability.md "
+                        "'Performance attribution') after the soak")
     args = p.parse_args(argv)
 
     import paddle_tpu as paddle
@@ -714,6 +727,14 @@ def main(argv=None):
                     f"dedicated {mid} baseline p95 TTFT {p:.3f}s "
                     f"missed the {objective:g}s objective — the "
                     "parity grade has no valid baseline")
+
+    if args.profile:
+        # where the soak's decode rounds went + what compiled; the
+        # fleet_info/render_fleet_status calls above already refreshed
+        # the pdt_mem_bytes ledger from the live fleet
+        from paddle_tpu.observability import profile as _profile
+        print()
+        print(_profile.snapshot_report())
 
     print()
     if failures:

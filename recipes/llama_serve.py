@@ -351,6 +351,14 @@ def main(argv=None):
           "(load in chrome://tracing or https://ui.perfetto.dev; "
           "pid=replica, tid=request)")
 
+    # 3f) performance attribution (docs/observability.md "Performance
+    # attribution"): where did the drill's decode rounds go, and what
+    # compiled — the waterfall + compile-cache table from the live
+    # registry, same report `paddle-tpu-obs profile` renders offline
+    # (fleet_info above already refreshed the pdt_mem_bytes ledger)
+    from paddle_tpu.observability import profile as _profile
+    print(_profile.snapshot_report())
+
     # 3e) disaggregated prefill/decode (docs/serving.md
     # "Disaggregation"): the same jobs through a colocated fleet (the
     # oracle) and a role-split fleet, with a kill-a-prefill-replica-

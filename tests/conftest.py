@@ -68,12 +68,20 @@ _TELEMETRY_FILES = ("test_serving.py", "test_chaos.py",
                     "test_loadgen.py", "test_tp_serving.py",
                     "test_journal.py", "test_sentry.py",
                     "test_quant_serving.py", "test_autoscaler.py",
-                    "test_multimodel.py", "test_async_pipeline.py")
+                    "test_multimodel.py", "test_async_pipeline.py",
+                    "test_profile.py")
 
 # failing fleet-drill tests additionally attach a Chrome-trace export
 # of the telemetry ring: the failover timeline that produced the
 # failure is then directly loadable in chrome://tracing / Perfetto
 _CHROME_TRACE_FILES = ("test_chaos.py", "test_router.py")
+
+# failing perf-sensitive tests additionally attach the performance-
+# attribution report (decode-round decomposition + compile table +
+# memory ledger): a hang or throughput collapse then arrives with its
+# own waterfall instead of needing a rerun under a profiler
+_PROFILE_REPORT_FILES = ("test_async_pipeline.py", "test_tp_serving.py",
+                         "test_quant_serving.py", "test_profile.py")
 
 
 @pytest.fixture(autouse=True)
@@ -113,6 +121,13 @@ def pytest_runtest_makereport(item, call):
                                 default=str)))
             except Exception:
                 pass
+        if base in _PROFILE_REPORT_FILES:
+            try:
+                from paddle_tpu.observability import profile
+                rep.sections.append(
+                    ("profile report", profile.snapshot_report()))
+            except Exception:
+                pass
 
 
 @pytest.fixture(autouse=True)
@@ -127,7 +142,8 @@ def _serving_invariant_checks(request, monkeypatch):
             "test_loadgen.py", "test_tp_serving.py",
             "test_journal.py", "test_sentry.py",
             "test_quant_serving.py", "test_autoscaler.py",
-            "test_multimodel.py", "test_async_pipeline.py"):
+            "test_multimodel.py", "test_async_pipeline.py",
+            "test_profile.py"):
         monkeypatch.setenv("PDT_CHECK_INVARIANTS", "1")
     yield
 

@@ -23,6 +23,7 @@ from paddle_tpu.analysis import (Baseline, Project, by_code,
 from paddle_tpu.analysis.__main__ import BASELINE_NAME
 from paddle_tpu.analysis.__main__ import main as cli_main
 from paddle_tpu.analysis.checkers import (CatalogDriftChecker,
+                                          CompileSeamChecker,
                                           DurableWriteChecker,
                                           FaultCoverageChecker,
                                           FaultSiteDriftChecker,
@@ -678,6 +679,85 @@ class TestHarvestSeam:
         assert res.new == []
 
 
+# -- PDT012 compile-seam ------------------------------------------------
+class TestCompileSeam:
+    def test_jit_outside_builder_flagged(self, tmp_path):
+        res = run_one(tmp_path, CompileSeamChecker(), {
+            "paddle_tpu/models/serving.py": """\
+                import jax
+                from jax.experimental import pallas as pl
+
+                def _decode(self):
+                    fn = jax.jit(self._step)          # finding
+                    return fn(self._tok)
+
+                def _admit(self, req):
+                    k = pl.pallas_call(self._kern)    # finding
+                    return k
+            """})
+        assert [(f.code, f.detail) for f in res.new] == [
+            ("PDT012", "_decode:jax.jit"),
+            ("PDT012", "_admit:pallas_call")]
+
+    def test_builders_and_seam_pass(self, tmp_path):
+        res = run_one(tmp_path, CompileSeamChecker(), {
+            "paddle_tpu/models/serving.py": """\
+                import jax
+
+                def _build_decode(self):
+                    return jax.jit(self._fwd)         # builder: legal
+
+                def _build_ragged_step(self, k):
+                    def run(*a):
+                        return a
+                    return jax.jit(run)               # builder: legal
+
+                def _jit_lru(self, cache, key, build, family="misc"):
+                    jit = build()
+                    cache[key] = jit                  # the seam: legal
+                    return jit
+
+                def _decode_jit_getter(self):
+                    self._decode_jit = None           # reset: legal
+                    self._decode_jit = \\
+                        self._jit_singleton("decode", self._build_decode)
+                    return self._decode_jit
+            """})
+        assert res.new == []
+
+    def test_cache_store_and_raw_slot_flagged(self, tmp_path):
+        res = run_one(tmp_path, CompileSeamChecker(), {
+            "paddle_tpu/models/serving.py": """\
+                import jax
+
+                def _build_prefill(self):
+                    return jax.jit(self._fwd)
+
+                def _get_prefill(self, bucket):
+                    jit = self._build_prefill()
+                    self._prefill_jits[bucket] = jit  # finding: bypass
+                    return jit
+
+                def _get_decode(self):
+                    self._decode_jit = self._build_decode()  # finding
+                    return self._decode_jit
+            """})
+        assert [(f.code, f.detail) for f in res.new] == [
+            ("PDT012", "_get_prefill:_prefill_jits[]"),
+            ("PDT012", "_get_decode:_decode_jit")]
+
+    def test_scope_is_the_engine_file(self, tmp_path):
+        res = run_one(tmp_path, CompileSeamChecker(), {
+            # jit outside the engine file: not this rule's scope
+            "paddle_tpu/models/llama.py": """\
+                import jax
+
+                def generate(self, ids):
+                    return jax.jit(self._fwd)(ids)
+            """})
+        assert res.new == []
+
+
 # -- suppressions -------------------------------------------------------
 class TestSuppressions:
     FILES = {
@@ -1024,7 +1104,7 @@ class TestRepoGate:
         assert sorted(by_code()) == ["PDT001", "PDT002", "PDT003",
                                      "PDT004", "PDT005", "PDT006",
                                      "PDT007", "PDT008", "PDT009",
-                                     "PDT010", "PDT011"]
+                                     "PDT010", "PDT011", "PDT012"]
         assert len(default_checkers(["PDT003", "PDT004"])) == 2
         with pytest.raises(ValueError):
             default_checkers(["PDT777"])

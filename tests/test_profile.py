@@ -1,0 +1,358 @@
+"""Performance attribution plane (ISSUE 20,
+paddle_tpu/observability/profile.py): decode-round decomposition,
+the dispatch-gap sampler, compile-cache observability behind the
+`_jit_lru`/`_jit_singleton` seam, the memory ledger, histogram
+exemplars, and the `paddle-tpu-obs profile` CLI.
+
+The two acceptance gates pinned here:
+
+* the decomposition components sum to within 10% of the measured
+  round wall on the CPU oracle (the attribution is honest — nothing
+  big is missing and nothing is double-counted);
+* 50 warm pipelined rounds record ZERO compiles (the steady-state
+  claim every bench number rests on, finally verified).
+
+conftest runs this file with PDT_TELEMETRY=1 and
+PDT_CHECK_INVARIANTS=1 and attaches the profile report to failing
+reports."""
+import json
+import time
+
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.observability as telemetry
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+from paddle_tpu.observability import profile
+from paddle_tpu.observability.__main__ import main as obs_main
+
+pytestmark = pytest.mark.telemetry
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=2,
+                      num_key_value_heads=1, max_position_embeddings=64)
+    paddle.seed(7)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _engine(model, k=1, **kw):
+    kw.setdefault("max_batch_size", 3)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("page_size", 4)
+    return ContinuousBatchingEngine(model, harvest_every=k, **kw)
+
+
+JOBS = [([1, 2, 3], 40), ([4, 5], 38), ([6, 7, 8, 9], 36)]
+
+
+def _warm_engine(model, k=1, jobs=JOBS):
+    eng = _engine(model, k)
+    for p, n in jobs:
+        eng.add_request(list(p), n)
+    for _ in range(4):
+        eng.step()
+    return eng
+
+
+def _compile_total(snap):
+    return sum(snap.get("counters", {}).get(
+        "pdt_jit_compiles_total", {}).values())
+
+
+# -- no-op unless enabled ----------------------------------------------
+class TestDisabledNoOp:
+    def test_disabled_records_nothing(self, model, monkeypatch):
+        monkeypatch.delenv("PDT_TELEMETRY", raising=False)
+        telemetry.disable()
+        telemetry.reset()
+        try:
+            profile.note_round("dispatch", 0.01)
+            jit = profile.compile_timed(lambda: 7, "decode")
+            assert jit() == 7
+            profile.note_cache("prefill", 3, evicted=1)
+            eng = _warm_engine(model)
+            eng.step()
+            snap = telemetry.snapshot()
+        finally:
+            telemetry.disable(clear_override=True)  # back to env-driven
+        for section in ("counters", "gauges", "histograms"):
+            assert not any(
+                n.startswith(("pdt_profile_", "pdt_jit_", "pdt_mem_"))
+                for n in snap.get(section, {})), snap[section]
+
+    def test_fence_is_identity_when_unarmed(self):
+        x = object()
+        assert profile.fence("qkv", x) is x
+
+
+# -- decode-round decomposition ----------------------------------------
+class TestDecomposition:
+    @pytest.mark.parametrize("k", [1, 4])
+    def test_components_sum_close_to_round_wall(self, model, k):
+        """THE honesty gate: sum of the per-component walls recorded
+        across 20 warm steps lands within 10% of the outer wall of
+        those same steps."""
+        eng = _warm_engine(model, k)
+        telemetry.reset()            # drop warm-phase observations
+        t0 = time.perf_counter()
+        for _ in range(20):
+            eng.step()
+        eng.quiesce()                # commit the tail window
+        wall = time.perf_counter() - t0
+        snap = telemetry.snapshot()
+        series = snap["histograms"].get("pdt_profile_round_seconds", {})
+        total = sum(v["sum"] for v in series.values())
+        comps = {lbl.split('"')[1] for lbl in series}
+        assert {"dispatch", "device", "harvest", "host"} <= comps
+        assert 0.90 * wall <= total <= 1.10 * wall, (
+            f"decomposition covers {total / wall:.1%} of the round "
+            f"wall (components {sorted(comps)})")
+
+    def test_components_are_catalogued_set(self):
+        assert profile.COMPONENTS == ("dispatch", "device", "harvest",
+                                      "journal", "sentry", "host")
+
+
+# -- dispatch-gap sampler ----------------------------------------------
+class TestGapSampler:
+    def test_profile_round_table_and_determinism(self, model):
+        """The sampled round is observation-only: interleaving
+        profile_round() between steps must leave the greedy streams
+        bit-identical to an undisturbed engine."""
+        plain = _engine(model)
+        sampled = _engine(model)
+        for eng in (plain, sampled):
+            for p, n in JOBS:
+                eng.add_request(list(p), n)
+            for _ in range(3):
+                eng.step()
+        tables = []
+        for i in range(6):
+            plain.step()
+            sampled.step()
+            if i % 2 == 0:
+                tables.append(sampled.profile_round())
+        out_p = {r.request_id: list(r.output)
+                 for r in plain._slot_req if r is not None}
+        out_s = {r.request_id: list(r.output)
+                 for r in sampled._slot_req if r is not None}
+        assert out_p == out_s
+        # ranked table over the fenced op families of llama.py
+        table = tables[-1]
+        assert table, "sampled round produced no gap rows"
+        pairs = [row["op_pair"] for row in table]
+        gaps = [row["gap_s"] for row in table]
+        assert gaps == sorted(gaps, reverse=True)
+        fenced = {p for pair in pairs for p in pair.split("->")}
+        assert fenced <= {"embed", "rmsnorm", "qkv", "rope",
+                          "kv_scatter", "attention", "oproj", "mlp"}
+        assert "qkv" in fenced and "attention" in fenced
+        # and the ranked gauges are published
+        gs = telemetry.snapshot()["gauges"].get(
+            "pdt_profile_gap_seconds", {})
+        assert len(gs) == len(table)
+
+    def test_profile_round_requires_ragged_paged(self, model):
+        eng = _engine(model, attention_impl="legacy")
+        eng.add_request([1, 2], 8)
+        eng.step()
+        with pytest.raises(RuntimeError, match="paged\\+ragged"):
+            eng.profile_round()
+
+    def test_profile_round_requires_active_slot(self, model):
+        eng = _engine(model)
+        with pytest.raises(RuntimeError, match="active slot"):
+            eng.profile_round()
+
+
+# -- compile-cache observability ---------------------------------------
+class TestCompileObservability:
+    def test_fifty_warm_pipelined_rounds_zero_compiles(self, model):
+        """THE steady-state gate (ISSUE 20 acceptance): 50 warm
+        pipelined rounds on a shape-stable batch mint zero programs."""
+        eng = _warm_engine(model, k=4,
+                           jobs=[([1, 2, 3], 60), ([4, 5], 58),
+                                 ([6, 7, 8, 9], 56)])
+        telemetry.reset()
+        for _ in range(50):
+            eng.step()
+        snap = telemetry.snapshot()
+        assert _compile_total(snap) == 0, snap["counters"][
+            "pdt_jit_compiles_total"]
+
+    def test_compiles_metered_per_family(self, model):
+        telemetry.reset()
+        eng = _warm_engine(model)
+        snap = telemetry.snapshot()
+        compiles = snap["counters"]["pdt_jit_compiles_total"]
+        fams = {lbl.split('"')[1] for lbl in compiles}
+        # the paged+ragged admission/decode path mints exactly these:
+        # one keyed ragged-prefill program + the decode singleton
+        assert {"decode", "ragged"} <= fams
+        hist = snap["histograms"]["pdt_jit_compile_seconds"]
+        for lbl, n in compiles.items():
+            assert hist[lbl]["count"] == n
+        # the jit.compile span joined the trace ring
+        assert any(e.get("name") == "jit.compile"
+                   for e in telemetry.events())
+
+    def test_lru_eviction_metered(self, model):
+        telemetry.reset()
+        from collections import OrderedDict
+        eng = _engine(model)
+        cache = OrderedDict()
+        for key in ("a", "b", "c"):
+            eng._jit_lru(cache, key, lambda: (lambda: None), cap=2,
+                         family="suffix")
+        snap = telemetry.snapshot()
+        assert snap["counters"]["pdt_jit_cache_evictions_total"][
+            'family="suffix"'] == 1.0
+        assert snap["gauges"]["pdt_jit_cache_entries"][
+            'family="suffix"'] == 2.0
+        assert len(cache) == 2
+
+    def test_retrace_storm_fires_on_churn_not_on_warm(self):
+        clock = FakeClock()
+        win = profile.configure_retrace(window_s=30.0, threshold=4,
+                                        clock=clock)
+        try:
+            telemetry.reset()
+            telemetry.clear_events()
+            # warm path: ONE program invoked many times — no storm
+            jit = profile.compile_timed(lambda: 0, "decode")
+            for _ in range(20):
+                jit()
+                clock.advance(0.1)
+            assert not any(e.get("name") == "profile.retrace_storm"
+                           for e in telemetry.events())
+            # churn: a fresh program every call (the program-key-churn
+            # failure mode pow2 bucketing exists to prevent)
+            for _ in range(4):
+                profile.compile_timed(lambda: 0, "ragged")()
+                clock.advance(0.1)
+            evts = [e for e in telemetry.events()
+                    if e.get("name") == "profile.retrace_storm"]
+            assert len(evts) == 1
+            assert telemetry.snapshot()["counters"][
+                "pdt_jit_retrace_storms_total"][""] == 1.0
+            # still inside the same saturated window: no re-fire
+            profile.compile_timed(lambda: 0, "ragged")()
+            assert sum(1 for e in telemetry.events()
+                       if e.get("name") == "profile.retrace_storm") == 1
+        finally:
+            profile.configure_retrace(window_s=30.0, threshold=10,
+                                      clock=time.monotonic)
+
+
+# -- memory ledger ------------------------------------------------------
+class TestMemoryLedger:
+    def test_ledger_pools_from_live_engine(self, model):
+        eng = _warm_engine(model)
+        mem = profile.memory_ledger([eng])
+        assert mem["kv_pool"] > 0
+        assert 0 < mem["kv_in_use"] <= mem["kv_pool"]
+        gs = telemetry.snapshot()["gauges"]["pdt_mem_bytes"]
+        assert gs['pool="kv_pool"'] == mem["kv_pool"]
+
+    def test_fleet_info_perf_section(self, model):
+        from paddle_tpu.serving import ServingRouter
+        router = ServingRouter(
+            lambda i: _engine(model), num_replicas=1)
+        router.submit([1, 2, 3], max_new_tokens=6)
+        for _ in range(4):
+            router.step()
+        perf = router.fleet_info()["perf"]
+        assert perf["mem_bytes"]["kv_pool"] > 0
+        assert perf["jit"]["decode"]["compiles"] >= 1
+        # and status.py renders it
+        text = telemetry.render_fleet_status(router.fleet_info())
+        assert "memory: " in text and "jit compiles: " in text
+
+
+# -- exemplars ----------------------------------------------------------
+class TestExemplars:
+    def test_observe_exemplar_snapshot_and_roundtrip(self):
+        telemetry.reset()
+        h = telemetry.histogram("pdt_test_exemplar_seconds", "t",
+                                buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar="req-1")
+        h.observe(0.5, exemplar='we"ird\\id')
+        h.observe(0.07)          # no exemplar: keeps req-1's bucket
+        snap = telemetry.snapshot()
+        ex = snap["histograms"]["pdt_test_exemplar_seconds"][""][
+            "exemplars"]
+        assert ex["0.1"] == {"trace_id": "req-1", "value": 0.05}
+        assert ex["1"]["trace_id"] == 'we"ird\\id'
+        text = telemetry.to_prometheus()
+        assert '# {trace_id="req-1"} 0.05' in text
+        parsed = telemetry.parse_prometheus(text)
+        snap.pop("enabled", None)
+        assert parsed == snap
+
+    def test_ttft_exemplar_links_request(self, model):
+        telemetry.reset()
+        eng = _engine(model)
+        rid = eng.add_request([1, 2, 3], 4)
+        for _ in range(3):
+            eng.step()
+        ex = telemetry.snapshot()["histograms"][
+            "pdt_serving_ttft_seconds"][""]["exemplars"]
+        assert any(e["trace_id"] == str(rid) for e in ex.values())
+
+
+# -- report + CLI -------------------------------------------------------
+class TestReportAndCli:
+    def _fleet_snapshot(self, model, tmp_path):
+        telemetry.reset()
+        eng = _warm_engine(model)
+        for _ in range(4):
+            eng.step()
+        eng.profile_round()
+        profile.memory_ledger([eng])
+        path = tmp_path / "snap.json"
+        path.write_text(json.dumps(telemetry.snapshot()))
+        return path
+
+    def test_cli_renders_ranked_report(self, model, tmp_path, capsys):
+        path = self._fleet_snapshot(model, tmp_path)
+        assert obs_main(["profile", "--from", str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "decode-round decomposition" in out
+        assert "top dispatch gaps" in out
+        assert "compile cache" in out
+        assert "memory ledger" in out
+        # ranked: first gap row is the largest
+        gap_lines = [ln for ln in out.splitlines()
+                     if "->" in ln]
+        assert gap_lines, out
+
+    def test_cli_prom_text_input(self, model, tmp_path, capsys):
+        json_path = self._fleet_snapshot(model, tmp_path)
+        prom = tmp_path / "snap.prom"
+        prom.write_text(telemetry.render_prometheus(
+            json.loads(json_path.read_text())))
+        assert obs_main(["profile", "--from", str(prom)]) == 0
+
+    def test_cli_exit_one_on_empty_snapshot(self, tmp_path, capsys):
+        p = tmp_path / "empty.json"
+        p.write_text(json.dumps(
+            {"counters": {}, "gauges": {}, "histograms": {}}))
+        assert obs_main(["profile", "--from", str(p)]) == 1
+        assert "no profile data" in capsys.readouterr().out
